@@ -1,0 +1,51 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+A from-scratch rebuild of the reference graph-program framework
+(/root/reference, PaddlePaddle Fluid v1.3-era) designed TPU-first:
+
+* Python builds a Program (blocks of ops) — same control plane as the
+  reference (SURVEY §1) — but the Executor lowers a whole block to ONE XLA
+  computation instead of interpreting ops, so fusion/layout/memory/GC are
+  the compiler's job, not a runtime's.
+* Gradients are graph ops appended by append_backward; their lowerings come
+  mechanically from jax.vjp of the forward lowerings.
+* Data parallelism is SPMD over a jax.sharding.Mesh (CompiledProgram
+  .with_data_parallel); collectives ride ICI via XLA, replacing the
+  reference's NCCL op-handle engine.
+
+Import as `import paddle_tpu as fluid` — the API surface mirrors
+python/paddle/fluid.
+"""
+
+from . import ops as _ops  # registers all op lowerings  # noqa: F401
+from . import (  # noqa: F401
+    backward,
+    clip,
+    initializer,
+    io,
+    layers,
+    metrics,
+    nets,
+    optimizer,
+    profiler,
+    regularizer,
+)
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .core.executor import Executor  # noqa: F401
+from .core.place import CPUPlace, CUDAPlace, TPUPlace, is_compiled_with_tpu  # noqa: F401
+from .core.program import (  # noqa: F401
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    unique_name,
+)
+from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+__version__ = "0.1.0"
+
+# reference-parity alias: user code does `fluid.io.save_params(...)` etc.
+name = "paddle_tpu"

@@ -1,0 +1,5 @@
+"""Re-export of the graph autodiff (fluid.backward parity)."""
+
+from .core.backward import append_backward, calc_gradient  # noqa: F401
+
+__all__ = ["append_backward", "calc_gradient"]

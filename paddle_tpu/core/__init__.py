@@ -1,0 +1,15 @@
+from .executor import Executor  # noqa: F401
+from .place import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from .program import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    unique_name,
+)
+from .registry import all_ops, get_op, has_op, register_op  # noqa: F401
+from .scope import Scope, global_scope, scope_guard  # noqa: F401

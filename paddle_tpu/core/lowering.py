@@ -1,0 +1,92 @@
+"""Whole-block lowering: Program ops -> one JAX computation.
+
+This replaces the reference's per-op interpreter hot loop
+(/root/reference/paddle/fluid/framework/executor.cc:452-458 and the kernel
+dispatch in operator.cc:877-930). Instead of choosing a kernel per op at
+runtime, each op's registered lowering emits JAX ops into a single trace;
+XLA then fuses/schedules the whole step. Shape/dtype inference, data layout
+transform and the garbage collector all disappear into the compiler.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .program import Block
+from .registry import get_op
+
+__all__ = ["LowerContext", "lower_block"]
+
+
+class LowerContext:
+    """Carries trace-wide state across op lowerings: the PRNG key chain,
+    the owning block (for sub-block control flow), and mode flags."""
+
+    def __init__(self, block: Optional[Block] = None, rng: Optional[jax.Array] = None,
+                 is_test: bool = False):
+        self.block = block
+        self._rng = rng
+        self.is_test = is_test
+        self.rng_used = False
+
+    def next_rng(self) -> jax.Array:
+        if self._rng is None:
+            # pure re-trace (vjp of a forward lowering) must not consume rng
+            raise RuntimeError(
+                "op requested RNG in a pure context; register a custom grad "
+                "lowering that reuses saved randomness (e.g. dropout mask)"
+            )
+        self.rng_used = True
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def final_rng(self):
+        return self._rng
+
+    def sub(self, block: Block) -> "LowerContext":
+        c = LowerContext(block, self._rng, self.is_test)
+        return c
+
+    def pure(self) -> "LowerContext":
+        """Context for re-tracing a forward lowering inside a vjp: no RNG."""
+        return LowerContext(self.block, None, self.is_test)
+
+
+def lower_op(ctx: LowerContext, op, env: Dict[str, Any]) -> None:
+    opdef = get_op(op.type)
+    ins: Dict[str, List[Any]] = {}
+    for slot, names in op.inputs.items():
+        ins[slot] = [env[n] if n else None for n in names]
+    outs = opdef.lowering(ctx, ins, op.attrs)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for name, val in zip(names, vals):
+            if name and val is not None:
+                env[name] = val
+
+
+def lower_block(ctx: LowerContext, block: Block, env: Dict[str, Any]) -> None:
+    """Run every op's lowering in program order, mutating `env`
+    (name -> traced value). This is the whole-program analog of
+    Executor::RunPreparedContext's op loop."""
+    for op in block.ops:
+        try:
+            lower_op(ctx, op, env)
+        except Exception as e:
+            raise type(e)(
+                "while lowering op %r (inputs=%s outputs=%s): %s"
+                % (op.type, op.inputs, op.outputs, e)
+            ) from e
+
+
+def as_jax_dtype(dtype: str):
+    if dtype == "bool":
+        return jnp.bool_
+    return jnp.dtype(dtype)

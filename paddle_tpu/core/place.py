@@ -1,0 +1,60 @@
+"""Device identity types.
+
+Analog of /root/reference/paddle/fluid/platform/place.h:79
+(boost::variant<CUDAPlace, CPUPlace, CUDAPinnedPlace>). The TPU build's
+variant is {CPUPlace, TPUPlace}; a Place resolves to a concrete
+jax.Device, and the DeviceContextPool analog is JAX's device table —
+streams/handles are owned by PJRT, not by us.
+"""
+
+from __future__ import annotations
+
+__all__ = ["CPUPlace", "TPUPlace", "CUDAPlace", "Place", "is_compiled_with_tpu"]
+
+
+class Place:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+    def jax_device(self):
+        import jax
+
+        if isinstance(self, CPUPlace):
+            try:
+                return jax.devices("cpu")[self.device_id]
+            except RuntimeError:
+                return None  # cpu not a visible backend; let jax default
+        devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    pass
+
+
+class TPUPlace(Place):
+    """The accelerator place. On this build the accelerator is always the
+    default JAX backend (TPU on hardware, CPU in tests)."""
+
+
+# The reference's CUDAPlace maps to the accelerator slot here; kept as an
+# alias so reference-shaped user code ports without edits.
+CUDAPlace = TPUPlace
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        return False

@@ -1,0 +1,114 @@
+"""Op registry: op type -> lowering rule (+ optional custom grad).
+
+TPU-native analog of the reference's OpRegistry/OpInfo
+(/root/reference/paddle/fluid/framework/op_registry.h:197-243, op_info.h).
+Where the reference registers per-device kernel functors
+(REGISTER_OP_CPU_KERNEL / REGISTER_OP_CUDA_KERNEL), here a "kernel" is a
+*lowering*: a pure function from JAX values to JAX values. The Executor
+composes lowerings for a whole block and hands the result to XLA, which does
+the fusion/scheduling the reference's SSA-graph engine did by hand.
+
+Gradients: the reference requires a hand-written GradOpDescMaker + grad
+kernels per op (grad_op_desc_maker.h). Here the default grad is derived
+mechanically from the forward lowering via jax.vjp (see core.backward);
+an op only registers a custom grad when its grad must differ from the vjp of
+its forward (e.g. dropout re-using its saved mask).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["OpDef", "register_op", "get_op", "has_op", "all_ops", "OPS"]
+
+# lowering signature: fn(ctx, ins: Dict[slot, List[jax.Array]], attrs) ->
+#                     Dict[slot, List[jax.Array]]
+LoweringFn = Callable[[Any, Dict[str, List[Any]], Dict[str, Any]], Dict[str, List[Any]]]
+
+
+class OpDef:
+    def __init__(
+        self,
+        type: str,
+        lowering: LoweringFn,
+        grad_maker: Optional[Callable] = None,
+        grad_lowering: Optional[LoweringFn] = None,
+        no_grad: bool = False,
+        diff_inputs: Optional[List[str]] = None,
+        uses_rng: bool = False,
+        infer_shape: Optional[Callable] = None,
+    ):
+        self.type = type
+        self.lowering = lowering
+        self.grad_maker = grad_maker  # custom append-backward rule, if any
+        self.grad_lowering = grad_lowering  # custom grad lowering, if any
+        self.no_grad = no_grad  # op is never differentiated (optimizers, io)
+        # slots that may carry gradients; None = all float inputs
+        self.diff_inputs = diff_inputs
+        self.uses_rng = uses_rng
+        self.infer_shape = infer_shape
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def register_op(
+    type: str,
+    *,
+    grad_maker=None,
+    grad_lowering=None,
+    no_grad: bool = False,
+    diff_inputs: Optional[List[str]] = None,
+    uses_rng: bool = False,
+    infer_shape=None,
+):
+    """Decorator: @register_op("softmax") def _softmax(ctx, ins, attrs): ..."""
+
+    def deco(fn: LoweringFn) -> LoweringFn:
+        if type in OPS:
+            raise ValueError("op %r registered twice" % type)
+        OPS[type] = OpDef(
+            type,
+            fn,
+            grad_maker=grad_maker,
+            grad_lowering=grad_lowering,
+            no_grad=no_grad,
+            diff_inputs=diff_inputs,
+            uses_rng=uses_rng,
+            infer_shape=infer_shape,
+        )
+        return fn
+
+    return deco
+
+
+def register_grad_lowering(fwd_type: str):
+    """Attach a custom grad lowering to an already-registered op."""
+
+    def deco(fn: LoweringFn) -> LoweringFn:
+        OPS[fwd_type].grad_lowering = fn
+        return fn
+
+    return deco
+
+
+def get_op(type: str) -> OpDef:
+    if type not in OPS:
+        if type.endswith("_grad") and type[:-5] in OPS:
+            # synthesize the grad op from the forward lowering (see autodiff)
+            from .autodiff import make_generic_grad
+
+            OPS[type] = OpDef(type, make_generic_grad(type[:-5]), no_grad=True)
+        else:
+            raise KeyError(
+                "op %r has no registered lowering (known: %d ops)" % (type, len(OPS))
+            )
+    return OPS[type]
+
+
+def has_op(type: str) -> bool:
+    return type in OPS or (type.endswith("_grad") and type[:-5] in OPS)
+
+
+def all_ops() -> List[str]:
+    return sorted(OPS)

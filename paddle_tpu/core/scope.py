@@ -1,0 +1,84 @@
+"""Scope: name -> runtime value map with parent chain.
+
+Analog of /root/reference/paddle/fluid/framework/scope.h:48 (Scope::Var/
+FindVar). Values are jax.Arrays (device-resident) or host objects (ints,
+LoD metadata). Persistable program vars live here across Executor runs;
+temporaries never materialize — they are SSA values inside the lowered
+XLA computation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["Scope", "global_scope", "scope_guard"]
+
+import contextlib
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Any] = {}
+        self.parent = parent
+
+    def var(self, name: str):
+        """Create-or-get (reference Scope::Var, scope.h:60)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars[name]
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name: str, value) -> None:
+        # write into the scope that owns the name, else locally
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                s._vars[name] = value
+                return
+            s = s.parent
+        self._vars[name] = value
+
+    def erase(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+    def new_scope(self) -> "Scope":
+        return Scope(parent=self)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def drop_kids(self):  # API-compat no-op: kids are plain objects here
+        pass
+
+
+_global_scope = Scope()
+_current_scope = _global_scope
+
+
+def global_scope() -> Scope:
+    return _current_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _current_scope
+    old, _current_scope = _current_scope, scope
+    try:
+        yield
+    finally:
+        _current_scope = old

@@ -1,0 +1,152 @@
+"""Checkpoint save/load + inference model export.
+
+Analog of /root/reference/python/paddle/fluid/io.py (save_vars:92,
+save_params:213, save_persistables:441, load_persistables:658,
+save/load_inference_model:863,1015) and the save/load_combine ops
+(operators/save_combine_op.cc). The reference writes per-var files through
+ops; here persistables are gathered from the Scope and written as one .npz
+manifest per checkpoint ("persistables = savable vars" rule, SURVEY §5) —
+sharded-array checkpoints live in parallel/checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .core.program import Parameter, Program, default_main_program
+from .core.scope import global_scope
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+]
+
+_COMBINED = "__model_combined__.npz"
+_MODEL_FILE = "__model__.json"
+
+
+def _persistable_names(program: Program, predicate) -> List[str]:
+    names = []
+    for var in program.list_vars():
+        if var.persistable and predicate(var):
+            names.append(var.name)
+    return sorted(set(names))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is not None:
+        names = [v.name if hasattr(v, "name") else v for v in vars]
+    else:
+        names = _persistable_names(program, predicate or (lambda v: True))
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for n in names:
+        val = scope.find_var(n)
+        if val is None:
+            raise RuntimeError("variable %r not initialized; cannot save" % n)
+        arrays[n] = np.asarray(val)
+    np.savez(os.path.join(dirname, filename or _COMBINED), **arrays)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program,
+              predicate=lambda v: isinstance(v, Parameter), filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program,
+              predicate=lambda v: v.persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    path = os.path.join(dirname, filename or _COMBINED)
+    data = np.load(path, allow_pickle=False)
+    if vars is not None:
+        names = [v.name if hasattr(v, "name") else v for v in vars]
+    else:
+        names = _persistable_names(program, predicate or (lambda v: True))
+    import jax.numpy as jnp
+
+    for n in names:
+        if n not in data:
+            raise RuntimeError("checkpoint %s lacks variable %r" % (path, n))
+        scope.set_var(n, jnp.asarray(data[n]))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: isinstance(v, Parameter), filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: v.persistable, filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """Prune to the inference subgraph + save params (reference io.py:863 /
+    framework/prune.cc)."""
+    program = main_program or default_main_program()
+    pruned = program._prune(target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "feed": list(feeded_var_names),
+        "fetch": [v.name if hasattr(v, "name") else v for v in target_vars],
+        "program": pruned.to_dict(),
+    }
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, pruned, filename=params_filename)
+    return meta["fetch"]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE)) as f:
+        meta = json.load(f)
+    program = _program_from_dict(meta["program"])
+    load_persistables(executor, dirname, program, filename=params_filename)
+    return program, meta["feed"], [program.global_block().var(n) for n in meta["fetch"]]
+
+
+def _program_from_dict(d) -> Program:
+    from .core.program import Block, Operator, Variable
+
+    p = Program()
+    p.random_seed = d.get("random_seed")
+    p.blocks = []
+    for bd in d["blocks"]:
+        b = Block(p, bd["idx"], bd["parent_idx"])
+        for name, vd in bd["vars"].items():
+            v = Variable(
+                b, name,
+                shape=vd["shape"], dtype=vd["dtype"],
+                persistable=vd["persistable"], stop_gradient=vd["stop_gradient"],
+                is_data=vd["is_data"], lod_level=vd.get("lod_level", 0),
+            )
+            b.vars[name] = v
+        for od in bd["ops"]:
+            op = Operator(b, od["type"], None, None, od["attrs"])
+            op.inputs = {k: list(v) for k, v in od["inputs"].items()}
+            op.outputs = {k: list(v) for k, v in od["outputs"].items()}
+            b.ops.append(op)
+        p.blocks.append(b)
+    return p

@@ -1,0 +1,134 @@
+"""LayerHelper: shared machinery for layer functions.
+
+Analog of /root/reference/python/paddle/fluid/layer_helper.py — creates
+parameters (in main + startup programs), temp output vars, bias/activation
+epilogues.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .core.program import (
+    Parameter,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    unique_name,
+)
+from .initializer import Constant, Xavier
+from .param_attr import ParamAttr
+
+__all__ = ["LayerHelper"]
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+        self.name = kwargs.get("name") or unique_name.generate(layer_type)
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    @property
+    def block(self):
+        return self.main_program.current_block()
+
+    def create_parameter(
+        self,
+        attr,
+        shape,
+        dtype="float32",
+        is_bias: bool = False,
+        default_initializer=None,
+    ) -> Optional[Parameter]:
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        suffix = "b" if is_bias else "w"
+        name = attr.name or unique_name.generate("%s.%s" % (self.name, suffix))
+        init = attr.initializer or default_initializer or (
+            Constant(0.0) if is_bias else Xavier()
+        )
+        shape = [int(s) for s in shape]
+        p = self.block.create_parameter(
+            name=name,
+            shape=shape,
+            dtype=dtype,
+            trainable=attr.trainable,
+        )
+        p.regularizer = attr.regularizer
+        p.gradient_clip_attr = attr.gradient_clip
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        sb = self.startup_program.global_block()
+        sv = sb.create_var(
+            name=name, shape=shape, dtype=dtype, persistable=True, stop_gradient=True
+        )
+        init(sv, sb)
+        return p
+
+    def create_variable_for_type_inference(self, dtype="float32", stop_gradient=False) -> Variable:
+        return self.block.create_var(
+            name=unique_name.generate(self.name + ".tmp"),
+            dtype=dtype,
+            stop_gradient=stop_gradient,
+        )
+
+    # persistable non-trainable state (bn running stats, auc buffers, lr...)
+    def create_global_variable(self, name=None, shape=(1,), dtype="float32",
+                               initializer=None, stop_gradient=True) -> Variable:
+        name = name or unique_name.generate(self.name + ".global")
+        main_block = self.main_program.global_block()
+        v = main_block.create_var(
+            name=name, shape=tuple(shape), dtype=dtype, persistable=True,
+            stop_gradient=stop_gradient,
+        )
+        sb = self.startup_program.global_block()
+        sv = sb.create_var(name=name, shape=tuple(shape), dtype=dtype,
+                           persistable=True, stop_gradient=True)
+        (initializer or Constant(0.0))(sv, sb)
+        return v
+
+    def append_op(self, **kwargs):
+        return self.block.append_op(
+            type=kwargs["type"],
+            inputs=kwargs.get("inputs"),
+            outputs=kwargs.get("outputs"),
+            attrs=kwargs.get("attrs"),
+        )
+
+    def append_bias_op(self, input_var: Variable, dim_start=1, bias_attr=None,
+                       size=None, dtype=None) -> Variable:
+        attr = ParamAttr._to_attr(bias_attr if bias_attr is not None else self.kwargs.get("bias_attr"))
+        if attr is False:
+            return input_var
+        if size is None:
+            size = input_var.shape[-1] if input_var.shape else None
+        b = self.create_parameter(attr, [size], dtype or input_var.dtype, is_bias=True)
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(
+            type="elementwise_add",
+            inputs={"X": [input_var], "Y": [b]},
+            outputs={"Out": [out]},
+            attrs={"axis": dim_start},
+        )
+        out.shape = input_var.shape
+        return out
+
+    def append_activation(self, input_var: Variable, act=None) -> Variable:
+        act = act if act is not None else self.kwargs.get("act")
+        if act is None:
+            return input_var
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(type=act, inputs={"X": [input_var]}, outputs={"Out": [out]})
+        out.shape = input_var.shape
+        return out
+
+    def input_dtype(self, var):
+        return var.dtype

@@ -1,0 +1,12 @@
+"""layers: user-facing op-builder API (reference: python/paddle/fluid/layers)."""
+
+from . import control_flow, detection, io, learning_rate_scheduler, metric_op, nn, ops, sequence, tensor
+from .control_flow import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403

@@ -1,0 +1,24 @@
+"""Control-flow layers (reference: python/paddle/fluid/layers/control_flow.py).
+
+While/StaticRNN lower to XLA While via lax.scan-style sub-block lowering;
+round-1 ships increment/array-free basics, the loop constructs land with the
+sequence/RNN milestone.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["increment"]
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="increment", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"step": float(value)})
+    out.shape = x.shape
+    return out

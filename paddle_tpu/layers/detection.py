@@ -1,0 +1,5 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py).
+CV-detection parity (prior_box, multiclass_nms, roi ops, yolo) is scheduled
+after the core baselines; this module reserves the namespace."""
+
+__all__ = []

@@ -1,0 +1,25 @@
+"""Input layers (reference: python/paddle/fluid/layers/io.py — data:39)."""
+
+from __future__ import annotations
+
+from ..core.program import default_main_program, default_startup_program
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True):
+    """Declare a feed variable. With append_batch_size, a leading -1 batch
+    dim is added (reference io.py:39). On TPU the concrete shape is bound at
+    compile time from the first feed (bucketing handles variation)."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().global_block()
+    v = block.create_var(
+        name=name, shape=shape, dtype=dtype, is_data=True,
+        stop_gradient=stop_gradient, lod_level=lod_level,
+    )
+    # mirror into startup so program pairs stay consistent (reference parity)
+    default_startup_program()
+    return v

@@ -1,0 +1,1180 @@
+"""NN layer builders — the user-facing op-composition API.
+
+Analog of /root/reference/python/paddle/fluid/layers/nn.py (157 defs listed
+at nn.py:36). Each function appends ops to the default main program and
+returns the output Variable(s); shapes are propagated eagerly (the
+compile-time InferShape role, reference framework/shape_inference.h) so
+later layers can size their parameters.
+"""
+
+from __future__ import annotations
+
+from functools import reduce as _reduce
+from operator import mul as _mul
+
+from ..core.program import Variable
+from ..initializer import Constant, Normal, Xavier
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+from .tensor import cast, concat, fill_constant  # re-exported via layers
+
+__all__ = [
+    "fc",
+    "embedding",
+    "conv2d",
+    "conv2d_transpose",
+    "conv3d",
+    "pool2d",
+    "adaptive_pool2d",
+    "batch_norm",
+    "layer_norm",
+    "group_norm",
+    "dropout",
+    "softmax",
+    "log_softmax",
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "square_error_cost",
+    "smooth_l1",
+    "huber_loss",
+    "log_loss",
+    "matmul",
+    "mul",
+    "topk",
+    "reshape",
+    "squeeze",
+    "unsqueeze",
+    "transpose",
+    "split",
+    "stack",
+    "unstack",
+    "flatten",
+    "expand",
+    "gather",
+    "gather_nd",
+    "scatter",
+    "pad",
+    "pad2d",
+    "slice",
+    "strided_slice",
+    "l2_normalize",
+    "mean",
+    "reduce_sum",
+    "reduce_mean",
+    "reduce_max",
+    "reduce_min",
+    "reduce_prod",
+    "reduce_all",
+    "reduce_any",
+    "clip",
+    "clip_by_norm",
+    "scale",
+    "one_hot",
+    "prelu",
+    "maxout",
+    "lrn",
+    "shape",
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "elementwise_mod",
+    "elementwise_floordiv",
+    "equal",
+    "not_equal",
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "logical_and",
+    "logical_or",
+    "logical_xor",
+    "logical_not",
+    "where",
+    "cumsum",
+    "sign",
+    "cos_sim",
+    "math_op",
+    "uniform_random_batch_size_like",
+    "gaussian_random",
+    "sampling_id",
+    "unbind",
+]
+
+
+def _prod(xs):
+    return _reduce(_mul, xs, 1)
+
+
+def _same_shape_out(helper, x, op_type, attrs=None, extra_inputs=None, dtype=None):
+    out = helper.create_variable_for_type_inference(dtype or x.dtype)
+    inputs = {"X": [x]}
+    if extra_inputs:
+        inputs.update(extra_inputs)
+    helper.append_op(type=op_type, inputs=inputs, outputs={"Out": [out]}, attrs=attrs or {})
+    out.shape = x.shape
+    return out
+
+
+# --------------------------------------------------------------------- fc
+def fc(
+    input,
+    size,
+    num_flatten_dims=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    is_test=False,
+    name=None,
+):
+    """Fully-connected (reference nn.py fc): mul + sum + bias + act."""
+    helper = LayerHelper("fc", name=name, bias_attr=bias_attr, act=act)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    attrs = param_attr if isinstance(param_attr, (list, tuple)) else [param_attr] * len(inputs)
+    mul_outs = []
+    for x, pa in zip(inputs, attrs):
+        in_dim = _prod(x.shape[num_flatten_dims:])
+        w = helper.create_parameter(pa, [in_dim, size], x.dtype)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(
+            type="mul",
+            inputs={"X": [x], "Y": [w]},
+            outputs={"Out": [out]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        out.shape = tuple(x.shape[:num_flatten_dims]) + (size,)
+        mul_outs.append(out)
+    if len(mul_outs) == 1:
+        pre_bias = mul_outs[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(inputs[0].dtype)
+        helper.append_op(type="sum", inputs={"X": mul_outs}, outputs={"Out": [pre_bias]})
+        pre_bias.shape = mul_outs[0].shape
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims, size=size)
+    return helper.append_activation(pre_act)
+
+
+def embedding(
+    input,
+    size,
+    is_sparse=False,
+    is_distributed=False,
+    padding_idx=None,
+    param_attr=None,
+    dtype="float32",
+):
+    """lookup_table (reference nn.py embedding / lookup_table_op.cc).
+    is_sparse selects SelectedRows-style grads on the PS path; on the dense
+    TPU path the scatter-add grad is already sparse-friendly under XLA."""
+    helper = LayerHelper("embedding")
+    w = helper.create_parameter(param_attr, size, dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    pad = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx
+    )
+    helper.append_op(
+        type="lookup_table",
+        inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [out]},
+        attrs={"is_sparse": is_sparse, "is_distributed": is_distributed,
+               "padding_idx": pad},
+    )
+    ishape = input.shape or (-1,)
+    if ishape and ishape[-1] == 1:
+        ishape = ishape[:-1]
+    out.shape = tuple(ishape) + (size[1],)
+    return out
+
+
+# --------------------------------------------------------------------- conv
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _conv_dim(h, k, s, p, d=1):
+    if h is None or h < 0:
+        return -1
+    return (h + 2 * p - (d * (k - 1) + 1)) // s + 1
+
+
+def conv2d(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups=1,
+    param_attr=None,
+    bias_attr=None,
+    use_cudnn=True,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("conv2d", name=name, bias_attr=bias_attr, act=act)
+    k = _pair(filter_size)
+    s = _pair(stride)
+    p = _pair(padding)
+    d = _pair(dilation)
+    c = input.shape[1]
+    filter_shape = [num_filters, c // groups, k[0], k[1]]
+    std = (2.0 / (k[0] * k[1] * c)) ** 0.5
+    w = helper.create_parameter(param_attr, filter_shape, input.dtype,
+                                default_initializer=Normal(0.0, std))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="conv2d" if groups == 1 or groups != c else "depthwise_conv2d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": list(s), "paddings": list(p), "dilations": list(d),
+               "groups": groups},
+    )
+    n, _, h, wd = input.shape
+    out.shape = (n, num_filters, _conv_dim(h, k[0], s[0], p[0], d[0]),
+                 _conv_dim(wd, k[1], s[1], p[1], d[1]))
+    pre_act = helper.append_bias_op(out, dim_start=1, size=num_filters)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(
+    input,
+    num_filters,
+    output_size=None,
+    filter_size=None,
+    padding=0,
+    stride=1,
+    dilation=1,
+    groups=1,
+    param_attr=None,
+    bias_attr=None,
+    use_cudnn=True,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("conv2d_transpose", name=name, bias_attr=bias_attr, act=act)
+    k = _pair(filter_size)
+    s = _pair(stride)
+    p = _pair(padding)
+    d = _pair(dilation)
+    c = input.shape[1]
+    w = helper.create_parameter(param_attr, [c, num_filters // groups, k[0], k[1]],
+                                input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="conv2d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": list(s), "paddings": list(p), "dilations": list(d),
+               "groups": groups},
+    )
+    n, _, h, wd = input.shape
+
+    def _tdim(x, kk, ss, pp, dd):
+        if x is None or x < 0:
+            return -1
+        return (x - 1) * ss - 2 * pp + dd * (kk - 1) + 1
+
+    out.shape = (n, num_filters, _tdim(h, k[0], s[0], p[0], d[0]),
+                 _tdim(wd, k[1], s[1], p[1], d[1]))
+    pre_act = helper.append_bias_op(out, dim_start=1, size=num_filters)
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d", name=name, bias_attr=bias_attr, act=act)
+    k = (filter_size,) * 3 if isinstance(filter_size, int) else tuple(filter_size)
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    d = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    c = input.shape[1]
+    w = helper.create_parameter(param_attr, [num_filters, c // groups, *k], input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": list(s), "paddings": list(p), "dilations": list(d),
+               "groups": groups},
+    )
+    n = input.shape[0]
+    dims = [_conv_dim(x, kk, ss, pp, dd) for x, kk, ss, pp, dd in
+            zip(input.shape[2:], k, s, p, d)]
+    out.shape = (n, num_filters, *dims)
+    pre_act = helper.append_bias_op(out, dim_start=1, size=num_filters)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(
+    input,
+    pool_size=-1,
+    pool_type="max",
+    pool_stride=1,
+    pool_padding=0,
+    global_pooling=False,
+    use_cudnn=True,
+    ceil_mode=False,
+    exclusive=True,
+    name=None,
+):
+    helper = LayerHelper("pool2d", name=name)
+    k = _pair(pool_size)
+    s = _pair(pool_stride)
+    p = _pair(pool_padding)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="pool2d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": list(k), "strides": list(s),
+               "paddings": list(p), "global_pooling": global_pooling,
+               "exclusive": exclusive, "ceil_mode": ceil_mode},
+    )
+    n, c, h, w = input.shape
+    if global_pooling:
+        out.shape = (n, c, 1, 1)
+    else:
+        out.shape = (n, c, _conv_dim(h, k[0], s[0], p[0]), _conv_dim(w, k[1], s[1], p[1]))
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    n, c, h, w = input.shape
+    oh, ow = _pair(pool_size)
+    return pool2d(input, pool_size=(h // oh, w // ow), pool_type=pool_type,
+                  pool_stride=(h // oh, w // ow), name=name)
+
+
+# --------------------------------------------------------------------- norm
+def batch_norm(
+    input,
+    act=None,
+    is_test=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    data_layout="NCHW",
+    name=None,
+    moving_mean_name=None,
+    moving_variance_name=None,
+    use_global_stats=False,
+):
+    helper = LayerHelper("batch_norm", name=name, act=act)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype, is_bias=True)
+    mean = helper.create_global_variable(name=moving_mean_name, shape=[c],
+                                         dtype=input.dtype, initializer=Constant(0.0))
+    var = helper.create_global_variable(name=moving_variance_name, shape=[c],
+                                        dtype=input.dtype, initializer=Constant(1.0))
+    saved_mean = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [var]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [var],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout, "use_global_stats": use_global_stats},
+    )
+    out.shape = input.shape
+    return helper.append_activation(out)
+
+
+def layer_norm(
+    input,
+    scale=True,
+    shift=True,
+    begin_norm_axis=1,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("layer_norm", name=name, act=act)
+    norm_shape = [_prod(input.shape[begin_norm_axis:])]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(param_attr, norm_shape, input.dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, norm_shape, input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    m = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    v = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(
+        type="layer_norm", inputs=inputs,
+        outputs={"Y": [out], "Mean": [m], "Variance": [v]},
+        attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
+    )
+    out.shape = input.shape
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None):
+    helper = LayerHelper("group_norm", name=name, act=act)
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        s = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s]
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [c], input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    m = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    v = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(type="group_norm", inputs=inputs,
+                     outputs={"Y": [out], "Mean": [m], "Variance": [v]},
+                     attrs={"groups": groups, "epsilon": epsilon})
+    out.shape = input.shape
+    return helper.append_activation(out)
+
+
+def l2_normalize(x, axis, epsilon=1e-10, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(type="norm", inputs={"X": [x]},
+                     outputs={"Out": [out], "Norm": [norm]},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    out.shape = x.shape
+    return out
+
+
+# --------------------------------------------------------------------- misc
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(
+        type="dropout", inputs={"X": [x]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+               "fix_seed": seed is not None, "seed": seed or 0,
+               "dropout_implementation": dropout_implementation},
+    )
+    out.shape = x.shape
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    helper = LayerHelper("softmax", name=name)
+    return _same_shape_out(helper, input, "softmax", {"axis": axis})
+
+
+def log_softmax(input, axis=-1, name=None):
+    helper = LayerHelper("log_softmax", name=name)
+    return _same_shape_out(helper, input, "log_softmax", {"axis": axis})
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="cross_entropy", inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    out.shape = tuple(input.shape[:-1]) + (1,)
+    return out
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100,
+    numeric_stable_mode=True, return_softmax=False,
+):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    sm = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        type="softmax_with_cross_entropy",
+        inputs={"Logits": [logits], "Label": [label]},
+        outputs={"Softmax": [sm], "Loss": [loss]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    sm.shape = logits.shape
+    loss.shape = tuple(logits.shape[:-1]) + (1,)
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sigmoid_cross_entropy_with_logits",
+        inputs={"X": [x], "Label": [label]}, outputs={"Out": [out]},
+        attrs={"ignore_index": ignore_index, "normalize": normalize},
+    )
+    out.shape = x.shape
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="square_error_cost",
+                     inputs={"X": [input], "Y": [label]}, outputs={"Out": [out]})
+    out.shape = input.shape
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1")
+    diff = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="smooth_l1_loss", inputs={"X": [x], "Y": [y]},
+                     outputs={"Diff": [diff], "Out": [out]},
+                     attrs={"sigma": sigma or 1.0})
+    out.shape = (x.shape[0], 1)
+    return out
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    residual = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="huber_loss", inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out], "Residual": [residual]},
+                     attrs={"delta": delta})
+    out.shape = input.shape
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper("log_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="log_loss",
+                     inputs={"Predicted": [input], "Labels": [label]},
+                     outputs={"Loss": [out]}, attrs={"epsilon": epsilon})
+    out.shape = input.shape
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="matmul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y, "alpha": alpha},
+    )
+    if x.shape and y.shape:
+        xs = list(x.shape)
+        ys = list(y.shape)
+        if transpose_x:
+            xs[-1], xs[-2] = xs[-2], xs[-1]
+        if transpose_y and len(ys) > 1:
+            ys[-1], ys[-2] = ys[-2], ys[-1]
+        batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+        out.shape = tuple(batch + [xs[-2], ys[-1]]) if len(xs) > 1 else (ys[-1],)
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="mul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    out.shape = tuple(x.shape[:x_num_col_dims]) + tuple(y.shape[y_num_col_dims:])
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    vals = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    ids = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [vals], "Indices": [ids]}, attrs={"k": k})
+    vals.shape = tuple(input.shape[:-1]) + (k,)
+    ids.shape = vals.shape
+    return vals, ids
+
+
+# ----------------------------------------------------------------- reshape &c
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(type="reshape2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"shape": list(shape)})
+    if x.shape is not None:
+        known = _prod([s for s in shape if s > 0])
+        oshape = []
+        for i, s in enumerate(shape):
+            if s == 0:
+                oshape.append(x.shape[i])
+                known *= x.shape[i]
+            else:
+                oshape.append(s)
+        if -1 in oshape and all(d >= 0 for d in x.shape):
+            total = _prod(x.shape)
+            oshape[oshape.index(-1)] = total // known
+        out.shape = tuple(oshape)
+    return helper.append_activation(out, act)
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(type="squeeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": list(axes)})
+    if input.shape is not None:
+        ax = [a % len(input.shape) for a in axes]
+        out.shape = tuple(s for i, s in enumerate(input.shape) if i not in ax or s != 1)
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(type="unsqueeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": list(axes)})
+    if input.shape is not None:
+        s = list(input.shape)
+        for a in sorted(axes):
+            s.insert(a if a >= 0 else a + len(s) + 1, 1)
+        out.shape = tuple(s)
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(type="transpose2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": list(perm)})
+    if x.shape is not None:
+        out.shape = tuple(x.shape[p] for p in perm)
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    axis = dim % len(input.shape) if input.shape else dim
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sections = []
+        sizes = [input.shape[axis] // n] * n if input.shape else [None] * n
+    else:
+        sections = list(num_or_sections)
+        n = len(sections)
+        sizes = sections
+    outs = [helper.create_variable_for_type_inference(input.dtype) for _ in range(n)]
+    helper.append_op(
+        type="split", inputs={"X": [input]}, outputs={"Out": outs},
+        attrs={"axis": axis,
+               "num": num_or_sections if isinstance(num_or_sections, int) else 0,
+               "sections": sections},
+    )
+    for o, sz in zip(outs, sizes):
+        if input.shape is not None:
+            s = list(input.shape)
+            s[axis] = sz
+            o.shape = tuple(s)
+    return outs
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op(type="stack", inputs={"X": xs}, outputs={"Y": [out]},
+                     attrs={"axis": axis})
+    if xs[0].shape is not None:
+        s = list(xs[0].shape)
+        s.insert(axis if axis >= 0 else axis + len(s) + 1, len(xs))
+        out.shape = tuple(s)
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    n = num or x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in range(n)]
+    helper.append_op(type="unstack", inputs={"X": [x]}, outputs={"Y": outs},
+                     attrs={"axis": axis, "num": n})
+    s = [d for i, d in enumerate(x.shape) if i != axis % len(x.shape)]
+    for o in outs:
+        o.shape = tuple(s)
+    return outs
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(type="flatten2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": axis})
+    if x.shape is not None:
+        out.shape = (_prod(x.shape[:axis]) if axis else 1, _prod(x.shape[axis:]))
+        if any(d < 0 for d in x.shape[:axis]):
+            out.shape = (-1, _prod(x.shape[axis:]))
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="expand", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"expand_times": list(expand_times)})
+    if x.shape is not None:
+        out.shape = tuple(s * t if s >= 0 else -1 for s, t in zip(x.shape, expand_times))
+    return out
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gather", inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    if input.shape is not None and index.shape is not None:
+        out.shape = (index.shape[0],) + tuple(input.shape[1:])
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gather_nd", inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    if input.shape is not None and index.shape is not None:
+        out.shape = tuple(index.shape[:-1]) + tuple(input.shape[index.shape[-1]:])
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="scatter",
+        inputs={"X": [input], "Ids": [index], "Updates": [updates]},
+        outputs={"Out": [out]}, attrs={"overwrite": overwrite},
+    )
+    out.shape = input.shape
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="pad", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings), "pad_value": pad_value})
+    if x.shape is not None:
+        out.shape = tuple(
+            (s + paddings[2 * i] + paddings[2 * i + 1]) if s >= 0 else -1
+            for i, s in enumerate(x.shape)
+        )
+    return out
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="pad2d", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings), "mode": mode,
+                            "pad_value": pad_value})
+    if input.shape is not None:
+        n, c, h, w = input.shape
+        out.shape = (n, c,
+                     h + paddings[0] + paddings[1] if h >= 0 else -1,
+                     w + paddings[2] + paddings[3] if w >= 0 else -1)
+    return out
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="slice", inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
+    if input.shape is not None:
+        s = list(input.shape)
+        for a, st, e in zip(axes, starts, ends):
+            dim = s[a]
+            if dim < 0:
+                continue
+            st2 = max(st + dim, 0) if st < 0 else min(st, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            s[a] = max(e2 - st2, 0)
+        out.shape = tuple(s)
+    return out
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    helper = LayerHelper("strided_slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="strided_slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends), "strides": list(strides)})
+    return out
+
+
+# --------------------------------------------------------------- reductions
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = ()
+    return out
+
+
+def _reduce_layer(op_type, input, dim, keep_dim, name):
+    helper = LayerHelper(op_type, name=name)
+    dims = [dim] if isinstance(dim, int) else (list(dim) if dim is not None else None)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type=op_type, inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"dim": dims or [0], "keep_dim": keep_dim, "reduce_all": dims is None},
+    )
+    if input.shape is not None:
+        if dims is None:
+            out.shape = () if not keep_dim else (1,) * len(input.shape)
+        else:
+            nd = len(input.shape)
+            ax = {d % nd for d in dims}
+            out.shape = tuple(
+                (1 if keep_dim else None) if i in ax else s
+                for i, s in enumerate(input.shape)
+            )
+            out.shape = tuple(s for s in out.shape if s is not None)
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_prod", input, dim, keep_dim, name)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_all", input, dim, keep_dim, name)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_any", input, dim, keep_dim, name)
+
+
+# ------------------------------------------------------------------- pointwise
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    return _same_shape_out(helper, x, "clip", {"min": min, "max": max})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    return _same_shape_out(helper, x, "clip_by_norm", {"max_norm": max_norm})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", name=name, act=act)
+    out = _same_shape_out(helper, x, "scale",
+                          {"scale": scale, "bias": bias,
+                           "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
+def sign(x):
+    helper = LayerHelper("sign")
+    return _same_shape_out(helper, x, "sign")
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    helper = LayerHelper("cumsum")
+    return _same_shape_out(helper, x, "cumsum",
+                           {"axis": axis, "exclusive": exclusive, "reverse": reverse})
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="one_hot", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"depth": depth})
+    ishape = input.shape or (-1,)
+    if ishape and ishape[-1] == 1:
+        ishape = ishape[:-1]
+    out.shape = tuple(ishape) + (depth,)
+    return out
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(param_attr, alpha_shape, x.dtype,
+                                    default_initializer=Constant(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    out.shape = x.shape
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="maxout", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"groups": groups})
+    if x.shape is not None:
+        s = list(x.shape)
+        s[1] //= groups
+        out.shape = tuple(s)
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    out.shape = input.shape
+    return out
+
+
+def shape(input):
+    helper = LayerHelper("shape")
+    out = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    helper.append_op(type="shape", inputs={"Input": [input]}, outputs={"Out": [out]})
+    out.shape = (len(input.shape),) if input.shape is not None else None
+    return out
+
+
+def cos_sim(X, Y):
+    xn = l2_normalize(X, axis=-1)
+    yn = l2_normalize(Y, axis=-1)
+    return reduce_sum(elementwise_mul(xn, yn), dim=-1, keep_dim=True)
+
+
+def where(condition, x, y, name=None):
+    helper = LayerHelper("where", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="where_op",
+                     inputs={"Condition": [condition], "X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+# ------------------------------------------------------------- elementwise
+def _elementwise(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, name=name, act=act)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    out.shape = x.shape
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_pow", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mod", x, y, axis, act, name)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_floordiv", x, y, axis, act, name)
+
+
+def _compare(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool", stop_gradient=True)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]})
+    cond.shape = x.shape
+    return cond
+
+
+def less_than(x, y, cond=None):
+    return _compare("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)
+
+
+def _logical(op_type, x, y=None, out=None):
+    helper = LayerHelper(op_type)
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool", stop_gradient=True)
+    inputs = {"X": [x]} if y is None else {"X": [x], "Y": [y]}
+    helper.append_op(type=op_type, inputs=inputs, outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical("logical_or", x, y, out)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical("logical_xor", x, y, out)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical("logical_not", x, None, out)
+
+
+# ----------------------------------------------------------------- random
+def uniform_random_batch_size_like(input, shape, dtype="float32", input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(
+        type="uniform_random_batch_size_like", inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx, "min": min, "max": max,
+               "seed": seed, "dtype": dtype},
+    )
+    s = list(shape)
+    s[output_dim_idx] = input.shape[input_dim_idx] if input.shape else -1
+    out.shape = tuple(s)
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(type="gaussian_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "mean": mean, "std": std,
+                            "seed": seed, "dtype": dtype})
+    out.shape = tuple(shape)
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    # sample an id from each row's categorical distribution
+    helper = LayerHelper("sampling_id")
+    out = argmax_of_gumbel = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True
+    )
+    del argmax_of_gumbel
+    helper.append_op(type="sampling_id", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"seed": seed})
+    out.shape = (x.shape[0],)
+    return out
+
+
+# scalar/variable arithmetic used by Variable operator overloading
+def math_op(x, other, op_type, reverse=False):
+    if isinstance(other, Variable):
+        a, b = (other, x) if reverse else (x, other)
+        return _elementwise(op_type, a, b)
+    val = float(other)
+    if not reverse:
+        if op_type == "elementwise_add":
+            return scale(x, 1.0, val)
+        if op_type == "elementwise_sub":
+            return scale(x, 1.0, -val)
+        if op_type == "elementwise_mul":
+            return scale(x, val, 0.0)
+        if op_type == "elementwise_div":
+            return scale(x, 1.0 / val, 0.0)
+    else:
+        if op_type == "elementwise_add":
+            return scale(x, 1.0, val)
+        if op_type == "elementwise_sub":
+            return scale(x, -1.0, val)
+        if op_type == "elementwise_mul":
+            return scale(x, val, 0.0)
+    y = fill_constant([1], x.dtype, val)
+    a, b = (y, x) if reverse else (x, y)
+    if op_type in ("less_than", "less_equal", "greater_than", "greater_equal",
+                   "equal", "not_equal"):
+        return _compare(op_type, a, b)
+    return _elementwise(op_type, a, b)

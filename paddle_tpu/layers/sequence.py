@@ -1,0 +1,24 @@
+"""Sequence layers over padded+masked dense batches.
+
+The reference handles ragged sequences with LoDTensor offsets
+(/root/reference/paddle/fluid/framework/lod_tensor.h:58) and a zoo of
+LoD-aware ops (operators/sequence_ops/). XLA wants static shapes, so the
+TPU-native design is padded batches + explicit length masks (SURVEY §5
+"Long-context"); these layers produce masked dense equivalents.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["sequence_mask"]
+
+
+def sequence_mask(x, maxlen=None, dtype="float32", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(type="sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
+                     attrs={"maxlen": maxlen or -1, "out_dtype": dtype})
+    if x.shape is not None and maxlen:
+        out.shape = tuple(x.shape) + (maxlen,)
+    return out

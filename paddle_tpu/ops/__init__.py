@@ -1,0 +1,17 @@
+"""Op lowerings — importing this package registers every op.
+
+The registry is the analog of the reference's static kernel registry
+(paddle/fluid/framework/op_registry.h); modules here mirror the
+operators/ directory layout (SURVEY §2.2).
+"""
+
+from . import (  # noqa: F401
+    activations,
+    basic,
+    math,
+    metrics,
+    nn,
+    optimizer_ops,
+    sequence,
+    tensor_ops,
+)

@@ -1,0 +1,79 @@
+"""Activation ops (reference: paddle/fluid/operators/activation_op.cc — one
+macro-generated op family; here one registration loop)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _act(name, fn):
+    @register_op(name)
+    def _op(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0], attrs)]}
+
+    return _op
+
+
+_act("relu", lambda x, a: jax.nn.relu(x))
+_act("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_act("tanh", lambda x, a: jnp.tanh(x))
+_act("sqrt", lambda x, a: jnp.sqrt(x))
+_act("rsqrt", lambda x, a: jax.lax.rsqrt(x))
+_act("abs", lambda x, a: jnp.abs(x))
+_act("exp", lambda x, a: jnp.exp(x))
+_act("log", lambda x, a: jnp.log(x))
+_act("square", lambda x, a: x * x)
+_act("reciprocal", lambda x, a: 1.0 / x)
+_act("softplus", lambda x, a: jax.nn.softplus(x))
+_act("softsign", lambda x, a: x / (1 + jnp.abs(x)))
+_act("ceil", lambda x, a: jnp.ceil(x))
+_act("floor", lambda x, a: jnp.floor(x))
+_act("round", lambda x, a: jnp.round(x))
+_act("cos", lambda x, a: jnp.cos(x))
+_act("sin", lambda x, a: jnp.sin(x))
+_act("gelu", lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate", False)))
+_act("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_act("leaky_relu", lambda x, a: jax.nn.leaky_relu(x, a.get("alpha", 0.02)))
+_act("elu", lambda x, a: jax.nn.elu(x, a.get("alpha", 1.0)))
+_act("pow", lambda x, a: jnp.power(x, a.get("factor", 1.0)))
+_act("stanh", lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 0.67) * x))
+_act(
+    "hard_sigmoid",
+    lambda x, a: jnp.clip(a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+)
+_act(
+    "hard_swish",
+    lambda x, a: x
+    * jnp.clip(x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0))
+    / a.get("scale", 6.0),
+)
+_act("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_act("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+_act(
+    "soft_relu",
+    lambda x, a: jnp.log1p(jnp.exp(jnp.clip(x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))),
+)
+_act("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_act("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_act(
+    "thresholded_relu",
+    lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, jnp.zeros_like(x)),
+)
+_act(
+    "hard_shrink",
+    lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, jnp.zeros_like(x)),
+)
+_act("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+_act("silu", lambda x, a: jax.nn.silu(x))
+
+
+@register_op("prelu", diff_inputs=["X", "Alpha"])
+def _prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel" and alpha.ndim == 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}

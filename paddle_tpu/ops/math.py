@@ -1,0 +1,248 @@
+"""Math ops: matmul family, broadcasted elementwise, reductions, comparisons.
+
+Parity targets: /root/reference/paddle/fluid/operators/mul_op.cc,
+matmul_op.cc, elementwise/*.cc, sum_op.cc, mean_op.cc, reduce_ops/*.cc,
+controlflow/compare_op.cc, controlflow/logical_op.cc, arg_min_max_op*.cc,
+cum_op.cc, norm_op.cc, squared_l2_norm_op.cc, lod_array_length... (array ops
+live in controlflow.py). All lower to single XLA HLO ops; the MXU path is
+jnp.matmul/dot_general with preferred_element_type left to XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+import operator
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _flatten2d(x, num_col_dims):
+    lead = functools.reduce(operator.mul, x.shape[:num_col_dims], 1)
+    tail = functools.reduce(operator.mul, x.shape[num_col_dims:], 1)
+    return x.reshape(lead, tail)
+
+
+@register_op("mul", diff_inputs=["X", "Y"])
+def _mul(ctx, ins, attrs):
+    """Flattening matmul (reference mul_op.cc): x -> 2D by x_num_col_dims,
+    y -> 2D by y_num_col_dims, result reshaped back."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    x2 = _flatten2d(x, xnc)
+    y2 = _flatten2d(y, ync)
+    out = x2 @ y2
+    out_shape = x.shape[:xnc] + y.shape[ync:]
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register_op("matmul", diff_inputs=["X", "Y"])
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :] if not tx else x[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty and y.ndim > 1:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("matmul_v2", diff_inputs=["X", "Y"])
+def _matmul_v2(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": [jnp.matmul(x, y)]}
+
+
+@register_op("bmm", diff_inputs=["X", "Y"])
+def _bmm(ctx, ins, attrs):
+    return {"Out": [jnp.matmul(ins["X"][0], ins["Y"][0])]}
+
+
+# ---------------------------------------------------------------- elementwise
+def _bcast_y(x, y, axis):
+    """Paddle broadcast: y's shape matches a contiguous run of x's dims
+    starting at `axis` (elementwise_op_function.h). axis=-1 aligns trailing
+    (== numpy broadcasting)."""
+    if y.ndim == 0 or x.ndim == y.ndim:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    # strip trailing 1-dims paddle allows in y
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1 and len(yshape) > x.ndim - axis:
+        yshape.pop()
+    y = y.reshape(yshape) if tuple(yshape) != y.shape else y
+    pad = x.ndim - axis - y.ndim
+    if pad > 0:
+        y = y.reshape(y.shape + (1,) * pad)
+    return y
+
+
+def _ew(name, fn, diff=True):
+    @register_op(name, diff_inputs=(["X", "Y"] if diff else None),
+                 no_grad=not diff)
+    def _op(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [_fn(x, _bcast_y(x, y, attrs.get("axis", -1)))]}
+
+    return _op
+
+
+_ew("elementwise_add", operator.add)
+_ew("elementwise_sub", operator.sub)
+_ew("elementwise_mul", operator.mul)
+_ew("elementwise_div", operator.truediv)
+_ew("elementwise_max", jnp.maximum)
+_ew("elementwise_min", jnp.minimum)
+_ew("elementwise_pow", jnp.power)
+_ew("elementwise_mod", jnp.mod, diff=False)
+_ew("elementwise_floordiv", jnp.floor_divide, diff=False)
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    """Multi-input add — the gradient-aggregation op (sum_op.cc)."""
+    xs = [x for x in ins["X"] if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+# ---------------------------------------------------------------- reductions
+def _reduce(name, fn, diff=True):
+    @register_op(name, no_grad=not diff)
+    def _op(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        if attrs.get("reduce_all", False):
+            dims = None
+        else:
+            dims = tuple(d % x.ndim for d in attrs.get("dim", [0]))
+        keep = attrs.get("keep_dim", False)
+        return {"Out": [_fn(x, axis=dims, keepdims=keep)]}
+
+    return _op
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all, diff=False)
+_reduce("reduce_any", jnp.any, diff=False)
+
+
+# ---------------------------------------------------------------- comparisons
+def _cmp(name, fn):
+    @register_op(name, no_grad=True)
+    def _op(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [_fn(x, _bcast_y(x, y, attrs.get("axis", -1)))]}
+
+    return _op
+
+
+_cmp("less_than", operator.lt)
+_cmp("less_equal", operator.le)
+_cmp("greater_than", operator.gt)
+_cmp("greater_equal", operator.ge)
+_cmp("equal", operator.eq)
+_cmp("not_equal", operator.ne)
+
+
+def _logical(name, fn, unary=False):
+    @register_op(name, no_grad=True)
+    def _op(ctx, ins, attrs, _fn=fn, _u=unary):
+        if _u:
+            return {"Out": [_fn(ins["X"][0])]}
+        return {"Out": [_fn(ins["X"][0], ins["Y"][0])]}
+
+    return _op
+
+
+_logical("logical_and", jnp.logical_and)
+_logical("logical_or", jnp.logical_or)
+_logical("logical_xor", jnp.logical_xor)
+_logical("logical_not", jnp.logical_not, unary=True)
+
+
+@register_op("arg_max", no_grad=True)
+def _arg_max(ctx, ins, attrs):
+    return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+
+
+@register_op("arg_min", no_grad=True)
+def _arg_min(ctx, ins, attrs):
+    return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+
+
+@register_op("argsort", no_grad=True)
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    flat = attrs.get("flatten", False)
+    if flat:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+        if attrs.get("exclusive", False):
+            out = out - x
+    return {"Out": [out]}
+
+
+@register_op("norm")
+def _norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(x * x).reshape(())]}
+
+
+@register_op("dot", diff_inputs=["X", "Y"])
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}
+
+
+@register_op("maximum_with_index", no_grad=True)
+def _max_with_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.max(x)], "Index": [jnp.argmax(x)]}

@@ -1,0 +1,56 @@
+"""Metric ops (reference: paddle/fluid/operators/metrics/accuracy_op.cc,
+auc_op.cc; precision_recall later)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy", no_grad=True)
+def _accuracy(ctx, ins, attrs):
+    idx = ins["Indices"][0]
+    label = ins["Label"][0]
+    if label.ndim == 2 and label.shape[1] == 1:
+        label = label[:, 0]
+    hit = jnp.any(idx == label[:, None].astype(idx.dtype), axis=1)
+    correct = jnp.sum(hit.astype(jnp.float32))
+    total = jnp.asarray(label.shape[0], jnp.float32)
+    return {
+        "Accuracy": [(correct / total).reshape((1,))],
+        "Correct": [correct.astype(jnp.int32).reshape((1,))],
+        "Total": [total.astype(jnp.int32).reshape((1,))],
+    }
+
+
+@register_op("auc", no_grad=True)
+def _auc(ctx, ins, attrs):
+    """Streaming AUC with histogram stat buffers (auc_op.cc)."""
+    preds = ins["Predict"][0]
+    label = ins["Label"][0]
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresh = stat_pos.shape[0] - 1
+    if label.ndim == 2:
+        label = label[:, 0]
+    pos_prob = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 else preds.reshape(-1)
+    bucket = jnp.clip((pos_prob * num_thresh).astype(jnp.int32), 0, num_thresh)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[bucket].add(is_pos)
+    stat_neg = stat_neg.at[bucket].add(1 - is_pos)
+    # trapezoid rule over descending threshold
+    tp = jnp.cumsum(stat_pos[::-1])
+    fp = jnp.cumsum(stat_neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg), 0.0)
+    return {
+        "AUC": [auc.reshape((1,)).astype(jnp.float64)
+                if auc.dtype == jnp.float64 else auc.reshape((1,))],
+        "StatPosOut": [stat_pos],
+        "StatNegOut": [stat_neg],
+    }

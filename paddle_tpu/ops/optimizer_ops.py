@@ -1,0 +1,211 @@
+"""Optimizer update ops — run *inside* the step computation.
+
+Parity targets: /root/reference/paddle/fluid/operators/optimizers/
+(sgd_op.cc, momentum_op.cc, lars_momentum_op.cc, adam_op.cc, adamax_op.cc,
+adagrad_op.cc, decayed_adagrad_op.cc, adadelta_op.cc, rmsprop_op.cc,
+ftrl_op.cc). In the reference these are per-parameter CUDA kernels; here
+they are lowered into the same XLA computation as forward+backward, so the
+whole train step is one executable with donated parameter buffers — the
+in-graph-update design the reference approximates with in-place kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _p(ins, slot):
+    v = ins.get(slot)
+    return v[0] if v else None
+
+
+@register_op("sgd", no_grad=True)
+def _sgd(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    return {"ParamOut": [p - lr.reshape(()).astype(p.dtype) * g]}
+
+
+@register_op("momentum", no_grad=True)
+def _momentum(ctx, ins, attrs):
+    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register_op("lars_momentum", no_grad=True)
+def _lars_momentum(ctx, ins, attrs):
+    """Layer-wise adaptive rate scaling (lars_momentum_op.cc)."""
+    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 1e-3)
+    decay = attrs.get("lars_weight_decay", 5e-4)
+    eps = 1e-9
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    local_lr = lr * coeff * p_norm / (eps + g_norm + decay * p_norm)
+    v_new = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_new], "VelocityOut": [v_new]}
+
+
+@register_op("adam", no_grad=True)
+def _adam(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p, b2p = _p(ins, "Beta1Pow"), _p(ins, "Beta2Pow")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    b1p_ = b1p.reshape(()).astype(p.dtype)
+    b2p_ = b2p.reshape(()).astype(p.dtype)
+    lr_t = lr * jnp.sqrt(1 - b2p_ * b2) / (1 - b1p_ * b1)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {
+        "ParamOut": [p_new],
+        "Moment1Out": [m_new],
+        "Moment2Out": [v_new],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op("adamax", no_grad=True)
+def _adamax(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m, inf = _p(ins, "Moment"), _p(ins, "InfNorm")
+    b1p = _p(ins, "Beta1Pow").reshape(())
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_new = p - (lr / (1 - b1p.astype(p.dtype))) * (m_new / (inf_new + eps))
+    return {"ParamOut": [p_new], "MomentOut": [m_new], "InfNormOut": [inf_new]}
+
+
+@register_op("adagrad", no_grad=True)
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_new = mom + g * g
+    p_new = p - lr * g / (jnp.sqrt(mom_new) + eps)
+    return {"ParamOut": [p_new], "MomentOut": [mom_new]}
+
+
+@register_op("decayed_adagrad", no_grad=True)
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, mom = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_new = decay * mom + (1 - decay) * g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mom_new) + eps)], "MomentOut": [mom_new]}
+
+
+@register_op("adadelta", no_grad=True)
+def _adadelta(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    avg_sq, avg_upd = _p(ins, "AvgSquaredGrad"), _p(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    avg_sq_new = rho * avg_sq + (1 - rho) * g * g
+    upd = -jnp.sqrt((avg_upd + eps) / (avg_sq_new + eps)) * g
+    avg_upd_new = rho * avg_upd + (1 - rho) * upd * upd
+    return {
+        "ParamOut": [p + upd],
+        "AvgSquaredGradOut": [avg_sq_new],
+        "AvgSquaredUpdateOut": [avg_upd_new],
+    }
+
+
+@register_op("rmsprop", no_grad=True)
+def _rmsprop(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    ms, mom = _p(ins, "MeanSquare"), _p(ins, "Moment")
+    mg = _p(ins, "MeanGrad")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * g * g
+    outs = {}
+    if attrs.get("centered", False):
+        mg_new = rho * mg + (1 - rho) * g
+        mom_new = mu * mom + lr * g / jnp.sqrt(ms_new - mg_new * mg_new + eps)
+        outs["MeanGradOut"] = [mg_new]
+    else:
+        mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+        if mg is not None:
+            outs["MeanGradOut"] = [mg]
+    outs.update({"ParamOut": [p - mom_new], "MeanSquareOut": [ms_new], "MomentOut": [mom_new]})
+    return outs
+
+
+@register_op("ftrl", no_grad=True)
+def _ftrl(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    sq, lin = _p(ins, "SquaredAccumulator"), _p(ins, "LinearAccumulator")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_sq = sq + g * g
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    new_lin = lin + g - sigma * p
+    if power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    p_new = jnp.where(jnp.abs(new_lin) > l1, pre / denom, jnp.zeros_like(p))
+    return {
+        "ParamOut": [p_new],
+        "SquaredAccumOut": [new_sq],
+        "LinearAccumOut": [new_lin],
+    }
+
+
+@register_op("lamb", no_grad=True)
+def _lamb(ctx, ins, attrs):
+    """LAMB (TPU-era large-batch optimizer; not in the reference — an
+    extension for the BERT baseline workload)."""
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p, b2p = _p(ins, "Beta1Pow"), _p(ins, "Beta2Pow")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    mhat = m_new / (1 - b1p.reshape(()).astype(p.dtype) * b1)
+    vhat = v_new / (1 - b2p.reshape(()).astype(p.dtype) * b2)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return {
+        "ParamOut": [p - lr * ratio * r],
+        "Moment1Out": [m_new],
+        "Moment2Out": [v_new],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }

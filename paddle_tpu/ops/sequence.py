@@ -1,0 +1,21 @@
+"""Sequence ops on padded dense batches (reference: operators/sequence_ops/).
+LoD offsets become explicit length vectors + masks (SURVEY §5)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.lowering import as_jax_dtype
+from ..core.registry import register_op
+
+
+@register_op("sequence_mask", no_grad=True)
+def _sequence_mask(ctx, ins, attrs):
+    x = ins["X"][0]  # lengths
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError("TPU build needs a static maxlen for sequence_mask")
+    rng = jnp.arange(maxlen)
+    mask = rng[None, :] < x.reshape(-1, 1)
+    mask = mask.reshape(tuple(x.shape) + (maxlen,))
+    return {"Y": [mask.astype(as_jax_dtype(attrs.get("out_dtype", "float32")))]}

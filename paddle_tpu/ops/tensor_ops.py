@@ -1,0 +1,284 @@
+"""Tensor-manipulation ops: reshape/transpose/concat/split/slice/gather/...
+
+Parity targets: /root/reference/paddle/fluid/operators/reshape_op.cc,
+transpose_op.cc, concat_op.cc, split_op.cc, squeeze_op.cc, unsqueeze_op.cc,
+flatten_op.cc, stack_op.cc, slice_op.cc, gather_op.cc, scatter_op.cc,
+expand_op.cc, pad_op.cc, pad2d_op.cc, crop_op.cc, reverse_op.cc,
+where (select), shard_index. The *2 variants also emit XShape for the grad
+path, matching the reference's inplace-friendly op pairs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _infer_reshape(x, shape):
+    shape = list(shape)
+    out = []
+    neg = -1
+    known = 1
+    for i, s in enumerate(shape):
+        if s == -1:
+            neg = i
+            out.append(-1)
+        elif s == 0:
+            out.append(x.shape[i])
+            known *= x.shape[i]
+        else:
+            out.append(int(s))
+            known *= int(s)
+    if neg >= 0:
+        out[neg] = int(x.size // known)
+    return tuple(out)
+
+
+@register_op("reshape", diff_inputs=["X"])
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x.reshape(_infer_reshape(x, attrs["shape"]))]}
+
+
+@register_op("reshape2", diff_inputs=["X"])
+def _reshape2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = x.reshape(_infer_reshape(x, attrs["shape"]))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("transpose", diff_inputs=["X"])
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+@register_op("transpose2", diff_inputs=["X"])
+def _transpose2(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {
+        "Out": [jnp.transpose(x, attrs["axis"])],
+        "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)],
+    }
+
+
+@register_op("concat", diff_inputs=["X"])
+def _concat(ctx, ins, attrs):
+    xs = [x for x in ins["X"] if x is not None]
+    return {"Out": [jnp.concatenate(xs, axis=attrs.get("axis", 0))]}
+
+
+@register_op("split", diff_inputs=["X"])
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if num:
+        parts = jnp.split(x, num, axis=axis)
+    else:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        parts = jnp.split(x, idx, axis=axis)
+    return {"Out": parts}
+
+
+@register_op("squeeze", diff_inputs=["X"])
+def _squeeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    axes = [a % x.ndim for a in axes] or [i for i, s in enumerate(x.shape) if s == 1]
+    return {"Out": [jnp.squeeze(x, tuple(a for a in axes if x.shape[a] == 1))]}
+
+
+@register_op("squeeze2", diff_inputs=["X"])
+def _squeeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = _squeeze(ctx, ins, attrs)["Out"][0]
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("unsqueeze", diff_inputs=["X"])
+def _unsqueeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x]}
+
+
+@register_op("unsqueeze2", diff_inputs=["X"])
+def _unsqueeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = _unsqueeze(ctx, ins, attrs)["Out"][0]
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("flatten", diff_inputs=["X"])
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= s
+    return {"Out": [x.reshape(lead, -1)]}
+
+
+@register_op("flatten2", diff_inputs=["X"])
+def _flatten2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = _flatten(ctx, ins, attrs)["Out"][0]
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("stack", diff_inputs=["X"])
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack", diff_inputs=["X"])
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    parts = jnp.split(x, x.shape[axis], axis=axis)
+    return {"Y": [jnp.squeeze(p, axis) for p in parts]}
+
+
+@register_op("slice", diff_inputs=["Input"])
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("strided_slice", diff_inputs=["Input"])
+def _strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"], attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("gather", diff_inputs=["X"])
+def _gather(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+    return {"Out": [jnp.take(x, idx.astype(jnp.int32), axis=attrs.get("axis", 0))]}
+
+
+@register_op("gather_nd", diff_inputs=["X"])
+def _gather_nd(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    idx = idx.astype(jnp.int32)
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register_op("scatter", diff_inputs=["X", "Updates"])
+def _scatter(ctx, ins, attrs):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    ids = ids.astype(jnp.int32)
+    if ids.ndim == 2 and ids.shape[1] == 1:
+        ids = ids[:, 0]
+    if attrs.get("overwrite", True):
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    return {"Out": [out]}
+
+
+@register_op("expand", diff_inputs=["X"])
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, tuple(times))]}
+
+
+@register_op("expand_as", diff_inputs=["X"])
+def _expand_as(ctx, ins, attrs):
+    x, target = ins["X"][0], ins["target_tensor"][0]
+    reps = tuple(t // s for t, s in zip(target.shape, x.shape))
+    return {"Out": [jnp.tile(x, reps)]}
+
+
+@register_op("tile", diff_inputs=["X"])
+def _tile(ctx, ins, attrs):
+    return {"Out": [jnp.tile(ins["X"][0], tuple(attrs["repeat_times"]))]}
+
+
+@register_op("pad", diff_inputs=["X"])
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("pad2d", diff_inputs=["X"])
+def _pad2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": [jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))]}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": [jnp.pad(x, pairs, mode=jmode)]}
+
+
+@register_op("crop", diff_inputs=["X"])
+def _crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs["offsets"]
+    shape = attrs["shape"]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register_op("reverse", diff_inputs=["X"])
+def _reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    for a in attrs["axis"]:
+        x = jnp.flip(x, a)
+    return {"Out": [x]}
+
+
+@register_op("where_op", diff_inputs=["X", "Y"])
+def _where(ctx, ins, attrs):
+    cond, x, y = ins["Condition"][0], ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.where(cond, x, y)]}
+
+
+@register_op("shard_index", no_grad=True)
+def _shard_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore = attrs.get("ignore_value", -1)
+    size = (index_num + nshards - 1) // nshards
+    in_shard = (x // size) == shard_id
+    return {"Out": [jnp.where(in_shard, x % size, ignore)]}
+
+
+@register_op("roll", diff_inputs=["X"])
+def _roll(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.roll(x, attrs["shifts"], attrs.get("axis"))]}
+
+
+@register_op("meshgrid", diff_inputs=["X"])
+def _meshgrid(ctx, ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}

@@ -1,0 +1,65 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py:39-165 +
+platform/profiler.cc + tools/timeline.py).
+
+Host annotations use jax.profiler (XLA's trace replaces CUPTI); traces are
+viewable in TensorBoard/Perfetto — the chrome://tracing analog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "cuda_profiler",
+           "RecordEvent"]
+
+_trace_dir = None
+
+
+def start_profiler(state="All", trace_dir="/tmp/paddle_tpu_trace"):
+    global _trace_dir
+    import jax
+
+    _trace_dir = trace_dir
+    jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None,
+             trace_dir="/tmp/paddle_tpu_trace"):
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **kw):  # name kept for porting ease; maps to XLA trace
+    with profiler():
+        yield
+
+
+class RecordEvent:
+    """RAII trace annotation (reference platform/profiler.h:81)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        import jax
+
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+        return False

@@ -1,0 +1,41 @@
+"""Test config: force an 8-device virtual CPU mesh before jax loads, so
+sharding/collective paths are exercised without TPU hardware (the driver's
+dryrun does the same)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fresh_programs():
+    """Give a test its own main/startup programs and scope."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.program import (
+        Program,
+        switch_main_program,
+        switch_startup_program,
+    )
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    main, startup = Program(), Program()
+    old_main = switch_main_program(main)
+    old_startup = switch_startup_program(startup)
+    scope = Scope()
+    with scope_guard(scope):
+        yield main, startup, scope
+    switch_main_program(old_main)
+    switch_startup_program(old_startup)
+
+
+@pytest.fixture(autouse=True)
+def _seed_numpy():
+    np.random.seed(0)

@@ -1,0 +1,92 @@
+"""End-to-end basics: program build, startup init, fc forward, backward,
+SGD convergence on a tiny regression (tests/book-style smoke)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_fill_and_fetch(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = layers.fill_constant([2, 3], "float32", 5.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(main, fetch_list=[x])
+    assert out.shape == (2, 3)
+    assert np.allclose(out, 5.0)
+
+
+def test_feed_and_elementwise(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", [3], append_batch_size=False)
+        b = layers.data("b", [3], append_batch_size=False)
+        c = layers.elementwise_add(a, b)
+        d = layers.scale(c, scale=2.0)
+    exe = fluid.Executor()
+    av = np.array([1.0, 2.0, 3.0], np.float32)
+    bv = np.array([10.0, 20.0, 30.0], np.float32)
+    (out,) = exe.run(main, feed={"a": av, "b": bv}, fetch_list=[d])
+    assert np.allclose(out, (av + bv) * 2)
+
+
+def test_fc_forward_shapes(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.fc(x, size=8, act="relu")
+    exe = fluid.Executor()
+    exe.run(startup)
+    (out,) = exe.run(main, feed={"x": np.random.rand(5, 4).astype("float32")},
+                     fetch_list=[y])
+    assert out.shape == (5, 8)
+    assert (out >= 0).all()
+
+
+def test_linear_regression_converges(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    w_true = np.array([[2.0], [-3.0]], np.float32)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(300):
+        xv = rng.rand(16, 2).astype("float32")
+        yv = xv @ w_true + 0.5
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 5e-3, "did not converge: %s" % losses[-5:]
+
+
+def test_program_clone_for_test_disables_dropout(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [10])
+        h = layers.dropout(x, dropout_prob=0.5,
+                           dropout_implementation="upscale_in_train")
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor()
+    xv = np.ones((4, 10), np.float32)
+    (out,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[h])
+    assert np.allclose(out, xv)  # identity in test mode
+
+
+def test_persistable_state_updates(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        counter = layers.create_global_var([1], 0.0, "float32", persistable=True)
+        layers.increment(counter)
+    exe = fluid.Executor()
+    exe.run(startup)
+    for i in range(3):
+        (c,) = exe.run(main, fetch_list=[counter])
+    assert float(c) == 3.0
