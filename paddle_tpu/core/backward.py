@@ -39,23 +39,18 @@ def _find_op_path(block, loss_name: str, extra_targets: Sequence[str] = ()):
     return path
 
 
-def _requires_grad_set(block, path_ops, no_grad: Set[str]) -> Set[str]:
+def _requires_grad_set(block, no_grad: Set[str]) -> Set[str]:
+    """Vars that may carry gradient: any float var not marked stop_gradient
+    (params, temps, and leaves the caller unfroze — the OpTest numeric-grad
+    harness feeds leaf vars with stop_gradient=False). Over-inclusion is
+    harmless: unused grad subgraphs are dead code XLA eliminates."""
     req: Set[str] = set()
     for var in block.vars.values():
-        if isinstance(var, Parameter) and var.trainable and var.name not in no_grad:
-            req.add(var.name)
-        elif not var.stop_gradient and not var.is_data and not var.persistable:
-            # plain temps are differentiable once fed by a req var
-            pass
-    for op in path_ops:
-        opdef = get_op(op.type)
-        if opdef.no_grad:
+        if var.stop_gradient or var.name in no_grad or not _is_float(var):
             continue
-        if any(n in req for n in op.input_names()):
-            for n in op.output_names():
-                v = block.vars.get(n)
-                if (v is None or not v.stop_gradient) and n not in no_grad:
-                    req.add(n)
+        if isinstance(var, Parameter) and not var.trainable:
+            continue
+        req.add(var.name)
     return req
 
 
@@ -83,7 +78,7 @@ def append_backward(
             no_grad.add(var.name)
 
     path_ops = _find_op_path(block, loss.name)
-    req = _requires_grad_set(block, path_ops, no_grad)
+    req = _requires_grad_set(block, no_grad)
 
     # seed d(loss)/d(loss) = 1 (reference: fill_constant then scale-by-1/N
     # lives in the data-parallel engine, not here)

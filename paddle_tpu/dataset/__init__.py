@@ -1,0 +1,7 @@
+"""Datasets (reference: python/paddle/dataset/ — mnist, cifar, imdb,
+uci_housing, movielens, wmt14/16...). The reference downloads real corpora;
+this sandbox has no egress, so each module synthesizes a deterministic,
+*learnable* surrogate with the same sample schema and reader API. Point
+PADDLE_TPU_DATA_HOME at real data to swap in actual corpora."""
+
+from . import cifar, imdb, mnist, uci_housing  # noqa: F401

@@ -1,0 +1,41 @@
+"""CIFAR-schema dataset (reference: python/paddle/dataset/cifar.py).
+Samples: (3072-float image, int label). Synthetic class-template surrogate."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+_T = {}
+
+
+def _reader(num_classes, n, seed):
+    def reader():
+        if num_classes not in _T:
+            _T[num_classes] = np.random.RandomState(5).randn(
+                num_classes, 3072).astype("float32") * 0.5
+        t = _T[num_classes]
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(num_classes))
+            img = np.clip(t[label] + 0.5 * rng.randn(3072), -1, 1).astype("float32")
+            yield img, label
+
+    return reader
+
+
+def train10(n=4096):
+    return _reader(10, n, seed=0)
+
+
+def test10(n=512):
+    return _reader(10, n, seed=1)
+
+
+def train100(n=4096):
+    return _reader(100, n, seed=0)
+
+
+def test100(n=512):
+    return _reader(100, n, seed=1)

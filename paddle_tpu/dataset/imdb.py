@@ -1,0 +1,40 @@
+"""IMDB-schema dataset (reference: python/paddle/dataset/imdb.py).
+Samples: (word-id sequence, 0/1 label). Synthetic sentiment-by-lexicon."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "word_dict"]
+
+VOCAB = 5148  # reference vocab size ballpark
+
+
+def word_dict():
+    return {("w%d" % i).encode(): i for i in range(VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        pos_words = np.arange(100, 600)
+        neg_words = np.arange(600, 1100)
+        for _ in range(n):
+            label = int(rng.randint(2))
+            length = int(rng.randint(20, 120))
+            base = pos_words if label else neg_words
+            sentiment = rng.choice(base, size=length // 2)
+            noise = rng.randint(1100, VOCAB, size=length - length // 2)
+            seq = np.concatenate([sentiment, noise])
+            rng.shuffle(seq)
+            yield seq.astype("int64").tolist(), label
+
+    return reader
+
+
+def train(word_idx=None, n=4096):
+    return _reader(n, seed=3)
+
+
+def test(word_idx=None, n=512):
+    return _reader(n, seed=4)

@@ -121,13 +121,19 @@ def _pool2d(ctx, ins, attrs):
     strides4 = (1, 1) + strides
     padding = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        out = lax.reduce_window(x, jnp.array(init, x.dtype), lax.max, window, strides4, padding)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            init = -float("inf")  # scalar: keeps the differentiable max monoid
+        else:
+            # integer pools need a dtype-matched identity (weak int32 would
+            # mismatch the operand dtype); 0-d concrete arrays still hit the
+            # monoid special case
+            init = jnp.array(jnp.iinfo(x.dtype).min, x.dtype)
+        out = lax.reduce_window(x, init, lax.max, window, strides4, padding)
     else:
-        s = lax.reduce_window(x, jnp.array(0, x.dtype), lax.add, window, strides4, padding)
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides4, padding)
         if attrs.get("exclusive", True) and pads != (0, 0):
             ones = jnp.ones(x.shape, x.dtype)
-            cnt = lax.reduce_window(ones, jnp.array(0, x.dtype), lax.add, window, strides4, padding)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides4, padding)
             out = s / cnt
         else:
             out = s / (ksize[0] * ksize[1])

@@ -1,0 +1,152 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py —
+batch/shuffle/buffered/cache/map_readers/xmap_readers/chain/compose/firstn).
+A reader is a zero-arg callable returning a sample generator."""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ["batch", "shuffle", "buffered", "cache", "map_readers",
+           "xmap_readers", "chain", "compose", "firstn"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def shuffle(reader, buf_size):
+    def shuffle_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+
+    return shuffle_reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch (the py_reader/double-buffer analog for
+    plain python pipelines)."""
+    end = object()
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+        error = []
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as e:  # re-raised in the consumer
+                error.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                if error:
+                    raise error[0]
+                break
+            yield s
+
+    return buffered_reader
+
+
+def cache(reader):
+    all_data = []
+    filled = [False]
+
+    def cache_reader():
+        if not filled[0]:
+            all_data.extend(reader())
+            filled[0] = True
+        yield from all_data
+
+    return cache_reader
+
+
+def map_readers(func, *readers):
+    def reader():
+        for vals in zip(*[r() for r in readers]):
+            yield func(*vals)
+
+    return reader
+
+
+def xmap_readers(mapper, reader, process_num=1, buffer_size=1024, order=False):
+    # thread-pool map; order preserved when asked
+    def xreader():
+        if order or process_num <= 1:
+            for s in reader():
+                yield mapper(s)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(process_num) as pool:
+            yield from pool.map(mapper, reader())
+
+    return xreader
+
+
+def chain(*readers):
+    def chain_reader():
+        yield from itertools.chain(*[r() for r in readers])
+
+    return chain_reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment=True):
+    def compose_reader():
+        gens = [r() for r in readers]
+        sentinel = object()
+        while True:
+            vals = [next(g, sentinel) for g in gens]
+            done = [v is sentinel for v in vals]
+            if all(done):
+                return
+            if any(done):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                return
+            out = []
+            for v in vals:
+                if isinstance(v, tuple):
+                    out.extend(v)
+                else:
+                    out.append(v)
+            yield tuple(out)
+
+    return compose_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader

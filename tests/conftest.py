@@ -1,18 +1,34 @@
-"""Test config: force an 8-device virtual CPU mesh before jax loads, so
-sharding/collective paths are exercised without TPU hardware (the driver's
-dryrun does the same)."""
+"""Test config: force an 8-device virtual CPU mesh so sharding/collective
+paths are exercised without TPU hardware (the driver's dryrun does the same).
+
+Note: plugins (jaxtyping) import jax before this conftest runs, so setting
+os.environ alone is not enough — jax.config.update("jax_platforms") is the
+authoritative override; without it the suite silently dispatches over the
+session's live TPU tunnel (JAX_PLATFORMS=axon) and crawls.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_sessionstart(session):
+    assert all(d.platform == "cpu" for d in jax.devices()), (
+        "test suite must run on the virtual CPU mesh, got %s" % jax.devices()
+    )
 
 
 @pytest.fixture

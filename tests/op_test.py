@@ -1,0 +1,162 @@
+"""OpTest harness: per-lowering numeric contract.
+
+Analog of /root/reference/python/paddle/fluid/tests/unittests/op_test.py:134
+— builds a one-op program from numpy inputs, compares the lowered output
+against a numpy reference (check_output_with_place:362), and compares
+analytic grads from append_backward against finite differences
+(check_grad:526 / get_numeric_gradient:45).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.program import Program, switch_main_program, switch_startup_program
+from paddle_tpu.core.scope import Scope, scope_guard
+
+
+class _OpProgram:
+    """One-op program, compiled once, rerunnable with new feeds."""
+
+    def __init__(self, op_type, inputs, attrs, out_slots, loss_weights=None):
+        self.main = Program()
+        self.scope = Scope()
+        old_m = switch_main_program(self.main)
+        old_s = switch_startup_program(Program())
+        try:
+            with scope_guard(self.scope):
+                block = self.main.global_block()
+                in_vars = {}
+                self.feed_names = {}
+                for slot, arrs in inputs.items():
+                    names = []
+                    for i, a in enumerate(arrs):
+                        name = "%s_%d" % (slot.lower(), i)
+                        block.create_var(name=name, shape=a.shape,
+                                         dtype=str(a.dtype), is_data=True,
+                                         stop_gradient=False)
+                        names.append(name)
+                        self.feed_names[(slot, i)] = name
+                    in_vars[slot] = names
+                out_vars = {}
+                self.out_names = {}
+                for slot, n in out_slots.items():
+                    names = []
+                    for i in range(n):
+                        name = "out_%s_%d" % (slot.lower(), i)
+                        block.create_var(name=name, stop_gradient=False)
+                        names.append(name)
+                        self.out_names[(slot, i)] = name
+                    out_vars[slot] = names
+                block.append_op(op_type, in_vars, out_vars, attrs or {})
+                self.fetch = list(self.out_names.values())
+                self.grad_fetch = []
+                self.loss_name = None
+                if loss_weights:
+                    from paddle_tpu import layers
+                    from paddle_tpu.core.backward import append_backward
+
+                    parts = []
+                    for (slot, i), w in loss_weights.items():
+                        wv = layers.assign(w)
+                        prod = layers.elementwise_mul(
+                            block.var(self.out_names[(slot, i)]), wv)
+                        parts.append(layers.reduce_sum(prod))
+                    loss = parts[0]
+                    for p in parts[1:]:
+                        loss = layers.elementwise_add(loss, p)
+                    append_backward(loss)
+                    self.loss_name = loss.name
+                    self.grad_fetch = [n + "@GRAD" for n in self.feed_names.values()
+                                       if block.has_var(n + "@GRAD")]
+        finally:
+            switch_main_program(old_m)
+            switch_startup_program(old_s)
+        self.exe = fluid.Executor()
+
+    def run(self, feed, fetch):
+        with scope_guard(self.scope):
+            outs = self.exe.run(self.main, feed=feed, fetch_list=fetch)
+        return dict(zip(fetch, outs))
+
+
+def _as_feed(inputs):
+    return {"%s_%d" % (s.lower(), i): a
+            for s, arrs in inputs.items() for i, a in enumerate(arrs)}
+
+
+class OpTest:
+    """Harness entry points (no subclassing needed)."""
+
+    @staticmethod
+    def check_output(op_type, inputs, attrs, expected, atol=1e-5, rtol=1e-5):
+        out_slots = {s: len(v) for s, v in expected.items()}
+        prog = _OpProgram(op_type, inputs, attrs, out_slots)
+        got = prog.run(_as_feed(inputs), prog.fetch)
+        for slot, arrs in expected.items():
+            for i, want in enumerate(arrs):
+                if want is None:
+                    continue
+                name = prog.out_names[(slot, i)]
+                np.testing.assert_allclose(
+                    np.asarray(got[name]), want, atol=atol, rtol=rtol,
+                    err_msg="%s output %s[%d]" % (op_type, slot, i))
+
+    @staticmethod
+    def check_grad(op_type, inputs, attrs, out_slots, wrt,
+                   float_outs=None, delta=1e-3, atol=1e-3, rtol=1e-2):
+        """Analytic grads (append_backward) vs central finite differences."""
+        feed = _as_feed(inputs)
+        probe = _OpProgram(op_type, inputs, attrs, out_slots)
+        pout = probe.run(feed, probe.fetch)
+        rng = np.random.RandomState(42)
+        weights = {}
+        for (slot, i), name in probe.out_names.items():
+            val = np.asarray(pout[name])
+            if not np.issubdtype(val.dtype, np.floating):
+                continue
+            if float_outs is not None and (slot, i) not in float_outs:
+                continue
+            weights[(slot, i)] = rng.uniform(0.1, 1.0, val.shape).astype("float32")
+
+    # build once with loss+grads; reuse for numeric probing (loss fetch only)
+        prog = _OpProgram(op_type, inputs, attrs, out_slots, loss_weights=weights)
+        wanted = [prog.feed_names[(s, i)] + "@GRAD"
+                  for (s, i) in prog.feed_names if s in wrt
+                  if prog.feed_names[(s, i)] + "@GRAD" in prog.grad_fetch]
+        analytic = prog.run(feed, wanted + [prog.loss_name])
+
+        def loss_of(fd):
+            return float(np.asarray(prog.run(fd, [prog.loss_name])[prog.loss_name]))
+
+        for (slot, i), fname in prog.feed_names.items():
+            if slot not in wrt:
+                continue
+            gname = fname + "@GRAD"
+            assert gname in analytic, "no grad produced for %s" % fname
+            # ensure in-place perturbation reaches the fed array (reshape(-1)
+            # on a non-contiguous array would silently copy)
+            arr = np.ascontiguousarray(feed[fname])
+            feed[fname] = arr
+            numeric = np.zeros(arr.shape, dtype=np.float64)
+            flat = arr.reshape(-1)
+            nflat = numeric.reshape(-1)
+            for j in range(flat.size):
+                orig = flat[j]
+                flat[j] = orig + delta
+                fp = loss_of(feed)
+                flat[j] = orig - delta
+                fm = loss_of(feed)
+                flat[j] = orig
+                nflat[j] = (fp - fm) / (2 * delta)
+            a = np.asarray(analytic[gname], dtype=np.float64)
+            # reference-style comparison (op_test.py __assert_is_close):
+            # |a - n| / max(|a|max, 1e-3) bounded, robust to fp32 fd noise
+            denom = max(np.abs(a).max(), np.abs(numeric).max(), 1e-3)
+            rel = np.abs(a - numeric) / denom
+            assert rel.max() < max(rtol, atol / denom), (
+                "%s grad wrt %s: max rel err %g\nanalytic=%s\nnumeric=%s"
+                % (op_type, fname, rel.max(), a, numeric))

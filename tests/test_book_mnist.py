@@ -1,0 +1,75 @@
+"""Book-test parity: MNIST recognize_digits training end-to-end.
+
+Analog of /root/reference/python/paddle/fluid/tests/book/
+test_recognize_digits.py — train MLP + conv models with the Executor,
+check accuracy target, then save/load inference model round-trip.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+from paddle_tpu.dataset import mnist
+
+
+def _mlp(img):
+    h = layers.fc(img, size=128, act="relu")
+    h = layers.fc(h, size=64, act="relu")
+    return layers.fc(h, size=10, act="softmax")
+
+
+def _convnet(img):
+    img2d = layers.reshape(img, [-1, 1, 28, 28])
+    c1 = nets.simple_img_conv_pool(img2d, num_filters=8, filter_size=5,
+                                   pool_size=2, pool_stride=2, act="relu")
+    c2 = nets.simple_img_conv_pool(c1, num_filters=16, filter_size=5,
+                                   pool_size=2, pool_stride=2, act="relu")
+    return layers.fc(c2, size=10, act="softmax")
+
+
+def _train(net_fn, steps=80, lr=0.01):
+    import paddle_tpu.reader as reader_mod
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [784])
+        label = layers.data("label", [1], dtype="int64")
+        probs = net_fn(img)
+        loss = layers.mean(layers.cross_entropy(probs, label))
+        acc = layers.accuracy(probs, label)
+        test_prog = main.clone(for_test=True)
+        opt = fluid.optimizer.Adam(learning_rate=lr)
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    feeder = fluid.DataFeeder([img, label])
+    train_reader = reader_mod.batch(mnist.train(n=64 * steps), 64)
+    for batch in train_reader():
+        exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+
+    accs = []
+    for batch in reader_mod.batch(mnist.test(n=512), 128)():
+        (a,) = exe.run(test_prog, feed=feeder.feed(batch), fetch_list=[acc])
+        accs.append(float(a))
+    return float(np.mean(accs)), main, test_prog, img, probs, exe
+
+
+def test_recognize_digits_mlp(fresh_programs, tmp_path):
+    final_acc, main, test_prog, img, probs, exe = _train(_mlp)
+    assert final_acc > 0.95, "mlp acc=%.3f" % final_acc
+
+    # save/load inference round-trip (reference book test does the same)
+    path = str(tmp_path / "mnist_model")
+    fluid.io.save_inference_model(path, ["img"], [probs], exe, test_prog)
+    infer_prog, feeds, fetches = fluid.io.load_inference_model(path, exe)
+    batch = np.random.RandomState(0).rand(4, 784).astype("float32")
+    (out,) = exe.run(infer_prog, feed={feeds[0]: batch}, fetch_list=fetches)
+    assert out.shape == (4, 10)
+    np.testing.assert_allclose(out.sum(1), np.ones(4), atol=1e-4)
+
+
+def test_recognize_digits_conv(fresh_programs):
+    final_acc = _train(_convnet, steps=60)[0]
+    assert final_acc > 0.95, "conv acc=%.3f" % final_acc
