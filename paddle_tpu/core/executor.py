@@ -120,66 +120,84 @@ class Executor:
         return (id(program), program.version, sig, tuple(fetch_names))
 
     def _prepare(self, program: Program, feed_vals, fetch_names, scope) -> _Plan:
-        block = program.global_block()
         feed_names = sorted(feed_vals)
-
-        produced = set(feed_names)
-        external: List[str] = []
-        needs_rng = False
-        for op in block.ops:
-            if not has_op(op.type):
-                raise KeyError("op %r has no registered lowering" % op.type)
-            if get_op(op.type).uses_rng:
-                needs_rng = True
-            for n in op.input_names():
-                if n not in produced and n not in external:
-                    external.append(n)
-            produced.update(op.output_names())
-
-        written = []
-        seen_w = set()
-        for op in block.ops:
-            for n in op.output_names():
-                if n in seen_w:
-                    continue
-                var = block.vars.get(n)
-                persist = (var is not None and var.persistable) or (
-                    var is None and scope.has_var(n)
-                )
-                if persist:
-                    written.append(n)
-                    seen_w.add(n)
-
-        for n in fetch_names:
-            if n not in produced and n not in external:
-                external.append(n)  # fetch straight from scope state
-
-        missing = [n for n in external if not scope.has_var(n)]
-        if missing:
-            raise RuntimeError(
-                "uninitialized variables %s: run the startup program first" % missing
-            )
-
-        mut_state = [n for n in external if n in seen_w]
-        const_state = [n for n in external if n not in seen_w]
-        pure_written = [n for n in written if n not in external]
-
-        def step(feeds, const_vals, mut_vals, rng):
-            env: Dict[str, Any] = {}
-            env.update(zip(const_state, const_vals))
-            env.update(zip(mut_state, mut_vals))
-            env.update(zip(feed_names, feeds))
-            ctx = LowerContext(block, rng)
-            lower_block(ctx, block, env)
-            fetches = [env[n] for n in fetch_names]
-            new_mut = [env[n] for n in mut_state]
-            new_pure = [env[n] for n in pure_written]
-            out_rng = ctx.final_rng() if ctx.rng_used else rng
-            return fetches, new_mut, new_pure, out_rng
-
+        (feed_names, fetch_names, const_state, mut_state, pure_written,
+         needs_rng, step) = analyze_block(program, feed_names, fetch_names, scope)
         fn = jax.jit(step, donate_argnums=(2,))
         return _Plan(feed_names, fetch_names, const_state, mut_state,
                      pure_written, needs_rng, fn)
+
+
+def analyze_block(program: Program, feed_names, fetch_names, scope):
+    """Classify block vars into feeds / read-only state / read-write state /
+    write-only persistables, and build the pure whole-block step function.
+    Shared by the single-device Executor and the mesh ParallelEngine — the
+    analog of Executor::Prepare (executor.cc:362) + the var-creation pass
+    (executor.cc:154), done once per (program, feed signature).
+
+    Returns (feed_names, fetch_names, const_state, mut_state, pure_written,
+    needs_rng, step) where step(feeds, const_vals, mut_vals, rng) ->
+    (fetches, new_mut, new_pure, new_rng) is jit-able.
+    """
+    block = program.global_block()
+    feed_names = sorted(feed_names)
+
+    produced = set(feed_names)
+    external: List[str] = []
+    needs_rng = False
+    for op in block.ops:
+        if not has_op(op.type):
+            raise KeyError("op %r has no registered lowering" % op.type)
+        if get_op(op.type).uses_rng:
+            needs_rng = True
+        for n in op.input_names():
+            if n not in produced and n not in external:
+                external.append(n)
+        produced.update(op.output_names())
+
+    written = []
+    seen_w = set()
+    for op in block.ops:
+        for n in op.output_names():
+            if n in seen_w:
+                continue
+            var = block.vars.get(n)
+            persist = (var is not None and var.persistable) or (
+                var is None and scope.has_var(n)
+            )
+            if persist:
+                written.append(n)
+                seen_w.add(n)
+
+    for n in fetch_names:
+        if n not in produced and n not in external:
+            external.append(n)  # fetch straight from scope state
+
+    missing = [n for n in external if not scope.has_var(n)]
+    if missing:
+        raise RuntimeError(
+            "uninitialized variables %s: run the startup program first" % missing
+        )
+
+    mut_state = [n for n in external if n in seen_w]
+    const_state = [n for n in external if n not in seen_w]
+    pure_written = [n for n in written if n not in external]
+
+    def step(feeds, const_vals, mut_vals, rng):
+        env: Dict[str, Any] = {}
+        env.update(zip(const_state, const_vals))
+        env.update(zip(mut_state, mut_vals))
+        env.update(zip(feed_names, feeds))
+        ctx = LowerContext(block, rng)
+        lower_block(ctx, block, env)
+        fetches = [env[n] for n in fetch_names]
+        new_mut = [env[n] for n in mut_state]
+        new_pure = [env[n] for n in pure_written]
+        out_rng = ctx.final_rng() if ctx.rng_used else rng
+        return fetches, new_mut, new_pure, out_rng
+
+    return (feed_names, fetch_names, const_state, mut_state, pure_written,
+            needs_rng, step)
 
 
 def _require(scope: Scope, name: str):

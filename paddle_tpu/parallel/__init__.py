@@ -1,0 +1,15 @@
+"""Mesh-parallel engine: the TPU-native ParallelExecutor.
+
+Reference analog: paddle/fluid/framework/parallel_executor.cc:184 and the
+details/ SSA-graph machinery (multi_devices_graph_pass.cc:515, all_reduce
+op handles over NCCL). Here parallelism is expressed as jax.sharding
+annotations over a device Mesh; XLA's SPMD partitioner inserts the ICI
+collectives (all-reduce/all-gather/reduce-scatter) that the reference
+hand-built as op handles (SURVEY §2.9).
+"""
+
+from .engine import ParallelEngine
+from .sharding import ShardingRules
+from .env import init_parallel_env, ParallelEnv
+
+__all__ = ["ParallelEngine", "ShardingRules", "init_parallel_env", "ParallelEnv"]

@@ -1,0 +1,69 @@
+"""Multi-host bootstrap: the gen_nccl_id / NCCL2-mode analog.
+
+Reference: DistributeTranspiler "nccl2" mode (distribute_transpiler.py:226)
+makes rank 0 create an ncclUniqueId and ship it over gRPC
+(gen_nccl_id_op.cc); NCCLContextMap then inits comms with
+nranks/rank (nccl_helper.h:129). The launcher contract is env vars
+(distributed/launch.py:40-80): PADDLE_TRAINER_ID, PADDLE_TRAINER_ENDPOINTS,
+PADDLE_CURRENT_ENDPOINT.
+
+TPU-native: the same env contract feeds jax.distributed.initialize — the
+coordinator at trainer 0's endpoint takes the place of the broadcasted
+ncclUniqueId; after init, jax.devices() spans all hosts and a global Mesh
+over ICI(+DCN) replaces the per-rank comm table.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+__all__ = ["ParallelEnv", "init_parallel_env"]
+
+
+class ParallelEnv:
+    """Parsed cluster description from the launcher env contract."""
+
+    def __init__(self, env: Optional[dict] = None):
+        e = env if env is not None else os.environ
+        self.trainer_id = int(e.get("PADDLE_TRAINER_ID", "0"))
+        eps = e.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints: List[str] = [x for x in eps.split(",") if x]
+        self.current_endpoint = e.get(
+            "PADDLE_CURRENT_ENDPOINT",
+            self.trainer_endpoints[self.trainer_id]
+            if self.trainer_id < len(self.trainer_endpoints) else "",
+        )
+        self.nranks = max(len(self.trainer_endpoints), 1)
+
+    @property
+    def rank(self) -> int:
+        return self.trainer_id
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+
+_initialized = False
+
+
+def init_parallel_env(env: Optional[ParallelEnv] = None) -> ParallelEnv:
+    """Initialize the multi-host runtime. Single-host is a no-op (the local
+    mesh is already visible); multi-host connects every process to the
+    trainer-0 coordinator so jax.devices() becomes global."""
+    global _initialized
+    penv = env or ParallelEnv()
+    if _initialized or penv.nranks <= 1:
+        _initialized = True
+        return penv
+    import jax
+
+    coordinator = penv.trainer_endpoints[0]
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=penv.nranks,
+        process_id=penv.trainer_id,
+    )
+    _initialized = True
+    return penv

@@ -1,0 +1,71 @@
+"""Sharding rules: var-name pattern -> PartitionSpec over the mesh.
+
+This is the TPU-native replacement for the reference's graph-builder pass
+(details/multi_devices_graph_pass.cc): instead of rewriting the graph with
+broadcast/all-reduce op handles per variable, each variable gets a
+PartitionSpec annotation and XLA's SPMD partitioner derives the collective
+schedule. Rules are (regex, spec) pairs matched in order; unmatched vars
+are replicated (the data-parallel default, = BCastParamsToDevices at
+parallel_executor.cc:355 without the explicit ncclBcast).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingRules", "P"]
+
+
+class ShardingRules:
+    def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None,
+                 data_axis: str = "data"):
+        self.rules: List[Tuple[re.Pattern, P]] = [
+            (re.compile(pat), spec) for pat, spec in (rules or [])
+        ]
+        self.data_axis = data_axis
+
+    def add(self, pattern: str, spec: P) -> "ShardingRules":
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, shape, mesh: Mesh) -> P:
+        """Spec for a state var. Falls back to replicated when no rule
+        matches or the matched spec doesn't divide the shape."""
+        for pat, spec in self.rules:
+            if pat.search(name):
+                if _divides(spec, shape, mesh):
+                    return spec
+                break
+        return P()
+
+    def feed_spec(self, shape, mesh: Mesh) -> P:
+        """Batch-shard feeds on dim 0 (FeedAndSplitTensorIntoLocalScopes
+        analog, parallel_executor.cc:468): the user feeds the global batch
+        and it is split across the data axis of the mesh."""
+        if self.data_axis not in mesh.axis_names:
+            return P()
+        n = mesh.shape[self.data_axis]
+        if len(shape) >= 1 and shape[0] % n == 0 and shape[0] > 0:
+            return P(self.data_axis)
+        return P()
+
+    def sharding(self, name: str, shape, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.spec_for(name, shape, mesh))
+
+
+def _divides(spec: P, shape, mesh: Mesh) -> bool:
+    if shape is None:
+        return False
+    for dim, axes in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axes is None:
+            continue
+        axes = axes if isinstance(axes, tuple) else (axes,)
+        k = 1
+        for a in axes:
+            k *= mesh.shape[a]
+        if dim % k != 0:
+            return False
+    return True
