@@ -1,0 +1,62 @@
+"""Headline benchmark: Transformer-base training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference prints examples/sec from benchmark/fluid/fluid_benchmark.py
+(print_train_time, :296-301) with no committed numbers (BASELINE.md), so
+vs_baseline is reported against the self-measured target of 1.0.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    seq_len = 128
+    batch = 32
+    cfg = transformer.base_config()
+    cfg["max_length"] = seq_len
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        loss, feeds = transformer.build(cfg, seq_len=seq_len)
+        opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    rs = np.random.RandomState(0)
+    feed = {
+        "src_ids": rs.randint(1, cfg["src_vocab"], (batch, seq_len)).astype("int64"),
+        "trg_ids": rs.randint(1, cfg["trg_vocab"], (batch, seq_len)).astype("int64"),
+        "lbl_ids": rs.randint(1, cfg["trg_vocab"], (batch, seq_len)).astype("int64"),
+    }
+
+    # warmup: first call compiles the whole train step to one XLA executable
+    for _ in range(3):
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        vals = exe.run(main_prog, feed=feed, fetch_list=[loss])
+    float(vals[0])  # block on the result
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq_len * steps / dt
+    print(json.dumps({
+        "metric": "transformer_base_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -145,23 +145,62 @@ def analyze_block(program: Program, feed_names, fetch_names, scope):
     produced = set(feed_names)
     external: List[str] = []
     needs_rng = False
-    for op in block.ops:
+
+    def op_effects(op):
+        """(reads, writes) of one op, recursing into control-flow
+        sub-blocks (while_op/conditional_block carry their body's
+        reads/writes — the analog of while_op.cc's input/output lists)."""
+        reads = list(op.input_names())
+        writes = list(op.output_names())
+        if "sub_block" in op.attrs:
+            sub = program.block(op.attrs["sub_block"])
+            sub_produced = set()
+            for sop in sub.ops:
+                r, w = op_effects(sop)
+                reads.extend(n for n in r if n not in sub_produced)
+                writes.extend(w)
+                sub_produced.update(w)
+            cond = op.attrs.get("condition")
+            if cond:
+                reads.append(cond)
+        return reads, writes
+
+    def op_uses_rng(op):
+        if get_op(op.type).uses_rng:
+            return True
+        if "sub_block" in op.attrs:
+            return any(op_uses_rng(s) for s in
+                       program.block(op.attrs["sub_block"]).ops)
+        return False
+
+    all_blocks_ops = [(block, op) for op in block.ops]
+    for blk, op in all_blocks_ops:
         if not has_op(op.type):
             raise KeyError("op %r has no registered lowering" % op.type)
-        if get_op(op.type).uses_rng:
+        if op_uses_rng(op):
             needs_rng = True
-        for n in op.input_names():
+        reads, writes = op_effects(op)
+        for n in reads:
             if n not in produced and n not in external:
                 external.append(n)
-        produced.update(op.output_names())
+        produced.update(writes)
+
+    def _find_var(name):
+        v = block.vars.get(name)
+        if v is not None:
+            return v
+        for b in program.blocks:
+            if name in b.vars:
+                return b.vars[name]
+        return None
 
     written = []
     seen_w = set()
-    for op in block.ops:
-        for n in op.output_names():
+    for blk, op in all_blocks_ops:
+        for n in op_effects(op)[1]:
             if n in seen_w:
                 continue
-            var = block.vars.get(n)
+            var = _find_var(n)
             persist = (var is not None and var.persistable) or (
                 var is None and scope.has_var(n)
             )

@@ -60,7 +60,14 @@ def lower_op(ctx: LowerContext, op, env: Dict[str, Any]) -> None:
     ins: Dict[str, List[Any]] = {}
     for slot, names in op.inputs.items():
         ins[slot] = [env[n] if n else None for n in names]
-    outs = opdef.lowering(ctx, ins, op.attrs)
+    attrs = op.attrs
+    if opdef.needs_env:
+        attrs = dict(op.attrs)
+        attrs["__env__"] = env
+    outs = opdef.lowering(ctx, ins, attrs)
+    upd = outs.pop("__env_update__", None) if isinstance(outs, dict) else None
+    if upd:
+        env.update(upd)
     for slot, names in op.outputs.items():
         vals = outs.get(slot)
         if vals is None:
@@ -80,9 +87,9 @@ def lower_block(ctx: LowerContext, block: Block, env: Dict[str, Any]) -> None:
         try:
             lower_op(ctx, op, env)
         except Exception as e:
-            raise type(e)(
-                "while lowering op %r (inputs=%s outputs=%s): %s"
-                % (op.type, op.inputs, op.outputs, e)
+            raise RuntimeError(
+                "while lowering op %r (inputs=%s outputs=%s): %s: %s"
+                % (op.type, op.inputs, op.outputs, type(e).__name__, e)
             ) from e
 
 

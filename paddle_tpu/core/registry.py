@@ -37,6 +37,7 @@ class OpDef:
         diff_inputs: Optional[List[str]] = None,
         uses_rng: bool = False,
         infer_shape: Optional[Callable] = None,
+        needs_env: bool = False,
     ):
         self.type = type
         self.lowering = lowering
@@ -47,6 +48,9 @@ class OpDef:
         self.diff_inputs = diff_inputs
         self.uses_rng = uses_rng
         self.infer_shape = infer_shape
+        # control-flow ops get the live lowering env injected as
+        # attrs["__env__"] and may return {"__env_update__": {...}}
+        self.needs_env = needs_env
 
 
 OPS: Dict[str, OpDef] = {}
@@ -61,6 +65,7 @@ def register_op(
     diff_inputs: Optional[List[str]] = None,
     uses_rng: bool = False,
     infer_shape=None,
+    needs_env: bool = False,
 ):
     """Decorator: @register_op("softmax") def _softmax(ctx, ins, attrs): ..."""
 
@@ -76,6 +81,7 @@ def register_op(
             diff_inputs=diff_inputs,
             uses_rng=uses_rng,
             infer_shape=infer_shape,
+            needs_env=needs_env,
         )
         return fn
 

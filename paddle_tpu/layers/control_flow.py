@@ -1,15 +1,24 @@
 """Control-flow layers (reference: python/paddle/fluid/layers/control_flow.py).
 
-While/StaticRNN lower to XLA While via lax.scan-style sub-block lowering;
-round-1 ships increment/array-free basics, the loop constructs land with the
-sequence/RNN milestone.
+The reference's While (control_flow.py While class) builds a sub-block that
+a nested C++ Executor interprets per iteration (operators/controlflow/
+while_op.cc). Here the sub-block lowers into the body of one XLA While
+(ops/control_flow_ops.py) — compiled once, no per-iteration host work.
+
+Semantics note (TPU/XLA static-shape contract): any variable that must be
+visible AFTER the loop has to exist BEFORE it (created with fill_constant/
+assign in the parent block); loop-local temporaries stay local. The
+reference has the same requirement, enforced through its scope chain.
 """
 
 from __future__ import annotations
 
-from ..layer_helper import LayerHelper
+import contextlib
 
-__all__ = ["increment"]
+from ..layer_helper import LayerHelper
+from .tensor import assign, fill_constant
+
+__all__ = ["increment", "While", "Switch", "cond", "while_loop"]
 
 
 def increment(x, value=1.0, in_place=True):
@@ -22,3 +31,121 @@ def increment(x, value=1.0, in_place=True):
                      attrs={"step": float(value)})
     out.shape = x.shape
     return out
+
+
+class While:
+    """reference control_flow.py While:
+
+        cond = layers.less_than(i, n)
+        w = While(cond)
+        with w.block():
+            ...body layers...
+            layers.increment(i)
+            layers.assign(layers.less_than(i, n), cond)
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+
+    @contextlib.contextmanager
+    def block(self):
+        prog = self.helper.main_program
+        parent = prog.current_block()
+        sub = prog.create_block()
+        yield
+        prog.rollback()
+        parent.append_op(
+            type="while",
+            inputs={"Condition": [self.cond_var]},
+            outputs={},
+            attrs={"sub_block": sub.idx, "condition": self.cond_var.name,
+                   "is_test": False},
+        )
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """Functional wrapper (the later paddle.static.nn.while_loop shape):
+    loop_vars are pre-created variables mutated in body_fn via assign."""
+    c = cond_fn(*loop_vars)
+    w = While(c)
+    with w.block():
+        new_vars = body_fn(*loop_vars)
+        if new_vars is not None:
+            if not isinstance(new_vars, (list, tuple)):
+                new_vars = [new_vars]
+            for old, new in zip(loop_vars, new_vars):
+                if new is not old:
+                    assign(new, out=old)
+        assign(cond_fn(*loop_vars), out=c)
+    return loop_vars
+
+
+def cond(pred, true_fn=None, false_fn=None):
+    """Two-branch conditional. Both branches must write the same output
+    variables (assign into pre-created vars); lowers to XLA Conditional.
+    reference analog: conditional_block_op.cc + layers.cond."""
+    helper = LayerHelper("conditional_block")
+    prog = helper.main_program
+    out_true = out_false = None
+    if true_fn is not None:
+        parent = prog.current_block()
+        sub = prog.create_block()
+        out_true = true_fn()
+        prog.rollback()
+        parent.append_op(type="conditional_block",
+                         inputs={"Cond": [pred]}, outputs={},
+                         attrs={"sub_block": sub.idx})
+    if false_fn is not None:
+        import paddle_tpu.layers as L
+
+        not_pred = L.logical_not(pred)
+        parent = prog.current_block()
+        sub = prog.create_block()
+        out_false = false_fn()
+        prog.rollback()
+        parent.append_op(type="conditional_block",
+                         inputs={"Cond": [not_pred]}, outputs={},
+                         attrs={"sub_block": sub.idx})
+    return out_true if out_true is not None else out_false
+
+
+class Switch:
+    """reference control_flow.py Switch — sequential case chain of
+    conditional blocks (used for learning-rate schedules)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._taken = None  # float [1] flag: 1.0 once a case has fired
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        import paddle_tpu.layers as L
+
+        if self._taken is None:
+            self._taken = fill_constant([1], "float32", 0.0)
+        not_taken = L.less_than(self._taken, fill_constant([1], "float32", 0.5))
+        fire = L.logical_and(L.cast(condition, "bool"), not_taken)
+        prog = self.helper.main_program
+        parent = prog.current_block()
+        sub = prog.create_block()
+        yield
+        assign(fill_constant([1], "float32", 1.0), out=self._taken)
+        prog.rollback()
+        parent.append_op(type="conditional_block",
+                         inputs={"Cond": [fire]}, outputs={},
+                         attrs={"sub_block": sub.idx})
+
+    @contextlib.contextmanager
+    def default(self):
+        import paddle_tpu.layers as L
+
+        not_taken = L.less_than(self._taken, fill_constant([1], "float32", 0.5))
+        prog = self.helper.main_program
+        parent = prog.current_block()
+        sub = prog.create_block()
+        yield
+        prog.rollback()
+        parent.append_op(type="conditional_block",
+                         inputs={"Cond": [not_taken]}, outputs={},
+                         attrs={"sub_block": sub.idx})

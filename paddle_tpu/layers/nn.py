@@ -21,6 +21,8 @@ from .tensor import cast, concat, fill_constant  # re-exported via layers
 __all__ = [
     "fc",
     "embedding",
+    "label_smooth",
+    "fused_attention",
     "conv2d",
     "conv2d_transpose",
     "conv3d",
@@ -489,7 +491,8 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
         outputs={"Y": [out]},
         attrs={"soft_label": soft_label, "ignore_index": ignore_index},
     )
-    out.shape = tuple(input.shape[:-1]) + (1,)
+    if input.shape is not None:
+        out.shape = tuple(input.shape[:-1]) + (1,)
     return out
 
 
@@ -602,8 +605,9 @@ def topk(input, k, name=None):
     ids = helper.create_variable_for_type_inference("int64", stop_gradient=True)
     helper.append_op(type="top_k", inputs={"X": [input]},
                      outputs={"Out": [vals], "Indices": [ids]}, attrs={"k": k})
-    vals.shape = tuple(input.shape[:-1]) + (k,)
-    ids.shape = vals.shape
+    if input.shape is not None:
+        vals.shape = tuple(input.shape[:-1]) + (k,)
+        ids.shape = vals.shape
     return vals, ids
 
 
@@ -1010,8 +1014,28 @@ def _elementwise(op_type, x, y, axis=-1, act=None, name=None):
     out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]}, attrs={"axis": axis})
-    out.shape = x.shape
+    out.shape = _broadcast_shape(x.shape, getattr(y, "shape", None))
     return helper.append_activation(out)
+
+
+def _broadcast_shape(xs, ys):
+    """numpy-style broadcast of two build-time shapes (-1 = unknown dim)."""
+    if xs is None or ys is None:
+        return xs if ys is None else (ys if xs is None else None)
+    n = max(len(xs), len(ys))
+    xs = (1,) * (n - len(xs)) + tuple(xs)
+    ys = (1,) * (n - len(ys)) + tuple(ys)
+    out = []
+    for a, b in zip(xs, ys):
+        if a == 1:
+            out.append(b)
+        elif b == 1 or a == b:
+            out.append(a)
+        elif a == -1 or b == -1:
+            out.append(-1)
+        else:
+            out.append(max(a, b))
+    return tuple(out)
 
 
 def elementwise_add(x, y, axis=-1, act=None, name=None):
@@ -1178,3 +1202,32 @@ def math_op(x, other, op_type, reverse=False):
                    "equal", "not_equal"):
         return _compare(op_type, a, b)
     return _elementwise(op_type, a, b)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None):
+    """reference layers/nn.py label_smooth -> label_smooth_op.cc."""
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    helper.append_op(type="label_smooth", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"epsilon": float(epsilon)})
+    out.shape = label.shape
+    return out
+
+
+def fused_attention(q, k, v, bias=None, scale=1.0, dropout=0.0, name=None):
+    """Single-kernel scaled-dot-product attention over [B,H,S,D] tensors
+    (Pallas flash kernel; see ops/attention.py). The reference composes
+    this from matmul+softmax layer calls — SURVEY §5."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op(type="fused_attention", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"scale": float(scale), "dropout": float(dropout)})
+    out.shape = q.shape
+    return out

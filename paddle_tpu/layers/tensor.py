@@ -226,8 +226,16 @@ def range(start, end, step, dtype="float32"):
     e = fill_constant([1], dtype, end) if not isinstance(end, Variable) else end
     st = fill_constant([1], dtype, step) if not isinstance(step, Variable) else step
     out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    attrs = {}
+    # static bounds recorded for the lowering: XLA needs the output shape
+    # at trace time (SURVEY §7 "static shapes")
+    if not any(isinstance(v, Variable) for v in (start, end, step)):
+        attrs = {"static_start": float(start), "static_end": float(end),
+                 "static_step": float(step), "dtype": dtype}
+        n = max(0, -(-int(float(end) - float(start)) // int(float(step))))
+        out.shape = (n,)
     helper.append_op(type="range", inputs={"Start": [s], "End": [e], "Step": [st]},
-                     outputs={"Out": [out]})
+                     outputs={"Out": [out]}, attrs=attrs)
     return out
 
 

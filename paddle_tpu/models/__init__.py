@@ -1,0 +1,13 @@
+"""Model zoo: the BASELINE workload set, built on the paddle_tpu layer API.
+
+Mirrors /root/reference/benchmark/fluid/models/ (mnist, resnet, vgg,
+machine_translation) plus the distributed-test models
+(unittests/dist_transformer.py, dist_ctr.py) and the BASELINE.json
+workloads (BERT-base MLM, DeepFM/Wide&Deep). Every model is a pure
+program-builder: call inside a fluid.program_guard and it appends ops to
+the current main/startup programs, returning the loss/feed variables.
+"""
+
+from . import mnist, resnet, vgg, transformer, bert, ctr
+
+__all__ = ["mnist", "resnet", "vgg", "transformer", "bert", "ctr"]

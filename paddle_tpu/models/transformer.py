@@ -1,0 +1,200 @@
+"""Transformer-base for WMT-style seq2seq.
+
+Reference: the fluid transformer model used by the distributed tests and
+benchmarks (/root/reference/python/paddle/fluid/tests/unittests/
+dist_transformer.py; benchmark/fluid/models/machine_translation.py is the
+older RNN seq2seq). The reference composes attention from matmul/softmax/
+elementwise layer calls (SURVEY §5 — no fused attention op); here the same
+layer-level composition is used, and XLA fuses the QK^T->softmax->V chain.
+Pallas flash attention is available as a drop-in via use_fused_attention.
+
+TPU-first choices vs the reference:
+  - fixed max_length padding + in-graph masks instead of LoD ragged batches
+  - pre-norm residual blocks (stable without warmup games)
+  - sinusoid position table baked as a frozen parameter
+"""
+
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+from ..initializer import NumpyArrayInitializer
+
+__all__ = ["encoder", "decoder", "build", "base_config"]
+
+
+def base_config():
+    """Transformer-base (Vaswani et al.): the dist_transformer config."""
+    return dict(d_model=512, d_ff=2048, n_head=8, n_layer=6,
+                src_vocab=30000, trg_vocab=30000, max_length=256,
+                dropout=0.1)
+
+
+def _position_table(max_length, d_model):
+    pos = np.arange(max_length)[:, None].astype("float64")
+    inv = 1.0 / np.power(10000.0, np.arange(0, d_model, 2) / d_model)
+    tab = np.zeros((max_length, d_model), dtype="float32")
+    tab[:, 0::2] = np.sin(pos * inv)
+    tab[:, 1::2] = np.cos(pos * inv)
+    return tab
+
+
+def _embed(ids, vocab, d_model, max_length, dropout, is_test, name):
+    """token embedding * sqrt(d) + sinusoid position embedding."""
+    emb = layers.embedding(
+        ids, size=[vocab, d_model],
+        param_attr=ParamAttr(name=name + "_word_emb"))
+    emb = layers.scale(emb, scale=d_model ** 0.5)
+    seq_len = ids.shape[1]
+    pos_tab = _position_table(max_length, d_model)[:seq_len]
+    pos = layers.create_parameter(
+        [seq_len, d_model], "float32", name=name + "_pos_enc",
+        default_initializer=NumpyArrayInitializer(pos_tab))
+    pos.stop_gradient = True
+    out = layers.elementwise_add(emb, pos)
+    if dropout:
+        out = layers.dropout(out, dropout, is_test=is_test)
+    return out
+
+
+def _split_heads(x, seq_len, n_head, d_head):
+    x = layers.reshape(x, [-1, seq_len, n_head, d_head])
+    return layers.transpose(x, perm=[0, 2, 1, 3])
+
+
+def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
+                         is_test, name, use_fused_attention=False):
+    d_head = d_model // n_head
+    seq_q = q_in.shape[1]
+    seq_kv = kv_in.shape[1]
+    q = layers.fc(q_in, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=ParamAttr(name=name + "_q.w_0"))
+    k = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=ParamAttr(name=name + "_k.w_0"))
+    v = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=ParamAttr(name=name + "_v.w_0"))
+    q = _split_heads(q, seq_q, n_head, d_head)
+    k = _split_heads(k, seq_kv, n_head, d_head)
+    v = _split_heads(v, seq_kv, n_head, d_head)
+    if use_fused_attention:
+        ctxv = layers.fused_attention(q, k, v, bias, scale=d_head ** -0.5,
+                                      dropout=dropout if not is_test else 0.0)
+    else:
+        scores = layers.matmul(q, k, transpose_y=True, alpha=d_head ** -0.5)
+        if bias is not None:
+            scores = layers.elementwise_add(scores, bias)
+        weights = layers.softmax(scores)
+        if dropout:
+            weights = layers.dropout(weights, dropout, is_test=is_test)
+        ctxv = layers.matmul(weights, v)
+    ctxv = layers.transpose(ctxv, perm=[0, 2, 1, 3])
+    ctxv = layers.reshape(ctxv, [-1, seq_q, d_model])
+    return layers.fc(ctxv, d_model, num_flatten_dims=2, bias_attr=False,
+                     param_attr=ParamAttr(name=name + "_o.w_0"))
+
+
+def _ffn(x, d_model, d_ff, name):
+    h = layers.fc(x, d_ff, num_flatten_dims=2, act="relu",
+                  param_attr=ParamAttr(name=name + "_ffn1.w_0"))
+    return layers.fc(h, d_model, num_flatten_dims=2,
+                     param_attr=ParamAttr(name=name + "_ffn2.w_0"))
+
+
+def _prenorm(x, sub_fn, dropout, is_test, name):
+    h = layers.layer_norm(x, begin_norm_axis=2, param_attr=ParamAttr(name=name + "_ln_s"),
+                          bias_attr=ParamAttr(name=name + "_ln_b"))
+    h = sub_fn(h)
+    if dropout:
+        h = layers.dropout(h, dropout, is_test=is_test)
+    return layers.elementwise_add(x, h)
+
+
+def encoder(src_emb, self_bias, cfg, is_test=False, use_fused_attention=False):
+    x = src_emb
+    for i in range(cfg["n_layer"]):
+        nm = "enc_%d" % i
+        x = _prenorm(x, lambda h, nm=nm: multi_head_attention(
+            h, h, self_bias, cfg["d_model"], cfg["n_head"], cfg["dropout"],
+            is_test, nm + "_att", use_fused_attention),
+            cfg["dropout"], is_test, nm + "_pre1")
+        x = _prenorm(x, lambda h, nm=nm: _ffn(h, cfg["d_model"], cfg["d_ff"], nm),
+                     cfg["dropout"], is_test, nm + "_pre2")
+    return layers.layer_norm(x, begin_norm_axis=2)
+
+
+def decoder(trg_emb, enc_out, self_bias, cross_bias, cfg, is_test=False,
+            use_fused_attention=False):
+    x = trg_emb
+    for i in range(cfg["n_layer"]):
+        nm = "dec_%d" % i
+        x = _prenorm(x, lambda h, nm=nm: multi_head_attention(
+            h, h, self_bias, cfg["d_model"], cfg["n_head"], cfg["dropout"],
+            is_test, nm + "_satt", use_fused_attention),
+            cfg["dropout"], is_test, nm + "_pre1")
+        x = _prenorm(x, lambda h, nm=nm: multi_head_attention(
+            h, enc_out, cross_bias, cfg["d_model"], cfg["n_head"],
+            cfg["dropout"], is_test, nm + "_xatt", use_fused_attention),
+            cfg["dropout"], is_test, nm + "_pre2")
+        x = _prenorm(x, lambda h, nm=nm: _ffn(h, cfg["d_model"], cfg["d_ff"], nm),
+                     cfg["dropout"], is_test, nm + "_pre3")
+    return layers.layer_norm(x, begin_norm_axis=2)
+
+
+def _pad_bias(ids, pad_idx=0):
+    """[B,S] ids -> [B,1,1,S] additive attention bias (-1e9 at pads)."""
+    pad = layers.fill_constant([1], "int64", pad_idx)
+    mask = layers.cast(layers.equal(ids, pad), "float32")
+    bias = layers.scale(mask, scale=-1e9)
+    return layers.unsqueeze(layers.unsqueeze(bias, [1]), [1])
+
+
+def _causal_bias(seq_len):
+    """[1,1,S,S] additive bias: -1e9 above the diagonal."""
+    r = layers.range(0, seq_len, 1, "int64")
+    row = layers.unsqueeze(r, [1])           # [S,1] query index i
+    col = layers.unsqueeze(r, [0])           # [1,S] key index j
+    allowed = layers.cast(layers.less_equal(col, row), "float32")
+    bias = layers.scale(layers.elementwise_sub(
+        layers.fill_constant([1], "float32", 1.0), allowed), scale=-1e9)
+    return layers.unsqueeze(layers.unsqueeze(bias, [0]), [0])
+
+
+def build(cfg=None, seq_len=64, is_test=False, label_smooth_eps=0.1,
+          use_fused_attention=False):
+    """Full training graph. Returns (avg_cost, feeds)."""
+    cfg = cfg or base_config()
+    src = layers.data("src_ids", [seq_len], dtype="int64")
+    trg = layers.data("trg_ids", [seq_len], dtype="int64")
+    lbl = layers.data("lbl_ids", [seq_len], dtype="int64")
+
+    src_bias = _pad_bias(src)
+    trg_bias = layers.elementwise_add(_pad_bias(trg), _causal_bias(seq_len))
+
+    src_emb = _embed(src, cfg["src_vocab"], cfg["d_model"], cfg["max_length"],
+                     cfg["dropout"], is_test, "src")
+    trg_emb = _embed(trg, cfg["trg_vocab"], cfg["d_model"], cfg["max_length"],
+                     cfg["dropout"], is_test, "trg")
+
+    enc_out = encoder(src_emb, src_bias, cfg, is_test, use_fused_attention)
+    dec_out = decoder(trg_emb, enc_out, trg_bias, src_bias, cfg, is_test,
+                      use_fused_attention)
+
+    logits = layers.fc(dec_out, cfg["trg_vocab"], num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=ParamAttr(name="out_proj.w_0"))
+    if label_smooth_eps:
+        soft = layers.label_smooth(
+            layers.one_hot(layers.reshape(lbl, [-1, seq_len, 1]),
+                           cfg["trg_vocab"]),
+            epsilon=label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(logits, soft, soft_label=True)
+    else:
+        cost = layers.softmax_with_cross_entropy(
+            logits, layers.reshape(lbl, [-1, seq_len, 1]))
+    # mask pad positions out of the loss, normalize by real token count
+    pad = layers.fill_constant([1], "int64", 0)
+    nonpad = layers.cast(layers.not_equal(lbl, pad), "float32")
+    cost = layers.elementwise_mul(layers.reshape(cost, [-1, seq_len]), nonpad)
+    avg_cost = layers.elementwise_div(
+        layers.reduce_sum(cost), layers.reduce_sum(nonpad))
+    return avg_cost, [src, trg, lbl]

@@ -7,10 +7,13 @@ operators/ directory layout (SURVEY §2.2).
 
 from . import (  # noqa: F401
     activations,
+    attention,
     basic,
+    control_flow_ops,
     math,
     metrics,
     nn,
+    rnn,
     optimizer_ops,
     sequence,
     tensor_ops,

@@ -1,0 +1,148 @@
+"""Fused attention: Pallas TPU kernel + custom VJP.
+
+The reference has NO fused attention op — attention is composed from
+matmul/softmax/elementwise layer calls (SURVEY §5, e.g.
+/root/reference/python/paddle/fluid/tests/unittests/dist_transformer.py).
+This op is the TPU-first upgrade slot: the forward is one Pallas kernel
+(scores never round-trip to HBM; softmax runs in VMEM against the MXU
+matmuls), the backward recomputes scores under XLA (flash-style
+rematerialisation — trades FLOPs for HBM, SURVEY §7 hard-parts list).
+
+Layout: q,k,v [B, H, S, D]; bias broadcastable [B|1, H|1, Sq|1, Sk],
+additive (-1e9 at masked positions). On non-TPU backends the kernel runs
+in interpret mode (tests) so numerics match the TPU path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..core.registry import register_op
+
+__all__ = ["flash_attention"]
+
+_BQ = 256  # query block rows per kernel instance
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale, have_bias):
+    q = q_ref[0]                      # [bq, D]
+    k = k_ref[0]                      # [S, D]
+    v = v_ref[0]                      # [S, D]
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                         # [bq, S]
+    if have_bias:
+        b = b_ref[0, 0]               # [bq|1, S]
+        s = s + b.astype(jnp.float32)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.dot(p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+
+
+def _attention_reference(q, k, v, bias, scale):
+    """Plain-XLA attention used for the recompute backward (and as the
+    numeric contract for the kernel)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def _forward_pallas(q, k, v, bias, scale):
+    B, H, S, D = q.shape
+    bq = min(_BQ, S)
+    if S % bq != 0:
+        bq = S
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    grid = (B * H, S // bq)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, iq: (bh, iq, 0)),
+        pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
+        pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
+    ]
+    operands = [qf, kf, vf]
+    have_bias = bias is not None
+    if have_bias:
+        Bb, Hb, Sqb, Skb = bias.shape
+        bias_bq = bq if Sqb > 1 else 1
+
+        def bias_map(bh, iq, Bb=Bb, Hb=Hb, Sqb=Sqb, H=H):
+            b = (bh // H) if Bb > 1 else 0
+            h = (bh % H) if Hb > 1 else 0
+            return (b, h, iq if Sqb > 1 else 0, 0)
+
+        in_specs.append(pl.BlockSpec((1, 1, bias_bq, Skb), bias_map))
+        operands.append(bias.reshape(Bb, Hb, Sqb, Skb))
+
+    kern = functools.partial(_attn_kernel, scale=scale, have_bias=have_bias)
+    if not have_bias:
+        kern = lambda q_ref, k_ref, v_ref, o_ref: _attn_kernel(  # noqa: E731
+            q_ref, k_ref, v_ref, None, o_ref, scale=scale, have_bias=False)
+
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(*operands)
+    return out.reshape(B, H, S, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def flash_attention(q, k, v, bias, scale):
+    return _forward_pallas(q, k, v, bias, scale)
+
+
+def _fa_fwd(q, k, v, bias, scale):
+    return _forward_pallas(q, k, v, bias, scale), (q, k, v, bias)
+
+
+def _fa_bwd(scale, res, g):
+    q, k, v, bias = res
+    # recompute-based backward: vjp of the XLA reference (scores live only
+    # inside this fused backward computation)
+    def f(q, k, v, bias):
+        return _attention_reference(q, k, v, bias, scale)
+
+    if bias is None:
+        _, vjp = jax.vjp(lambda a, b, c: f(a, b, c, None), q, k, v)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, None
+    _, vjp = jax.vjp(f, q, k, v, bias)
+    dq, dk, dv, db = vjp(g)
+    return dq, dk, dv, db
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@register_op("fused_attention", diff_inputs=["Q", "K", "V"], uses_rng=True)
+def _fused_attention(ctx, ins, attrs):
+    q = ins["Q"][0]
+    k = ins["K"][0]
+    v = ins["V"][0]
+    bias = (ins.get("Bias") or [None])[0]
+    scale = attrs.get("scale", 1.0)
+    dropout = attrs.get("dropout", 0.0)
+    out = flash_attention(q, k, v, bias, scale)
+    if dropout:
+        # dropout on the *output* (weights-dropout does not commute with the
+        # fused kernel; divergence from the layer-composed path documented)
+        keep = 1.0 - dropout
+        mask = jax.random.bernoulli(ctx.next_rng(), keep, out.shape)
+        out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
+    return {"Out": [out]}

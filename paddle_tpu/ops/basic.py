@@ -139,9 +139,16 @@ def _increment(ctx, ins, attrs):
 
 @register_op("range", no_grad=True)
 def _range(ctx, ins, attrs):
+    # static-shape contract: bounds must be trace-time constants on TPU;
+    # the layer records them in attrs (tensor inputs only kept for
+    # desc-level parity with range_op.cc)
+    if "static_start" in attrs:
+        return {"Out": [jnp.arange(attrs["static_start"], attrs["static_end"],
+                                   attrs["static_step"]).astype(_dt(attrs))]}
     start, end, step = ins["Start"][0], ins["End"][0], ins["Step"][0]
-    # static-shape contract: bounds must be trace-time constants on TPU
-    return {"Out": [jnp.arange(float(start), float(end), float(step))]}
+    s, e, st = (float(jnp.asarray(v).reshape(())) for v in (start, end, step))
+    dt = start.dtype if hasattr(start, "dtype") else _dt(attrs)
+    return {"Out": [jnp.arange(s, e, st).astype(dt)]}
 
 
 @register_op("clip")

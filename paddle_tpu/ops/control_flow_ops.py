@@ -1,0 +1,105 @@
+"""Control-flow op lowerings: while -> lax.while_loop, conditional_block ->
+lax.cond.
+
+Reference analogs: operators/controlflow/while_op.cc (runs its sub-block
+via a nested Executor per iteration) and conditional_block_op.cc. Here the
+sub-block is *lowered into the loop body* so the whole loop compiles to a
+single XLA While/Conditional — no per-iteration interpreter, static
+shapes for every carried value (SURVEY §7 "compiler-friendly control
+flow").
+
+Carried state = every parent-env var the sub-block writes (+ the RNG key
+when the body draws randomness). Parent vars only read are closed over as
+trace constants.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _sub_block(ctx, attrs):
+    idx = attrs["sub_block"]
+    return ctx.block.program.block(idx)
+
+
+def _written_carry(block, env) -> List[str]:
+    names = []
+    for op in block.ops:
+        for n in op.output_names():
+            if n in env and n not in names:
+                names.append(n)
+    return names
+
+
+@register_op("while", no_grad=True, uses_rng=True, needs_env=True)
+def _while(ctx, ins, attrs):
+    from ..core.lowering import lower_block
+
+    block = _sub_block(ctx, attrs)
+    cond_name = attrs["condition"]
+    env = attrs["__env__"]  # injected by lower_op for block ops
+    carry_names = _written_carry(block, env)
+    if cond_name not in carry_names:
+        carry_names.append(cond_name)
+    use_rng = any(_block_uses_rng(block))
+    rng0 = ctx.next_rng() if use_rng else None
+
+    def cond_fn(carry):
+        vals = carry[0]
+        return jnp.reshape(vals[carry_names.index(cond_name)], ())
+
+    def body_fn(carry):
+        vals, rng = carry
+        local = dict(env)
+        local.update(zip(carry_names, vals))
+        sub_ctx = ctx.sub(block)
+        sub_ctx._rng = rng
+        lower_block(sub_ctx, block, local)
+        new_rng = sub_ctx.final_rng() if use_rng else rng
+        return (tuple(local[n] for n in carry_names), new_rng)
+
+    init = (tuple(env[n] for n in carry_names),
+            rng0 if use_rng else jnp.zeros((2,), jnp.uint32))
+    out_vals, _ = lax.while_loop(cond_fn, body_fn, init)
+    return {"__env_update__": dict(zip(carry_names, out_vals))}
+
+
+@register_op("conditional_block", no_grad=True, uses_rng=True, needs_env=True)
+def _conditional_block(ctx, ins, attrs):
+    from ..core.lowering import lower_block
+
+    block = _sub_block(ctx, attrs)
+    env = attrs["__env__"]
+    pred = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+    carry_names = _written_carry(block, env)
+    use_rng = any(_block_uses_rng(block))
+    rng0 = ctx.next_rng() if use_rng else jnp.zeros((2,), jnp.uint32)
+
+    def true_fn(vals):
+        local = dict(env)
+        local.update(zip(carry_names, vals))
+        sub_ctx = ctx.sub(block)
+        sub_ctx._rng = rng0
+        lower_block(sub_ctx, block, local)
+        return tuple(local[n] for n in carry_names)
+
+    def false_fn(vals):
+        return vals
+
+    init = tuple(env[n] for n in carry_names)
+    out_vals = lax.cond(pred, true_fn, false_fn, init)
+    return {"__env_update__": dict(zip(carry_names, out_vals))}
+
+
+def _block_uses_rng(block):
+    from ..core.registry import get_op, has_op
+
+    for op in block.ops:
+        yield has_op(op.type) and get_op(op.type).uses_rng

@@ -419,3 +419,14 @@ def _maxout(ctx, ins, attrs):
 @register_op("im2sequence", no_grad=True)
 def _im2sequence(ctx, ins, attrs):  # rarely used; minimal static version
     raise NotImplementedError("im2sequence is not supported on the TPU build")
+
+
+@register_op("label_smooth", diff_inputs=["X"])
+def _label_smooth(ctx, ins, attrs):
+    # reference operators/label_smooth_op.cc: (1-eps)*X + eps*prior (or 1/K)
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    dist = (ins.get("PriorDist") or [None])[0]
+    if dist is not None:
+        return {"Out": [(1.0 - eps) * x + eps * dist.reshape((1,) * (x.ndim - 1) + (-1,))]}
+    return {"Out": [(1.0 - eps) * x + eps / x.shape[-1]]}

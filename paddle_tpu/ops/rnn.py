@@ -1,0 +1,128 @@
+"""Recurrent ops: LSTM / GRU over padded batches via lax.scan.
+
+Reference analogs: operators/lstm_op.cc + math/lstm_compute (dynamic_lstm
+layer) and gru_op.cc + math/gru_compute (dynamic_gru). The reference
+consumes LoD-packed ragged sequences and walks batches per timestep on
+the host; the TPU-native design is a compiled lax.scan over the time axis
+of a padded [B, S, *] batch with an optional length mask (SURVEY §5 LoD
+strategy, §7 hard-parts "while/DynamicRNN lowering").
+
+Contracts (documented divergence from LoD):
+  - input is pre-projected, [B, S, 4D] for lstm / [B, S, 3D] for gru
+    (the layer does the input fc, same as the reference's dynamic_lstm)
+  - optional "Length" input [B] int: steps >= length keep state frozen
+    and emit zeros (matches LoD semantics after padding)
+  - lstm gate order is i, f, g(candidate), o; gru is u, r, c
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _mask_scan(step, init, xs_t, length, B, S):
+    """Run scan with per-timestep freeze masking. xs_t: [S, B, *]."""
+    ts = jnp.arange(S)
+
+    def body(carry, inp):
+        t, xt = inp
+        new_carry, out = step(carry, xt)
+        if length is not None:
+            alive = (t < length).reshape(B, *([1] * (out[0].ndim - 1)))
+            new_carry = tuple(jnp.where(alive, n, c)
+                              for n, c in zip(new_carry, carry))
+            out = tuple(jnp.where(alive, o, jnp.zeros_like(o)) for o in out)
+        return new_carry, out
+
+    return lax.scan(body, init, (ts, xs_t))
+
+
+@register_op("lstm", diff_inputs=["Input", "Weight", "Bias", "H0", "C0"])
+def _lstm(ctx, ins, attrs):
+    x = ins["Input"][0]                      # [B, S, 4D]
+    w = ins["Weight"][0]                     # [D, 4D]
+    b = (ins.get("Bias") or [None])[0]       # [1, 4D]
+    length = (ins.get("Length") or [None])[0]
+    B, S, four_d = x.shape
+    D = four_d // 4
+    h0 = (ins.get("H0") or [None])[0]
+    c0 = (ins.get("C0") or [None])[0]
+    h0 = jnp.zeros((B, D), x.dtype) if h0 is None else h0
+    c0 = jnp.zeros((B, D), x.dtype) if c0 is None else c0
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    reverse = attrs.get("is_reverse", False)
+
+    def step(carry, xt):
+        h, c = carry
+        g = xt + h @ w
+        if b is not None:
+            g = g + b.reshape(1, -1)
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        gg = cand_act(gg)
+        c2 = f * c + i * gg
+        h2 = o * cell_act(c2)
+        return (h2, c2), (h2, c2)
+
+    xs = jnp.swapaxes(x, 0, 1)               # [S, B, 4D]
+    if reverse:
+        xs = xs[::-1]
+    _, (hs, cs) = _mask_scan(step, (h0, c0), xs, length, B, S)
+    if reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)]}
+
+
+@register_op("gru", diff_inputs=["Input", "Weight", "Bias", "H0"])
+def _gru(ctx, ins, attrs):
+    x = ins["Input"][0]                      # [B, S, 3D]
+    w = ins["Weight"][0]                     # [D, 3D]: [u|r | c]
+    b = (ins.get("Bias") or [None])[0]
+    length = (ins.get("Length") or [None])[0]
+    B, S, three_d = x.shape
+    D = three_d // 3
+    h0 = (ins.get("H0") or [None])[0]
+    h0 = jnp.zeros((B, D), x.dtype) if h0 is None else h0
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    reverse = attrs.get("is_reverse", False)
+    origin_mode = attrs.get("origin_mode", False)
+    w_ur, w_c = w[:, : 2 * D], w[:, 2 * D:]
+
+    def step(carry, xt):
+        (h,) = carry
+        x_ur, x_c = xt[:, : 2 * D], xt[:, 2 * D:]
+        g_ur = x_ur + h @ w_ur
+        if b is not None:
+            g_ur = g_ur + b.reshape(1, -1)[:, : 2 * D]
+        u, r = jnp.split(gate_act(g_ur), 2, axis=-1)
+        g_c = x_c + (r * h) @ w_c
+        if b is not None:
+            g_c = g_c + b.reshape(1, -1)[:, 2 * D:]
+        c = cand_act(g_c)
+        # gru_op.cc origin_mode: h' = u*h + (1-u)*c ; default (False):
+        # h' = (1-u)*h + u*c
+        h2 = u * h + (1 - u) * c if origin_mode else (1 - u) * h + u * c
+        return (h2,), (h2,)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+    _, (hs,) = _mask_scan(step, (h0,), xs, length, B, S)
+    if reverse:
+        hs = hs[::-1]
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)]}

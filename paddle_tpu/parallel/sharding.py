@@ -57,7 +57,7 @@ class ShardingRules:
 
 
 def _divides(spec: P, shape, mesh: Mesh) -> bool:
-    if shape is None:
+    if shape is None or len(spec) > len(shape):
         return False
     for dim, axes in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
         if axes is None:
