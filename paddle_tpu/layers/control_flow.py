@@ -76,8 +76,8 @@ def while_loop(cond_fn, body_fn, loop_vars):
                 new_vars = [new_vars]
             for old, new in zip(loop_vars, new_vars):
                 if new is not old:
-                    assign(new, out=old)
-        assign(cond_fn(*loop_vars), out=c)
+                    assign(new, output=old)
+        assign(cond_fn(*loop_vars), output=c)
     return loop_vars
 
 
@@ -130,7 +130,7 @@ class Switch:
         parent = prog.current_block()
         sub = prog.create_block()
         yield
-        assign(fill_constant([1], "float32", 1.0), out=self._taken)
+        assign(fill_constant([1], "float32", 1.0), output=self._taken)
         prog.rollback()
         parent.append_op(type="conditional_block",
                          inputs={"Cond": [fire]}, outputs={},

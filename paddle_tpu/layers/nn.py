@@ -23,6 +23,8 @@ __all__ = [
     "embedding",
     "label_smooth",
     "fused_attention",
+    "dynamic_lstm",
+    "dynamic_gru",
     "conv2d",
     "conv2d_transpose",
     "conv3d",
@@ -1231,3 +1233,88 @@ def fused_attention(q, k, v, bias=None, scale=1.0, dropout=0.0, name=None):
                      attrs={"scale": float(scale), "dropout": float(dropout)})
     out.shape = q.shape
     return out
+
+
+# ----------------------------------------------------------------- recurrent
+def dynamic_lstm(
+    input,
+    size,
+    h_0=None,
+    c_0=None,
+    param_attr=None,
+    bias_attr=None,
+    use_peepholes=False,
+    is_reverse=False,
+    gate_activation="sigmoid",
+    cell_activation="tanh",
+    candidate_activation="tanh",
+    dtype="float32",
+    seq_len=None,
+    name=None,
+):
+    """LSTM over a padded [B,S,4D] pre-projected batch (reference nn.py
+    dynamic_lstm -> lstm_op.cc; input fc to 4*hidden done by the caller,
+    same contract). LoD ragged input is replaced by the optional seq_len
+    mask (SURVEY §5). use_peepholes is not supported on the TPU build."""
+    if use_peepholes:
+        raise NotImplementedError("peephole LSTM is not supported (TPU build)")
+    helper = LayerHelper("lstm", name=name)
+    hidden_size = size // 4
+    w = helper.create_parameter(param_attr, [hidden_size, 4 * hidden_size], dtype)
+    b = helper.create_parameter(bias_attr, [1, 4 * hidden_size], dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    if seq_len is not None:
+        inputs["Length"] = [seq_len]
+    helper.append_op(
+        type="lstm", inputs=inputs,
+        outputs={"Hidden": [hidden], "Cell": [cell]},
+        attrs={"is_reverse": is_reverse, "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation},
+    )
+    if input.shape is not None:
+        out_shape = tuple(input.shape[:-1]) + (hidden_size,)
+        hidden.shape = out_shape
+        cell.shape = out_shape
+    return hidden, cell
+
+
+def dynamic_gru(
+    input,
+    size,
+    param_attr=None,
+    bias_attr=None,
+    is_reverse=False,
+    gate_activation="sigmoid",
+    candidate_activation="tanh",
+    h_0=None,
+    origin_mode=False,
+    dtype="float32",
+    seq_len=None,
+    name=None,
+):
+    """GRU over a padded [B,S,3D] pre-projected batch (reference nn.py
+    dynamic_gru -> gru_op.cc). size = hidden width D."""
+    helper = LayerHelper("gru", name=name)
+    w = helper.create_parameter(param_attr, [size, 3 * size], dtype)
+    b = helper.create_parameter(bias_attr, [1, 3 * size], dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if seq_len is not None:
+        inputs["Length"] = [seq_len]
+    helper.append_op(
+        type="gru", inputs=inputs, outputs={"Hidden": [hidden]},
+        attrs={"is_reverse": is_reverse, "gate_activation": gate_activation,
+               "activation": candidate_activation, "origin_mode": origin_mode},
+    )
+    if input.shape is not None:
+        hidden.shape = tuple(input.shape[:-1]) + (size,)
+    return hidden

@@ -1,0 +1,137 @@
+"""RNN (scan-lowered lstm/gru) and control-flow (While/cond) tests.
+
+Reference analogs: unittests/test_lstm_op.py & test_gru_op.py (numeric
+reference in numpy) and test_while_op.py (loop accumulates; fetch after
+loop).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _np_lstm(x, w, b, D):
+    """numpy reference: gate order i,f,g,o (ops/rnn.py contract)."""
+    B, S, _ = x.shape
+    h = np.zeros((B, D), "float32")
+    c = np.zeros((B, D), "float32")
+    hs = []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(S):
+        g = x[:, t] + h @ w + b
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        hs.append(h)
+    return np.stack(hs, 1)
+
+
+def test_lstm_matches_numpy(fresh_programs):
+    main, startup, scope = fresh_programs
+    B, S, D = 2, 5, 8
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [S, 4 * D])
+        h, c = layers.dynamic_lstm(x, size=4 * D)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(0)
+    xv = rs.randn(B, S, 4 * D).astype("float32")
+    (hv,) = exe.run(main, feed={"x": xv}, fetch_list=[h], scope=scope)
+    w = np.asarray(scope.find_var([n for n in scope.local_var_names()
+                                   if n.endswith(".w_0")][0]))
+    b = np.asarray(scope.find_var([n for n in scope.local_var_names()
+                                   if n.endswith(".b_0")][0]))
+    want = _np_lstm(xv, w, b.reshape(1, -1), D)
+    np.testing.assert_allclose(hv, want, atol=1e-4, rtol=1e-4)
+
+
+def test_lstm_gru_train(fresh_programs):
+    """Sequence classifier with lstm+gru trains on a fixed batch."""
+    main, startup, scope = fresh_programs
+    B, S, D = 4, 6, 8
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [S, 16])
+        label = layers.data("label", [1], dtype="int64")
+        proj = layers.fc(x, 4 * D, num_flatten_dims=2, bias_attr=False)
+        h, _ = layers.dynamic_lstm(proj, size=4 * D)
+        proj2 = layers.fc(h, 3 * D, num_flatten_dims=2, bias_attr=False)
+        g = layers.dynamic_gru(proj2, size=D)
+        last = layers.reduce_mean(g, dim=1)
+        probs = layers.fc(last, 4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(probs, label))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(0)
+    feed = {"x": rs.randn(B, S, 16).astype("float32"),
+            "label": rs.randint(0, 4, (B, 1)).astype("int64")}
+    ls = [float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+          for _ in range(8)]
+    assert ls[-1] < ls[0]
+
+
+def test_lstm_seq_len_mask(fresh_programs):
+    """Padded steps must not change the masked outputs."""
+    main, startup, scope = fresh_programs
+    B, S, D = 2, 6, 4
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [S, 4 * D])
+        ln = layers.data("len", [], dtype="int64")
+        h, _ = layers.dynamic_lstm(x, size=4 * D, seq_len=ln)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(0)
+    xv = rs.randn(B, S, 4 * D).astype("float32")
+    lens = np.array([4, 6], "int64")
+    (h1,) = exe.run(main, feed={"x": xv, "len": lens}, fetch_list=[h],
+                    scope=scope)
+    xv2 = xv.copy()
+    xv2[0, 4:] = 99.0  # garbage in padded region of seq 0
+    (h2,) = exe.run(main, feed={"x": xv2, "len": lens}, fetch_list=[h],
+                    scope=scope)
+    np.testing.assert_allclose(h1, h2, atol=1e-6)
+    assert np.all(h1[0, 4:] == 0)  # padded outputs are zeros
+
+
+def test_while_loop_sums(fresh_programs):
+    """while: i from 0..9 accumulating into s (test_while_op analog)."""
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "float32", 0.0)
+        s = layers.fill_constant([1], "float32", 0.0)
+        n = layers.fill_constant([1], "float32", 10.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(layers.elementwise_add(s, i), output=s)
+            layers.increment(i, 1.0)
+            layers.assign(layers.less_than(i, n), output=cond)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    (sv, iv) = exe.run(main, fetch_list=[s, i], scope=scope)
+    assert float(sv) == 45.0
+    assert float(iv) == 10.0
+
+
+def test_conditional_block(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [1])
+        out = layers.fill_constant([1], "float32", 0.0)
+        thresh = layers.fill_constant([1], "float32", 0.5)
+        pred = layers.greater_than(x, thresh)
+        layers.cond(pred,
+                    true_fn=lambda: layers.assign(
+                        layers.fill_constant([1], "float32", 1.0), output=out),
+                    false_fn=lambda: layers.assign(
+                        layers.fill_constant([1], "float32", -1.0), output=out))
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    (v,) = exe.run(main, feed={"x": np.array([0.9], "float32")},
+                   fetch_list=[out], scope=scope)
+    assert float(v) == 1.0
+    (v,) = exe.run(main, feed={"x": np.array([0.1], "float32")},
+                   fetch_list=[out], scope=scope)
+    assert float(v) == -1.0
