@@ -1,0 +1,96 @@
+"""Ring attention: sequence/context parallelism over the mesh.
+
+The reference has NO sequence parallelism (SURVEY §5 "Long-context …
+Absent") — this is the TPU-first extension slot called out there. Design
+follows blockwise/ring attention: the sequence axis is sharded over a mesh
+axis; each step every device computes flash-style partial attention
+(running max / numerator / denominator) against its current K/V block,
+then rotates K/V one hop around the ring with lax.ppermute so compute
+overlaps the ICI transfer. After n_shards steps every query block has seen
+every key block without any device ever holding the full sequence.
+
+Use under shard_map with q,k,v sharded on the sequence dim:
+
+    mesh = Mesh(devices, ("sp",))
+    f = shard_map(lambda q,k,v: ring_attention(q,k,v,scale=s,axis_name="sp",
+                                               causal=True),
+                  mesh=mesh, in_specs=P(None,None,"sp",None),
+                  out_specs=P(None,None,"sp",None))
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention"]
+
+
+def _block_partials(q, k, v, scale, mask):
+    """Unnormalised flash partials for one K/V block.
+    q:[B,H,Sq,D] k,v:[B,H,Sk,D] mask:[...,Sq,Sk] additive or None.
+    Returns o_hat (= sum_j exp(s - m) v_j), m (rowmax), l (rowsum)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = s + mask
+    m = jnp.max(s, axis=-1)                        # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                        # [B,H,Sq]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def ring_attention(q, k, v, scale: float, axis_name: str,
+                   causal: bool = False,
+                   kv_bias: Optional[jax.Array] = None):
+    """Attention over a sequence sharded on `axis_name`.
+
+    q,k,v: [B,H,Sl,D] local shards. kv_bias: [B,1,1,Sl] additive bias that
+    travels with the K/V blocks (e.g. padding mask). causal=True applies
+    the global lower-triangular mask using ring positions.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Sl, D = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    q32 = q.astype(jnp.float32)
+    neg = jnp.float32(-1e9)
+
+    def step(i, carry):
+        o_acc, m_acc, l_acc, k_cur, v_cur, b_cur = carry
+        src = (idx - i) % n                        # origin block of k_cur
+        mask = None
+        if causal:
+            q_pos = idx * Sl + jnp.arange(Sl)      # global query positions
+            k_pos = src * Sl + jnp.arange(Sl)
+            mask = jnp.where(k_pos[None, :] > q_pos[:, None], neg, 0.0)
+            mask = mask[None, None]
+        if b_cur is not None:
+            bm = b_cur.astype(jnp.float32)
+            mask = bm if mask is None else mask + bm
+        o, m, l = _block_partials(q32, k_cur, v_cur, scale, mask)
+        new_m = jnp.maximum(m_acc, m)
+        a = jnp.exp(m_acc - new_m)
+        b = jnp.exp(m - new_m)
+        o_acc = o_acc * a[..., None] + o * b[..., None]
+        l_acc = l_acc * a + l * b
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        if b_cur is not None:
+            b_cur = lax.ppermute(b_cur, axis_name, perm)
+        return o_acc, new_m, l_acc, k_cur, v_cur, b_cur
+
+    o0 = jnp.zeros((B, H, Sl, D), jnp.float32)
+    m0 = jnp.full((B, H, Sl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl), jnp.float32)
+    carry = (o0, m0, l0, k, v, kv_bias)
+    # the ring length is static (mesh-axis size), so the loop unrolls and
+    # XLA pipelines each ppermute against the next block's matmuls
+    for i in range(int(n)):
+        carry = step(i, carry)
+    o_acc, _, l_acc, _, _, _ = carry
+    return (o_acc / l_acc[..., None]).astype(q.dtype)
