@@ -1,10 +1,22 @@
-"""Input layers (reference: python/paddle/fluid/layers/io.py — data:39)."""
+"""Input layers (reference: python/paddle/fluid/layers/io.py — data:39,
+py_reader:636, double_buffer).
+
+py_reader in the reference is an op stack: a LoDTensorBlockingQueue fed
+from Python, popped by create_py_reader_op, wrapped by buffered_reader's
+async device prefetch (operators/reader/buffered_reader.cc). Here the
+executor feeds arrays directly, so PyReader is a host-side prefetcher: a
+producer thread pulls batches from the user reader and jax.device_put's
+them ahead of the train loop (JAX async dispatch = the double buffer).
+"""
 
 from __future__ import annotations
 
+import queue as _queue
+import threading
+
 from ..core.program import default_main_program, default_startup_program
 
-__all__ = ["data"]
+__all__ = ["data", "PyReader", "py_reader", "double_buffer"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -23,3 +35,102 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     # mirror into startup so program pairs stay consistent (reference parity)
     default_startup_program()
     return v
+
+
+class PyReader:
+    """Iterable device-prefetching reader (reference layers/io.py:636
+    py_reader + reader/buffered_reader.cc double buffering).
+
+        reader = PyReader(feed_list=[img, label], capacity=64)
+        reader.decorate_batch_generator(gen)   # gen yields tuples of arrays
+        for feed in reader():
+            exe.run(main, feed=feed, fetch_list=[loss])
+    """
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
+        self._gen = None
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._gen = reader
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        """reader yields lists of per-sample tuples (DataFeeder format)."""
+        from ..data_feeder import DataFeeder
+
+        feeder = DataFeeder(self.feed_list)
+
+        def gen():
+            for samples in reader():
+                fd = feeder.feed(samples)
+                yield tuple(fd[v.name] for v in self.feed_list)
+
+        self._gen = gen
+
+    def __call__(self):
+        return iter(self)
+
+    def __iter__(self):
+        import jax
+
+        if self._gen is None:
+            raise RuntimeError("decorate a generator before iterating")
+        q = _queue.Queue(maxsize=self.capacity)
+        stop = object()
+
+        def produce():
+            try:
+                for batch in self._gen():
+                    if self.use_double_buffer:
+                        # async device transfer overlaps the training step
+                        batch = tuple(jax.device_put(b) for b in batch)
+                    q.put(batch)
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        names = [v.name for v in self.feed_list]
+        while True:
+            item = q.get()
+            if item is stop:
+                return
+            yield dict(zip(names, item))
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Legacy functional form; returns a PyReader without bound feed vars
+    (caller supplies dicts)."""
+    r = PyReader(capacity=capacity, use_double_buffer=use_double_buffer)
+    r.shapes, r.dtypes = shapes, dtypes
+    return r
+
+
+def double_buffer(reader, place=None, name=None):
+    """Decorator form over a plain batch reader (reference layers/io.py
+    double_buffer): prefetch one batch to device ahead of consumption."""
+    import jax
+
+    def buffered():
+        q = _queue.Queue(maxsize=2)
+        stop = object()
+
+        def produce():
+            try:
+                for b in reader():
+                    q.put(jax.tree_util.tree_map(jax.device_put, b))
+            finally:
+                q.put(stop)
+
+        threading.Thread(target=produce, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is stop:
+                return
+            yield item
+
+    return buffered

@@ -1,0 +1,36 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference glues C++ to Python with pybind11 (paddle/fluid/pybind/);
+pybind11 isn't available in this image, so the native pieces expose a C
+API consumed through ctypes. Libraries are compiled on first use with g++
+and cached next to the source (rebuilt when the source is newer).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def _build(name: str) -> str:
+    src = os.path.join(_DIR, name + ".cc")
+    so = os.path.join(_DIR, "lib" + name + ".so")
+    with _BUILD_LOCK:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", src, "-o", so]
+            subprocess.run(cmd, check=True, capture_output=True)
+    return so
+
+
+def load(name: str) -> ctypes.CDLL:
+    if name not in _LIBS:
+        _LIBS[name] = ctypes.CDLL(_build(name))
+    return _LIBS[name]
