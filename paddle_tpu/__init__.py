@@ -30,7 +30,9 @@ from . import (  # noqa: F401
     profiler,
     regularizer,
 )
+from . import transpiler  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .core.executor import Executor  # noqa: F401
 from .core.place import CPUPlace, CUDAPlace, TPUPlace, is_compiled_with_tpu  # noqa: F401
 from .core.program import (  # noqa: F401

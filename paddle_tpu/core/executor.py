@@ -72,6 +72,16 @@ class Executor:
 
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
+
+        # a pserver program is one listen_and_serv op: enter the PS loop
+        # (the reference enters ListenAndServOp::RunImpl the same way)
+        ops0 = program.global_block().ops
+        if ops0 and ops0[0].type == "listen_and_serv":
+            from ..distributed.ps import run_pserver_loop
+
+            run_pserver_loop(ops0[0].attrs, scope, executor=self)
+            return []
+
         feed = feed or {}
         fetch_names = [
             v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
@@ -112,7 +122,13 @@ class Executor:
         return list(fetches)
 
     def close(self):
+        """Release cached executables and tell any connected pservers this
+        trainer is done (Executor.close → SendComplete analog,
+        executor.py:388-405 / rpc_client.h:86)."""
         self._cache.clear()
+        from ..ops.distributed_ops import complete_and_reset
+
+        complete_and_reset()
 
     # -------------------------------------------------------------- prepare
     def _cache_key(self, program, feed_vals, fetch_names):

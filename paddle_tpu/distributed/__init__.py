@@ -1,0 +1,23 @@
+"""Distributed training stack.
+
+Two paths, mirroring the reference (SURVEY §2.9):
+
+* **Collective data parallel** — CompiledProgram.with_data_parallel over a
+  jax.sharding.Mesh; multi-host boots via parallel/env.py
+  (init_parallel_env) with the launcher env contract
+  (PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS). The reference's
+  NCCL2-mode transpile + gen_nccl_id becomes jax.distributed.initialize.
+* **Parameter server** — DistributeTranspiler splits the program into
+  trainer and pserver halves over the native TCP RPC transport
+  (native/ps_service.cc), for huge sparse embeddings and CTR-style
+  workloads (reference operators/distributed + listen_and_serv).
+"""
+
+from ..parallel.env import ParallelEnv, init_parallel_env  # noqa: F401
+from .rpc import RPCClient, RPCServer, SelectedRows  # noqa: F401
+from .transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    HashName,
+    RoundRobin,
+)

@@ -1,0 +1,317 @@
+"""RPCClient / RPCServer: ctypes wrappers over the native PS transport.
+
+Analog of the reference's transport-agnostic RPC API
+(/root/reference/paddle/fluid/operators/distributed/rpc_client.h:32 —
+AsyncSendVar/AsyncGetVar/AsyncPrefetchVar/barriers/Complete — and
+rpc_server.h). The wire transport is the native C++ service in
+paddle_tpu/native/ps_service.cc (gRPC/BRPC stack analog); vars cross as
+numpy arrays, sparse grads as (rows, values) pairs (SelectedRows analog,
+selected_rows.h:32).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..native import load
+
+__all__ = ["RPCClient", "RPCServer", "SelectedRows", "parse_endpoint"]
+
+# dtype codes shared with ps_service.cc
+_DTYPES = {
+    np.dtype("float32"): 0,
+    np.dtype("int64"): 1,
+    np.dtype("float64"): 2,
+    np.dtype("int32"): 3,
+    np.dtype("uint8"): 4,
+    np.dtype("bool"): 4,
+}
+_NP_OF_CODE = {0: np.float32, 1: np.int64, 2: np.float64, 3: np.int32,
+               4: np.uint8}
+
+
+def parse_endpoint(ep: str) -> Tuple[str, int]:
+    host, port = ep.rsplit(":", 1)
+    return host or "127.0.0.1", int(port)
+
+
+class SelectedRows:
+    """Sparse rows {row ids -> value rows} of a bigger tensor — the wire
+    format for embedding gradients (reference selected_rows.h:32)."""
+
+    def __init__(self, rows: np.ndarray, values: np.ndarray, height: int = -1):
+        self.rows = np.ascontiguousarray(rows, dtype=np.int64)
+        self.values = np.ascontiguousarray(values)
+        self.height = height  # dim0 of the dense tensor this represents
+
+    def __repr__(self):
+        return "SelectedRows(%d rows of %s)" % (len(self.rows), self.values.shape)
+
+
+def _lib():
+    lib = load("ps_service")
+    if getattr(lib, "_ps_typed", False):
+        return lib
+    c = ctypes
+    lib.ps_server_create.restype = c.c_void_p
+    lib.ps_server_create.argtypes = [c.c_int, c.c_int, c.c_int]
+    for fn in ("ps_server_port", "ps_server_active"):
+        getattr(lib, fn).restype = c.c_int
+        getattr(lib, fn).argtypes = [c.c_void_p]
+    for fn in ("ps_server_start", "ps_server_stop", "ps_server_destroy",
+               "ps_server_serve"):
+        getattr(lib, fn).restype = None
+        getattr(lib, fn).argtypes = [c.c_void_p]
+    lib.ps_server_set_var.restype = None
+    lib.ps_server_set_var.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int,
+                                      c.POINTER(c.c_int64), c.c_void_p]
+    lib.ps_server_var_meta.restype = c.c_int
+    lib.ps_server_var_meta.argtypes = [c.c_void_p, c.c_char_p,
+                                       c.POINTER(c.c_int), c.POINTER(c.c_int),
+                                       c.POINTER(c.c_int64)]
+    lib.ps_server_read_var.restype = c.c_int
+    lib.ps_server_read_var.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
+                                       c.c_int64]
+    lib.ps_server_wait_grads.restype = c.c_void_p
+    lib.ps_server_wait_grads.argtypes = [c.c_void_p]
+    lib.ps_server_pop_async.restype = c.c_void_p
+    lib.ps_server_pop_async.argtypes = [c.c_void_p, c.c_int]
+    lib.ps_server_poll_notify.restype = c.c_int
+    lib.ps_server_poll_notify.argtypes = [c.c_void_p, c.c_char_p, c.c_int,
+                                          c.c_int]
+    lib.ps_batch_count.restype = c.c_int
+    lib.ps_batch_count.argtypes = [c.c_void_p]
+    lib.ps_batch_name.restype = c.c_char_p
+    lib.ps_batch_name.argtypes = [c.c_void_p, c.c_int]
+    for fn in ("ps_batch_dtype", "ps_batch_ndim", "ps_batch_trainer"):
+        getattr(lib, fn).restype = c.c_int
+        getattr(lib, fn).argtypes = [c.c_void_p, c.c_int]
+    lib.ps_batch_dims.restype = None
+    lib.ps_batch_dims.argtypes = [c.c_void_p, c.c_int, c.POINTER(c.c_int64)]
+    lib.ps_batch_nrows.restype = c.c_int64
+    lib.ps_batch_nrows.argtypes = [c.c_void_p, c.c_int]
+    lib.ps_batch_rows.restype = c.POINTER(c.c_int64)
+    lib.ps_batch_rows.argtypes = [c.c_void_p, c.c_int]
+    lib.ps_batch_data.restype = c.c_void_p
+    lib.ps_batch_data.argtypes = [c.c_void_p, c.c_int]
+    lib.ps_batch_nbytes.restype = c.c_int64
+    lib.ps_batch_nbytes.argtypes = [c.c_void_p, c.c_int]
+    lib.ps_batch_free.restype = None
+    lib.ps_batch_free.argtypes = [c.c_void_p]
+    lib.ps_client_create.restype = c.c_void_p
+    lib.ps_client_create.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.ps_client_destroy.restype = None
+    lib.ps_client_destroy.argtypes = [c.c_void_p]
+    lib.ps_client_connect.restype = c.c_int
+    lib.ps_client_connect.argtypes = [c.c_void_p]
+    lib.ps_client_send_var.restype = c.c_int
+    lib.ps_client_send_var.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int, c.c_int, c.POINTER(c.c_int64),
+        c.c_int64, c.POINTER(c.c_int64), c.c_void_p, c.c_int64]
+    lib.ps_client_get_var.restype = c.c_void_p
+    lib.ps_client_get_var.argtypes = [c.c_void_p, c.c_char_p]
+    lib.ps_client_prefetch.restype = c.c_void_p
+    lib.ps_client_prefetch.argtypes = [c.c_void_p, c.c_char_p,
+                                       c.POINTER(c.c_int64), c.c_int64]
+    for fn in ("ps_client_send_barrier", "ps_client_fetch_barrier",
+               "ps_client_complete"):
+        getattr(lib, fn).restype = c.c_int
+        getattr(lib, fn).argtypes = [c.c_void_p]
+    lib.ps_client_checkpoint.restype = c.c_int
+    lib.ps_client_checkpoint.argtypes = [c.c_void_p, c.c_char_p]
+    lib._ps_typed = True
+    return lib
+
+
+def _dims_ptr(shape):
+    return (ctypes.c_int64 * max(len(shape), 1))(*shape)
+
+
+def _contig(value) -> np.ndarray:
+    """C-contiguous ndarray, PRESERVING 0-d shape (np.ascontiguousarray
+    silently promotes 0-d to 1-d, hence the reshape)."""
+    a = np.asarray(value)
+    return a if a.flags["C_CONTIGUOUS"] else (
+        np.ascontiguousarray(a).reshape(a.shape))
+
+
+def _batch_read(lib, b) -> List[Tuple[str, object, int]]:
+    """Decode a native batch into [(name, ndarray | SelectedRows, trainer)]."""
+    out = []
+    for i in range(lib.ps_batch_count(b)):
+        name = lib.ps_batch_name(b, i).decode()
+        code = lib.ps_batch_dtype(b, i)
+        ndim = lib.ps_batch_ndim(b, i)
+        dims = (ctypes.c_int64 * max(ndim, 1))()
+        if ndim:
+            lib.ps_batch_dims(b, i, dims)
+        shape = tuple(dims[j] for j in range(ndim))
+        nbytes = lib.ps_batch_nbytes(b, i)
+        raw = ctypes.string_at(lib.ps_batch_data(b, i), nbytes)
+        flat = np.frombuffer(raw, dtype=_NP_OF_CODE[code])
+        nrows = lib.ps_batch_nrows(b, i)
+        if nrows >= 0:
+            # sparse: dims carry the dense height, data only nrows rows
+            if nrows > 0:
+                rows = np.ctypeslib.as_array(lib.ps_batch_rows(b, i),
+                                             (int(nrows),)).copy()
+            else:
+                rows = np.empty((0,), np.int64)
+            height = shape[0] if ndim else -1
+            arr = SelectedRows(rows, flat.reshape((nrows,) + shape[1:]).copy(),
+                               height=height)
+        else:
+            arr = flat.reshape(shape).copy()
+        out.append((name, arr, lib.ps_batch_trainer(b, i)))
+    lib.ps_batch_free(b)
+    return out
+
+
+class RPCServer:
+    """In-process parameter-server endpoint: var store + barrier-cycled grad
+    exchange. The optimize step happens in the host runtime (ps.py), not in
+    the transport — see ps_service.cc header."""
+
+    def __init__(self, port: int = 0, num_trainers: int = 1, sync: bool = True):
+        self._lib = _lib()
+        self._h = self._lib.ps_server_create(port, num_trainers, int(sync))
+        if not self._h:
+            raise RuntimeError("could not bind PS server on port %d" % port)
+        self.port = self._lib.ps_server_port(self._h)
+        self.num_trainers = num_trainers
+        self.sync = sync
+
+    def start(self):
+        self._lib.ps_server_start(self._h)
+
+    def set_var(self, name: str, value: np.ndarray):
+        value = _contig(value)
+        code = _DTYPES[value.dtype]
+        self._lib.ps_server_set_var(
+            self._h, name.encode(), code, value.ndim, _dims_ptr(value.shape),
+            value.ctypes.data_as(ctypes.c_void_p))
+
+    def get_var(self, name: str) -> Optional[np.ndarray]:
+        dt, nd = ctypes.c_int(), ctypes.c_int()
+        dims = (ctypes.c_int64 * 8)()
+        if not self._lib.ps_server_var_meta(self._h, name.encode(),
+                                            ctypes.byref(dt), ctypes.byref(nd),
+                                            dims):
+            return None
+        shape = tuple(dims[i] for i in range(nd.value))
+        out = np.empty(shape, dtype=_NP_OF_CODE[dt.value])
+        ok = self._lib.ps_server_read_var(
+            self._h, name.encode(), out.ctypes.data_as(ctypes.c_void_p),
+            out.nbytes)
+        return out if ok else None
+
+    def wait_grads(self) -> List[Tuple[str, object, int]]:
+        """Block until every active trainer send-barriered; return the
+        cycle's received vars (dense ndarray or SelectedRows)."""
+        b = self._lib.ps_server_wait_grads(self._h)
+        return _batch_read(self._lib, b)
+
+    def serve(self):
+        """Publish the store and open the GET window for this cycle."""
+        self._lib.ps_server_serve(self._h)
+
+    def pop_async(self, timeout_ms: int = 100):
+        b = self._lib.ps_server_pop_async(self._h, timeout_ms)
+        if not b:
+            return None
+        return _batch_read(self._lib, b)[0]
+
+    def poll_notify(self, timeout_ms: int = 0) -> Optional[str]:
+        buf = ctypes.create_string_buffer(4096)
+        if self._lib.ps_server_poll_notify(self._h, buf, 4096, timeout_ms):
+            return buf.value.decode()
+        return None
+
+    @property
+    def active_trainers(self) -> int:
+        return self._lib.ps_server_active(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.ps_server_stop(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.ps_server_stop(self._h)
+            self._lib.ps_server_destroy(self._h)
+            self._h = None
+
+
+class RPCClient:
+    """Trainer-side connection to one pserver endpoint
+    (rpc_client.h:32 analog; blocking calls — the reference's Async* +
+    Wait pairs collapse to synchronous calls under the barrier cycle)."""
+
+    def __init__(self, endpoint: str, trainer_id: int = 0):
+        self._lib = _lib()
+        host, port = parse_endpoint(endpoint)
+        self.endpoint = endpoint
+        self._h = self._lib.ps_client_create(host.encode(), port, trainer_id)
+
+    def connect(self, required: bool = True) -> bool:
+        ok = bool(self._lib.ps_client_connect(self._h))
+        if required and not ok:
+            raise RuntimeError("cannot reach pserver %s" % self.endpoint)
+        return ok
+
+    def send_var(self, name: str, value) -> None:
+        if isinstance(value, SelectedRows):
+            rows, vals, height = value.rows, value.values, value.height
+            dims = (height if height >= 0 else len(rows),) + vals.shape[1:]
+            nrows = len(rows)
+            rows_ptr = rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        else:
+            vals = _contig(value)
+            dims, nrows, rows_ptr = vals.shape, -1, None
+        vals = _contig(vals)
+        ok = self._lib.ps_client_send_var(
+            self._h, name.encode(), _DTYPES[vals.dtype], len(dims),
+            _dims_ptr(dims), nrows, rows_ptr,
+            vals.ctypes.data_as(ctypes.c_void_p), vals.nbytes)
+        if not ok:
+            raise RuntimeError("send_var(%s) to %s failed" % (name, self.endpoint))
+
+    def get_var(self, name: str, retries: int = 50) -> np.ndarray:
+        # retry: in async mode a GET can race the trainer-0 init push
+        import time
+
+        for attempt in range(max(retries, 1)):
+            b = self._lib.ps_client_get_var(self._h, name.encode())
+            if b:
+                return _batch_read(self._lib, b)[0][1]
+            time.sleep(0.1)
+        raise RuntimeError("get_var(%s) from %s failed" % (name, self.endpoint))
+
+    def prefetch(self, table: str, ids: np.ndarray) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, dtype=np.int64).ravel()
+        b = self._lib.ps_client_prefetch(
+            self._h, table.encode(),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(ids))
+        if not b:
+            raise RuntimeError("prefetch(%s) from %s failed" % (table, self.endpoint))
+        return _batch_read(self._lib, b)[0][1]
+
+    def send_barrier(self):
+        self._lib.ps_client_send_barrier(self._h)
+
+    def fetch_barrier(self):
+        self._lib.ps_client_fetch_barrier(self._h)
+
+    def send_complete(self):
+        self._lib.ps_client_complete(self._h)
+
+    def checkpoint_notify(self, dirname: str):
+        self._lib.ps_client_checkpoint(self._h, dirname.encode())
+
+    def close(self):
+        if self._h:
+            self._lib.ps_client_destroy(self._h)
+            self._h = None

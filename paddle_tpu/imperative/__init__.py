@@ -1,0 +1,314 @@
+"""Imperative (dygraph) mode: eager op execution with a tape.
+
+Analog of /root/reference/paddle/fluid/imperative/ (SURVEY §2.7):
+`Tracer::Trace` (tracer.h:44-57) records each eagerly-executed op and its
+grad op; `VarBase` (layer.h:113) pairs a value with its gradient;
+`Layer` (layer.h:106) is the module base; Python wrappers live in
+python/paddle/fluid/imperative/ (guard, to_variable, nn layers).
+
+TPU-native shape: an eager op IS its registered XLA lowering applied to
+concrete jax.Arrays (op-by-op dispatch, like the reference's imperative
+mode bypassing the Program). backward() walks the tape in reverse and
+invokes the SAME grad-op lowerings the graph Executor uses (core.autodiff
+vjp synthesis + custom grad lowerings like dropout's saved mask), so
+graph mode and dygraph share one gradient implementation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autodiff import ATTR_DIFF, ATTR_FWD_IN, ATTR_FWD_OUT
+from ..core.lowering import LowerContext, as_jax_dtype
+from ..core.registry import get_op
+
+__all__ = ["guard", "enabled", "to_variable", "VarBase", "Tracer", "Layer"]
+
+_tracer: Optional["Tracer"] = None
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+@contextlib.contextmanager
+def guard(place=None, seed: int = 0):
+    """Enable dygraph mode (python/paddle/fluid/imperative/base.py guard
+    analog)."""
+    global _tracer
+    old = _tracer
+    _tracer = Tracer(seed=seed)
+    try:
+        yield
+    finally:
+        _tracer = old
+
+
+def get_tracer() -> "Tracer":
+    if _tracer is None:
+        raise RuntimeError("imperative ops need `with imperative.guard():`")
+    return _tracer
+
+
+class VarBase:
+    """value (+ gradient) holder — reference layer.h:113."""
+
+    def __init__(self, value, name: Optional[str] = None,
+                 stop_gradient: bool = False):
+        self.value = jnp.asarray(value)
+        self.name = name
+        self.stop_gradient = stop_gradient
+        self._grad: Optional[jax.Array] = None
+
+    # ---- tensor protocol
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def gradient(self) -> Optional[np.ndarray]:
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def backward(self):
+        get_tracer().backward(self)
+
+    # legacy reference spelling
+    _backward = backward
+
+    def detach(self) -> "VarBase":
+        return VarBase(self.value, stop_gradient=True)
+
+    def __repr__(self):
+        return "VarBase(shape=%s, dtype=%s%s)" % (
+            self.shape, self.dtype, ", grad" if self._grad is not None else "")
+
+    # ---- eager math sugar
+    def _binary(self, other, op, reverse=False):
+        o = other if isinstance(other, VarBase) else VarBase(
+            jnp.asarray(other, dtype=self.value.dtype), stop_gradient=True)
+        a, b = (o, self) if reverse else (self, o)
+        return trace_op(op, {"X": [a], "Y": [b]}, {})["Out"][0]
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    def __radd__(self, o):
+        return self._binary(o, "elementwise_add", True)
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    def __rmul__(self, o):
+        return self._binary(o, "elementwise_mul", True)
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+
+def to_variable(value, name=None, block=None) -> VarBase:
+    """numpy -> VarBase (python/paddle/fluid/imperative/base.py:to_variable
+    analog). Data fed this way is a gradient leaf unless stop_gradient."""
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name)
+
+
+class _TapeEntry:
+    __slots__ = ("type", "ins", "outs", "attrs")
+
+    def __init__(self, type, ins, outs, attrs):
+        self.type = type
+        self.ins = ins      # slot -> List[Optional[VarBase]]
+        self.outs = outs    # slot -> List[Optional[VarBase]]
+        self.attrs = attrs
+
+
+class Tracer:
+    """Records (op, inputs, outputs) per eager execution
+    (reference tracer.h:44 Tracer::Trace)."""
+
+    def __init__(self, seed: int = 0):
+        self.tape: List[_TapeEntry] = []
+        self._rng = jax.random.PRNGKey(seed)
+
+    def next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def trace(self, entry: _TapeEntry):
+        self.tape.append(entry)
+
+    # ----------------------------------------------------------- backward
+    def backward(self, loss: VarBase):
+        grads: Dict[int, jax.Array] = {id(loss): jnp.ones_like(loss.value)}
+        ctx = LowerContext()
+
+        for entry in reversed(self.tape):
+            opdef = get_op(entry.type)
+            if opdef.no_grad:
+                continue
+            out_grads: Dict[str, List[Optional[jax.Array]]] = {}
+            any_g = False
+            for slot, vs in entry.outs.items():
+                gs = []
+                for v in vs:
+                    g = grads.get(id(v)) if v is not None else None
+                    gs.append(g)
+                    any_g = any_g or g is not None
+                out_grads[slot] = gs
+            if not any_g:
+                continue
+
+            diff = []
+            for slot, vs in entry.ins.items():
+                if opdef.diff_inputs is not None and slot not in opdef.diff_inputs:
+                    continue
+                for i, v in enumerate(vs):
+                    if (v is not None and not v.stop_gradient
+                            and jnp.issubdtype(v.value.dtype, jnp.floating)):
+                        diff.append((slot, i))
+            if not diff:
+                continue
+
+            grad_ins: Dict[str, List[Any]] = {}
+            for slot, vs in entry.ins.items():
+                grad_ins[slot] = [v.value if v is not None else None for v in vs]
+            for slot, vs in entry.outs.items():
+                grad_ins.setdefault(
+                    slot, [v.value if v is not None else None for v in vs])
+            for slot, gs in out_grads.items():
+                grad_ins[slot + "@GRAD"] = gs
+
+            attrs = dict(entry.attrs)
+            attrs[ATTR_FWD_IN] = {s: len(v) for s, v in entry.ins.items()}
+            attrs[ATTR_FWD_OUT] = {s: len(v) for s, v in entry.outs.items()}
+            attrs[ATTR_DIFF] = [list(d) for d in diff]
+
+            outs = get_op(entry.type + "_grad").lowering(ctx, grad_ins, attrs)
+            for slot, i in diff:
+                g = outs.get(slot + "@GRAD", [None] * (i + 1))[i]
+                if g is None:
+                    continue
+                v = entry.ins[slot][i]
+                prev = grads.get(id(v))
+                acc = g if prev is None else prev + g
+                grads[id(v)] = acc
+                v._grad = acc
+
+        # leaf var grads are now in ._grad; clear tape (one backward per tape,
+        # like the reference's ClearBlock)
+        self.tape.clear()
+
+
+class _EagerCtx(LowerContext):
+    """LowerContext whose RNG chains through the tracer so dropout etc.
+    work eagerly."""
+
+    def __init__(self, tracer: Tracer):
+        super().__init__(None, None, is_test=False)
+        self._tracer = tracer
+
+    def next_rng(self):
+        self.rng_used = True
+        return self._tracer.next_rng()
+
+
+def trace_op(op_type: str, ins: Dict[str, Sequence[Optional[VarBase]]],
+             attrs: Dict[str, Any]) -> Dict[str, List[Optional[VarBase]]]:
+    """Execute one op eagerly through its registered lowering and record it
+    on the tape (the analog of imperative::Tracer::Trace + kernel run)."""
+    tracer = get_tracer()
+    opdef = get_op(op_type)
+    norm_ins = {s: list(vs if isinstance(vs, (list, tuple)) else [vs])
+                for s, vs in ins.items()}
+    vals = {s: [v.value if v is not None else None for v in vs]
+            for s, vs in norm_ins.items()}
+    ctx = _EagerCtx(tracer)
+    raw = opdef.lowering(ctx, vals, dict(attrs))
+    outs: Dict[str, List[Optional[VarBase]]] = {}
+    stop = all(v is None or v.stop_gradient
+               for vs in norm_ins.values() for v in vs)
+    for slot, vs in raw.items():
+        if slot == "__env_update__":
+            continue
+        if not isinstance(vs, (list, tuple)):
+            vs = [vs]
+        outs[slot] = [None if v is None else VarBase(v, stop_gradient=stop)
+                      for v in vs]
+    tracer.trace(_TapeEntry(op_type, norm_ins, outs, dict(attrs)))
+    return outs
+
+
+class Layer:
+    """Module base (reference imperative layer.h:106 /
+    python/paddle/fluid/imperative/layers.py)."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._name = name_scope or type(self).__name__
+        self._dtype = dtype
+        self._parameters: Dict[str, VarBase] = {}
+        self._sub_layers: Dict[str, "Layer"] = {}
+
+    def create_parameter(self, name: str, shape, dtype="float32",
+                         initializer=None) -> VarBase:
+        if initializer is None:
+            fan_in = int(np.prod(shape[:-1])) if len(shape) > 1 else shape[0]
+            limit = float(np.sqrt(6.0 / max(fan_in + shape[-1], 1)))
+            init = np.random.uniform(-limit, limit, size=shape)
+        elif callable(initializer):
+            init = initializer(shape)
+        else:
+            init = np.full(shape, float(initializer))
+        p = VarBase(jnp.asarray(init, dtype=as_jax_dtype(dtype)), name=name)
+        self._parameters[name] = p
+        return p
+
+    def add_sublayer(self, name: str, layer: "Layer") -> "Layer":
+        self._sub_layers[name] = layer
+        return layer
+
+    def __setattr__(self, k, v):
+        if isinstance(v, Layer):
+            self.__dict__.setdefault("_sub_layers", {})[k] = v
+        elif isinstance(v, VarBase) and not k.startswith("_"):
+            self.__dict__.setdefault("_parameters", {})[k] = v
+        object.__setattr__(self, k, v)
+
+    def parameters(self) -> List[VarBase]:
+        out = list(self._parameters.values())
+        for sub in self._sub_layers.values():
+            out.extend(sub.parameters())
+        return out
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def forward(self, *a, **kw):
+        raise NotImplementedError
+
+    def __call__(self, *a, **kw):
+        return self.forward(*a, **kw)
+
+
+from . import nn  # noqa: E402,F401  (FC/Conv2D/BatchNorm/Embedding/Pool2D)

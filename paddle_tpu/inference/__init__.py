@@ -1,0 +1,139 @@
+"""Inference engine: load → optimize → AOT-compile → serve.
+
+Analog of /root/reference/paddle/fluid/inference/ (SURVEY §2.5, §3.4):
+`AnalysisConfig` (api/paddle_analysis_config.h), `create_paddle_predictor`
+(api/paddle_api.h:335), `AnalysisPredictor` (api/analysis_predictor.cc:69
+Init, :183 Run, :342 OptimizeInferenceProgram) and `ZeroCopyTensor`
+(api/paddle_api.h:146).
+
+Where the reference runs ~25 IR fusion passes (conv+bn, fc fuse, ...) and
+then interprets the op list with NaiveExecutor, here "optimization" is
+structural (prune to the fetch subgraph + is_test rewrite) and the entire
+program is AOT-compiled by XLA into one serving executable per input-shape
+bucket — fusion, layout and scheduling are the compiler's job. The
+TensorRT/Anakin subgraph engines have no analog: XLA *is* the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.program import Program
+from ..core.scope import Scope
+
+__all__ = ["AnalysisConfig", "Predictor", "create_paddle_predictor",
+           "PaddleTensor"]
+
+
+class AnalysisConfig:
+    """Predictor configuration (api/paddle_analysis_config.h analog)."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 model_filename: Optional[str] = None,
+                 params_filename: Optional[str] = None):
+        self.model_dir = model_dir
+        self.model_filename = model_filename
+        self.params_filename = params_filename
+        # shape buckets to AOT-compile at init (batch dims); empty = compile
+        # lazily on first run per shape signature
+        self.warmup_batch_sizes: List[int] = []
+        self.switch_ir_optim = True  # kept for API parity; XLA optimizes
+
+
+class PaddleTensor:
+    """Named tensor crossing the predictor boundary
+    (api/paddle_api.h PaddleTensor/ZeroCopyTensor analog — numpy arrays
+    are already zero-copy views on host memory)."""
+
+    def __init__(self, name: str, data: np.ndarray):
+        self.name = name
+        self.data = np.asarray(data)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+
+class Predictor:
+    """AnalysisPredictor analog: owns a Scope with the loaded params and an
+    Executor whose compile cache holds one XLA executable per input-shape
+    signature."""
+
+    def __init__(self, config: AnalysisConfig):
+        from ..core.executor import Executor
+        from ..io import load_inference_model
+
+        self.config = config
+        self.scope = Scope()
+        self._exe = Executor()
+        program, feeds, fetches = load_inference_model(
+            config.model_dir, self._exe,
+            model_filename=config.model_filename,
+            params_filename=config.params_filename,
+            scope=self.scope)
+        self.program: Program = _rewrite_for_inference(program)
+        self.feed_names: List[str] = list(feeds)
+        self.fetch_vars = fetches
+        self.fetch_names = [v.name for v in fetches]
+        for bs in config.warmup_batch_sizes:
+            self._warmup(bs)
+
+    # ------------------------------------------------------------- serving
+    def get_input_names(self) -> List[str]:
+        return list(self.feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self.fetch_names)
+
+    def run(self, inputs) -> List[np.ndarray]:
+        """inputs: list of PaddleTensor / list of arrays in feed order /
+        dict name->array. Returns fetch arrays."""
+        feed = self._as_feed(inputs)
+        return self._exe.run(self.program, feed=feed,
+                             fetch_list=self.fetch_names, scope=self.scope)
+
+    __call__ = run
+
+    def _as_feed(self, inputs) -> Dict[str, np.ndarray]:
+        if isinstance(inputs, dict):
+            return inputs
+        if isinstance(inputs, (list, tuple)):
+            vals = [t.data if isinstance(t, PaddleTensor) else t for t in inputs]
+            names = ([t.name for t in inputs]
+                     if all(isinstance(t, PaddleTensor) for t in inputs)
+                     else self.feed_names)
+            return dict(zip(names, vals))
+        return {self.feed_names[0]: inputs}
+
+    def _warmup(self, batch_size: int):
+        """AOT-compile the serving executable for one batch size by running
+        zero feeds through the jit cache."""
+        feed = {}
+        block = self.program.global_block()
+        for n in self.feed_names:
+            var = block.var(n)
+            shape = [batch_size if (s is None or s < 0) else s
+                     for s in (var.shape or ())]
+            feed[n] = np.zeros(shape, dtype=var.dtype)
+        self._exe.run(self.program, feed=feed, fetch_list=self.fetch_names,
+                      scope=self.scope)
+
+
+def _rewrite_for_inference(program: Program) -> Program:
+    """OptimizeInferenceProgram analog: flip train-only attrs to test mode
+    (dropout passthrough, batch_norm running stats). Op fusion itself is
+    XLA's job — see module docstring."""
+    p = program.clone(for_test=True)
+    for b in p.blocks:
+        for op in b.ops:
+            if op.type in ("dropout", "batch_norm"):
+                op.attrs["is_test"] = True
+    p._bump()
+    return p
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> Predictor:
+    """CreatePaddlePredictor (api/paddle_api.h:335) analog."""
+    return Predictor(config)

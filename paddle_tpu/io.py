@@ -44,9 +44,9 @@ def _persistable_names(program: Program, predicate) -> List[str]:
 
 
 def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
-              filename=None):
+              filename=None, scope=None):
     program = main_program or default_main_program()
-    scope = global_scope()
+    scope = scope or global_scope()
     if vars is not None:
         names = [v.name if hasattr(v, "name") else v for v in vars]
     else:
@@ -72,9 +72,9 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
-              filename=None):
+              filename=None, scope=None):
     program = main_program or default_main_program()
-    scope = global_scope()
+    scope = scope or global_scope()
     path = os.path.join(dirname, filename or _COMBINED)
     data = np.load(path, allow_pickle=False)
     if vars is not None:
@@ -94,9 +94,10 @@ def load_params(executor, dirname, main_program=None, filename=None):
               predicate=lambda v: isinstance(v, Parameter), filename=filename)
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
     load_vars(executor, dirname, main_program,
-              predicate=lambda v: v.persistable, filename=filename)
+              predicate=lambda v: v.persistable, filename=filename, scope=scope)
 
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
@@ -119,11 +120,12 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, scope=None):
     with open(os.path.join(dirname, model_filename or _MODEL_FILE)) as f:
         meta = json.load(f)
     program = _program_from_dict(meta["program"])
-    load_persistables(executor, dirname, program, filename=params_filename)
+    load_persistables(executor, dirname, program, filename=params_filename,
+                      scope=scope)
     return program, meta["feed"], [program.global_block().var(n) for n in meta["fetch"]]
 
 

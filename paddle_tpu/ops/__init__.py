@@ -10,6 +10,7 @@ from . import (  # noqa: F401
     attention,
     basic,
     control_flow_ops,
+    distributed_ops,
     math,
     metrics,
     nn,

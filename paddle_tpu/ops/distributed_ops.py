@@ -1,0 +1,162 @@
+"""Distributed PS ops: send / recv / barriers / distributed lookup prefetch.
+
+Analogs of /root/reference/paddle/fluid/operators/distributed_ops/
+(send_op.cc, recv_op.cc, send_barrier_op.cc, fetch_barrier_op.cc,
+prefetch_op.cc). The reference runs these as C++ kernels calling the gRPC
+client; here each lowers to a jax ordered io_callback that drives the
+native TCP RPC client (paddle_tpu/distributed/rpc.py → ps_service.cc), so
+they sequence correctly *inside* the single lowered XLA step: grads flow
+out and fresh params flow back without leaving the compiled computation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+_clients: Dict[Tuple[str, int], object] = {}
+
+
+def _trainer_id() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def client_for(endpoint: str):
+    """Process-wide RPCClient pool (RPCClient::GetInstance analog,
+    rpc_client.h:59)."""
+    key = (endpoint, _trainer_id())
+    c = _clients.get(key)
+    if c is None:
+        from ..distributed.rpc import RPCClient
+
+        c = RPCClient(endpoint, trainer_id=_trainer_id())
+        c.connect()
+        _clients[key] = c
+    return c
+
+
+def reset_clients():
+    for c in _clients.values():
+        try:
+            c.close()
+        except Exception:
+            pass
+    _clients.clear()
+
+
+def complete_and_reset():
+    """SendComplete to every connected pserver, then drop the pool
+    (Executor.close path — rpc_client.h:86 analog)."""
+    for c in _clients.values():
+        try:
+            c.send_complete()
+        except Exception:
+            pass
+    reset_clients()
+
+
+_FLAG = jax.ShapeDtypeStruct((), jnp.int32)
+
+
+def _ordered_cb(fn, result_spec, *args):
+    return jax.experimental.io_callback(fn, result_spec, *args, ordered=True)
+
+
+@register_op("send", no_grad=True)
+def _send(ctx, ins, attrs):
+    endpoint = attrs["endpoint"]
+    wire_name = attrs["var_name"]
+
+    def cb(x):
+        client_for(endpoint).send_var(wire_name, np.asarray(x))
+        return np.int32(0)
+
+    flag = _ordered_cb(cb, _FLAG, ins["X"][0])
+    return {"Out": [flag]}
+
+
+@register_op("send_sparse", no_grad=True)
+def _send_sparse(ctx, ins, attrs):
+    """Sparse grad send: rows + values as SelectedRows
+    (sendrecvop_utils.cc SelectedRows serde analog)."""
+    endpoint = attrs["endpoint"]
+    wire_name = attrs["var_name"]
+    height = int(attrs["height"])
+
+    def cb(rows, values):
+        from ..distributed.rpc import SelectedRows
+
+        client_for(endpoint).send_var(
+            wire_name, SelectedRows(np.asarray(rows), np.asarray(values),
+                                    height=height))
+        return np.int32(0)
+
+    flag = _ordered_cb(cb, _FLAG, ins["Rows"][0], ins["Values"][0])
+    return {"Out": [flag]}
+
+
+@register_op("send_barrier", no_grad=True)
+def _send_barrier(ctx, ins, attrs):
+    endpoints = list(attrs["endpoints"])
+
+    def cb():
+        for ep in endpoints:
+            client_for(ep).send_barrier()
+        return np.int32(0)
+
+    return {"Out": [_ordered_cb(cb, _FLAG)]}
+
+
+@register_op("recv", no_grad=True)
+def _recv(ctx, ins, attrs):
+    endpoint = attrs["endpoint"]
+    wire_name = attrs["var_name"]
+    shape = tuple(attrs["shape"])
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+
+    def cb():
+        return np.asarray(client_for(endpoint).get_var(wire_name), dtype=dtype)
+
+    out = _ordered_cb(cb, jax.ShapeDtypeStruct(shape, dtype))
+    return {"Out": [out]}
+
+
+@register_op("fetch_barrier", no_grad=True)
+def _fetch_barrier(ctx, ins, attrs):
+    endpoints = list(attrs["endpoints"])
+
+    def cb():
+        for ep in endpoints:
+            client_for(ep).fetch_barrier()
+        return np.int32(0)
+
+    return {"Out": [_ordered_cb(cb, _FLAG)]}
+
+
+@register_op("prefetch", no_grad=True)
+def _prefetch(ctx, ins, attrs):
+    """Remote sparse-table row fetch (prefetch_op.cc →
+    parameter_prefetch.cc analog): Ids -> rows of the pserver-resident
+    table. Gradient flows back via an explicit send_sparse op appended by
+    the transpiler, not by autodiff (the table never lives on the trainer)."""
+    endpoint = attrs["endpoint"]
+    table = attrs["table_name"]
+    width = int(attrs["width"])
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+
+    ids = ins["Ids"][0]
+    n = int(np.prod(ids.shape)) if ids.shape else 1
+
+    def cb(ids_arr):
+        flat = np.asarray(ids_arr, dtype=np.int64).ravel()
+        return np.asarray(client_for(endpoint).prefetch(table, flat),
+                          dtype=dtype)
+
+    rows = _ordered_cb(cb, jax.ShapeDtypeStruct((n, width), dtype), ids)
+    return {"Out": [rows.reshape(tuple(ids.shape) + (width,))]}

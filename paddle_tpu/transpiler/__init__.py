@@ -1,0 +1,26 @@
+"""fluid.transpiler namespace (reference python/paddle/fluid/transpiler/).
+
+DistributeTranspiler lives in paddle_tpu.distributed.transpiler; the
+memory-optimization transpiler of the reference
+(memory_optimization_transpiler.py) is subsumed by XLA buffer assignment
+and donated state buffers — see docs/MEMORY.md.
+"""
+
+from ..distributed.transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    HashName,
+    RoundRobin,
+)
+
+
+def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
+                    level=0):
+    """No-op: liveness-based var reuse (reference
+    memory_optimization_transpiler.py) is handled by XLA's buffer
+    assignment; donated mut-state buffers already give in-place updates."""
+    return input_program
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    return input_program

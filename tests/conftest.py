@@ -25,6 +25,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running integration test")
+
+
 def pytest_sessionstart(session):
     assert all(d.platform == "cpu" for d in jax.devices()), (
         "test suite must run on the virtual CPU mesh, got %s" % jax.devices()

@@ -1,0 +1,86 @@
+"""Cluster-test worker script (reference dist_mnist.py-style model file,
+run by test_dist_ps.py the way test_dist_base.py:344 _run_cluster does):
+linear regression, role/topology from PADDLE_* env vars, losses written
+as JSON for the harness to compare against a single-process run."""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+
+STEPS = 5
+LR = 0.1
+FEATURES = 6
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[FEATURES], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(
+            x, size=1,
+            param_attr=fluid.ParamAttr(
+                name="fc_w", initializer=fluid.initializer.Constant(0.5)),
+            bias_attr=fluid.ParamAttr(
+                name="fc_b", initializer=fluid.initializer.Constant(0.0)))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(LR).minimize(loss)
+    return main, startup, loss
+
+
+def data(step):
+    rng = np.random.RandomState(100 + step)
+    X = rng.randn(32, FEATURES).astype(np.float32)
+    W = np.linspace(-1, 1, FEATURES).astype(np.float32).reshape(-1, 1)
+    Y = X @ W + 0.3
+    return X, Y
+
+
+def main():
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER")
+    pservers = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    sync = os.environ.get("PADDLE_SYNC_MODE", "1") == "1"
+
+    main_prog, startup, loss = build()
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.min_block_size = int(os.environ.get("MIN_BLOCK_SIZE", "8192"))
+    t = fluid.DistributeTranspiler(cfg)
+    t.transpile(trainer_id=trainer_id, program=main_prog, pservers=pservers,
+                trainers=trainers, sync_mode=sync, startup_program=startup)
+
+    exe = fluid.Executor()
+    if role == "PSERVER":
+        ep = os.environ["PADDLE_CURRENT_ENDPOINT"]
+        exe.run(t.get_startup_program(ep))
+        exe.run(t.get_pserver_program(ep))
+        return
+
+    prog = t.get_trainer_program()
+    exe.run(t.get_trainer_startup_program())
+    losses = []
+    for step in range(STEPS):
+        X, Y = data(step)
+        # shard the global batch across trainers
+        Xs, Ys = X[trainer_id::trainers], Y[trainer_id::trainers]
+        lv, = exe.run(prog, feed={"x": Xs, "y": Ys}, fetch_list=[loss.name])
+        losses.append(float(lv))
+    exe.close()
+    out = os.environ.get("LOSS_OUT")
+    if out:
+        with open(out, "w") as f:
+            json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

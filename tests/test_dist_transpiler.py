@@ -1,0 +1,120 @@
+"""Transpiler structure tests — no network (reference
+test_dist_transpiler.py analog: golden assertions on the transformed
+programs)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed.transpiler import slice_variable
+
+
+def _build_net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(
+            x, size=1,
+            param_attr=fluid.ParamAttr(
+                name="fc_w", initializer=fluid.initializer.Constant(0.1)),
+            bias_attr=fluid.ParamAttr(
+                name="fc_b", initializer=fluid.initializer.Constant(0.0)))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_slice_variable():
+    blocks = slice_variable("w", (10, 4), True, 8, 3)
+    assert len(blocks) == 3
+    assert [b.rows for b in blocks] == [4, 3, 3]
+    assert [b.offset for b in blocks] == [0, 4, 7]
+    assert blocks[0].block_name == "w.block0"
+    assert blocks[0].grad_name == "w.block0@GRAD"
+    # too small to slice
+    assert len(slice_variable("w", (10, 4), True, 8192, 3)) == 1
+    assert slice_variable("w", (10, 4), True, 8192, 3)[0].block_name == "w"
+    # slicing disabled
+    assert len(slice_variable("w", (10, 4), False, 1, 3)) == 1
+
+
+def test_trainer_program_structure():
+    main, startup, loss = _build_net()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers="1.1.1.1:6170",
+                trainers=2, sync_mode=True, startup_program=startup)
+    prog = t.get_trainer_program()
+    types = [op.type for op in prog.global_block().ops]
+    assert "sgd" not in types, "update ops must move to the pserver"
+    assert types.count("send") == 2          # fc_w, fc_b grads
+    assert types.count("recv") == 2
+    assert types.index("send_barrier") < types.index("recv")
+    assert types[-1] == "fetch_barrier"
+    # original program is untouched
+    orig_types = [op.type for op in main.global_block().ops]
+    assert "sgd" in orig_types and "send" not in orig_types
+
+
+def test_pserver_program_structure():
+    main, startup, loss = _build_net()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers="1.1.1.1:6170",
+                trainers=2, sync_mode=True, startup_program=startup)
+    ps = t.get_pserver_program("1.1.1.1:6170")
+    op = ps.global_block().ops[0]
+    assert op.type == "listen_and_serv"
+    assert op.attrs["Fanin"] == 2 and op.attrs["sync_mode"] is True
+    specs = {s["param_block"]: s for s in op.attrs["block_specs"]}
+    assert set(specs) == {"fc_w", "fc_b"}
+    assert specs["fc_w"]["shape"] == [8, 1]
+    assert specs["fc_w"]["opt_type"] == "sgd"
+    opt_types = [o.type for o in op.attrs["optimize_program"].global_block().ops]
+    assert opt_types == ["sgd", "sgd"]
+    # lr constant carried into pserver startup
+    sp = t.get_startup_program("1.1.1.1:6170")
+    fills = {o.output("Out")[0]: o.attrs["value"]
+             for o in sp.global_block().ops if o.type == "fill_constant"}
+    assert any(abs(v - 0.1) < 1e-9 for n, v in fills.items()
+               if n.startswith("learning_rate"))
+
+
+def test_sliced_param_split_concat():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(
+            x, size=1,
+            param_attr=fluid.ParamAttr(
+                name="w", initializer=fluid.initializer.Constant(0.1)),
+            bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.min_block_size = 2  # force slicing of the 6x1 param
+    t = fluid.DistributeTranspiler(cfg)
+    eps = "1.1.1.1:6170,2.2.2.2:6170"
+    t.transpile(trainer_id=0, program=main, pservers=eps, trainers=1,
+                sync_mode=True, startup_program=startup)
+    prog = t.get_trainer_program()
+    types = [op.type for op in prog.global_block().ops]
+    assert "split" in types and "concat" in types
+    assert types.count("send") == 2 and types.count("recv") == 2
+    # one block per pserver
+    ps1 = t.get_pserver_program("1.1.1.1:6170").global_block().ops[0]
+    ps2 = t.get_pserver_program("2.2.2.2:6170").global_block().ops[0]
+    names1 = {s["param_block"] for s in ps1.attrs["block_specs"]}
+    names2 = {s["param_block"] for s in ps2.attrs["block_specs"]}
+    assert names1 == {"w.block0"} and names2 == {"w.block1"}
+    assert ps1.attrs["block_specs"][0]["shape"] == [3, 1]
+
+
+def test_collective_mode_no_surgery():
+    main, startup, loss = _build_net()
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.mode = "nccl2"
+    t = fluid.DistributeTranspiler(cfg)
+    t.transpile(trainer_id=0, program=main, trainers=2,
+                startup_program=startup)
+    assert t.get_trainer_program() is main

@@ -1,0 +1,120 @@
+"""Dygraph mode tests (reference test_imperative.py /
+test_imperative_mnist.py analog): eager ops, tape backward vs numeric and
+graph-mode gradients, Layer training loop."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import imperative
+from paddle_tpu.imperative import nn as inn
+
+
+def test_eager_math_and_numpy():
+    with imperative.guard():
+        a = imperative.to_variable(np.array([1.0, 2.0], np.float32))
+        b = imperative.to_variable(np.array([3.0, 4.0], np.float32))
+        c = a * b + 2.0
+        np.testing.assert_allclose(c.numpy(), [5.0, 10.0])
+        assert c.shape == (2,) and c.dtype == "float32"
+
+
+def test_backward_simple_chain():
+    with imperative.guard():
+        x = imperative.to_variable(np.array([[1.0, 2.0]], np.float32))
+        y = x * x               # dy/dx = 2x
+        s = imperative.trace_op("reduce_sum", {"X": [y]},
+                                {"reduce_all": True})["Out"][0]
+        s.backward()
+        np.testing.assert_allclose(x.gradient(), [[2.0, 4.0]])
+
+
+def test_backward_matches_graph_mode(fresh_programs):
+    main, startup, scope = fresh_programs
+    X = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    W = np.random.RandomState(1).randn(3, 2).astype(np.float32)
+
+    # graph mode
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        wv = fluid.layers.create_parameter(
+            [3, 2], "float32", name="w",
+            default_initializer=fluid.initializer.NumpyArrayInitializer(W))
+        out = fluid.layers.matmul(xv, wv)
+        loss = fluid.layers.mean(fluid.layers.square(out))
+        from paddle_tpu.core.backward import append_backward
+
+        append_backward(loss)
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    g_graph, = exe.run(main, feed={"x": X}, fetch_list=["w@GRAD"], scope=scope)
+
+    # dygraph
+    with imperative.guard():
+        xd = imperative.to_variable(X)
+        xd.stop_gradient = True
+        wd = imperative.to_variable(W)
+        out = imperative.trace_op("matmul", {"X": [xd], "Y": [wd]}, {})["Out"][0]
+        sq = imperative.trace_op("square", {"X": [out]}, {})["Out"][0]
+        m = imperative.trace_op("mean", {"X": [sq]}, {})["Out"][0]
+        m.backward()
+        np.testing.assert_allclose(wd.gradient(), g_graph, rtol=1e-5, atol=1e-6)
+
+
+def test_layer_training_loop():
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    Y = (X @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)) + 0.3
+
+    with imperative.guard(seed=0):
+        fc = inn.FC("fc", size=1)
+        losses = []
+        for step in range(20):
+            x = imperative.to_variable(X)
+            x.stop_gradient = True
+            y = imperative.to_variable(Y)
+            y.stop_gradient = True
+            pred = fc(x)
+            diff = pred - y
+            sq = imperative.trace_op("square", {"X": [diff]}, {})["Out"][0]
+            loss = imperative.trace_op("mean", {"X": [sq]}, {})["Out"][0]
+            loss.backward()
+            for p in fc.parameters():
+                g = p.gradient()
+                assert g is not None
+                p.value = p.value - 0.1 * g
+            fc.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.1, losses
+
+
+def test_conv_pool_bn_layers_run():
+    with imperative.guard(seed=0):
+        img = imperative.to_variable(
+            np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+        img.stop_gradient = True
+        conv = inn.Conv2D("conv", num_channels=3, num_filters=4,
+                          filter_size=3, padding=1, act="relu")
+        pool = inn.Pool2D("pool", pool_size=2, pool_stride=2)
+        bn = inn.BatchNorm("bn", num_channels=4)
+        out = pool(bn(conv(img)))
+        assert out.shape == (2, 4, 4, 4)
+        s = imperative.trace_op("reduce_sum", {"X": [out]},
+                                {"reduce_all": True})["Out"][0]
+        s.backward()
+        assert conv._filter.gradient() is not None
+
+
+def test_embedding_layer():
+    with imperative.guard():
+        emb = inn.Embedding("emb", size=(10, 4))
+        ids = imperative.to_variable(np.array([[1], [3]], np.int64))
+        ids.stop_gradient = True
+        out = emb(ids)
+        assert out.shape[0] == 2 and out.shape[-1] == 4
+        s = imperative.trace_op("reduce_sum", {"X": [out]},
+                                {"reduce_all": True})["Out"][0]
+        s.backward()
+        g = emb._w.gradient()
+        assert g is not None and np.abs(g[[1, 3]]).sum() > 0
+        assert np.abs(g[0]).sum() == 0
