@@ -1,0 +1,6 @@
+"""Contrib: quantization / model-compression utilities
+(reference python/paddle/fluid/contrib/ — slim/, quantize/,
+int8_inference/; SURVEY §2.8)."""
+
+from . import quantize  # noqa: F401
+from .quantize import QuantizeTranspiler  # noqa: F401

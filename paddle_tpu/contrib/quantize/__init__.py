@@ -1,0 +1,147 @@
+"""QuantizeTranspiler: quantization-aware-training program rewrite.
+
+Analog of /root/reference/python/paddle/fluid/contrib/quantize/
+quantize_transpiler.py and contrib/slim/quantization/quantization_pass.py:
+insert fake-quant ops on the weights and activations feeding the heavy
+compute ops (conv2d/depthwise_conv2d/mul/matmul) so training sees int8
+rounding, and freeze the collected scales for inference export.
+
+Call `training_transpile(program, startup_program)` BEFORE
+optimizer.minimize: the straight-through-estimator grads of the quant ops
+(ops/quant_ops.py) then flow through append_backward like any other op —
+the reference instead patches grad ops post-hoc.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.program import Program, default_main_program, default_startup_program
+
+__all__ = ["QuantizeTranspiler", "QUANTIZABLE_OP_TYPES"]
+
+QUANTIZABLE_OP_TYPES = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+
+_WEIGHT_SLOTS = {
+    "conv2d": ("Filter",),
+    "depthwise_conv2d": ("Filter",),
+    "mul": ("Y",),
+    "matmul": ("Y",),
+}
+_ACT_SLOTS = {
+    "conv2d": ("Input",),
+    "depthwise_conv2d": ("Input",),
+    "mul": ("X",),
+    "matmul": ("X",),
+}
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 moving_rate: float = 0.9):
+        assert activation_quantize_type in (
+            "abs_max", "moving_average_abs_max", "range_abs_max")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.moving_rate = moving_rate
+
+    # ------------------------------------------------------------ training
+    def training_transpile(self, program: Optional[Program] = None,
+                           startup_program: Optional[Program] = None):
+        """Insert fake-quant ops in-place (quantize_transpiler.py
+        training_transpile analog)."""
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        block = program.global_block()
+        from ...core.program import Parameter
+
+        quantized = {}  # var name -> quantized var name (dedup)
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in QUANTIZABLE_OP_TYPES:
+                i += 1
+                continue
+            for slot in _WEIGHT_SLOTS[op.type] + _ACT_SLOTS[op.type]:
+                names = op.inputs.get(slot)
+                if not names:
+                    continue
+                name = names[0]
+                if name in quantized:
+                    op.inputs[slot] = [quantized[name]]
+                    continue
+                var = block.var(name)
+                is_weight = isinstance(var, Parameter)
+                bits = self.weight_bits if is_weight else self.activation_bits
+                qname = name + ".quantized"
+                block.create_var(name=qname, shape=var.shape,
+                                 dtype=var.dtype, stop_gradient=False)
+                scale_name = name + ".scale"
+                block.create_var(name=scale_name, shape=(1,), dtype="float32",
+                                 persistable=True, stop_gradient=True)
+                if is_weight or self.act_type == "abs_max":
+                    block.insert_op(
+                        i, "fake_quantize_abs_max",
+                        {"X": [name]}, {"Out": [qname], "OutScale": [scale_name]},
+                        {"bit_length": bits})
+                    i += 1
+                else:
+                    ins = {"X": [name], "InScale": [scale_name]}
+                    outs = {"Out": [qname], "OutScale": [scale_name]}
+                    attrs = {"bit_length": bits, "moving_rate": self.moving_rate}
+                    state_vars = []
+                    if self.act_type == "moving_average_abs_max":
+                        for extra in ("accum", "state"):
+                            sn = "%s.%s" % (name, extra)
+                            block.create_var(name=sn, shape=(1,),
+                                             dtype="float32", persistable=True,
+                                             stop_gradient=True)
+                            state_vars.append(sn)
+                        ins["InAccum"], ins["InState"] = [state_vars[0]], [state_vars[1]]
+                        outs["OutAccum"], outs["OutState"] = [state_vars[0]], [state_vars[1]]
+                        op_type = "fake_quantize_moving_average_abs_max"
+                    else:
+                        op_type = "fake_quantize_range_abs_max"
+                    block.insert_op(i, op_type, ins, outs, attrs)
+                    i += 1
+                    for sn in state_vars + [scale_name]:
+                        self._init_zero(startup, sn)
+                if is_weight or self.act_type == "abs_max":
+                    self._init_zero(startup, scale_name)
+                quantized[name] = qname
+                op.inputs[slot] = [qname]
+            i += 1
+        program._bump()
+
+    def _init_zero(self, startup: Program, name: str):
+        sb = startup.global_block()
+        if any(name in op.output_names() for op in sb.ops):
+            return
+        sb.create_var(name=name, shape=(1,), dtype="float32",
+                      persistable=True, stop_gradient=True)
+        sb.append_op("fill_constant", {}, {"Out": [name]},
+                     {"shape": [1], "value": 0.0, "dtype": "float32"})
+
+    # ------------------------------------------------------------ freezing
+    def freeze_program(self, program: Program) -> Program:
+        """Freeze collected scales for inference: quant ops switch to
+        is_test (scale read from state, never updated). The reference's
+        freeze_program additionally rewrites weights to int8 storage, which
+        has no TPU benefit (bf16 compute); the scales are what deployment
+        needs."""
+        p = program.clone(for_test=True)
+        for b in p.blocks:
+            for op in b.ops:
+                if op.type.startswith("fake_quantize"):
+                    op.attrs["is_test"] = True
+                    if op.type == "fake_quantize_abs_max":
+                        # feed the collected scale back in so inference
+                        # reads it instead of recomputing per batch
+                        op.inputs.setdefault("InScale",
+                                             list(op.output("OutScale")))
+        p._bump()
+        return p

@@ -108,7 +108,20 @@ class Executor:
             rng = jax.random.PRNGKey(seed)
 
         feeds = [feed_vals[n] for n in plan.feed_names]
-        fetches, new_mut, new_pure, new_rng = plan.fn(feeds, const_state, mut_state, rng)
+        from ..profiler import RecordEvent, is_profiler_enabled
+
+        if is_profiler_enabled():
+            # whole-step annotation: the analog of the per-op RecordEvent in
+            # the reference's interpreter loop (operator.cc:180) — ops fuse
+            # into this one launch
+            with RecordEvent("executor_run"):
+                fetches, new_mut, new_pure, new_rng = plan.fn(
+                    feeds, const_state, mut_state, rng)
+                fetches = [f.block_until_ready() if hasattr(f, "block_until_ready")
+                           else f for f in fetches]
+        else:
+            fetches, new_mut, new_pure, new_rng = plan.fn(
+                feeds, const_state, mut_state, rng)
 
         for n, v in zip(plan.mut_state, new_mut):
             scope.set_var(n, v)

@@ -1,5 +1,109 @@
-"""Detection layers (reference: python/paddle/fluid/layers/detection.py).
-CV-detection parity (prior_box, multiclass_nms, roi ops, yolo) is scheduled
-after the core baselines; this module reserves the namespace."""
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py —
+prior_box:~140, box_coder, iou_similarity, multiclass_nms, roi ops live in
+nn.py there). Lowerings in ops/detection_ops.py; multiclass_nms returns a
+fixed-size padded tensor instead of a LoD tensor (static shapes)."""
 
-__all__ = []
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box",
+    "box_coder",
+    "iou_similarity",
+    "multiclass_nms",
+    "roi_align",
+    "roi_pool",
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference("float32",
+                                                      stop_gradient=True)
+    var = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    helper.append_op(
+        type="prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios),
+               "variances": list(variance), "flip": flip, "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset})
+    return boxes, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(type="box_coder", inputs=ins,
+                     outputs={"OutputBox": [out]},
+                     attrs={"code_type": code_type,
+                            "box_normalized": box_normalized})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=-1, name=None):
+    """Fixed-size output [keep_top_k, 6] padded with class=-1 (static-shape
+    redesign of the reference's LoD output)."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype,
+                                                    stop_gradient=True)
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "background_label": background_label,
+               "normalized": normalized, "nms_eta": nms_eta})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_batch=None,
+              name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        ins["RoisBatch"] = [rois_batch]
+    helper.append_op(type="roi_align", inputs=ins, outputs={"Out": [out]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale,
+                            "sampling_ratio": (sampling_ratio
+                                               if sampling_ratio > 0 else 2)})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_batch=None, name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        ins["RoisBatch"] = [rois_batch]
+    helper.append_op(type="roi_pool", inputs=ins,
+                     outputs={"Out": [out], "Argmax": [None]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out

@@ -995,9 +995,16 @@ def shape(input):
 
 
 def cos_sim(X, Y):
-    xn = l2_normalize(X, axis=-1)
-    yn = l2_normalize(Y, axis=-1)
-    return reduce_sum(elementwise_mul(xn, yn), dim=-1, keep_dim=True)
+    """cos_sim_op.cc analog (single lowering, not an l2_normalize
+    composite, so the XNorm/YNorm byproducts match the reference op)."""
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]},
+                     attrs={})
+    return out
 
 
 def where(condition, x, y, name=None):

@@ -10,10 +10,13 @@ from . import (  # noqa: F401
     attention,
     basic,
     control_flow_ops,
+    detection_ops,
     distributed_ops,
+    loss_ops,
     math,
     metrics,
     nn,
+    quant_ops,
     rnn,
     optimizer_ops,
     sequence,

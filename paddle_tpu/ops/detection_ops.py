@@ -1,0 +1,362 @@
+"""Detection ops + image interpolation.
+
+Analogs of /root/reference/paddle/fluid/operators/detection/ (prior_box_op,
+box_coder_op, iou_similarity_op, multiclass_nms_op, roi_align_op,
+roi_pool_op) and the interpolate ops (interpolate_op.cc: bilinear_interp /
+nearest_interp). Static-shape redesigns: multiclass_nms emits a fixed-size
+[N, 6] result padded with -1 class (XLA-friendly, sorted by score) instead
+of the reference's LoD-shaped output.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+# ------------------------------------------------------------ interpolation
+def _interp_sizes(x, attrs):
+    out_h = int(attrs.get("out_h", 0))
+    out_w = int(attrs.get("out_w", 0))
+    scale = attrs.get("scale", 0)
+    if (out_h <= 0 or out_w <= 0) and scale:
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    return out_h, out_w
+
+
+@register_op("bilinear_interp", diff_inputs=["X"])
+def _bilinear_interp(ctx, ins, attrs):
+    """interpolate_op.cc bilinear, NCHW, align_corners handling matching
+    the reference's formula."""
+    x = ins["X"][0]
+    out_h, out_w = _interp_sizes(x, attrs)
+    align = bool(attrs.get("align_corners", True))
+    B, C, H, W = x.shape
+
+    def src_idx(dst, src_len, dst_len):
+        if align and dst_len > 1:
+            return dst * (src_len - 1) / (dst_len - 1)
+        ratio = src_len / dst_len
+        return jnp.maximum((dst + 0.5) * ratio - 0.5, 0)
+
+    hy = src_idx(jnp.arange(out_h, dtype=x.dtype), H, out_h)
+    wx = src_idx(jnp.arange(out_w, dtype=x.dtype), W, out_w)
+    h0 = jnp.clip(jnp.floor(hy).astype(jnp.int32), 0, H - 1)
+    w0 = jnp.clip(jnp.floor(wx).astype(jnp.int32), 0, W - 1)
+    h1 = jnp.minimum(h0 + 1, H - 1)
+    w1 = jnp.minimum(w0 + 1, W - 1)
+    dh = (hy - h0.astype(x.dtype))[None, None, :, None]
+    dw = (wx - w0.astype(x.dtype))[None, None, None, :]
+    v00 = x[:, :, h0][:, :, :, w0]
+    v01 = x[:, :, h0][:, :, :, w1]
+    v10 = x[:, :, h1][:, :, :, w0]
+    v11 = x[:, :, h1][:, :, :, w1]
+    out = (v00 * (1 - dh) * (1 - dw) + v01 * (1 - dh) * dw
+           + v10 * dh * (1 - dw) + v11 * dh * dw)
+    return {"Out": [out]}
+
+
+@register_op("nearest_interp", diff_inputs=["X"])
+def _nearest_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    out_h, out_w = _interp_sizes(x, attrs)
+    align = bool(attrs.get("align_corners", True))
+    B, C, H, W = x.shape
+
+    def idx(src_len, dst_len):
+        if align and dst_len > 1:  # per-axis, not joint (a size-1 width
+            return jnp.round(        # must not degrade the height axis)
+                jnp.arange(dst_len) * (src_len - 1) / (dst_len - 1)
+            ).astype(jnp.int32)
+        return jnp.floor(jnp.arange(dst_len) * src_len / dst_len
+                         ).astype(jnp.int32)
+
+    hs, ws = idx(H, out_h), idx(W, out_w)
+    return {"Out": [x[:, :, hs][:, :, :, ws]]}
+
+
+# ---------------------------------------------------------------- detection
+@register_op("prior_box", no_grad=True)
+def _prior_box(ctx, ins, attrs):
+    """prior_box_op.cc: SSD anchor generation over the feature map grid."""
+    feat = ins["Input"][0]      # [B, C, H, W]
+    image = ins["Image"][0]     # [B, C, IH, IW]
+    min_sizes = [float(v) for v in attrs["min_sizes"]]
+    max_sizes = [float(v) for v in attrs.get("max_sizes", [])]
+    ratios = [float(v) for v in attrs.get("aspect_ratios", [1.0])]
+    flip = bool(attrs.get("flip", False))
+    clip = bool(attrs.get("clip", False))
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    step_w = float(attrs.get("step_w", 0.0))
+    step_h = float(attrs.get("step_h", 0.0))
+
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    if step_w <= 0:
+        step_w = IW / W
+    if step_h <= 0:
+        step_h = IH / H
+
+    ars = [1.0]
+    for r in ratios:
+        if all(abs(r - a) > 1e-6 for a in ars):
+            ars.append(r)
+            if flip:
+                ars.append(1.0 / r)
+
+    whs = []
+    for ms in min_sizes:
+        whs.append((ms, ms))
+        for r in ars:
+            if abs(r - 1.0) < 1e-6:
+                continue
+            whs.append((ms * (r ** 0.5), ms / (r ** 0.5)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            whs.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+    P = len(whs)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cx = jnp.broadcast_to(cx[None, :, None], (H, W, P))
+    cy = jnp.broadcast_to(cy[:, None, None], (H, W, P))
+    bw = jnp.asarray([w for w, _ in whs], jnp.float32) / 2.0
+    bh = jnp.asarray([h for _, h in whs], jnp.float32) / 2.0
+    boxes = jnp.stack([(cx - bw) / IW, (cy - bh) / IH,
+                       (cx + bw) / IW, (cy + bh) / IH], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, P, 4))
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("iou_similarity", no_grad=True)
+def _iou_similarity(ctx, ins, attrs):
+    """iou_similarity_op.cc: pairwise IoU of [N,4] x [M,4] xyxy boxes."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    area = lambda b: jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0)
+    ix1 = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    iy1 = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    ix2 = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    iy2 = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area(x)[:, None] + area(y)[None, :] - inter
+    return {"Out": [inter / jnp.maximum(union, 1e-10)]}
+
+
+@register_op("box_coder", no_grad=True)
+def _box_coder(ctx, ins, attrs):
+    """box_coder_op.cc: encode/decode between boxes and SSD offsets."""
+    prior = ins["PriorBox"][0]          # [M, 4] xyxy
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = bool(attrs.get("box_normalized", True))
+
+    pw = prior[:, 2] - prior[:, 0] + (0 if norm else 1)
+    ph = prior[:, 3] - prior[:, 1] + (0 if norm else 1)
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is None:
+        pvar = jnp.ones((prior.shape[0], 4), prior.dtype)
+
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + (0 if norm else 1)
+        th = target[:, 3] - target[:, 1] + (0 if norm else 1)
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1]
+        ow = jnp.log(tw[:, None] / pw[None, :]) / pvar[None, :, 2]
+        oh = jnp.log(th[:, None] / ph[None, :]) / pvar[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)  # [N, M, 4]
+    else:
+        # decode: target [N, M, 4] offsets (or [M,4] broadcast)
+        t = target if target.ndim == 3 else target[None]
+        dcx = t[..., 0] * pvar[None, :, 0] * pw[None, :] + pcx[None, :]
+        dcy = t[..., 1] * pvar[None, :, 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(t[..., 2] * pvar[None, :, 2]) * pw[None, :]
+        dh = jnp.exp(t[..., 3] * pvar[None, :, 3]) * ph[None, :]
+        out = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                         dcx + dw * 0.5 - (0 if norm else 1),
+                         dcy + dh * 0.5 - (0 if norm else 1)], axis=-1)
+        if target.ndim != 3:
+            out = out[0]
+    return {"OutputBox": [out]}
+
+
+@register_op("multiclass_nms", no_grad=True)
+def _multiclass_nms(ctx, ins, attrs):
+    """multiclass_nms_op.cc, static-shape redesign: greedy per-class NMS
+    with fixed iteration counts, vmapped over class and image axes so the
+    traced kernel is emitted once; output [keep_top_k, 6] rows
+    (class, score, x1, y1, x2, y2) padded with class=-1. The background
+    class (background_label) is excluded like the reference."""
+    boxes = ins["BBoxes"][0]     # [M, 4] (single image) or [B, M, 4]
+    scores = ins["Scores"][0]    # [C, M] or [B, C, M]
+    batched = boxes.ndim == 3
+    if not batched:
+        boxes, scores = boxes[None], scores[None]
+    score_thresh = float(attrs.get("score_threshold", 0.0))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    background = int(attrs.get("background_label", -1))
+    B, C, M = scores.shape
+    nms_top_k = min(nms_top_k, M)
+
+    def area(b):
+        return jnp.maximum(b[..., 2] - b[..., 0], 0) * jnp.maximum(
+            b[..., 3] - b[..., 1], 0)
+
+    def one_class(bx, s_row, c):
+        # top-k by score, then greedy suppression
+        s = jnp.where(s_row >= score_thresh, s_row, -1.0)
+        top_s, top_i = lax.top_k(s, nms_top_k)
+        cand = bx[top_i]                       # [K, 4]
+        ar = area(cand)
+        keep = jnp.ones((nms_top_k,), bool)
+
+        def body(i, keep):
+            ix1 = jnp.maximum(cand[i, 0], cand[:, 0])
+            iy1 = jnp.maximum(cand[i, 1], cand[:, 1])
+            ix2 = jnp.minimum(cand[i, 2], cand[:, 2])
+            iy2 = jnp.minimum(cand[i, 3], cand[:, 3])
+            inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+            iou = inter / jnp.maximum(ar[i] + ar - inter, 1e-10)
+            sup = (iou > nms_thresh) & (jnp.arange(nms_top_k) > i)
+            return jnp.where(sup & keep[i], False, keep)
+
+        keep = lax.fori_loop(0, nms_top_k, body, keep)
+        valid = keep & (top_s > -1.0) & (c != background)
+        return jnp.concatenate([
+            jnp.where(valid, c.astype(cand.dtype), -1.0)[:, None],
+            jnp.where(valid, top_s, -1.0)[:, None],
+            cand], axis=1)                     # [K, 6]
+
+    def one_image(bx, sc):
+        rows = jax.vmap(one_class, in_axes=(None, 0, 0))(
+            bx, sc, jnp.arange(C, dtype=bx.dtype))      # [C, K, 6]
+        rows = rows.reshape(C * nms_top_k, 6)
+        k = min(keep_top_k, rows.shape[0])
+        _, order = lax.top_k(jnp.where(rows[:, 0] >= 0, rows[:, 1], -1.0), k)
+        out = rows[order]
+        pad = keep_top_k - k
+        if pad > 0:
+            out = jnp.concatenate(
+                [out, jnp.full((pad, 6), -1.0, out.dtype)], axis=0)
+        return out
+
+    outs = jax.vmap(one_image)(boxes, scores)
+    return {"Out": [outs if batched else outs[0]]}
+
+
+def _roi_grid(x, rois, roi_batch, pooled_h, pooled_w, spatial_scale,
+              sampling, mode):
+    """Shared ROI pooling kernel: bilinear sample a sub-grid per bin."""
+    B, C, H, W = x.shape
+    N = rois.shape[0]
+    x1 = rois[:, 0] * spatial_scale
+    y1 = rois[:, 1] * spatial_scale
+    x2 = rois[:, 2] * spatial_scale
+    y2 = rois[:, 3] * spatial_scale
+    if mode == "align":
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+    else:
+        x1, y1 = jnp.round(x1), jnp.round(y1)
+        x2, y2 = jnp.round(x2), jnp.round(y2)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+    bin_w = rw / pooled_w
+    bin_h = rh / pooled_h
+
+    gy = (jnp.arange(pooled_h)[:, None] +
+          (jnp.arange(sampling)[None, :] + 0.5) / sampling)  # [PH, S]
+    gx = (jnp.arange(pooled_w)[:, None] +
+          (jnp.arange(sampling)[None, :] + 0.5) / sampling)
+    # continuous coords → pixel-index space: pixel i's center sits at
+    # coordinate i + 0.5 (standard ROIAlign convention)
+    sy = y1[:, None, None] + gy[None] * bin_h[:, None, None] - 0.5  # [N,PH,S]
+    sx = x1[:, None, None] + gx[None] * bin_w[:, None, None] - 0.5
+
+    def sample(img, yy, xx):
+        # img [C, H, W]; yy/xx [...]: bilinear, clamped at the border
+        yy = jnp.clip(yy, 0.0, H - 1.0)
+        xx = jnp.clip(xx, 0.0, W - 1.0)
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+        y1_ = jnp.minimum(y0 + 1, H - 1)
+        x1_ = jnp.minimum(x0 + 1, W - 1)
+        dy = yy - y0
+        dx = xx - x0
+        v = (img[:, y0, x0] * (1 - dy) * (1 - dx)
+             + img[:, y0, x1_] * (1 - dy) * dx
+             + img[:, y1_, x0] * dy * (1 - dx)
+             + img[:, y1_, x1_] * dy * dx)
+        return v  # [C, ...]
+
+    imgs = x[roi_batch]  # [N, C, H, W]
+
+    def one_roi(img, sy_n, sx_n):
+        yy = jnp.broadcast_to(sy_n[:, None, :, None],
+                              (pooled_h, pooled_w, sampling, sampling))
+        xx = jnp.broadcast_to(sx_n[None, :, None, :],
+                              (pooled_h, pooled_w, sampling, sampling))
+        vals = sample(img, yy, xx)  # [C, PH, PW, S, S]
+        if mode == "align":
+            return vals.mean(axis=(-1, -2))
+        return vals.max(axis=(-1, -2))
+
+    return jax.vmap(one_roi)(imgs, sy, sx)  # [N, C, PH, PW]
+
+
+@register_op("roi_align", diff_inputs=["X"])
+def _roi_align(ctx, ins, attrs):
+    """roi_align_op.cc: average of bilinear samples per bin."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]  # [N, 4]
+    roi_batch = (ins["RoisBatch"][0].reshape(-1).astype(jnp.int32)
+                 if ins.get("RoisBatch")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    out = _roi_grid(x, rois, roi_batch,
+                    int(attrs.get("pooled_height", 1)),
+                    int(attrs.get("pooled_width", 1)),
+                    float(attrs.get("spatial_scale", 1.0)),
+                    max(int(attrs.get("sampling_ratio", 2)), 1), "align")
+    return {"Out": [out]}
+
+
+@register_op("roi_pool", diff_inputs=["X"])
+def _roi_pool(ctx, ins, attrs):
+    """roi_pool_op.cc: max over sampled grid per bin (sampled approximation
+    of the reference's exact integer-bin max, identical for aligned bins)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    roi_batch = (ins["RoisBatch"][0].reshape(-1).astype(jnp.int32)
+                 if ins.get("RoisBatch")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    out = _roi_grid(x, rois, roi_batch,
+                    int(attrs.get("pooled_height", 1)),
+                    int(attrs.get("pooled_width", 1)),
+                    float(attrs.get("spatial_scale", 1.0)),
+                    max(int(attrs.get("sampling_ratio", 4)), 1), "pool")
+    return {"Out": [out], "Argmax": [None]}
+
+
+@register_op("affine_channel", diff_inputs=["X", "Scale", "Bias"])
+def _affine_channel(ctx, ins, attrs):
+    """affine_channel_op.cc: per-channel x*scale+bias (NCHW)."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(1, -1, *([1] * (x.ndim - 2)))
+    bias = ins["Bias"][0].reshape(1, -1, *([1] * (x.ndim - 2)))
+    return {"Out": [x * scale + bias]}

@@ -1,0 +1,335 @@
+"""Loss / structured-prediction ops.
+
+Analogs of reference operators: cos_sim_op.cc, rank_loss_op.cc,
+margin_rank_loss_op.cc, bpr_loss_op.cc, nce_op.cc (sampled noise-
+contrastive estimation), hierarchical_sigmoid_op.cc, warpctc_op.cc (the
+reference dlopens warp-ctc; here CTC is a lax.scan forward algorithm in
+log space — fully differentiable, no external kernel),
+linear_chain_crf_op.cc + crf_decoding_op.cc (forward algorithm + Viterbi
+as scans), edit_distance_op.cc (Levenshtein DP as a scan over one string
+axis). Ragged inputs use the padded+length convention of ops/sequence.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_grad_lowering, register_op
+
+NEG = -1e30
+
+
+@register_op("cos_sim", diff_inputs=["X", "Y"])
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("rank_loss", diff_inputs=["Left", "Right"])
+def _rank_loss(ctx, ins, attrs):
+    """rank_loss_op.cc: RankNet pairwise loss."""
+    label = ins["Label"][0]
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jax.nn.softplus(d) - label * d]}
+
+
+@register_op("margin_rank_loss", diff_inputs=["X1", "X2"])
+def _margin_rank_loss(ctx, ins, attrs):
+    label = ins["Label"][0]
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    margin = float(attrs.get("margin", 0.0))
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("bpr_loss", diff_inputs=["X"])
+def _bpr_loss(ctx, ins, attrs):
+    """bpr_loss_op.cc: Bayesian Personalized Ranking over logits [B, C]
+    with positive-item Label [B, 1]."""
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    B, C = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)
+    diff = pos - x  # [B, C]
+    lose = -jnp.log(jax.nn.sigmoid(diff) + 1e-12)
+    mask = jnp.ones((B, C), x.dtype).at[jnp.arange(B), label].set(0)
+    out = jnp.sum(lose * mask, axis=1, keepdims=True) / jnp.maximum(C - 1, 1)
+    return {"Out": [out]}
+
+
+def _nce_loss(x, w, b, ids, k, C):
+    B = x.shape[0]
+    logits = jnp.einsum("bd,bkd->bk", x, w[ids])
+    if b is not None:
+        logits = logits + b[ids]
+    # uniform noise: log q = -log C; NCE logit correction
+    logits = logits - jnp.log(k / C)
+    labels01 = jnp.concatenate(
+        [jnp.ones((B, 1), x.dtype), jnp.zeros((B, k), x.dtype)], axis=1)
+    loss = jnp.sum(
+        jax.nn.softplus(logits) - labels01 * logits, axis=1, keepdims=True)
+    return loss, logits
+
+
+@register_op("nce", diff_inputs=["Input", "Weight", "Bias"], uses_rng=True)
+def _nce(ctx, ins, attrs):
+    """nce_op.cc: NCE loss with a uniform negative sampler (the
+    reference's default sampler). SampleLabels carries the drawn ids so
+    the grad op can replay the sample deterministically."""
+    x = ins["Input"][0]                     # [B, D]
+    w = ins["Weight"][0]                    # [C, D]
+    b = ins["Bias"][0] if ins.get("Bias") else None
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)  # [B]
+    k = int(attrs.get("num_neg_samples", 10))
+    C = w.shape[0]
+    B = x.shape[0]
+    neg = jax.random.randint(ctx.next_rng(), (B, k), 0, C)
+    ids = jnp.concatenate([label[:, None], neg], axis=1)   # [B, 1+k]
+    loss, logits = _nce_loss(x, w, b, ids, k, C)
+    return {"Cost": [loss], "SampleLogits": [logits], "SampleLabels": [ids]}
+
+
+@register_grad_lowering("nce")
+def _nce_grad(ctx, ins, attrs):
+    """Custom grad: reuse the saved SampleLabels instead of re-drawing
+    (the RNG is unavailable in the pure vjp re-trace)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    has_bias = bool(ins.get("Bias")) and ins["Bias"][0] is not None
+    b = ins["Bias"][0] if has_bias else None
+    ids = ins["SampleLabels"][0]
+    if ids is None:
+        raise ValueError(
+            "nce grad needs the SampleLabels output materialized")
+    k = int(attrs.get("num_neg_samples", 10))
+    C = w.shape[0]
+    dcost = ins["Cost@GRAD"][0]
+
+    if has_bias:
+        def f(x_, w_, b_):
+            return _nce_loss(x_, w_, b_, ids, k, C)[0]
+
+        _, vjp = jax.vjp(f, x, w, b)
+        dx, dw, db = vjp(dcost)
+    else:
+        def f(x_, w_):
+            return _nce_loss(x_, w_, None, ids, k, C)[0]
+
+        _, vjp = jax.vjp(f, x, w)
+        dx, dw = vjp(dcost)
+        db = None
+    return {"Input@GRAD": [dx], "Weight@GRAD": [dw], "Bias@GRAD": [db],
+            "Label@GRAD": [None]}
+
+
+@register_op("hierarchical_sigmoid", diff_inputs=["X", "W", "Bias"])
+def _hsigmoid(ctx, ins, attrs):
+    """hierarchical_sigmoid_op.cc, default complete-binary-tree codes: the
+    path/code of class c are the bits of (c + C) walking down from the
+    root, exactly the reference's SimpleCode scheme
+    (matrix_bit_code.h: calc_index = (c + C) >> (d+1) - 1)."""
+    x = ins["X"][0]                # [B, D]
+    w = ins["W"][0]                # [C-1, D] internal nodes
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    C = int(attrs["num_classes"])
+    depth = max((C - 1).bit_length(), 1)
+    code = label + C
+    losses = []
+    for d in range(depth):
+        idx = (code >> (d + 1)) - 1            # internal node index
+        bit = (code >> d) & 1                  # branch taken
+        valid = idx >= 0
+        idxc = jnp.clip(idx, 0, w.shape[0] - 1)
+        logit = jnp.einsum("bd,bd->b", x, w[idxc])
+        if bias is not None:
+            logit = logit + bias.reshape(-1)[idxc]
+        # P(bit) via sigmoid; loss = softplus(logit) - bit*logit
+        l = jax.nn.softplus(logit) - bit.astype(x.dtype) * logit
+        losses.append(jnp.where(valid, l, 0))
+    out = sum(losses).reshape(-1, 1)
+    return {"Out": [out], "PreOut": [None]}
+
+
+@register_op("warpctc", diff_inputs=["Logits"])
+def _warpctc(ctx, ins, attrs):
+    """warpctc_op.cc analog: CTC negative log-likelihood. Forward algorithm
+    over the extended label sequence in log space, lax.scan over time;
+    gradients come from autodiff of the scan instead of warp-ctc's
+    hand-written backward."""
+    logits = ins["Logits"][0]        # [B, T, C] raw (softmax applied here)
+    label = ins["Label"][0].astype(jnp.int32)  # [B, L] padded
+    logit_len = ins["LogitsLength"][0].reshape(-1).astype(jnp.int32)
+    label_len = ins["LabelLength"][0].reshape(-1).astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    B, T, C = logits.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    # extended labels: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    ext_valid = jnp.arange(S)[None, :] < (2 * label_len + 1)[:, None]
+    # can skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    emit = jnp.take_along_axis(
+        jnp.transpose(logp, (1, 0, 2)),      # [T, B, C]
+        jnp.broadcast_to(ext[None], (T, B, S)), axis=2)  # [T, B, S]
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(emit[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_len > 0, emit[0, :, 1], NEG))
+
+    def step(alpha, em):
+        a_prev = alpha
+        a_m1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=NEG)
+        a_m2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=NEG)
+        a_m2 = jnp.where(can_skip, a_m2, NEG)
+        new = jnp.logaddexp(jnp.logaddexp(a_prev, a_m1), a_m2) + em
+        new = jnp.where(ext_valid, new, NEG)
+        return new, new
+
+    _, alphas = lax.scan(step, alpha0, emit[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+
+    # likelihood at t = logit_len-1, states 2*label_len and 2*label_len-1
+    a_final = jnp.take_along_axis(
+        alphas, (logit_len - 1).reshape(1, B, 1), axis=0)[0]  # [B, S]
+    send = jnp.take_along_axis(a_final, (2 * label_len)[:, None], axis=1)
+    send1 = jnp.take_along_axis(
+        a_final, jnp.maximum(2 * label_len - 1, 0)[:, None], axis=1)
+    ll = jnp.logaddexp(send, jnp.where(label_len[:, None] > 0, send1, NEG))
+    return {"Loss": [-ll]}
+
+
+@register_op("linear_chain_crf", diff_inputs=["Emission", "Transition"])
+def _linear_chain_crf(ctx, ins, attrs):
+    """linear_chain_crf_op.cc analog: Transition rows 0/1 are start/stop
+    weights, rows 2..C+1 the CxC transition matrix (the reference layout).
+    Returns per-sequence LogLikelihood; grads via autodiff of the forward
+    scan rather than hand-coded beta recursions."""
+    emission = ins["Emission"][0]   # [B, T, C]
+    transition = ins["Transition"][0]  # [C+2, C]
+    label = ins["Label"][0].astype(jnp.int32)  # [B, T]
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    B, T, C = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+
+    t_idx = jnp.arange(T)
+    mask = t_idx[None, :] < length[:, None]          # [B, T]
+
+    # gold path score
+    em_score = jnp.take_along_axis(emission, label[:, :, None], axis=2)[..., 0]
+    em_score = jnp.sum(jnp.where(mask, em_score, 0), axis=1)
+    first_lab = label[:, 0]
+    last_lab = jnp.take_along_axis(
+        label, jnp.maximum(length - 1, 0)[:, None], axis=1)[:, 0]
+    tr_pairs = trans[label[:, :-1], label[:, 1:]]     # [B, T-1]
+    pair_mask = mask[:, 1:]
+    tr_score = jnp.sum(jnp.where(pair_mask, tr_pairs, 0), axis=1)
+    gold = em_score + tr_score + start[first_lab] + stop[last_lab]
+
+    # partition function (forward algorithm)
+    alpha0 = start[None, :] + emission[:, 0]          # [B, C]
+
+    def step(carry, t):
+        alpha = carry
+        em = emission[:, t]
+        new = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + trans[None], axis=1) + em
+        new = jnp.where((t < length)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    logz = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=1)
+    ll = gold - logz
+    return {"LogLikelihood": [ll.reshape(-1, 1)], "Alpha": [None],
+            "EmissionExps": [None], "TransitionExps": [None]}
+
+
+@register_op("crf_decoding", no_grad=True)
+def _crf_decoding(ctx, ins, attrs):
+    """crf_decoding_op.cc analog: Viterbi decode with the same transition
+    layout; scan forward keeping backpointers, then backtrack."""
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    B, T, C = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+
+    alpha0 = start[None, :] + emission[:, 0]
+
+    def fwd(alpha, t):
+        scores = alpha[:, :, None] + trans[None]       # [B, C, C]
+        best_prev = jnp.argmax(scores, axis=1)         # [B, C]
+        new = jnp.max(scores, axis=1) + emission[:, t]
+        new = jnp.where((t < length)[:, None], new, alpha)
+        best_prev = jnp.where((t < length)[:, None], best_prev,
+                              jnp.arange(C)[None, :])
+        return new, best_prev
+
+    alpha, bps = lax.scan(fwd, alpha0, jnp.arange(1, T))  # bps: [T-1, B, C]
+    last = jnp.argmax(alpha + stop[None, :], axis=1)      # [B]
+
+    def back(carry, bp):
+        cur = carry
+        prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+        return prev, cur
+
+    first, path_rest = lax.scan(back, last, bps, reverse=True)
+    # path_rest[k] is the label at position k+1; the final carry is position 0
+    path = jnp.concatenate([first[None], path_rest], axis=0).T  # [B, T]
+    mask = jnp.arange(T)[None, :] < length[:, None]
+    return {"ViterbiPath": [jnp.where(mask, path, 0).astype(jnp.int64)]}
+
+
+@register_op("edit_distance", no_grad=True)
+def _edit_distance(ctx, ins, attrs):
+    """edit_distance_op.cc analog: Levenshtein distance between padded id
+    sequences, DP as a scan over the hypothesis axis."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)       # [B, T1]
+    ref = ins["Refs"][0].astype(jnp.int32)       # [B, T2]
+    hyp_len = ins["HypsLength"][0].reshape(-1).astype(jnp.int32)
+    ref_len = ins["RefsLength"][0].reshape(-1).astype(jnp.int32)
+    normalized = bool(attrs.get("normalized", False))
+    B, T1 = hyp.shape
+    T2 = ref.shape[1]
+
+    # row0: distance from empty hyp prefix = j (clipped at ref_len)
+    j = jnp.arange(T2 + 1)
+    row0 = jnp.broadcast_to(j[None, :], (B, T2 + 1)).astype(jnp.int32)
+
+    def step(carry, i):
+        prev = carry  # [B, T2+1] distances for hyp prefix i
+        ins_cost = prev[:, 1:] + 1
+        sub = prev[:, :-1] + (hyp[:, i][:, None] != ref).astype(jnp.int32)
+
+        def inner(c, jj):
+            # c: current row prefix value at jj (del comes from c)
+            left = c + 1
+            best = jnp.minimum(jnp.minimum(left, ins_cost[:, jj]), sub[:, jj])
+            return best, best
+
+        first = prev[:, 0] + 1
+        _, rest = lax.scan(inner, first, jnp.arange(T2))
+        new = jnp.concatenate([first[:, None], rest.T], axis=1)
+        new = jnp.where((i < hyp_len)[:, None], new, prev)
+        return new, None
+
+    final, _ = lax.scan(step, row0, jnp.arange(T1))
+    d = jnp.take_along_axis(final, ref_len[:, None], axis=1)[:, 0]
+    d = d.astype(jnp.float32)
+    if normalized:
+        d = d / jnp.maximum(ref_len.astype(jnp.float32), 1)
+    return {"Out": [d.reshape(-1, 1)],
+            "SequenceNum": [jnp.asarray(float(B), jnp.float32).reshape(1)]}

@@ -1,0 +1,112 @@
+"""Quantization-simulation ops.
+
+Analogs of /root/reference/paddle/fluid/operators/fake_quantize_op.cc
+(fake_quantize_abs_max, fake_quantize_range_abs_max,
+fake_quantize_moving_average_abs_max) and fake_dequantize_op.cc. These
+simulate int8 inference during float training: quantize-round-dequantize
+in-graph, with a straight-through-estimator gradient (identity on X),
+which the reference implements via its grad kernels' pass-through.
+
+bf16/float stays the storage dtype — on TPU the win is exercising the
+same scale statistics the int8 deployment will use, not int8 compute.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op, register_grad_lowering
+
+
+def _qrange(bits: int) -> float:
+    return float((1 << (bits - 1)) - 1)
+
+
+def _quant_dequant(x, scale, qmax):
+    scale = jnp.maximum(scale, 1e-8)
+    y = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return y * scale / qmax
+
+
+@register_op("fake_quantize_abs_max", diff_inputs=["X"])
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    qmax = _qrange(int(attrs.get("bit_length", 8)))
+    if attrs.get("is_test", False) and ins.get("InScale") \
+            and ins["InScale"][0] is not None:
+        # frozen inference: use the collected scale, don't recompute
+        scale = ins["InScale"][0].reshape(())
+    else:
+        scale = jnp.max(jnp.abs(x))
+    return {"Out": [_quant_dequant(x, scale, qmax)],
+            "OutScale": [scale.reshape(1)]}
+
+
+@register_grad_lowering("fake_quantize_abs_max")
+def _fq_abs_max_grad(ctx, ins, attrs):
+    # straight-through estimator: dX = dOut
+    return {"X@GRAD": [ins["Out@GRAD"][0]]}
+
+
+@register_op("fake_quantize_moving_average_abs_max", diff_inputs=["X"])
+def _fake_quantize_ma_abs_max(ctx, ins, attrs):
+    """Activation quantization with a debiased moving-average scale
+    (fake_quantize_op.cc MovingAverageAbsMax: accum' = rate*accum + cur,
+    state' = rate*state + 1, scale = accum'/state')."""
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0]
+    qmax = _qrange(int(attrs.get("bit_length", 8)))
+    rate = float(attrs.get("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x))
+    if attrs.get("is_test", False):
+        scale = in_scale.reshape(())
+        return {"Out": [_quant_dequant(x, scale, qmax)],
+                "OutScale": [in_scale.reshape(1)]}
+    accum = ins["InAccum"][0] if ins.get("InAccum") else in_scale
+    state = ins["InState"][0] if ins.get("InState") else None
+    if state is not None:
+        new_accum = rate * accum.reshape(()) + cur
+        new_state = rate * state.reshape(()) + 1.0
+        scale = new_accum / new_state
+        return {"Out": [_quant_dequant(x, scale, qmax)],
+                "OutScale": [scale.reshape(1)],
+                "OutAccum": [new_accum.reshape(1)],
+                "OutState": [new_state.reshape(1)]}
+    scale = rate * in_scale.reshape(()) + (1.0 - rate) * cur
+    return {"Out": [_quant_dequant(x, scale, qmax)],
+            "OutScale": [scale.reshape(1)]}
+
+
+@register_grad_lowering("fake_quantize_moving_average_abs_max")
+def _fq_ma_grad(ctx, ins, attrs):
+    return {"X@GRAD": [ins["Out@GRAD"][0]], "InScale@GRAD": [None],
+            "InAccum@GRAD": [None], "InState@GRAD": [None]}
+
+
+@register_op("fake_quantize_range_abs_max", diff_inputs=["X"])
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Window-max variant (fake_quantize_op.cc RangeAbsMax): scale = max of
+    current and running scale (simplified window)."""
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0]
+    qmax = _qrange(int(attrs.get("bit_length", 8)))
+    cur = jnp.max(jnp.abs(x))
+    if attrs.get("is_test", False):
+        scale = in_scale.reshape(())
+    else:
+        scale = jnp.maximum(in_scale.reshape(()), cur)
+    return {"Out": [_quant_dequant(x, scale, qmax)],
+            "OutScale": [scale.reshape(1)]}
+
+
+@register_grad_lowering("fake_quantize_range_abs_max")
+def _fq_range_grad(ctx, ins, attrs):
+    return {"X@GRAD": [ins["Out@GRAD"][0]], "InScale@GRAD": [None]}
+
+
+@register_op("fake_dequantize_max_abs", diff_inputs=["X"])
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": [x * scale.reshape(()) / max_range]}

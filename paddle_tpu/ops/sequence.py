@@ -1,12 +1,29 @@
-"""Sequence ops on padded dense batches (reference: operators/sequence_ops/).
-LoD offsets become explicit length vectors + masks (SURVEY §5)."""
+"""Sequence ops on padded dense batches.
+
+Analog of /root/reference/paddle/fluid/operators/sequence_ops/ (~5k LoC of
+LoD-aware CPU/CUDA kernels) and math/sequence_* helpers. The reference
+threads ragged batches through LoD offset vectors (lod_tensor.h:58); XLA
+wants static shapes, so every sequence here is (X: [B, T, ...] padded,
+Length: [B] int) and the kernels become masked dense ops (SURVEY §5/§7
+"LoD vs static shapes"). Positions t >= Length[b] are padding and never
+influence results or gradients.
+"""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.lowering import as_jax_dtype
 from ..core.registry import register_op
+
+
+def _time_mask(x, length, fill=None):
+    """[B, T] bool mask broadcastable to x's shape from a [B] length vec."""
+    B, T = x.shape[0], x.shape[1]
+    m = jnp.arange(T)[None, :] < length.reshape(-1, 1)
+    extra = (1,) * (x.ndim - 2)
+    return m.reshape((B, T) + extra)
 
 
 @register_op("sequence_mask", no_grad=True)
@@ -19,3 +36,236 @@ def _sequence_mask(ctx, ins, attrs):
     mask = rng[None, :] < x.reshape(-1, 1)
     mask = mask.reshape(tuple(x.shape) + (maxlen,))
     return {"Y": [mask.astype(as_jax_dtype(attrs.get("out_dtype", "float32")))]}
+
+
+@register_op("sequence_pool", diff_inputs=["X"])
+def _sequence_pool(ctx, ins, attrs):
+    """sequence_pool_op.cc analog: pool over the time dim under the mask.
+    pool_type: average|sum|sqrt|max|last|first."""
+    x = ins["X"][0]
+    length = ins["Length"][0]
+    ptype = attrs.get("pool_type", "average").lower()
+    m = _time_mask(x, length)
+    n = jnp.maximum(length.reshape((-1,) + (1,) * (x.ndim - 2)), 1)
+    n = n.astype(x.dtype)
+    if ptype in ("average", "mean"):
+        out = jnp.sum(jnp.where(m, x, 0), axis=1) / n
+    elif ptype == "sum":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1)
+    elif ptype == "sqrt":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1) / jnp.sqrt(n)
+    elif ptype == "max":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m, x, neg), axis=1)
+    elif ptype == "last":
+        idx = jnp.maximum(length - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "first":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pool_type %r" % ptype)
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax", diff_inputs=["X"])
+def _sequence_softmax(ctx, ins, attrs):
+    """sequence_softmax_op.cc analog: softmax over valid timesteps only."""
+    x = ins["X"][0]
+    length = ins["Length"][0]
+    m = _time_mask(x, length)
+    z = jnp.where(m, x, jnp.finfo(x.dtype).min)
+    z = z - jax.scipy.special.logsumexp(z, axis=1, keepdims=True)
+    return {"Out": [jnp.where(m, jnp.exp(z), 0)]}
+
+
+@register_op("sequence_reverse", diff_inputs=["X"])
+def _sequence_reverse(ctx, ins, attrs):
+    """sequence_reverse_op.h analog: reverse each row's valid prefix, keep
+    padding in place."""
+    x = ins["X"][0]
+    length = ins["Length"][0]
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]
+    L = length.reshape(-1, 1)
+    src = jnp.where(t < L, L - 1 - t, t)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    return {"Y": [out]}
+
+
+@register_op("sequence_expand", diff_inputs=["X"])
+def _sequence_expand(ctx, ins, attrs):
+    """sequence_expand_op.cc analog, static form: tile each row of X
+    ref_level times (Y provides the repeat count via its time dim)."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    rep = y.shape[1]
+    out = jnp.repeat(x[:, None], rep, axis=1)
+    return {"Out": [out.reshape((x.shape[0] * rep,) + tuple(x.shape[1:]))]}
+
+
+@register_op("sequence_expand_as", diff_inputs=["X"])
+def _sequence_expand_as(ctx, ins, attrs):
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    rep = y.shape[0] // x.shape[0]
+    out = jnp.repeat(x, rep, axis=0)
+    return {"Out": [out]}
+
+
+@register_op("sequence_conv", diff_inputs=["X", "Filter"])
+def _sequence_conv(ctx, ins, attrs):
+    """sequence_conv_op.cc analog: context-window conv along time.
+    Filter: [context_length * D, F]. Padding timesteps contribute zeros
+    (the reference's zero-padded im2col path)."""
+    x = ins["X"][0]  # [B, T, D]
+    filt = ins["Filter"][0]
+    length = ins["Length"][0]
+    ctx_len = int(attrs.get("context_length", 3))
+    ctx_start = int(attrs.get("context_start", -(ctx_len // 2)))
+    B, T, D = x.shape
+    m = _time_mask(x, length)
+    xm = jnp.where(m, x, 0)
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(xm, -off, axis=1)
+        t = jnp.arange(T)
+        valid = ((t + off) >= 0) & ((t + off) < T)
+        cols.append(jnp.where(valid[None, :, None], shifted, 0))
+    col = jnp.concatenate(cols, axis=-1)  # [B, T, ctx_len*D]
+    out = jnp.einsum("btc,cf->btf", col, filt)
+    out = jnp.where(m, out, 0)
+    return {"Out": [out]}
+
+
+@register_op("sequence_pad", diff_inputs=["X"])
+def _sequence_pad(ctx, ins, attrs):
+    """sequence_pad_op.cc analog. Input already lives padded; this op
+    (re)applies the pad value outside each row's valid prefix and reports
+    lengths — the LoD-erasing boundary of the reference maps to a mask
+    refresh here."""
+    x = ins["X"][0]
+    length = ins["Length"][0]
+    pad_value = ins["PadValue"][0] if ins.get("PadValue") else jnp.zeros(
+        (), x.dtype)
+    m = _time_mask(x, length)
+    out = jnp.where(m, x, jnp.asarray(pad_value, x.dtype))
+    return {"Out": [out], "Length": [length]}
+
+
+@register_op("sequence_unpad", diff_inputs=["X"])
+def _sequence_unpad(ctx, ins, attrs):
+    """sequence_unpad_op.cc analog: zero out the padding (the ragged
+    flatten of the reference keeps static shape here)."""
+    x = ins["X"][0]
+    length = ins["Length"][0]
+    return {"Out": [jnp.where(_time_mask(x, length), x, 0)]}
+
+
+@register_op("sequence_concat", diff_inputs=["X"])
+def _sequence_concat(ctx, ins, attrs):
+    """sequence_concat_op.cc analog: concatenate per-row valid prefixes
+    along time. Output time dim = sum of input time dims (padding packed
+    to the tail via a gather built from the lengths)."""
+    xs = [v for v in ins["X"] if v is not None]
+    lens = [v.astype(jnp.int32) for v in ins["Length"] if v is not None]
+    B = xs[0].shape[0]
+    T_out = sum(int(x.shape[1]) for x in xs)
+    xcat = jnp.concatenate(xs, axis=1)  # [B, T_out, ...] segment-padded
+    # source index for output position t: walk segments, skipping padding
+    starts = []
+    acc = 0
+    for x in xs:
+        starts.append(acc)
+        acc += int(x.shape[1])
+    total = sum(lens)  # [B] valid rows
+    t = jnp.arange(T_out, dtype=jnp.int32)[None, :]
+    # offset of each output slot within the concatenated valid region
+    src = jnp.zeros((B, T_out), jnp.int32)
+    cum = jnp.zeros((B,), jnp.int32)
+    for x, ln, st in zip(xs, lens, starts):
+        seg_pos = t - cum[:, None]           # position inside this segment
+        in_seg = (seg_pos >= 0) & (seg_pos < ln[:, None])
+        src = jnp.where(in_seg, st + seg_pos, src)
+        cum = cum + ln
+    out = jnp.take_along_axis(
+        xcat, src.reshape(src.shape + (1,) * (xcat.ndim - 2)), axis=1)
+    m = t < total[:, None]
+    out = jnp.where(m.reshape(m.shape + (1,) * (out.ndim - 2)), out, 0)
+    return {"Out": [out], "LengthOut": [total]}
+
+
+@register_op("sequence_slice", diff_inputs=["X"])
+def _sequence_slice(ctx, ins, attrs):
+    """sequence_slice_op.h analog: per-row [offset, offset+length) window,
+    shifted to the front of the time dim."""
+    x = ins["X"][0]
+    offset = ins["Offset"][0].reshape(-1)
+    length = ins["SliceLength"][0].reshape(-1)
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]
+    src = jnp.clip(offset[:, None] + t, 0, T - 1)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    m = t < length[:, None]
+    out = jnp.where(m.reshape(m.shape + (1,) * (out.ndim - 2)), out, 0)
+    return {"Out": [out], "LengthOut": [length]}
+
+
+@register_op("sequence_enumerate", no_grad=True)
+def _sequence_enumerate(ctx, ins, attrs):
+    """sequence_enumerate_op.cc analog: sliding windows of ids, padded
+    with pad_value beyond each row's length."""
+    x = ins["X"][0]  # [B, T] int ids
+    length = ins["Length"][0] if ins.get("Length") else None
+    win = int(attrs.get("win_size", 2))
+    pad = int(attrs.get("pad_value", 0))
+    B, T = x.shape[0], x.shape[1]
+    outs = []
+    for k in range(win):
+        shifted = jnp.roll(x, -k, axis=1)
+        valid = (jnp.arange(T) + k) < T
+        if length is not None:
+            valid = valid[None, :] & ((jnp.arange(T)[None, :] + k)
+                                      < length.reshape(-1, 1))
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (B, T))
+        outs.append(jnp.where(valid, shifted, pad))
+    return {"Out": [jnp.stack(outs, axis=-1)]}
+
+
+@register_op("sequence_erase", no_grad=True)
+def _sequence_erase(ctx, ins, attrs):
+    """sequence_erase_op.cc analog: drop listed tokens, compact each row's
+    survivors to the front (stable), report new lengths."""
+    x = ins["X"][0]  # [B, T] int ids
+    length = ins["Length"][0]
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    T = x.shape[1]
+    valid = _time_mask(x, length)
+    keep = valid & ~jnp.isin(x, tokens)
+    # stable compaction: sort positions by (dropped, original index)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(T)[None, :], T + 1), axis=1)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1).astype(length.dtype)
+    out = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], compacted, 0)
+    return {"Out": [out], "LengthOut": [new_len]}
+
+
+@register_op("row_conv", diff_inputs=["X", "Filter"])
+def _row_conv(ctx, ins, attrs):
+    """row_conv_op.cc analog (lookahead conv for streaming ASR):
+    out[b,t] = sum_k filter[k] * x[b, t+k]."""
+    x = ins["X"][0]  # [B, T, D]
+    filt = ins["Filter"][0]  # [future_ctx, D]
+    K = filt.shape[0]
+    T = x.shape[1]
+    out = jnp.zeros_like(x)
+    for k in range(K):
+        shifted = jnp.roll(x, -k, axis=1)
+        valid = (jnp.arange(T) + k) < T
+        out = out + jnp.where(valid[None, :, None], shifted, 0) * filt[k][None, None, :]
+    return {"Out": [out]}

@@ -1,38 +1,95 @@
-"""Profiler (reference: python/paddle/fluid/profiler.py:39-165 +
-platform/profiler.cc + tools/timeline.py).
+"""Profiler: host annotations + aggregation tables + chrome trace export.
 
-Host annotations use jax.profiler (XLA's trace replaces CUPTI); traces are
-viewable in TensorBoard/Perfetto — the chrome://tracing analog.
+Analog of the reference profiling stack (SURVEY §5):
+* `RecordEvent` RAII markers — platform/profiler.h:81 (placed around every
+  op run in operator.cc:180; here around every compiled-step launch, since
+  ops fuse into one XLA executable)
+* `EnableProfiler/DisableProfiler` + aggregated event tables —
+  platform/profiler.cc (calls / total / min / max / avg per event key)
+* chrome://tracing JSON — tools/timeline.py converts the reference's
+  profiler.proto; here the host events serialize straight to the chrome
+  trace format, no converter needed
+* device side — DeviceTracer hooked CUPTI; the XLA/TPU analog is
+  jax.profiler's trace (TensorBoard/Perfetto), started alongside the host
+  recorder when state includes the device.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
+import os
+import threading
 import time
+from typing import Dict, List, Optional
 
-__all__ = ["profiler", "start_profiler", "stop_profiler", "cuda_profiler",
-           "RecordEvent"]
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "cuda_profiler", "RecordEvent", "is_profiler_enabled"]
 
-_trace_dir = None
-
-
-def start_profiler(state="All", trace_dir="/tmp/paddle_tpu_trace"):
-    global _trace_dir
-    import jax
-
-    _trace_dir = trace_dir
-    jax.profiler.start_trace(trace_dir)
+_lock = threading.Lock()
+_enabled = False
+_xla_trace = False
+_events: List[tuple] = []  # (name, start_us, end_us, thread_id)
+_start_ts: Optional[float] = None
 
 
-def stop_profiler(sorted_key=None, profile_path=None):
-    import jax
+def is_profiler_enabled() -> bool:
+    return _enabled
 
-    jax.profiler.stop_trace()
+
+def start_profiler(state: str = "All",
+                   trace_dir: str = "/tmp/paddle_tpu_trace"):
+    """EnableProfiler analog (profiler.h:166). state: CPU|GPU|All — GPU/All
+    also starts the XLA device trace (DeviceTracer/CUPTI analog)."""
+    global _enabled, _xla_trace, _start_ts
+    with _lock:
+        if _enabled:
+            return
+        _events.clear()
+        _enabled = True
+        _start_ts = time.perf_counter()
+    if state in ("GPU", "All"):
+        import jax
+
+        try:
+            jax.profiler.start_trace(trace_dir)
+            _xla_trace = True
+        except Exception:
+            _xla_trace = False
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: Optional[str] = None):
+    """DisableProfiler analog: stop traces, print the aggregated event
+    table, optionally dump a chrome://tracing JSON to profile_path."""
+    global _enabled, _xla_trace
+    with _lock:
+        if not _enabled:
+            return
+        _enabled = False
+        events = list(_events)
+    if _xla_trace:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _xla_trace = False
+    _print_table(events, sorted_key)
+    if profile_path:
+        _write_chrome_trace(events, profile_path)
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
 
 
 @contextlib.contextmanager
-def profiler(state="All", sorted_key=None, profile_path=None,
-             trace_dir="/tmp/paddle_tpu_trace"):
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: Optional[str] = None,
+             trace_dir: str = "/tmp/paddle_tpu_trace"):
+    """Context manager (python/paddle/fluid/profiler.py:39 analog)."""
     start_profiler(state, trace_dir)
     try:
         yield
@@ -47,19 +104,88 @@ def cuda_profiler(*a, **kw):  # name kept for porting ease; maps to XLA trace
 
 
 class RecordEvent:
-    """RAII trace annotation (reference platform/profiler.h:81)."""
+    """RAII trace annotation (platform/profiler.h:81). Always feeds the
+    host aggregation table; additionally shows up in the XLA device trace
+    when one is running."""
 
-    def __init__(self, name):
+    def __init__(self, name: str):
         self.name = name
-        self._ctx = None
+        self._t0 = None
+        self._ann = None
 
     def __enter__(self):
-        import jax
+        if _enabled:
+            self._t0 = time.perf_counter()
+        if _xla_trace:
+            import jax
 
-        self._ctx = jax.profiler.TraceAnnotation(self.name)
-        self._ctx.__enter__()
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
         return self
 
     def __exit__(self, *exc):
-        self._ctx.__exit__(*exc)
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        if self._t0 is not None:
+            t1 = time.perf_counter()
+            with _lock:
+                if _enabled:
+                    _events.append((
+                        self.name,
+                        (self._t0 - _start_ts) * 1e6,
+                        (t1 - _start_ts) * 1e6,
+                        threading.get_ident(),
+                    ))
+            self._t0 = None
         return False
+
+
+def record_event(name: str) -> RecordEvent:
+    return RecordEvent(name)
+
+
+# ---------------------------------------------------------------- reporting
+def _print_table(events, sorted_key=None):
+    if not events:
+        return
+    agg: Dict[str, List[float]] = {}
+    for name, s, e, _tid in events:
+        agg.setdefault(name, []).append(e - s)
+    rows = []
+    for name, ds in agg.items():
+        rows.append((name, len(ds), sum(ds), sum(ds) / len(ds), min(ds),
+                     max(ds)))
+    keyfn = {
+        None: lambda r: -r[2],
+        "default": lambda r: -r[2],
+        "total": lambda r: -r[2],
+        "calls": lambda r: -r[1],
+        "ave": lambda r: -r[3],
+        "min": lambda r: r[4],
+        "max": lambda r: -r[5],
+    }.get(sorted_key, lambda r: -r[2])
+    rows.sort(key=keyfn)
+    print("-------------------------  Profiling Report  "
+          "-------------------------")
+    print("%-40s %8s %12s %12s %12s %12s" %
+          ("Event", "Calls", "Total(us)", "Avg(us)", "Min(us)", "Max(us)"))
+    for name, calls, total, avg, mn, mx in rows:
+        print("%-40s %8d %12.1f %12.1f %12.1f %12.1f" %
+              (name[:40], calls, total, avg, mn, mx))
+
+
+def _write_chrome_trace(events, path: str):
+    """chrome://tracing JSON (tools/timeline.py output format analog)."""
+    tids = {}
+    trace = []
+    for name, s, e, tid in events:
+        tids.setdefault(tid, len(tids))
+        trace.append({
+            "name": name, "cat": "host", "ph": "X",
+            "ts": s, "dur": e - s, "pid": os.getpid(),
+            "tid": tids[tid],
+        })
+    with open(path, "w") as f:
+        json.dump({"traceEvents": trace,
+                   "displayTimeUnit": "ms"}, f)

@@ -1,0 +1,163 @@
+"""Detection + interpolation op tests vs numpy references (reference
+test_prior_box_op.py, test_iou_similarity_op.py, test_multiclass_nms_op.py,
+test_roi_align_op.py, test_bilinear_interp_op.py analogs)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest, _OpProgram, _as_feed
+
+
+def _r(*shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+def test_iou_similarity():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+    want = np.array([[1.0, 0.0], [1 / 7, 1 / 7]], np.float32)
+    OpTest.check_output("iou_similarity", {"X": [x], "Y": [y]}, {},
+                        {"Out": [want]}, atol=1e-5)
+
+
+def test_nearest_interp():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    prog = _OpProgram("nearest_interp", {"X": [x]},
+                      {"out_h": 2, "out_w": 2, "align_corners": True},
+                      {"Out": 1})
+    got = prog.run(_as_feed({"X": [x]}), prog.fetch)
+    out = np.asarray(got[prog.out_names[("Out", 0)]])
+    np.testing.assert_allclose(out[0, 0], [[0, 3], [12, 15]])
+
+
+def test_bilinear_interp_align_corners():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    prog = _OpProgram("bilinear_interp", {"X": [x]},
+                      {"out_h": 3, "out_w": 3, "align_corners": True},
+                      {"Out": 1})
+    got = prog.run(_as_feed({"X": [x]}), prog.fetch)
+    out = np.asarray(got[prog.out_names[("Out", 0)]])[0, 0]
+    want = np.array([[0, 0.5, 1], [1, 1.5, 2], [2, 2.5, 3]], np.float32)
+    np.testing.assert_allclose(out, want, atol=1e-6)
+
+
+def test_bilinear_interp_grad():
+    x = _r(1, 2, 3, 3, seed=1)
+    OpTest.check_grad("bilinear_interp", {"X": [x]},
+                      {"out_h": 5, "out_w": 5, "align_corners": True},
+                      {"Out": 1}, wrt=["X"])
+
+
+def test_prior_box_shapes_and_values():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    image = np.zeros((1, 3, 8, 8), np.float32)
+    prog = _OpProgram("prior_box", {"Input": [feat], "Image": [image]},
+                      {"min_sizes": [4.0], "aspect_ratios": [1.0, 2.0],
+                       "flip": True, "clip": True,
+                       "variances": [0.1, 0.1, 0.2, 0.2]},
+                      {"Boxes": 1, "Variances": 1})
+    got = prog.run(_as_feed({"Input": [feat], "Image": [image]}), prog.fetch)
+    boxes = np.asarray(got[prog.out_names[("Boxes", 0)]])
+    var = np.asarray(got[prog.out_names[("Variances", 0)]])
+    # P = 1 (min) + 2 (ratio 2 + flip) = 3 anchors per cell
+    assert boxes.shape == (2, 2, 3, 4)
+    assert var.shape == (2, 2, 3, 4)
+    # first cell, square anchor: center (2,2), size 4 → [0,0,4,4]/8
+    np.testing.assert_allclose(boxes[0, 0, 0], [0, 0, 0.5, 0.5], atol=1e-6)
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[0, 0, 4, 4], [2, 2, 8, 8]], np.float32)
+    target = np.array([[1, 1, 3, 3]], np.float32)
+    enc_prog = _OpProgram("box_coder",
+                          {"PriorBox": [prior], "TargetBox": [target]},
+                          {"code_type": "encode_center_size"},
+                          {"OutputBox": 1})
+    enc = np.asarray(enc_prog.run(
+        _as_feed({"PriorBox": [prior], "TargetBox": [target]}),
+        enc_prog.fetch)[enc_prog.out_names[("OutputBox", 0)]])
+    dec_prog = _OpProgram("box_coder",
+                          {"PriorBox": [prior], "TargetBox": [enc]},
+                          {"code_type": "decode_center_size"},
+                          {"OutputBox": 1})
+    dec = np.asarray(dec_prog.run(
+        _as_feed({"PriorBox": [prior], "TargetBox": [enc]}),
+        dec_prog.fetch)[dec_prog.out_names[("OutputBox", 0)]])
+    # decoding the encoding of the target against each prior recovers it
+    np.testing.assert_allclose(dec[0, 0], target[0], atol=1e-4)
+    np.testing.assert_allclose(dec[0, 1], target[0], atol=1e-4)
+
+
+def test_multiclass_nms_suppresses():
+    boxes = np.array([[0, 0, 2, 2], [0, 0, 2.1, 2.1], [5, 5, 7, 7]],
+                     np.float32)
+    scores = np.array([[0.9, 0.8, 0.7]], np.float32)  # 1 class, 3 boxes
+    prog = _OpProgram("multiclass_nms",
+                      {"BBoxes": [boxes], "Scores": [scores]},
+                      {"score_threshold": 0.1, "nms_threshold": 0.5,
+                       "nms_top_k": 3, "keep_top_k": 4},
+                      {"Out": 1})
+    got = np.asarray(prog.run(
+        _as_feed({"BBoxes": [boxes], "Scores": [scores]}),
+        prog.fetch)[prog.out_names[("Out", 0)]])
+    assert got.shape == (4, 6)
+    kept = got[got[:, 0] >= 0]
+    # overlapping 0.8 box suppressed; 0.9 and the far 0.7 kept
+    assert len(kept) == 2
+    assert abs(kept[0, 1] - 0.9) < 1e-6 and abs(kept[1, 1] - 0.7) < 1e-6
+
+
+def test_multiclass_nms_background_excluded():
+    boxes = np.array([[0, 0, 2, 2], [5, 5, 7, 7]], np.float32)
+    scores = np.array([[0.9, 0.8], [0.3, 0.4]], np.float32)  # 2 classes
+    prog = _OpProgram("multiclass_nms",
+                      {"BBoxes": [boxes], "Scores": [scores]},
+                      {"score_threshold": 0.1, "nms_threshold": 0.5,
+                       "nms_top_k": 2, "keep_top_k": 4,
+                       "background_label": 0},
+                      {"Out": 1})
+    got = np.asarray(prog.run(
+        _as_feed({"BBoxes": [boxes], "Scores": [scores]}),
+        prog.fetch)[prog.out_names[("Out", 0)]])
+    kept = got[got[:, 0] >= 0]
+    assert len(kept) == 2 and (kept[:, 0] == 1).all()
+
+
+def test_roi_align_and_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 4, 4]], np.float32)
+    prog = _OpProgram("roi_align", {"X": [x], "ROIs": [rois]},
+                      {"pooled_height": 2, "pooled_width": 2,
+                       "spatial_scale": 1.0, "sampling_ratio": 2},
+                      {"Out": 1})
+    out = np.asarray(prog.run(_as_feed({"X": [x], "ROIs": [rois]}),
+                              prog.fetch)[prog.out_names[("Out", 0)]])
+    assert out.shape == (1, 1, 2, 2)
+    # top-left bin of an aligned 4x4→2x2 average ≈ mean of the quadrant
+    assert abs(out[0, 0, 0, 0] - x[0, 0, :2, :2].mean()) < 1.0
+    OpTest.check_grad("roi_align", {"X": [x], "ROIs": [rois]},
+                      {"pooled_height": 2, "pooled_width": 2,
+                       "spatial_scale": 1.0, "sampling_ratio": 2},
+                      {"Out": 1}, wrt=["X"])
+    prog2 = _OpProgram("roi_pool", {"X": [x], "ROIs": [rois]},
+                       {"pooled_height": 2, "pooled_width": 2,
+                        "spatial_scale": 1.0},
+                       {"Out": 1})
+    out2 = np.asarray(prog2.run(_as_feed({"X": [x], "ROIs": [rois]}),
+                                prog2.fetch)[prog2.out_names[("Out", 0)]])
+    assert out2[0, 0, 1, 1] == 15.0  # max of bottom-right quadrant
+
+
+def test_affine_channel():
+    x = _r(2, 3, 2, 2, seed=2)
+    scale = np.array([1.0, 2.0, 3.0], np.float32)
+    bias = np.array([0.5, 0.0, -1.0], np.float32)
+    want = x * scale[None, :, None, None] + bias[None, :, None, None]
+    OpTest.check_output("affine_channel",
+                        {"X": [x], "Scale": [scale], "Bias": [bias]}, {},
+                        {"Out": [want]}, atol=1e-6)
+    OpTest.check_grad("affine_channel",
+                      {"X": [x], "Scale": [scale], "Bias": [bias]}, {},
+                      {"Out": 1}, wrt=["X", "Scale", "Bias"])

@@ -1,0 +1,136 @@
+"""Layer-level tests for the loss/detection/interp families: wiring +
+small end-to-end trainings (reference test_layers.py style)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.scope import scope_guard
+
+
+def test_crf_tagger_trains(fresh_programs):
+    """linear_chain_crf + crf_decoding with a shared transition param:
+    log-likelihood rises and decoding recovers the synthetic tag rule."""
+    main, startup, scope = fresh_programs
+    rng = np.random.RandomState(0)
+    B, T, C, D = 8, 6, 3, 5
+    W = rng.randn(D, C).astype(np.float32)
+    X = rng.randn(B, T, D).astype(np.float32)
+    gold = (X @ W).argmax(-1).astype(np.int64)
+    length = np.full((B,), T, np.int64)
+
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, D], dtype="float32")
+        lab = fluid.layers.data(name="lab", shape=[T], dtype="int64")
+        ln = fluid.layers.data(name="len", shape=[], dtype="int64")
+        emission = fluid.layers.fc(x, size=C, num_flatten_dims=2)
+        ll = fluid.layers.linear_chain_crf(
+            emission, lab, length=ln,
+            param_attr=fluid.ParamAttr(name="crf_trans"))
+        loss = fluid.layers.mean(fluid.layers.scale(ll, scale=-1.0))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+        path = fluid.layers.crf_decoding(
+            emission, param_attr=fluid.ParamAttr(name="crf_trans"), length=ln)
+
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = []
+        for _ in range(40):
+            lv, = exe.run(main, feed={"x": X, "lab": gold, "len": length},
+                          fetch_list=[loss.name], scope=scope)
+            losses.append(float(lv))
+        decoded, = exe.run(main, feed={"x": X, "lab": gold, "len": length},
+                           fetch_list=[path.name], scope=scope)
+    assert losses[-1] < losses[0] * 0.5, losses
+    acc = (decoded == gold).mean()
+    assert acc > 0.9, acc
+
+
+def test_warpctc_layer_trains(fresh_programs):
+    main, startup, scope = fresh_programs
+    rng = np.random.RandomState(1)
+    B, T, C, L = 4, 8, 5, 3
+    X = rng.randn(B, T, 6).astype(np.float32)
+    label = rng.randint(1, C, (B, L)).astype(np.int64)
+
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, 6], dtype="float32")
+        lab = fluid.layers.data(name="lab", shape=[L], dtype="int64")
+        xl = fluid.layers.data(name="xl", shape=[], dtype="int64")
+        ll = fluid.layers.data(name="ll", shape=[], dtype="int64")
+        logits = fluid.layers.fc(x, size=C, num_flatten_dims=2)
+        loss = fluid.layers.mean(
+            fluid.layers.warpctc(logits, lab, xl, ll, blank=0))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    feed = {"x": X, "lab": label,
+            "xl": np.full((B,), T, np.int64),
+            "ll": np.full((B,), L, np.int64)}
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss.name],
+                                scope=scope)[0]) for _ in range(30)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_nce_hsigmoid_layers(fresh_programs):
+    main, startup, scope = fresh_programs
+    rng = np.random.RandomState(2)
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="int64")
+        c1 = fluid.layers.nce(x, lab, num_total_classes=32, num_neg_samples=5)
+        c2 = fluid.layers.hsigmoid(x, lab, num_classes=32)
+        loss = fluid.layers.mean(c1) + fluid.layers.mean(c2)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        lv, = exe.run(main,
+                      feed={"x": rng.randn(6, 8).astype(np.float32),
+                            "lab": rng.randint(0, 32, (6, 1)).astype(np.int64)},
+                      fetch_list=[loss.name], scope=scope)
+    assert np.isfinite(lv).all()
+
+
+def test_detection_layers_build_and_run(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data(name="feat", shape=[8, 4, 4], dtype="float32")
+        img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+        boxes, var = fluid.layers.prior_box(feat, img, min_sizes=[8.0],
+                                            aspect_ratios=[1.0, 2.0],
+                                            clip=True)
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[4], dtype="float32")
+        iou = fluid.layers.iou_similarity(x, y)
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        b, v, i = exe.run(
+            main,
+            feed={"feat": np.zeros((1, 8, 4, 4), np.float32),
+                  "img": np.zeros((1, 3, 32, 32), np.float32),
+                  "x": np.array([[0, 0, 1, 1]], np.float32),
+                  "y": np.array([[0, 0, 1, 1], [5, 5, 6, 6]], np.float32)},
+            fetch_list=[boxes.name, var.name, iou.name], scope=scope)
+    assert b.shape[:2] == (4, 4) and b.shape[-1] == 4
+    np.testing.assert_allclose(i, [[1.0, 0.0]], atol=1e-6)
+
+
+def test_resize_layers(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2, 4, 4], dtype="float32")
+        up = fluid.layers.resize_bilinear(x, out_shape=[8, 8])
+        nn_ = fluid.layers.resize_nearest(x, out_shape=[2, 2])
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        a, b = exe.run(main,
+                       feed={"x": np.ones((1, 2, 4, 4), np.float32)},
+                       fetch_list=[up.name, nn_.name], scope=scope)
+    assert a.shape == (1, 2, 8, 8) and b.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(a, 1.0)

@@ -1,0 +1,228 @@
+"""Loss / structured-prediction op tests vs brute-force numpy references
+(reference test_warpctc_op.py, test_linear_chain_crf_op.py,
+test_edit_distance_op.py, test_rank_loss_op.py ... analogs)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _r(*shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).rand(*shape).astype(np.float32)
+            - 0.5) * 2 * scale
+
+
+def test_cos_sim():
+    x, y = _r(4, 6, seed=1), _r(4, 6, seed=2)
+    want = (x * y).sum(-1, keepdims=True) / (
+        np.linalg.norm(x, axis=-1, keepdims=True)
+        * np.linalg.norm(y, axis=-1, keepdims=True))
+    OpTest.check_output("cos_sim", {"X": [x], "Y": [y]}, {},
+                        {"Out": [want]}, atol=1e-5)
+    OpTest.check_grad("cos_sim", {"X": [x], "Y": [y]}, {},
+                      {"Out": 1, "XNorm": 1, "YNorm": 1},
+                      wrt=["X"], float_outs=[("Out", 0)])
+
+
+def test_rank_loss():
+    left, right = _r(5, 1, seed=1), _r(5, 1, seed=2)
+    label = (np.random.RandomState(3).rand(5, 1) > 0.5).astype(np.float32)
+    d = left - right
+    want = np.log1p(np.exp(d)) - label * d
+    OpTest.check_output("rank_loss",
+                        {"Label": [label], "Left": [left], "Right": [right]},
+                        {}, {"Out": [want]}, atol=1e-5)
+
+
+def test_margin_rank_loss():
+    x1, x2 = _r(6, 1, seed=1), _r(6, 1, seed=2)
+    label = np.sign(np.random.RandomState(3).randn(6, 1)).astype(np.float32)
+    want = np.maximum(0, -label * (x1 - x2) + 0.1)
+    OpTest.check_output("margin_rank_loss",
+                        {"Label": [label], "X1": [x1], "X2": [x2]},
+                        {"margin": 0.1}, {"Out": [want]}, atol=1e-6)
+
+
+def test_bpr_loss():
+    x = _r(3, 5, seed=4, scale=2.0)
+    label = np.array([[1], [0], [4]], np.int64)
+    B, C = x.shape
+    want = np.zeros((B, 1), np.float32)
+    for b in range(B):
+        pos = x[b, label[b, 0]]
+        s = 0.0
+        for c in range(C):
+            if c == label[b, 0]:
+                continue
+            s += -np.log(1.0 / (1.0 + np.exp(-(pos - x[b, c]))) + 1e-12)
+        want[b, 0] = s / (C - 1)
+    OpTest.check_output("bpr_loss", {"X": [x], "Label": [label]}, {},
+                        {"Out": [want]}, atol=1e-4)
+
+
+def _ctc_brute(logp, labels, blank=0):
+    """Enumerate all alignments for a tiny case."""
+    T, C = logp.shape
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                out.append(s)
+            prev = s
+        if out == list(labels):
+            total = np.logaddexp(total, sum(logp[t, path[t]] for t in range(T)))
+    return -total
+
+
+def test_warpctc_vs_bruteforce():
+    rng = np.random.RandomState(0)
+    T, C = 4, 3
+    logits = rng.randn(1, T, C).astype(np.float32)
+    label = np.array([[1, 2]], np.int64)
+    logit_len = np.array([T], np.int64)
+    label_len = np.array([2], np.int64)
+    logp = logits[0] - np.log(np.exp(logits[0]).sum(-1, keepdims=True))
+    want = _ctc_brute(logp, [1, 2])
+    OpTest.check_output("warpctc",
+                        {"Logits": [logits], "Label": [label],
+                         "LogitsLength": [logit_len],
+                         "LabelLength": [label_len]},
+                        {"blank": 0}, {"Loss": [np.array([[want]], np.float32)]},
+                        atol=1e-4)
+
+
+def test_warpctc_grad_runs():
+    rng = np.random.RandomState(1)
+    logits = rng.randn(2, 5, 4).astype(np.float32)
+    label = np.array([[1, 2], [3, 0]], np.int64)
+    OpTest.check_grad("warpctc",
+                      {"Logits": [logits], "Label": [label],
+                       "LogitsLength": [np.array([5, 4], np.int64)],
+                       "LabelLength": [np.array([2, 1], np.int64)]},
+                      {"blank": 0}, {"Loss": 1}, wrt=["Logits"], rtol=5e-2)
+
+
+def _crf_brute(emission, transition, length):
+    """logZ and best path by enumeration."""
+    T, C = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    logz = -np.inf
+    best, best_s = None, -np.inf
+    for path in itertools.product(range(C), repeat=length):
+        s = start[path[0]] + stop[path[-1]]
+        s += sum(emission[t, path[t]] for t in range(length))
+        s += sum(trans[path[t], path[t + 1]] for t in range(length - 1))
+        logz = np.logaddexp(logz, s)
+        if s > best_s:
+            best_s, best = s, path
+    return logz, list(best)
+
+
+def test_linear_chain_crf_vs_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, C = 2, 3, 3
+    emission = rng.randn(B, T, C).astype(np.float32)
+    transition = rng.randn(C + 2, C).astype(np.float32)
+    label = np.array([[0, 2, 1], [1, 1, 0]], np.int64)
+    length = np.array([3, 2], np.int64)
+    want = np.zeros((B, 1), np.float32)
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    for b in range(B):
+        L = length[b]
+        logz, _ = _crf_brute(emission[b], transition, L)
+        gold = start[label[b, 0]] + stop[label[b, L - 1]]
+        gold += sum(emission[b, t, label[b, t]] for t in range(L))
+        gold += sum(trans[label[b, t], label[b, t + 1]] for t in range(L - 1))
+        want[b, 0] = gold - logz
+    OpTest.check_output("linear_chain_crf",
+                        {"Emission": [emission], "Transition": [transition],
+                         "Label": [label], "Length": [length]},
+                        {}, {"LogLikelihood": [want]}, atol=1e-4)
+
+
+def test_crf_decoding_vs_bruteforce():
+    rng = np.random.RandomState(3)
+    B, T, C = 2, 4, 3
+    emission = rng.randn(B, T, C).astype(np.float32)
+    transition = rng.randn(C + 2, C).astype(np.float32)
+    length = np.array([4, 2], np.int64)
+    want = np.zeros((B, T), np.int64)
+    for b in range(B):
+        _, path = _crf_brute(emission[b], transition, length[b])
+        want[b, :length[b]] = path
+    OpTest.check_output("crf_decoding",
+                        {"Emission": [emission], "Transition": [transition],
+                         "Length": [length]},
+                        {}, {"ViterbiPath": [want]})
+
+
+def _lev(a, b):
+    dp = np.zeros((len(a) + 1, len(b) + 1), int)
+    dp[:, 0] = np.arange(len(a) + 1)
+    dp[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[len(a), len(b)]
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 4], [5, 6, 0, 0]], np.int64)
+    ref = np.array([[1, 3, 4, 0, 0], [5, 7, 6, 0, 0]], np.int64)
+    hl = np.array([4, 2], np.int64)
+    rl = np.array([3, 3], np.int64)
+    want = np.array(
+        [[_lev([1, 2, 3, 4], [1, 3, 4])], [_lev([5, 6], [5, 7, 6])]],
+        np.float32)
+    OpTest.check_output("edit_distance",
+                        {"Hyps": [hyp], "Refs": [ref],
+                         "HypsLength": [hl], "RefsLength": [rl]},
+                        {}, {"Out": [want]})
+
+
+def test_nce_and_hsigmoid_run(fresh_programs):
+    import paddle_tpu as fluid
+    from paddle_tpu.core.backward import append_backward
+    from paddle_tpu.core.scope import scope_guard
+
+    main, startup, scope = fresh_programs
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="int64")
+        blk = main.global_block()
+        w = fluid.layers.create_parameter([16, 8], "float32", name="nce_w")
+        b = fluid.layers.create_parameter([16], "float32", name="nce_b")
+        cost = blk.create_var(name="cost", dtype="float32")
+        slog = blk.create_var(name="slog", dtype="float32", stop_gradient=True)
+        slab = blk.create_var(name="slab", dtype="int64", stop_gradient=True)
+        blk.append_op("nce",
+                      {"Input": [x], "Weight": [w], "Bias": [b], "Label": [lab]},
+                      {"Cost": [cost], "SampleLogits": [slog],
+                       "SampleLabels": [slab]},
+                      {"num_neg_samples": 4, "num_total_classes": 16})
+        hw = fluid.layers.create_parameter([15, 8], "float32", name="hs_w")
+        hout = blk.create_var(name="hs_out", dtype="float32")
+        blk.append_op("hierarchical_sigmoid",
+                      {"X": [x], "W": [hw], "Label": [lab]},
+                      {"Out": [hout], "PreOut": [None]},
+                      {"num_classes": 16})
+        loss = fluid.layers.mean(cost) + fluid.layers.mean(hout)
+        append_backward(loss)
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        outs = exe.run(main,
+                       feed={"x": rng.randn(4, 8).astype(np.float32),
+                             "lab": rng.randint(0, 16, (4, 1)).astype(np.int64)},
+                       fetch_list=[loss.name, "nce_w@GRAD", "hs_w@GRAD"],
+                       scope=scope)
+    assert np.isfinite(outs[0]).all()
+    assert np.abs(outs[1]).sum() > 0 and np.abs(outs[2]).sum() > 0

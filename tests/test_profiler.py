@@ -1,0 +1,51 @@
+"""Profiler tests: RecordEvent aggregation + chrome trace export
+(reference test_profiler.py analog)."""
+
+import json
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler
+
+
+def test_record_event_table_and_chrome_trace(tmp_path, capsys):
+    path = str(tmp_path / "trace.json")
+    profiler.start_profiler(state="CPU")
+    for _ in range(3):
+        with profiler.RecordEvent("my_block"):
+            np.dot(np.ones((64, 64)), np.ones((64, 64)))
+    profiler.stop_profiler(sorted_key="total", profile_path=path)
+
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out
+    assert "my_block" in out
+
+    trace = json.load(open(path))
+    evs = [e for e in trace["traceEvents"] if e["name"] == "my_block"]
+    assert len(evs) == 3
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in evs)
+
+
+def test_executor_run_annotated(tmp_path, capsys, fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    X = np.ones((3, 4), np.float32)
+    with profiler.profiler(state="CPU", sorted_key="calls"):
+        for _ in range(4):
+            exe.run(main, feed={"x": X}, fetch_list=[y.name], scope=scope)
+    out = capsys.readouterr().out
+    assert "executor_run" in out
+
+
+def test_profiler_disabled_is_cheap():
+    # RecordEvent outside profiling must not record
+    with profiler.RecordEvent("ignored"):
+        pass
+    profiler.start_profiler(state="CPU")
+    profiler.stop_profiler()
+    assert not profiler.is_profiler_enabled()

@@ -1,0 +1,102 @@
+"""Quantization tests: fake-quant op numerics, STE gradients, and the
+QuantizeTranspiler QAT round trip (reference test_fake_quantize_op.py +
+test_quantize_transpiler.py analogs)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.quantize import QuantizeTranspiler
+from paddle_tpu.core.backward import append_backward
+
+
+def _ref_quant(x, scale, bits=8):
+    qmax = (1 << (bits - 1)) - 1
+    s = max(scale, 1e-8)
+    return np.clip(np.round(x / s * qmax), -qmax, qmax) * s / qmax
+
+
+def test_fake_quantize_abs_max_numeric(fresh_programs):
+    main, startup, scope = fresh_programs
+    X = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[5], dtype="float32")
+        out = main.global_block().create_var(name="q", dtype="float32")
+        sc = main.global_block().create_var(name="s", dtype="float32")
+        main.global_block().append_op(
+            "fake_quantize_abs_max", {"X": [x]},
+            {"Out": [out], "OutScale": [sc]}, {"bit_length": 8})
+    exe = fluid.Executor()
+    got, scale = exe.run(main, feed={"x": X}, fetch_list=["q", "s"],
+                         scope=scope)
+    assert np.allclose(scale, np.abs(X).max(), rtol=1e-6)
+    np.testing.assert_allclose(got, _ref_quant(X, np.abs(X).max()), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_ste_gradient_is_identity(fresh_programs):
+    main, startup, scope = fresh_programs
+    X = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        q = main.global_block().create_var(name="q", dtype="float32")
+        sc = main.global_block().create_var(name="s", dtype="float32")
+        main.global_block().append_op(
+            "fake_quantize_abs_max", {"X": [x]},
+            {"Out": [q], "OutScale": [sc]}, {"bit_length": 8})
+        loss = fluid.layers.mean(fluid.layers.square(q))
+        append_backward(loss)
+    exe = fluid.Executor()
+    g, = exe.run(main, feed={"x": X}, fetch_list=["x@GRAD"], scope=scope)
+    # STE: d(mean(q^2))/dx == 2*q/N exactly (grad passes through the round)
+    qv, = exe.run(main, feed={"x": X}, fetch_list=["q"], scope=scope)
+    np.testing.assert_allclose(g, 2 * qv / qv.size, rtol=1e-5, atol=1e-7)
+
+
+def test_qat_transpile_and_train(fresh_programs):
+    main, startup, scope = fresh_programs
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    Y = (X @ rng.randn(8, 1).astype(np.float32)) + 0.1
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        qt = QuantizeTranspiler()
+        qt.training_transpile(main, startup)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("fake_quantize_abs_max") >= 2          # weights
+    assert types.count("fake_quantize_moving_average_abs_max") >= 2  # acts
+    # every mul now consumes quantized tensors
+    for op in main.global_block().ops:
+        if op.type == "mul":
+            assert op.input("X")[0].endswith(".quantized")
+            assert op.input("Y")[0].endswith(".quantized")
+
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    losses = []
+    for _ in range(30):
+        lv, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss.name],
+                      scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+    # scales were collected
+    import numpy as _np
+
+    s = scope.find_var("x.scale")
+    assert s is not None and float(_np.asarray(s)[0]) > 0
+
+    frozen = qt.freeze_program(main)
+    for op in frozen.global_block().ops:
+        if op.type.startswith("fake_quantize"):
+            assert op.attrs["is_test"] is True
+        if op.type == "fake_quantize_abs_max":
+            # frozen graph must read the collected scale, not recompute
+            assert op.input("InScale") == op.output("OutScale")

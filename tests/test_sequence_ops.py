@@ -1,0 +1,192 @@
+"""Sequence-op tests: masked dense ops vs per-row numpy references built
+from explicit lengths (the reference's LoD-based sequence_ops contract,
+SURVEY §4 tier 2)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _seqs(B=3, T=5, D=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(B, T, D).astype(np.float32)
+    length = np.array([5, 3, 1][:B], np.int64)
+    return x, length
+
+
+def test_sequence_pool_types():
+    x, ln = _seqs()
+    rows = [x[b, :ln[b]] for b in range(len(ln))]
+    cases = {
+        "sum": np.stack([r.sum(0) for r in rows]),
+        "average": np.stack([r.mean(0) for r in rows]),
+        "sqrt": np.stack([r.sum(0) / np.sqrt(len(r)) for r in rows]),
+        "max": np.stack([r.max(0) for r in rows]),
+        "first": np.stack([r[0] for r in rows]),
+        "last": np.stack([r[-1] for r in rows]),
+    }
+    for ptype, want in cases.items():
+        OpTest.check_output("sequence_pool",
+                            {"X": [x], "Length": [ln]},
+                            {"pool_type": ptype}, {"Out": [want]}, atol=1e-5)
+
+
+def test_sequence_pool_grad():
+    x, ln = _seqs(B=2, T=4, D=3)
+    for ptype in ("sum", "average", "max"):
+        OpTest.check_grad("sequence_pool", {"X": [x], "Length": [ln]},
+                          {"pool_type": ptype}, {"Out": 1}, wrt=["X"])
+
+
+def test_sequence_softmax():
+    x, ln = _seqs(D=1)
+    x = x[:, :, 0]
+    want = np.zeros_like(x)
+    for b, l in enumerate(ln):
+        e = np.exp(x[b, :l] - x[b, :l].max())
+        want[b, :l] = e / e.sum()
+    OpTest.check_output("sequence_softmax", {"X": [x], "Length": [ln]}, {},
+                        {"Out": [want]}, atol=1e-5)
+
+
+def test_sequence_reverse():
+    x, ln = _seqs()
+    want = x.copy()
+    for b, l in enumerate(ln):
+        want[b, :l] = x[b, :l][::-1]
+    OpTest.check_output("sequence_reverse", {"X": [x], "Length": [ln]}, {},
+                        {"Y": [want]})
+
+
+def test_sequence_conv_vs_naive():
+    x, ln = _seqs(B=2, T=6, D=3)
+    F, ctx = 5, 3
+    rng = np.random.RandomState(7)
+    filt = rng.randn(ctx * 3, F).astype(np.float32)
+    want = np.zeros((2, 6, F), np.float32)
+    for b in range(2):
+        xm = x[b].copy()
+        xm[ln[b]:] = 0
+        for t in range(6):
+            col = []
+            for k in range(ctx):
+                src = t + (-(ctx // 2)) + k
+                col.append(xm[src] if 0 <= src < 6 else np.zeros(3, np.float32))
+            want[b, t] = np.concatenate(col) @ filt
+        want[b, ln[b]:] = 0
+    OpTest.check_output("sequence_conv",
+                        {"X": [x], "Filter": [filt], "Length": [ln]},
+                        {"context_length": ctx, "context_start": -(ctx // 2)},
+                        {"Out": [want]}, atol=1e-4)
+
+
+def test_sequence_conv_grad():
+    x, ln = _seqs(B=2, T=4, D=2)
+    filt = np.random.RandomState(3).randn(6, 3).astype(np.float32)
+    OpTest.check_grad("sequence_conv",
+                      {"X": [x], "Filter": [filt], "Length": [ln]},
+                      {"context_length": 3, "context_start": -1},
+                      {"Out": 1}, wrt=["X", "Filter"])
+
+
+def test_sequence_concat():
+    xa, la = _seqs(B=2, T=3, D=2, seed=1)
+    xb, lb = _seqs(B=2, T=4, D=2, seed=2)
+    la = np.array([2, 3], np.int64)
+    lb = np.array([4, 1], np.int64)
+    T_out = 7
+    want = np.zeros((2, T_out, 2), np.float32)
+    total = np.zeros(2, np.int32)
+    for b in range(2):
+        parts = np.concatenate([xa[b, :la[b]], xb[b, :lb[b]]])
+        want[b, :len(parts)] = parts
+        total[b] = len(parts)
+    OpTest.check_output("sequence_concat",
+                        {"X": [xa, xb], "Length": [la, lb]}, {},
+                        {"Out": [want], "LengthOut": [total]})
+
+
+def test_sequence_slice():
+    x, _ = _seqs(B=2, T=5, D=2)
+    offset = np.array([1, 0], np.int64)
+    slen = np.array([3, 2], np.int64)
+    want = np.zeros((2, 5, 2), np.float32)
+    for b in range(2):
+        want[b, :slen[b]] = x[b, offset[b]:offset[b] + slen[b]]
+    OpTest.check_output("sequence_slice",
+                        {"X": [x], "Offset": [offset], "SliceLength": [slen]},
+                        {}, {"Out": [want], "LengthOut": [slen]})
+
+
+def test_sequence_erase():
+    x = np.array([[2, 1, 2, 3, 0], [4, 2, 2, 0, 0]], np.int64)
+    ln = np.array([5, 3], np.int64)
+    # erase tokens {2, 0} from each valid prefix:
+    # row0 [2,1,2,3,0] -> [1,3]; row1 [4,2,2] -> [4]
+    lw = np.array([2, 1], np.int64)
+    OpTest.check_output("sequence_erase", {"X": [x], "Length": [ln]},
+                        {"tokens": [2, 0]},
+                        {"Out": [None], "LengthOut": [lw]})
+    from op_test import _OpProgram, _as_feed
+
+    prog = _OpProgram("sequence_erase", {"X": [x], "Length": [ln]},
+                      {"tokens": [2, 0]}, {"Out": 1, "LengthOut": 1})
+    got = prog.run(_as_feed({"X": [x], "Length": [ln]}), prog.fetch)
+    out = np.asarray(got[prog.out_names[("Out", 0)]])
+    assert out[0, :2].tolist() == [1, 3]
+    assert out[1, :1].tolist() == [4]
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 4]], np.int64)
+    ln = np.array([3], np.int64)
+    want = np.array([[[1, 2], [2, 3], [3, 0], [0, 0]]], np.int64)
+    OpTest.check_output("sequence_enumerate", {"X": [x], "Length": [ln]},
+                        {"win_size": 2, "pad_value": 0}, {"Out": [want]})
+
+
+def test_row_conv():
+    x, _ = _seqs(B=2, T=4, D=3)
+    filt = np.random.RandomState(5).randn(2, 3).astype(np.float32)
+    want = np.zeros_like(x)
+    for b in range(2):
+        for t in range(4):
+            for k in range(2):
+                if t + k < 4:
+                    want[b, t] += x[b, t + k] * filt[k]
+    OpTest.check_output("row_conv", {"X": [x], "Filter": [filt]}, {},
+                        {"Out": [want]}, atol=1e-5)
+    OpTest.check_grad("row_conv", {"X": [x], "Filter": [filt]}, {},
+                      {"Out": 1}, wrt=["X", "Filter"])
+
+
+def test_sequence_layers_build():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[5, 4], dtype="float32")
+        ln = fluid.layers.data(name="len", shape=[], dtype="int64")
+        pooled = fluid.layers.sequence_pool(x, "max", length=ln)
+        conv = fluid.layers.sequence_conv(x, num_filters=6, filter_size=3,
+                                          length=ln)
+        rev = fluid.layers.sequence_reverse(x, length=ln)
+        last = fluid.layers.sequence_last_step(x, length=ln)
+    types = [op.type for op in main.global_block().ops]
+    assert "sequence_pool" in types and "sequence_conv" in types
+    assert "sequence_reverse" in types
+    exe = fluid.Executor()
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        X = np.random.randn(2, 5, 4).astype(np.float32)
+        L = np.array([4, 2], np.int64)
+        outs = exe.run(main, feed={"x": X, "len": L},
+                       fetch_list=[pooled.name, conv.name, rev.name, last.name],
+                       scope=scope)
+    assert outs[0].shape == (2, 4)
+    assert outs[1].shape == (2, 5, 6)
+    np.testing.assert_allclose(outs[3][0], X[0, 3], rtol=1e-6)
