@@ -30,7 +30,8 @@ from . import (  # noqa: F401
     profiler,
     regularizer,
 )
-from . import transpiler  # noqa: F401
+from . import contrib, inference, transpiler  # noqa: F401
+from .async_executor import AsyncExecutor, DataFeedDesc  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .core.executor import Executor  # noqa: F401

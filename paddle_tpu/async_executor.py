@@ -1,0 +1,72 @@
+"""AsyncExecutor: file-driven training over the native data feed.
+
+Analog of /root/reference/paddle/fluid/framework/async_executor.cc
+(RunFromFile:236) + executor_thread_worker.cc and the Python driver
+python/paddle/fluid/async_executor.py:33 — the reference's CTR path:
+worker threads each parse slot files (MultiSlotDataFeed) and run Hogwild
+updates on shared CPU params.
+
+Deliberate divergence (SURVEY §7 hard parts): Hogwild's lock-free racing
+updates don't map to TPU. The native C++ reader threads still parse and
+batch files concurrently (paddle_tpu/native/datafeed.cc), but updates are
+applied as ordinary synchronous minibatch steps of the one compiled XLA
+step — same throughput shape (input pipeline off the Python thread),
+deterministic semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import Executor
+from .core.program import Program, default_main_program
+from .core.scope import Scope, global_scope
+from .native.data_feed import MultiSlotDataFeed, SlotDesc
+
+__all__ = ["AsyncExecutor", "DataFeedDesc"]
+
+
+class DataFeedDesc:
+    """Slot schema for MultiSlotDataFeed (data_feed.proto analog)."""
+
+    def __init__(self, slots: Sequence[SlotDesc], batch_size: int = 32):
+        self.slots = list(slots)
+        self.batch_size = batch_size
+
+    def set_batch_size(self, bs: int):
+        self.batch_size = bs
+
+
+class AsyncExecutor:
+    def __init__(self, place=None):
+        self.place = place
+        self._exe = Executor(place)
+
+    def run(self, program: Optional[Program], data_feed: DataFeedDesc,
+            filelist: List[str], thread_num: int = 2,
+            fetch: Optional[Sequence] = None, mode: str = "", debug: bool = False,
+            scope: Optional[Scope] = None, epochs: int = 1):
+        """Train `program` over slot files; returns the last fetch values
+        (AsyncExecutor.run / RunFromFile analog — thread_num drives the
+        native reader threads, not racing updaters)."""
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        fetch_names = [getattr(v, "name", v) for v in (fetch or [])]
+        feed = MultiSlotDataFeed(
+            files=filelist, slots=data_feed.slots,
+            batch_size=data_feed.batch_size, n_threads=thread_num,
+            epochs=epochs)
+        last = None
+        try:
+            for i, batch in enumerate(feed.feed_dict()):
+                last = self._exe.run(program, feed=batch,
+                                     fetch_list=fetch_names, scope=scope)
+                if debug and fetch_names and i % 10 == 0:
+                    print("step %d: %s" % (
+                        i, {n: np.asarray(v).ravel()[:4]
+                            for n, v in zip(fetch_names, last)}))
+        finally:
+            feed.close()
+        return last

@@ -58,6 +58,7 @@ def run_pserver_loop(attrs: Dict, scope: Scope, executor=None):
 
     param_blocks = {s["param_block"]: s for s in specs}
     grad_to_param = {s["grad_block"]: s["param_block"] for s in specs}
+    n_dense = sum(1 for s in specs if not s.get("sparse"))
 
     # publish startup state (zeros until the trainer-0 init push lands)
     for name in param_blocks:
@@ -97,7 +98,7 @@ def run_pserver_loop(attrs: Dict, scope: Scope, executor=None):
             if dense:
                 feed = {g: np.mean(vs, axis=0, dtype=vs[0].dtype)
                         for g, vs in dense.items()}
-                if len(feed) < len(specs):
+                if len(feed) < n_dense:
                     # memoize per feed-set: a fresh clone per cycle would
                     # miss the Executor compile cache (keyed by program id)
                     key = frozenset(feed)

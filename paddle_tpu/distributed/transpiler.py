@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core.program import Program, Variable, grad_var_name
+from ..core.program import Operator, Program, Variable, grad_var_name
 from ..core.scope import global_scope
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
@@ -168,16 +168,37 @@ class DistributeTranspiler:
         block = self.origin_program.global_block()
         self.update_ops = []
         self.param_infos: Dict[str, dict] = {}
+
+        # distributed sparse tables: lookup_table(is_distributed=True) keeps
+        # its W on a pserver; the trainer prefetches rows and ships sparse
+        # grads (reference distribute_lookup_table.py + parameter_prefetch)
+        self.table_infos: Dict[str, dict] = {}
+        for op in block.ops:
+            if (op.type in ("lookup_table", "lookup_table_v2")
+                    and op.attrs.get("is_distributed")):
+                wname = op.input("W")[0]
+                wvar = block.var(wname)
+                self.table_infos[wname] = {"var": wvar, "op": op}
+
         for op in block.ops:
             if (op.attrs.get("__op_role__") == "optimize"
                     and op.type in UPDATE_OP_TYPES
                     and op.input("Param") and op.input("Grad")):
                 self.update_ops.append(op)
+                pname = op.input("Param")[0]
+                if pname in self.table_infos:
+                    if op.type != "sgd":
+                        raise NotImplementedError(
+                            "distributed sparse tables support sgd updates "
+                            "(got %r for %s)" % (op.type, pname))
+                    self.table_infos[pname]["update_op"] = op
 
         n_eps = len(self.pserver_endpoints)
         all_blocks: List[VarBlock] = []
         for op in self.update_ops:
             pname = op.input("Param")[0]
+            if pname in self.table_infos:
+                continue  # sparse path, not a dense sliced param
             gname = op.input("Grad")[0]
             pvar = block.var(pname)
             blocks = slice_variable(pname, pvar.shape, self.config.slice_var_up,
@@ -191,6 +212,10 @@ class DistributeTranspiler:
         for vb, ep in zip(all_blocks, dispatcher.dispatch(all_blocks)):
             vb.endpoint = ep
         self.all_blocks = all_blocks
+
+        # tables are not sliced (whole-table rows served by one endpoint)
+        for i, (wname, info) in enumerate(sorted(self.table_infos.items())):
+            info["endpoint"] = self.pserver_endpoints[i % n_eps]
 
     # ----------------------------------------------- trainer-side programs
     def _append_sendrecv(self, prog: Program, per_param_src: Dict[str, str],
@@ -263,6 +288,7 @@ class DistributeTranspiler:
                    if not (op.attrs.get("__op_role__") == "optimize"
                            and op.type in UPDATE_OP_TYPES
                            and (op.type, tuple(op.input("Param"))) in update_keys)]
+        self._rewrite_sparse_tables(prog)
         self._append_sendrecv(
             prog,
             per_param_src={p: i["grad"] for p, i in self.param_infos.items()},
@@ -273,6 +299,66 @@ class DistributeTranspiler:
         prog._bump()
         self.trainer_program = prog
 
+    def _rewrite_sparse_tables(self, prog: Program):
+        """Distributed-table surgery: lookup_table → prefetch (remote row
+        fetch), lookup_table_grad → send_sparse of (ids, grad rows)
+        (reference parameter_prefetch.cc + SelectedRows grad send)."""
+        if not self.table_infos:
+            return
+        blk = prog.global_block()
+        new_ops = []
+        for op in blk.ops:
+            if (op.type in ("lookup_table", "lookup_table_v2")
+                    and op.input("W")
+                    and op.input("W")[0] in self.table_infos):
+                wname = op.input("W")[0]
+                info = self.table_infos[wname]
+                width = int(info["var"].shape[1])
+                pref = Operator(blk, "prefetch",
+                                {"Ids": [op.input("Ids")[0]]},
+                                {"Out": [op.output("Out")[0]]},
+                                {"endpoint": info["endpoint"],
+                                 "table_name": wname, "width": width,
+                                 "dtype": info["var"].dtype,
+                                 "padding_idx": op.attrs.get("padding_idx", -1),
+                                 "__op_role__": "dist"})
+                new_ops.append(pref)
+                continue
+            if (op.type in ("lookup_table_grad", "lookup_table_v2_grad")
+                    and op.input("W")
+                    and op.input("W")[0] in self.table_infos):
+                wname = op.input("W")[0]
+                info = self.table_infos[wname]
+                width = int(info["var"].shape[1])
+                height = int(info["var"].shape[0])
+                ids_name = op.input("Ids")[0]
+                dout_name = op.input("Out@GRAD")[0]
+                rows = blk.create_var(name="%s@ROWS" % wname, dtype="int64",
+                                      stop_gradient=True)
+                vals = blk.create_var(name="%s@VALROWS" % wname,
+                                      dtype=info["var"].dtype,
+                                      stop_gradient=True)
+                dummy = blk.create_var(name="%s@SPARSE_SENT" % wname,
+                                       shape=(), dtype="int32",
+                                       stop_gradient=True)
+                new_ops.append(Operator(
+                    blk, "reshape", {"X": [ids_name]}, {"Out": [rows.name]},
+                    {"shape": [-1], "__op_role__": "dist"}))
+                new_ops.append(Operator(
+                    blk, "reshape", {"X": [dout_name]}, {"Out": [vals.name]},
+                    {"shape": [-1, width], "__op_role__": "dist"}))
+                new_ops.append(Operator(
+                    blk, "send_sparse",
+                    {"Rows": [rows.name], "Values": [vals.name]},
+                    {"Out": [dummy.name]},
+                    {"endpoint": info["endpoint"],
+                     "var_name": grad_var_name(wname), "height": height,
+                     "padding_idx": op.attrs.get("padding_idx", -1),
+                     "__op_role__": "dist"}))
+                continue
+            new_ops.append(op)
+        blk.ops = new_ops
+
     def get_trainer_program(self) -> Program:
         return self.trainer_program
 
@@ -281,6 +367,15 @@ class DistributeTranspiler:
         blocks; every trainer pulls them back (see module docstring)."""
         prog = self.startup_program.clone()
         if self.trainer_id == 0:
+            # push initial sparse tables (whole-table send; the table then
+            # lives only on its pserver)
+            blk = prog.global_block()
+            for wname, info in sorted(self.table_infos.items()):
+                dummy = blk.create_var(name="%s@INIT_SENT" % wname, shape=(),
+                                       dtype="int32", stop_gradient=True)
+                blk.append_op("send", {"X": [wname]}, {"Out": [dummy.name]},
+                              {"endpoint": info["endpoint"],
+                               "var_name": wname, "__op_role__": "dist"})
             self._append_sendrecv(
                 prog,
                 per_param_src={p: p for p in self.param_infos},
@@ -397,6 +492,38 @@ class DistributeTranspiler:
                 "state_inits": state_inits,
             })
 
+        # sparse tables hosted here: no optimize ops (the runner applies
+        # SelectedRows grads directly), just the var + lr metadata
+        for wname, info in sorted(self.table_infos.items()):
+            if info["endpoint"] != endpoint:
+                continue
+            wvar = info["var"]
+            blk.create_var(name=wname, shape=wvar.shape, dtype=wvar.dtype,
+                           persistable=True, stop_gradient=True)
+            up = info.get("update_op")
+            if up is None:
+                raise ValueError(
+                    "distributed table %r has no sgd update op in the "
+                    "program — minimize() must run before transpile()"
+                    % wname)
+            lr_name = up.input("LearningRate")[0]
+            state_inits = []
+            if lr_name not in lr_done:
+                lr_done.add(lr_name)
+                init = self._startup_init_attrs(lr_name)
+                value = (init or {}).get("attrs", {}).get("value", 0.0)
+                state_inits.append((lr_name, [1], "float32", value))
+            block_specs.append({
+                "param_block": wname,
+                "grad_block": grad_var_name(wname),
+                "shape": list(wvar.shape),
+                "dtype": wvar.dtype,
+                "lr": lr_name,
+                "opt_type": "sgd",
+                "sparse": True,
+                "state_inits": state_inits,
+            })
+
         prog = Program()
         prog.global_block().append_op(
             "listen_and_serv", {}, {},
@@ -428,6 +555,15 @@ class DistributeTranspiler:
             blk.append_op("fill_constant", {}, {"Out": [vb.block_name]},
                           {"shape": list(vb.shape), "value": 0.0,
                            "dtype": info["var"].dtype})
+        for wname, info in sorted(self.table_infos.items()):
+            if info["endpoint"] != endpoint:
+                continue
+            wvar = info["var"]
+            blk.create_var(name=wname, shape=wvar.shape, dtype=wvar.dtype,
+                           persistable=True, stop_gradient=True)
+            blk.append_op("fill_constant", {}, {"Out": [wname]},
+                          {"shape": list(wvar.shape), "value": 0.0,
+                           "dtype": wvar.dtype})
         # state vars come from the block specs of get_pserver_program
         ps = self.get_pserver_program(endpoint)
         specs = ps.global_block().ops[0].attrs["block_specs"]

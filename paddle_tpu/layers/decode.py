@@ -1,0 +1,77 @@
+"""Decoding + host-callback layers.
+
+Reference locations: layers/nn.py beam_search / beam_search_decode
+(backed by operators/beam_search_op.cc, beam_search_decode_op.cc) and
+layers/nn.py py_func (py_func_op.cc). Beams are a dense [B, beam] axis
+here instead of a LoD level (see ops/beam_search_ops.py).
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["beam_search", "beam_search_decode", "py_func"]
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None,
+                ids=None, level=0):
+    """One beam expansion step. pre_ids/pre_scores: [B, beam];
+    scores: next-token log-probs [B, beam, V]. Returns
+    (selected_ids, selected_scores, parent_idx), each [B, beam]."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference("int64",
+                                                        stop_gradient=True)
+    sel_scores = helper.create_variable_for_type_inference(
+        pre_scores.dtype, stop_gradient=True)
+    parent = helper.create_variable_for_type_inference("int64",
+                                                       stop_gradient=True)
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "scores": [scores]},
+        outputs={"selected_ids": [sel_ids], "selected_scores": [sel_scores],
+                 "parent_idx": [parent]},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level})
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, scores, parent_idx, beam_size=None, end_id=0,
+                       name=None):
+    """Backtrack stacked [T, B, beam] step outputs into sequences
+    [B, beam, T] + final scores [B, beam]."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent = helper.create_variable_for_type_inference("int64",
+                                                     stop_gradient=True)
+    sc = helper.create_variable_for_type_inference(scores.dtype,
+                                                   stop_gradient=True)
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "ParentIdx": [parent_idx], "Scores": [scores]},
+        outputs={"SentenceIds": [sent], "SentenceScores": [sc]},
+        attrs={"beam_size": beam_size or 0, "end_id": end_id})
+    return sent, sc
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
+            name=None):
+    """Run a Python callable inside the lowered step (py_func_op.cc).
+    `out` declares result vars (shape/dtype must be set). backward_func is
+    not differentiated through — py_func output gradients stop here, like
+    registering the op no-grad; pass precomputed grads explicitly if
+    needed (documented divergence: arbitrary Python backward in-graph
+    would serialize the XLA step)."""
+    helper = LayerHelper("py_func", name=name)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        assert o.shape is not None and all(
+            s is not None and s >= 0 for s in o.shape), (
+            "py_func out var %r needs a static shape" % o.name)
+    helper.append_op(
+        type="py_func",
+        inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs={"forward_func": func,
+               "out_shapes": [list(o.shape) for o in outs],
+               "out_dtypes": [o.dtype for o in outs]})
+    return out

@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     activations,
     attention,
     basic,
+    beam_search_ops,
     control_flow_ops,
     detection_ops,
     distributed_ops,

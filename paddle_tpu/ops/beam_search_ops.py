@@ -1,0 +1,103 @@
+"""Beam search ops, static-shape redesign.
+
+Analogs of /root/reference/paddle/fluid/operators/beam_search_op.cc and
+beam_search_decode_op.cc (+ math/beam_search.{cc,cu}). The reference
+threads beams through LoD levels (source → beams) and emits ragged
+selected ids; here beams are a dense axis: state is [B, beam] and the
+candidate pool per source is beam*V, top-k'd with lax.top_k — the XLA-
+friendly form (one fused kernel per step, no host round trips).
+
+py_func (py_func_op.cc analog) also lives here: arbitrary Python callbacks
+enter the lowered program as ordered host callbacks. Forward-only by
+design — gradients stop at a py_func (see layers/decode.py for the
+documented divergence from the reference's backward_func support).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register_op
+
+NEG = -1e9
+
+
+@register_op("beam_search", no_grad=True)
+def _beam_search(ctx, ins, attrs):
+    """One expansion step. pre_ids/pre_scores: [B, beam]; scores: per-beam
+    next-token log-probs [B, beam, V]. Finished beams (pre_id == end_id)
+    propagate themselves with unchanged score (beam_search_op.cc's
+    is_end handling). Outputs selected ids/scores and the parent beam
+    index for backtracking."""
+    pre_ids = ins["pre_ids"][0].astype(jnp.int32)     # [B, beam]
+    pre_scores = ins["pre_scores"][0]                 # [B, beam]
+    scores = ins["scores"][0]                         # [B, beam, V]
+    beam = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    B, K, V = scores.shape
+
+    finished = pre_ids == end_id                      # [B, beam]
+    # live beams expand; finished beams contribute exactly one candidate
+    # (end_id, same score)
+    total = pre_scores[:, :, None] + scores           # [B, beam, V]
+    total = jnp.where(finished[:, :, None], NEG, total)
+    end_col = jnp.where(finished, pre_scores, NEG)    # [B, beam]
+    total = total.at[:, :, end_id].set(
+        jnp.where(finished, end_col, total[:, :, end_id]))
+
+    flat = total.reshape(B, K * V)
+    top_s, top_i = lax.top_k(flat, beam)              # [B, beam]
+    parent = (top_i // V).astype(jnp.int64)
+    ids = (top_i % V).astype(jnp.int64)
+    return {"selected_ids": [ids], "selected_scores": [top_s],
+            "parent_idx": [parent]}
+
+
+@register_op("beam_search_decode", no_grad=True)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack stacked per-step ids/parents into full sequences
+    (beam_search_decode_op.cc). Inputs Ids/ParentIdx: [T, B, beam];
+    outputs SentenceIds [B, beam, T] (+ final scores)."""
+    ids = ins["Ids"][0].astype(jnp.int32)             # [T, B, beam]
+    parents = ins["ParentIdx"][0].astype(jnp.int32)   # [T, B, beam]
+    scores = ins["Scores"][0]                         # [T, B, beam]
+    T, B, K = ids.shape
+
+    def back(carry, t_ins):
+        beam_at_t = carry                             # [B, beam] beam index
+        ids_t, parents_t = t_ins
+        tok = jnp.take_along_axis(ids_t, beam_at_t, axis=1)
+        prev = jnp.take_along_axis(parents_t, beam_at_t, axis=1)
+        return prev, tok
+
+    init = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None], (B, K))
+    _, toks = lax.scan(back, init, (ids, parents), reverse=True)
+    # toks: [T, B, beam] tokens along each final beam's ancestry
+    sentences = jnp.transpose(toks, (1, 2, 0)).astype(jnp.int64)  # [B,beam,T]
+    return {"SentenceIds": [sentences],
+            "SentenceScores": [scores[-1]]}  # final cumulative beam scores
+
+
+@register_op("py_func", no_grad=True, needs_env=False)
+def _py_func(ctx, ins, attrs):
+    """py_func_op.cc analog: call back into Python from inside the lowered
+    program (ordered host callback). attrs: forward_func (callable),
+    out_shapes / out_dtypes describing the results."""
+    fn = attrs["forward_func"]
+    shapes = [tuple(s) for s in attrs["out_shapes"]]
+    dtypes = [jnp.dtype(d) for d in attrs["out_dtypes"]]
+    xs = [v for v in ins.get("X", []) if v is not None]
+    result_spec = [jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)]
+
+    def cb(*arrs):
+        out = fn(*[np.asarray(a) for a in arrs])
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        return [np.asarray(o, dtype=d).reshape(s)
+                for o, s, d in zip(out, shapes, dtypes)]
+
+    outs = jax.experimental.io_callback(cb, result_spec, *xs, ordered=True)
+    return {"Out": list(outs)}

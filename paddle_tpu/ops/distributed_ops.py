@@ -88,13 +88,19 @@ def _send_sparse(ctx, ins, attrs):
     endpoint = attrs["endpoint"]
     wire_name = attrs["var_name"]
     height = int(attrs["height"])
+    pad = attrs.get("padding_idx", -1)
 
     def cb(rows, values):
         from ..distributed.rpc import SelectedRows
 
+        rows = np.asarray(rows)
+        values = np.asarray(values)
+        if pad is not None and pad != -1:
+            # padding rows never trained locally (forward used zeros):
+            # zero their grad so the pad embedding doesn't drift
+            values = np.where((rows == pad)[:, None], 0, values)
         client_for(endpoint).send_var(
-            wire_name, SelectedRows(np.asarray(rows), np.asarray(values),
-                                    height=height))
+            wire_name, SelectedRows(rows, values, height=height))
         return np.int32(0)
 
     flag = _ordered_cb(cb, _FLAG, ins["Rows"][0], ins["Values"][0])
@@ -144,13 +150,18 @@ def _prefetch(ctx, ins, attrs):
     """Remote sparse-table row fetch (prefetch_op.cc →
     parameter_prefetch.cc analog): Ids -> rows of the pserver-resident
     table. Gradient flows back via an explicit send_sparse op appended by
-    the transpiler, not by autodiff (the table never lives on the trainer)."""
+    the transpiler, not by autodiff (the table never lives on the trainer).
+    Matches lookup_table's shape contract: a trailing ids dim of 1 is
+    squeezed, and padding_idx rows come back as zeros."""
     endpoint = attrs["endpoint"]
     table = attrs["table_name"]
     width = int(attrs["width"])
     dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    pad = attrs.get("padding_idx", -1)
 
     ids = ins["Ids"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
     n = int(np.prod(ids.shape)) if ids.shape else 1
 
     def cb(ids_arr):
@@ -159,4 +170,7 @@ def _prefetch(ctx, ins, attrs):
                           dtype=dtype)
 
     rows = _ordered_cb(cb, jax.ShapeDtypeStruct((n, width), dtype), ids)
-    return {"Out": [rows.reshape(tuple(ids.shape) + (width,))]}
+    out = rows.reshape(tuple(ids.shape) + (width,))
+    if pad is not None and pad != -1:
+        out = jnp.where((ids != pad)[..., None], out, jnp.zeros_like(out))
+    return {"Out": [out]}

@@ -1,8 +1,9 @@
 """Metric ops (reference: paddle/fluid/operators/metrics/accuracy_op.cc,
-auc_op.cc; precision_recall later)."""
+auc_op.cc, precision_recall_op.cc)."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
@@ -54,3 +55,51 @@ def _auc(ctx, ins, attrs):
         "StatPosOut": [stat_pos],
         "StatNegOut": [stat_neg],
     }
+
+
+@register_op("precision_recall", no_grad=True)
+def _precision_recall(ctx, ins, attrs):
+    """precision_recall_op.cc: per-class TP/FP/FN stats and macro/micro
+    precision/recall/F1, with streaming accumulation through StatesInfo
+    ([C, 4] rows of TP, FP, TN, FN)."""
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)   # predicted class
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    weights = (ins["Weights"][0].reshape(-1)
+               if ins.get("Weights") and ins["Weights"][0] is not None
+               else jnp.ones(idx.shape, jnp.float32))
+    states = (ins["StatesInfo"][0]
+              if ins.get("StatesInfo") and ins["StatesInfo"][0] is not None
+              else None)
+    C = int(attrs["class_number"])
+
+    onehot_pred = jax.nn.one_hot(idx, C, dtype=jnp.float32) * weights[:, None]
+    onehot_lab = jax.nn.one_hot(label, C, dtype=jnp.float32) * weights[:, None]
+    hit = (idx == label).astype(jnp.float32) * weights
+    tp = jnp.sum(jax.nn.one_hot(label, C, dtype=jnp.float32)
+                 * hit[:, None], axis=0)
+    fp = jnp.sum(onehot_pred, axis=0) - tp
+    fn = jnp.sum(onehot_lab, axis=0) - tp
+    total = jnp.sum(weights)
+    tn = total - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)      # [C, 4]
+
+    def metrics(st):
+        tp_, fp_, _tn, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_), 0.0)
+        # macro F1 = F1 of the macro-averaged p/r (precision_recall_op.h
+        # :142-144), NOT the mean of per-class F1s
+        mp_, mr_ = prec.mean(), rec.mean()
+        mf1 = jnp.where(mp_ + mr_ > 0, 2 * mp_ * mr_ / (mp_ + mr_), 0.0)
+        macro = jnp.stack([mp_, mr_, mf1])
+        stp, sfp, sfn = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = jnp.where(stp + sfp > 0, stp / (stp + sfp), 0.0)
+        mr = jnp.where(stp + sfn > 0, stp / (stp + sfn), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / (mp + mr), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    accum_states = (states + batch_states if states is not None
+                    else batch_states)
+    return {"BatchMetrics": [metrics(batch_states)],
+            "AccumMetrics": [metrics(accum_states)],
+            "AccumStatesInfo": [accum_states]}

@@ -1,0 +1,26 @@
+"""API-stability gate: regenerate the public-API signature list and diff
+against the committed API.spec (reference tools/diff_api.py +
+paddle/fluid/API.spec contract)."""
+
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+def test_api_spec_matches():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import print_signatures
+
+    got = print_signatures.collect()
+    with open(os.path.join(ROOT, "API.spec")) as f:
+        want = [l.rstrip("\n") for l in f if l.strip()]
+    missing = sorted(set(want) - set(got))
+    added = sorted(set(got) - set(want))
+    assert not missing and not added, (
+        "public API drifted from API.spec.\n"
+        "Removed/changed (%d):\n  %s\nAdded (%d):\n  %s\n"
+        "If intentional, regenerate: python tools/print_signatures.py > API.spec"
+        % (len(missing), "\n  ".join(missing[:20]),
+           len(added), "\n  ".join(added[:20])))

@@ -1,0 +1,145 @@
+"""Beam search, py_func, precision_recall, AsyncExecutor tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest, _OpProgram, _as_feed
+from paddle_tpu.core.scope import Scope, scope_guard
+
+
+def test_beam_search_step():
+    # B=1, beam=2, V=3
+    pre_ids = np.array([[1, 2]], np.int64)
+    pre_scores = np.log(np.array([[0.6, 0.4]], np.float32))
+    probs = np.array([[[0.1, 0.6, 0.3], [0.2, 0.2, 0.6]]], np.float32)
+    scores = np.log(probs)
+    prog = _OpProgram("beam_search",
+                      {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                       "scores": [scores]},
+                      {"beam_size": 2, "end_id": 0, "level": 0},
+                      {"selected_ids": 1, "selected_scores": 1,
+                       "parent_idx": 1})
+    feed = _as_feed({"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                     "scores": [scores]})
+    got = prog.run(feed, prog.fetch)
+    ids = np.asarray(got[prog.out_names[("selected_ids", 0)]])
+    parent = np.asarray(got[prog.out_names[("parent_idx", 0)]])
+    sc = np.asarray(got[prog.out_names[("selected_scores", 0)]])
+    # joint probs: beam0: .06/.36/.18 ; beam1: .08/.08/.24
+    assert ids.tolist() == [[1, 2]]
+    assert parent.tolist() == [[0, 1]]
+    np.testing.assert_allclose(np.exp(sc), [[0.36, 0.24]], rtol=1e-5)
+
+
+def test_beam_search_finished_beam_propagates():
+    pre_ids = np.array([[0, 2]], np.int64)  # beam 0 finished (end_id=0)
+    pre_scores = np.log(np.array([[0.9, 0.1]], np.float32))
+    scores = np.log(np.full((1, 2, 3), 1 / 3, np.float32))
+    prog = _OpProgram("beam_search",
+                      {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                       "scores": [scores]},
+                      {"beam_size": 2, "end_id": 0, "level": 0},
+                      {"selected_ids": 1, "selected_scores": 1,
+                       "parent_idx": 1})
+    feed = _as_feed({"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                     "scores": [scores]})
+    got = prog.run(feed, prog.fetch)
+    ids = np.asarray(got[prog.out_names[("selected_ids", 0)]])
+    sc = np.asarray(got[prog.out_names[("selected_scores", 0)]])
+    # finished beam keeps (end_id, 0.9) as the top candidate
+    assert ids[0, 0] == 0
+    np.testing.assert_allclose(np.exp(sc[0, 0]), 0.9, rtol=1e-5)
+
+
+def test_beam_search_decode_backtrack():
+    # T=3, B=1, beam=2; parents: step1 both from beam0, step2 swaps
+    ids = np.array([[[5, 6]], [[7, 8]], [[9, 10]]], np.int64)
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+    scores = np.zeros((3, 1, 2), np.float32)
+    prog = _OpProgram("beam_search_decode",
+                      {"Ids": [ids], "ParentIdx": [parents],
+                       "Scores": [scores]},
+                      {"beam_size": 2, "end_id": 0},
+                      {"SentenceIds": 1, "SentenceScores": 1})
+    feed = _as_feed({"Ids": [ids], "ParentIdx": [parents],
+                     "Scores": [scores]})
+    got = prog.run(feed, prog.fetch)
+    sent = np.asarray(got[prog.out_names[("SentenceIds", 0)]])
+    assert sent.shape == (1, 2, 3)
+    # final beam 0 came from step-2 parent 1 → ids path 5,8,9
+    assert sent[0, 0].tolist() == [5, 8, 9]
+    assert sent[0, 1].tolist() == [5, 7, 10]
+
+
+def test_py_func_layer(fresh_programs):
+    main, startup, scope = fresh_programs
+
+    def double_plus_one(a):
+        return a * 2 + 1
+
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        out = main.global_block().create_var(
+            name="pyout", shape=(2, 3), dtype="float32")
+        fluid.layers.py_func(double_plus_one, x, out)
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        X = np.arange(6, dtype=np.float32).reshape(2, 3)
+        got, = exe.run(main, feed={"x": X}, fetch_list=["pyout"], scope=scope)
+    np.testing.assert_allclose(got, X * 2 + 1)
+
+
+def test_precision_recall():
+    idx = np.array([0, 1, 1, 2], np.int64)
+    lab = np.array([0, 1, 2, 2], np.int64)
+    prog = _OpProgram("precision_recall",
+                      {"Indices": [idx], "Label": [lab]},
+                      {"class_number": 3},
+                      {"BatchMetrics": 1, "AccumMetrics": 1,
+                       "AccumStatesInfo": 1})
+    got = prog.run(_as_feed({"Indices": [idx], "Label": [lab]}), prog.fetch)
+    bm = np.asarray(got[prog.out_names[("BatchMetrics", 0)]])
+    st = np.asarray(got[prog.out_names[("AccumStatesInfo", 0)]])
+    # class0: tp1 fp0 fn0; class1: tp1 fp1 fn0; class2: tp1 fp0 fn1
+    np.testing.assert_allclose(st[:, 0], [1, 1, 1])
+    np.testing.assert_allclose(st[:, 1], [0, 1, 0])
+    np.testing.assert_allclose(st[:, 3], [0, 0, 1])
+    # micro precision = recall = 3/4
+    np.testing.assert_allclose(bm[3:5], [0.75, 0.75], atol=1e-6)
+
+
+def test_async_executor_trains(tmp_path, fresh_programs):
+    main, startup, scope = fresh_programs
+    # slot file: "<n> ids... <n> vals..." → int64 id slot + float slot
+    rng = np.random.RandomState(0)
+    lines = []
+    for _ in range(64):
+        x = rng.randn(4)
+        y = float(x.sum() * 0.5 + 0.1)
+        lines.append("4 " + " ".join("%f" % v for v in x) + " 1 %f" % y)
+    f = tmp_path / "part-0"
+    f.write_text("\n".join(lines) + "\n")
+
+    from paddle_tpu.native.data_feed import SlotDesc
+
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    ae = fluid.AsyncExecutor()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        feed_desc = fluid.DataFeedDesc(
+            [SlotDesc("x", "float32", 4), SlotDesc("y", "float32", 1)],
+            batch_size=16)
+        last = ae.run(main, feed_desc, [str(f)], thread_num=2,
+                      fetch=[loss], scope=scope, epochs=8)
+    assert last is not None and float(last[0]) < 1.0

@@ -114,6 +114,64 @@ def test_sync_ps_sliced_two_pservers(tmp_path):
     np.testing.assert_allclose(avg, single, rtol=2e-4, atol=1e-5)
 
 
+def test_distributed_sparse_table_in_process():
+    """Distributed lookup table: trainer prefetches rows, ships SelectedRows
+    grads; pserver scatter-applies SGD (reference distribute_lookup_table +
+    parameter_prefetch path). Pserver runs on a thread, trainer in-process."""
+    import threading
+
+    from paddle_tpu.core.program import Program
+    from paddle_tpu.core.scope import Scope
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(
+            ids, size=[20, 4], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="emb_w"))
+        pred = fluid.layers.fc(emb, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.2).minimize(loss)
+
+    port = _free_ports(1)[0]
+    eps = "127.0.0.1:%d" % port
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=eps, trainers=1,
+                sync_mode=True, startup_program=startup)
+    tp = t.get_trainer_program()
+    types = [op.type for op in tp.global_block().ops]
+    assert "prefetch" in types and "send_sparse" in types
+    assert "lookup_table" not in types and "lookup_table_grad" not in types
+    specs = {s["param_block"]: s for s in
+             t.get_pserver_program(eps).global_block().ops[0]
+             .attrs["block_specs"]}
+    assert specs["emb_w"].get("sparse") is True
+
+    def pserver():
+        sc = Scope()
+        exe = fluid.Executor()
+        exe.run(t.get_startup_program(eps), scope=sc)
+        exe.run(t.get_pserver_program(eps), scope=sc)
+
+    th = threading.Thread(target=pserver, daemon=True)
+    th.start()
+    scope = Scope()
+    exe = fluid.Executor()
+    exe.run(t.get_trainer_startup_program(), scope=scope)
+    rng = np.random.RandomState(0)
+    IDS = rng.randint(0, 20, (32, 1)).astype(np.int64)
+    Y = IDS.astype(np.float32) / 10.0
+    losses = []
+    for _ in range(12):
+        lv, = exe.run(tp, feed={"ids": IDS, "y": Y},
+                      fetch_list=[loss.name], scope=scope)
+        losses.append(float(lv))
+    exe.close()
+    th.join(timeout=60)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
 @pytest.mark.slow
 def test_async_ps_converges(tmp_path):
     losses = _run_cluster(tmp_path, n_pservers=1, n_trainers=2, sync=False)

@@ -1,0 +1,86 @@
+"""Print the public API surface as stable one-line signatures.
+
+Analog of /root/reference/tools/print_signatures.py, which feeds the
+API-stability gate tools/diff_api.py against the committed
+paddle/fluid/API.spec (527 symbols). Usage:
+
+    python tools/print_signatures.py > API.spec
+
+tests/test_api_spec.py regenerates the list and diffs it against the
+committed API.spec, so accidental API breaks fail CI the same way the
+reference's gate does.
+"""
+
+from __future__ import annotations
+
+import inspect
+import sys
+
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.layers.sequence",
+    "paddle_tpu.layers.detection",
+    "paddle_tpu.layers.loss",
+    "paddle_tpu.layers.decode",
+    "paddle_tpu.layers.control_flow",
+    "paddle_tpu.layers.io",
+    "paddle_tpu.layers.tensor",
+    "paddle_tpu.layers.metric_op",
+    "paddle_tpu.layers.learning_rate_scheduler",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.io",
+    "paddle_tpu.metrics",
+    "paddle_tpu.nets",
+    "paddle_tpu.profiler",
+    "paddle_tpu.imperative",
+    "paddle_tpu.imperative.nn",
+    "paddle_tpu.inference",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.transpiler",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.contrib.quantize",
+    "paddle_tpu.async_executor",
+    "paddle_tpu.parallel",
+]
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def collect():
+    import importlib
+
+    lines = []
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append("%s.%s.__init__ %s"
+                             % (modname, name, _sig(obj.__init__)))
+                for mname, meth in sorted(vars(obj).items()):
+                    if mname.startswith("_") or not callable(meth):
+                        continue
+                    lines.append("%s.%s.%s %s"
+                                 % (modname, name, mname, _sig(meth)))
+            elif callable(obj):
+                lines.append("%s.%s %s" % (modname, name, _sig(obj)))
+    return sorted(set(lines))
+
+
+if __name__ == "__main__":
+    sys.stdout.write("\n".join(collect()) + "\n")
