@@ -17,20 +17,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..native import load
+from ..native.dtypes import CODE_OF_DTYPE as _DTYPES
+from ..native.dtypes import DTYPE_OF_CODE as _NP_OF_CODE
 
 __all__ = ["RPCClient", "RPCServer", "SelectedRows", "parse_endpoint"]
-
-# dtype codes shared with ps_service.cc
-_DTYPES = {
-    np.dtype("float32"): 0,
-    np.dtype("int64"): 1,
-    np.dtype("float64"): 2,
-    np.dtype("int32"): 3,
-    np.dtype("uint8"): 4,
-    np.dtype("bool"): 4,
-}
-_NP_OF_CODE = {0: np.float32, 1: np.int64, 2: np.float64, 3: np.int32,
-               4: np.uint8}
 
 
 def parse_endpoint(ep: str) -> Tuple[str, int]:

@@ -4,9 +4,10 @@ Analog of /root/reference/python/paddle/fluid/io.py (save_vars:92,
 save_params:213, save_persistables:441, load_persistables:658,
 save/load_inference_model:863,1015) and the save/load_combine ops
 (operators/save_combine_op.cc). The reference writes per-var files through
-ops; here persistables are gathered from the Scope and written as one .npz
-manifest per checkpoint ("persistables = savable vars" rule, SURVEY §5) —
-sharded-array checkpoints live in parallel/checkpoint.py.
+ops; here persistables are gathered from the Scope and written as one
+combined native-format file per checkpoint (tensor_store.cc, with a
+version header; legacy .npz checkpoints remain readable) —
+"persistables = savable vars" rule, SURVEY §5.
 """
 
 from __future__ import annotations
@@ -31,8 +32,26 @@ __all__ = [
     "load_inference_model",
 ]
 
-_COMBINED = "__model_combined__.npz"
+_COMBINED = "__model_combined__"
+_LEGACY_COMBINED = "__model_combined__.npz"
 _MODEL_FILE = "__model__.json"
+
+
+def _load_blob(dirname, filename):
+    """Read a combined checkpoint: native PTCK format (tensor_store.cc,
+    the save_combine_op.cc analog) or legacy .npz fallback."""
+    from .native.tensor_store import MAGIC, load_tensors
+
+    path = os.path.join(dirname, filename or _COMBINED)
+    if not os.path.exists(path):
+        legacy = os.path.join(dirname, filename or _LEGACY_COMBINED)
+        if os.path.exists(legacy):
+            path = legacy
+    with open(path, "rb") as f:
+        magic = f.read(4)
+    if magic == MAGIC:
+        return path, load_tensors(path)
+    return path, np.load(path, allow_pickle=False)
 
 
 def _persistable_names(program: Program, predicate) -> List[str]:
@@ -58,25 +77,30 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         if val is None:
             raise RuntimeError("variable %r not initialized; cannot save" % n)
         arrays[n] = np.asarray(val)
-    np.savez(os.path.join(dirname, filename or _COMBINED), **arrays)
+    from .native.tensor_store import save_tensors
+
+    save_tensors(os.path.join(dirname, filename or _COMBINED), arrays)
 
 
-def save_params(executor, dirname, main_program=None, filename=None):
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
     save_vars(executor, dirname, main_program,
-              predicate=lambda v: isinstance(v, Parameter), filename=filename)
+              predicate=lambda v: isinstance(v, Parameter), filename=filename,
+              scope=scope)
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
     save_vars(executor, dirname, main_program,
-              predicate=lambda v: v.persistable, filename=filename)
+              predicate=lambda v: v.persistable, filename=filename,
+              scope=scope)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
               filename=None, scope=None):
     program = main_program or default_main_program()
     scope = scope or global_scope()
-    path = os.path.join(dirname, filename or _COMBINED)
-    data = np.load(path, allow_pickle=False)
+    path, data = _load_blob(dirname, filename)
     if vars is not None:
         names = [v.name if hasattr(v, "name") else v for v in vars]
     else:
@@ -89,9 +113,11 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         scope.set_var(n, jnp.asarray(data[n]))
 
 
-def load_params(executor, dirname, main_program=None, filename=None):
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
     load_vars(executor, dirname, main_program,
-              predicate=lambda v: isinstance(v, Parameter), filename=filename)
+              predicate=lambda v: isinstance(v, Parameter), filename=filename,
+              scope=scope)
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None,

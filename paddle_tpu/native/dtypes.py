@@ -1,0 +1,51 @@
+"""The ONE dtype-code table shared by every native serde surface:
+ps_service.cc (RPC wire), tensor_store.cc (checkpoint files), and their
+Python wrappers. Adding a code here is the only step needed to keep the
+wire and file formats in agreement."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["CODE_OF_DTYPE", "DTYPE_OF_CODE", "code_of", "dtype_of"]
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+CODE_OF_DTYPE = {
+    np.dtype("float32"): 0,
+    np.dtype("int64"): 1,
+    np.dtype("float64"): 2,
+    np.dtype("int32"): 3,
+    np.dtype("uint8"): 4,
+    np.dtype("bool"): 6,
+    np.dtype("float16"): 7,
+    np.dtype("int8"): 8,
+    np.dtype("uint32"): 9,
+    np.dtype("int16"): 10,
+}
+if _BF16 is not None:
+    CODE_OF_DTYPE[_BF16] = 5
+
+DTYPE_OF_CODE = {c: d for d, c in CODE_OF_DTYPE.items()}
+
+
+def code_of(dtype) -> int:
+    dt = np.dtype(dtype)
+    code = CODE_OF_DTYPE.get(dt)
+    if code is None:
+        raise TypeError(
+            "dtype %s is not serializable (known: %s)"
+            % (dt, sorted(str(d) for d in CODE_OF_DTYPE)))
+    return code
+
+
+def dtype_of(code: int) -> np.dtype:
+    dt = DTYPE_OF_CODE.get(code)
+    if dt is None:
+        raise TypeError("unknown serialized dtype code %d" % code)
+    return dt

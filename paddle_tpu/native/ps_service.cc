@@ -52,15 +52,20 @@ enum MsgType : uint8_t {
   kCheckpoint = 7,
 };
 
-// dtype codes shared with the Python side (distributed/rpc.py)
+// dtype codes shared with the Python side (native/dtypes.py)
 inline size_t DtypeSize(uint8_t dt) {
   switch (dt) {
     case 0: return 4;   // f32
     case 1: return 8;   // i64
     case 2: return 8;   // f64
     case 3: return 4;   // i32
-    case 4: return 1;   // u8/bool
+    case 4: return 1;   // u8
     case 5: return 2;   // bf16
+    case 6: return 1;   // bool
+    case 7: return 2;   // f16
+    case 8: return 1;   // i8
+    case 9: return 4;   // u32
+    case 10: return 2;  // i16
     default: return 1;
   }
 }

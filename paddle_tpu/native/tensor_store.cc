@@ -1,0 +1,163 @@
+// Native combined-tensor checkpoint file (writer + reader).
+//
+// TPU-native equivalent of the reference's C++ checkpoint ops:
+//   - save_combine_op.cc / load_combine_op.cc (many tensors, one file)
+//   - the per-tensor version headers of framework/version.h and
+//     TensorToStream/TensorFromStream (framework/tensor_util.cc)
+//
+// Format (little-endian):
+//   magic "PTCK" | u32 format_version | u32 n_tensors
+//   per tensor: u32 name_len | name | u8 dtype | u8 ndim | i64 dims[ndim]
+//               | u64 nbytes | raw data
+//
+// dtype codes shared with ps_service.cc / distributed/rpc.py:
+//   0=f32 1=i64 2=f64 3=i32 4=u8 5=bf16
+//
+// C API: ts_write_begin/ts_write_add/ts_write_end (streams straight to
+// disk — no double buffering of a full checkpoint in memory) and
+// ts_read_open/ts_read_* accessors.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4b435450;  // "PTCK"
+constexpr uint32_t kVersion = 1;
+
+struct Entry {
+  std::string name;
+  uint8_t dtype;
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> data;
+};
+
+struct Writer {
+  FILE* f = nullptr;
+  uint32_t count = 0;
+  long count_pos = 0;
+};
+
+struct Reader {
+  std::vector<Entry> entries;
+};
+
+bool WriteRaw(FILE* f, const void* p, size_t n) {
+  return std::fwrite(p, 1, n, f) == n;
+}
+
+bool ReadRaw(FILE* f, void* p, size_t n) {
+  return std::fread(p, 1, n, f) == n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ts_write_begin(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer;
+  w->f = f;
+  uint32_t zero = 0;
+  if (!WriteRaw(f, &kMagic, 4) || !WriteRaw(f, &kVersion, 4)) {
+    std::fclose(f);
+    delete w;
+    return nullptr;
+  }
+  w->count_pos = std::ftell(f);
+  WriteRaw(f, &zero, 4);  // patched by ts_write_end
+  return w;
+}
+
+int ts_write_add(void* h, const char* name, int dtype, int ndim,
+                 const int64_t* dims, const void* data, int64_t nbytes) {
+  auto* w = static_cast<Writer*>(h);
+  uint32_t nlen = static_cast<uint32_t>(std::strlen(name));
+  uint8_t dt = static_cast<uint8_t>(dtype);
+  uint8_t nd = static_cast<uint8_t>(ndim);
+  uint64_t nb = static_cast<uint64_t>(nbytes);
+  if (!WriteRaw(w->f, &nlen, 4) || !WriteRaw(w->f, name, nlen) ||
+      !WriteRaw(w->f, &dt, 1) || !WriteRaw(w->f, &nd, 1) ||
+      (ndim && !WriteRaw(w->f, dims, 8 * ndim)) ||
+      !WriteRaw(w->f, &nb, 8) || (nb && !WriteRaw(w->f, data, nb)))
+    return 0;
+  ++w->count;
+  return 1;
+}
+
+int ts_write_end(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  int ok = 1;
+  if (std::fseek(w->f, w->count_pos, SEEK_SET) != 0 ||
+      !WriteRaw(w->f, &w->count, 4))
+    ok = 0;
+  if (std::fclose(w->f) != 0) ok = 0;
+  delete w;
+  return ok;
+}
+
+void* ts_read_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  uint32_t magic, version, count;
+  if (!ReadRaw(f, &magic, 4) || magic != kMagic ||
+      !ReadRaw(f, &version, 4) || version != kVersion ||
+      !ReadRaw(f, &count, 4)) {
+    std::fclose(f);
+    return nullptr;
+  }
+  auto* r = new Reader;
+  for (uint32_t i = 0; i < count; ++i) {
+    Entry e;
+    uint32_t nlen;
+    uint8_t nd;
+    uint64_t nb;
+    if (!ReadRaw(f, &nlen, 4)) goto fail;
+    e.name.resize(nlen);
+    if (nlen && !ReadRaw(f, &e.name[0], nlen)) goto fail;
+    if (!ReadRaw(f, &e.dtype, 1) || !ReadRaw(f, &nd, 1)) goto fail;
+    e.dims.resize(nd);
+    if (nd && !ReadRaw(f, e.dims.data(), 8 * nd)) goto fail;
+    if (!ReadRaw(f, &nb, 8)) goto fail;
+    e.data.resize(nb);
+    if (nb && !ReadRaw(f, e.data.data(), nb)) goto fail;
+    r->entries.push_back(std::move(e));
+  }
+  std::fclose(f);
+  return r;
+fail:
+  std::fclose(f);
+  delete r;
+  return nullptr;
+}
+
+int ts_read_count(void* h) {
+  return static_cast<int>(static_cast<Reader*>(h)->entries.size());
+}
+const char* ts_read_name(void* h, int i) {
+  return static_cast<Reader*>(h)->entries[i].name.c_str();
+}
+int ts_read_dtype(void* h, int i) {
+  return static_cast<Reader*>(h)->entries[i].dtype;
+}
+int ts_read_ndim(void* h, int i) {
+  return static_cast<int>(static_cast<Reader*>(h)->entries[i].dims.size());
+}
+void ts_read_dims(void* h, int i, int64_t* out) {
+  const auto& d = static_cast<Reader*>(h)->entries[i].dims;
+  std::memcpy(out, d.data(), 8 * d.size());
+}
+const void* ts_read_data(void* h, int i) {
+  return static_cast<Reader*>(h)->entries[i].data.data();
+}
+int64_t ts_read_nbytes(void* h, int i) {
+  return static_cast<int64_t>(
+      static_cast<Reader*>(h)->entries[i].data.size());
+}
+void ts_read_close(void* h) { delete static_cast<Reader*>(h); }
+
+}  // extern "C"

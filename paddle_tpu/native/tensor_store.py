@@ -1,0 +1,121 @@
+"""Python wrapper over the native combined-tensor checkpoint file
+(save_combine_op.cc / load_combine_op.cc analog — see tensor_store.cc).
+Dtype codes come from the shared table in native/dtypes.py; writes go to
+a temp file and rename into place, so a failed save never clobbers an
+existing good checkpoint."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict
+
+import numpy as np
+
+from . import load
+from .dtypes import code_of, dtype_of
+
+__all__ = ["save_tensors", "load_tensors", "MAGIC"]
+
+MAGIC = b"PTCK"
+
+
+def _lib():
+    lib = load("tensor_store")
+    if getattr(lib, "_ts_typed", False):
+        return lib
+    c = ctypes
+    lib.ts_write_begin.restype = c.c_void_p
+    lib.ts_write_begin.argtypes = [c.c_char_p]
+    lib.ts_write_add.restype = c.c_int
+    lib.ts_write_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int,
+                                 c.POINTER(c.c_int64), c.c_void_p, c.c_int64]
+    lib.ts_write_end.restype = c.c_int
+    lib.ts_write_end.argtypes = [c.c_void_p]
+    lib.ts_read_open.restype = c.c_void_p
+    lib.ts_read_open.argtypes = [c.c_char_p]
+    lib.ts_read_count.restype = c.c_int
+    lib.ts_read_count.argtypes = [c.c_void_p]
+    lib.ts_read_name.restype = c.c_char_p
+    lib.ts_read_name.argtypes = [c.c_void_p, c.c_int]
+    lib.ts_read_dtype.restype = c.c_int
+    lib.ts_read_dtype.argtypes = [c.c_void_p, c.c_int]
+    lib.ts_read_ndim.restype = c.c_int
+    lib.ts_read_ndim.argtypes = [c.c_void_p, c.c_int]
+    lib.ts_read_dims.restype = None
+    lib.ts_read_dims.argtypes = [c.c_void_p, c.c_int, c.POINTER(c.c_int64)]
+    lib.ts_read_data.restype = c.c_void_p
+    lib.ts_read_data.argtypes = [c.c_void_p, c.c_int]
+    lib.ts_read_nbytes.restype = c.c_int64
+    lib.ts_read_nbytes.argtypes = [c.c_void_p, c.c_int]
+    lib.ts_read_close.restype = None
+    lib.ts_read_close.argtypes = [c.c_void_p]
+    lib._ts_typed = True
+    return lib
+
+
+def save_tensors(path: str, tensors: Dict[str, np.ndarray]) -> None:
+    lib = _lib()
+    # normalize + dtype-check everything BEFORE touching the filesystem
+    prepared = []
+    for name, arr in tensors.items():
+        a = np.asarray(arr)
+        if not a.flags["C_CONTIGUOUS"]:
+            a = np.ascontiguousarray(a).reshape(a.shape)
+        prepared.append((name, a, code_of(a.dtype)))
+
+    tmp = path + ".tmp"
+    h = lib.ts_write_begin(tmp.encode())
+    if not h:
+        raise IOError("cannot open %s for writing" % tmp)
+    ended = finished = False
+    try:
+        for name, a, code in prepared:
+            dims = (ctypes.c_int64 * max(a.ndim, 1))(*a.shape)
+            ok = lib.ts_write_add(h, name.encode(), code, a.ndim, dims,
+                                  a.ctypes.data_as(ctypes.c_void_p), a.nbytes)
+            if not ok:
+                raise IOError("write failed for %r in %s" % (name, tmp))
+        ended = True
+        if not lib.ts_write_end(h):
+            raise IOError("finalize failed for %s" % tmp)
+        os.replace(tmp, path)
+        finished = True
+    finally:
+        if not ended:
+            lib.ts_write_end(h)  # closes and frees the native writer
+        if not finished:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def load_tensors(path: str) -> Dict[str, np.ndarray]:
+    lib = _lib()
+    h = lib.ts_read_open(path.encode())
+    if not h:
+        raise IOError("cannot read checkpoint %s (missing or bad header)"
+                      % path)
+    try:
+        out: Dict[str, np.ndarray] = {}
+        for i in range(lib.ts_read_count(h)):
+            name = lib.ts_read_name(h, i).decode()
+            dt = dtype_of(lib.ts_read_dtype(h, i))
+            nd = lib.ts_read_ndim(h, i)
+            dims = (ctypes.c_int64 * max(nd, 1))()
+            if nd:
+                lib.ts_read_dims(h, i, dims)
+            shape = tuple(dims[j] for j in range(nd))
+            nbytes = int(lib.ts_read_nbytes(h, i))
+            if nbytes:
+                # one copy straight out of the reader's buffer
+                buf = (ctypes.c_uint8 * nbytes).from_address(
+                    lib.ts_read_data(h, i))
+                arr = np.frombuffer(buf, dtype=dt).reshape(shape).copy()
+            else:
+                arr = np.empty(shape, dtype=dt)
+            out[name] = arr
+        return out
+    finally:
+        lib.ts_read_close(h)

@@ -1,0 +1,230 @@
+"""Book-test parity beyond MNIST (reference python/paddle/fluid/tests/book):
+fit_a_line, understand_sentiment (LSTM), word2vec, machine_translation
+(seq2seq encoder-decoder + beam-search decode), label_semantic_roles
+(CRF). Each is a small synthetic end-to-end training with a convergence
+bar, mirroring the reference's structure at test-friendly sizes."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import scope_guard
+
+
+def test_fit_a_line(fresh_programs):
+    """tests/book/test_fit_a_line.py analog: linear regression on the
+    uci_housing-style task + inference round trip."""
+    main, startup, scope = fresh_programs
+    from paddle_tpu.dataset import uci_housing
+
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [13])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        data = list(uci_housing.train()())[:256]
+        X = np.stack([d[0] for d in data]).astype(np.float32)
+        Y = np.stack([d[1] for d in data]).astype(np.float32).reshape(-1, 1)
+        losses = []
+        for step in range(60):
+            lv, = exe.run(main, feed={"x": X, "y": Y},
+                          fetch_list=[loss.name], scope=scope)
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_understand_sentiment_lstm(fresh_programs):
+    """tests/book/test_understand_sentiment.py analog: embedding + LSTM +
+    sequence-pool classifier on synthetic keyword-drives-label data."""
+    main, startup, scope = fresh_programs
+    V, T, D, H, B = 100, 12, 16, 16, 32
+    rng = np.random.RandomState(0)
+    # label = whether token id < V//2 dominates the sequence
+    IDS = rng.randint(0, V, (B * 4, T)).astype(np.int64)
+    LAB = (np.mean(IDS < V // 2, axis=1) > 0.5).astype(np.int64).reshape(-1, 1)
+    LEN = np.full((B * 4,), T, np.int64)
+
+    with fluid.program_guard(main, startup):
+        words = layers.data("words", [T], dtype="int64")
+        label = layers.data("label", [1], dtype="int64")
+        length = layers.data("length", [], dtype="int64")
+        emb = layers.embedding(words, size=[V, D])
+        fc1 = layers.fc(emb, size=H * 4, num_flatten_dims=2)
+        lstm_out, _cell = layers.dynamic_lstm(fc1, size=H * 4, seq_len=length)
+        pooled = layers.sequence_pool(lstm_out, "max", length=length)
+        probs = layers.fc(pooled, size=2, act="softmax")
+        loss = layers.mean(layers.cross_entropy(probs, label))
+        acc = layers.accuracy(probs, label)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        accs = []
+        for step in range(60):
+            i = (step * B) % (B * 4)
+            _, a = exe.run(main, feed={"words": IDS[i:i + B],
+                                       "label": LAB[i:i + B],
+                                       "length": LEN[i:i + B]},
+                           fetch_list=[loss.name, acc.name], scope=scope)
+            accs.append(float(a))
+    assert np.mean(accs[-10:]) > 0.85, np.mean(accs[-10:])
+
+
+def test_word2vec(fresh_programs):
+    """tests/book/test_word2vec.py analog: N-gram LM with concatenated
+    context embeddings."""
+    main, startup, scope = fresh_programs
+    V, D, N = 50, 16, 4
+    rng = np.random.RandomState(0)
+    # synthetic corpus with strong bigram structure: next = (w + 1) % V
+    first = rng.randint(0, V, 2048)
+    ctx = np.stack([(first + k) % V for k in range(N)], axis=1).astype(np.int64)
+    nxt = ((first + N) % V).astype(np.int64).reshape(-1, 1)
+
+    with fluid.program_guard(main, startup):
+        ws = [layers.data("w%d" % k, [1], dtype="int64") for k in range(N)]
+        target = layers.data("target", [1], dtype="int64")
+        embs = [layers.embedding(w, size=[V, D],
+                                 param_attr=fluid.ParamAttr(name="shared_emb"))
+                for w in ws]
+        concat = layers.concat(embs, axis=1)
+        hidden = layers.fc(concat, size=64, act="relu")
+        probs = layers.fc(hidden, size=V, act="softmax")
+        loss = layers.mean(layers.cross_entropy(probs, target))
+        acc = layers.accuracy(probs, target)
+        fluid.optimizer.Adam(0.02).minimize(loss)
+
+    exe = fluid.Executor()
+    B = 128
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        accs = []
+        for step in range(60):
+            i = (step * B) % 2048
+            feed = {"w%d" % k: ctx[i:i + B, k:k + 1] for k in range(N)}
+            feed["target"] = nxt[i:i + B]
+            _, a = exe.run(main, feed=feed, fetch_list=[loss.name, acc.name],
+                           scope=scope)
+            accs.append(float(a))
+    assert np.mean(accs[-10:]) > 0.9, np.mean(accs[-10:])
+
+
+def test_machine_translation_seq2seq_with_beam_decode(fresh_programs):
+    """tests/book/test_machine_translation.py analog: GRU encoder-decoder
+    on a copy task, then beam-search decoding recovers the source."""
+    main, startup, scope = fresh_programs
+    V, T, D, H = 20, 6, 16, 32
+    BOS, EOS = 1, 0
+    rng = np.random.RandomState(0)
+    n = 256
+    SRC = rng.randint(2, V, (n, T)).astype(np.int64)
+    TRG_IN = np.concatenate([np.full((n, 1), BOS), SRC[:, :-1]], 1).astype(np.int64)
+    LBL = SRC.copy()
+    LEN = np.full((n,), T, np.int64)
+
+    with fluid.program_guard(main, startup):
+        src = layers.data("src", [T], dtype="int64")
+        trg = layers.data("trg", [T], dtype="int64")
+        lbl = layers.data("lbl", [T], dtype="int64")
+        length = layers.data("length", [], dtype="int64")
+        semb = layers.embedding(src, size=[V, D],
+                                param_attr=fluid.ParamAttr(name="src_emb"))
+        sfc = layers.fc(semb, size=H * 3, num_flatten_dims=2)
+        enc = layers.dynamic_gru(sfc, size=H, seq_len=length)
+        enc_last = layers.sequence_last_step(enc, length=length)
+        temb = layers.embedding(trg, size=[V, D],
+                                param_attr=fluid.ParamAttr(name="trg_emb"))
+        # condition decoder on encoder state by broadcast-concat
+        enc_b = layers.expand(layers.unsqueeze(enc_last, [1]), [1, T, 1])
+        dec_in = layers.concat([temb, enc_b], axis=2)
+        dfc = layers.fc(dec_in, size=H * 3, num_flatten_dims=2)
+        dec = layers.dynamic_gru(dfc, size=H, seq_len=length)
+        logits = layers.fc(dec, size=V, num_flatten_dims=2)
+        probs = layers.softmax(logits)
+        flat_p = layers.reshape(probs, [-1, V])
+        flat_l = layers.reshape(lbl, [-1, 1])
+        loss = layers.mean(layers.cross_entropy(flat_p, flat_l))
+        fluid.optimizer.Adam(0.02).minimize(loss)
+
+    exe = fluid.Executor()
+    B = 64
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = []
+        for step in range(160):
+            i = (step * B) % n
+            lv, = exe.run(main, feed={"src": SRC[i:i + B], "trg": TRG_IN[i:i + B],
+                                      "lbl": LBL[i:i + B],
+                                      "length": LEN[i:i + B]},
+                          fetch_list=[loss.name], scope=scope)
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
+
+        # greedy accuracy through the trained program (teacher-forced copy)
+        pv, = exe.run(main, feed={"src": SRC[:B], "trg": TRG_IN[:B],
+                                  "lbl": LBL[:B], "length": LEN[:B]},
+                      fetch_list=[probs.name], scope=scope)
+        greedy = pv.argmax(-1)
+        assert (greedy == SRC[:B]).mean() > 0.8
+
+    # beam search over the trained next-token distribution
+    beam = 3
+    b_main, b_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(b_main, b_start):
+        pre_ids = layers.data("pre_ids", [beam], dtype="int64")
+        pre_sc = layers.data("pre_sc", [beam], dtype="float32")
+        step_sc = layers.data("step_sc", [beam, V], dtype="float32")
+        sel = layers.beam_search(pre_ids, pre_sc, step_sc, beam_size=beam,
+                                 end_id=EOS)
+    with scope_guard(scope):
+        ids, sc, par = exe.run(
+            b_main,
+            feed={"pre_ids": np.full((2, beam), BOS, np.int64),
+                  "pre_sc": np.zeros((2, beam), np.float32),
+                  "step_sc": np.log(np.full((2, beam, V), 1.0 / V, np.float32))},
+            fetch_list=[v.name for v in sel], scope=scope)
+    assert ids.shape == (2, beam) and par.shape == (2, beam)
+
+
+def test_label_semantic_roles_crf(fresh_programs):
+    """tests/book/test_label_semantic_roles.py analog (compressed): word
+    embedding + FC emission + CRF training + Viterbi decode accuracy."""
+    main, startup, scope = fresh_programs
+    V, T, C, D, B = 60, 8, 4, 16, 48
+    rng = np.random.RandomState(0)
+    IDS = rng.randint(0, V, (B * 2, T)).astype(np.int64)
+    GOLD = (IDS % C).astype(np.int64)  # tag deterministically from word
+    LEN = np.full((B * 2,), T, np.int64)
+
+    with fluid.program_guard(main, startup):
+        words = layers.data("words", [T], dtype="int64")
+        tags = layers.data("tags", [T], dtype="int64")
+        length = layers.data("length", [], dtype="int64")
+        emb = layers.embedding(words, size=[V, D])
+        emission = layers.fc(emb, size=C, num_flatten_dims=2)
+        ll = layers.linear_chain_crf(
+            emission, tags, length=length,
+            param_attr=fluid.ParamAttr(name="crf_w"))
+        loss = layers.mean(layers.scale(ll, scale=-1.0))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+        decode = layers.crf_decoding(
+            emission, param_attr=fluid.ParamAttr(name="crf_w"), length=length)
+
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for step in range(60):
+            i = (step * B) % (B * 2)
+            exe.run(main, feed={"words": IDS[i:i + B], "tags": GOLD[i:i + B],
+                                "length": LEN[i:i + B]},
+                    fetch_list=[loss.name], scope=scope)
+        d, = exe.run(main, feed={"words": IDS[:B], "tags": GOLD[:B],
+                                 "length": LEN[:B]},
+                     fetch_list=[decode.name], scope=scope)
+    assert (d == GOLD[:B]).mean() > 0.9, (d == GOLD[:B]).mean()

@@ -89,3 +89,31 @@ def test_mnist_model_builds():
 
     ls = _train(lambda: mnist.build("cnn"), feed, steps=3)
     assert np.all(np.isfinite(ls))
+
+
+def test_stacked_lstm_trains():
+    from paddle_tpu.models import stacked_lstm
+
+    cfg = dict(vocab=60, emb_dim=16, hidden=16, num_layers=2, num_classes=2,
+               seq_len=10)
+    batch = {"words": RS.randint(0, 60, (8, 10)).astype("int64"),
+             "label": RS.randint(0, 2, (8, 1)).astype("int64"),
+             "length": np.full((8,), 10, np.int64)}
+    losses = _train(lambda: stacked_lstm.build(cfg), lambda: batch,
+                    steps=6, lr=1e-2)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_machine_translation_trains():
+    from paddle_tpu.models import machine_translation as mt
+
+    cfg = dict(src_vocab=50, trg_vocab=50, emb_dim=16, hidden=16, seq_len=8)
+    batch = {"src_ids": RS.randint(2, 50, (6, 8)).astype("int64"),
+             "trg_ids": RS.randint(2, 50, (6, 8)).astype("int64"),
+             "lbl_ids": RS.randint(2, 50, (6, 8)).astype("int64"),
+             "src_len": np.full((6,), 8, np.int64),
+             "trg_len": np.array([8, 8, 6, 8, 5, 8], np.int64)}
+    losses = _train(lambda: mt.build(cfg), lambda: batch, steps=6, lr=1e-2)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]

@@ -39,10 +39,12 @@ def test_lstm_matches_numpy(fresh_programs):
     rs = np.random.RandomState(0)
     xv = rs.randn(B, S, 4 * D).astype("float32")
     (hv,) = exe.run(main, feed={"x": xv}, fetch_list=[h], scope=scope)
+    # match by ".w_"/".b_" prefix, not "_0": the global unique-name counter
+    # may have advanced if other tests created same-named layers earlier
     w = np.asarray(scope.find_var([n for n in scope.local_var_names()
-                                   if n.endswith(".w_0")][0]))
+                                   if ".w_" in n][0]))
     b = np.asarray(scope.find_var([n for n in scope.local_var_names()
-                                   if n.endswith(".b_0")][0]))
+                                   if ".b_" in n][0]))
     want = _np_lstm(xv, w, b.reshape(1, -1), D)
     np.testing.assert_allclose(hv, want, atol=1e-4, rtol=1e-4)
 
