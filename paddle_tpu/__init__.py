@@ -30,7 +30,8 @@ from . import (  # noqa: F401
     profiler,
     regularizer,
 )
-from . import contrib, inference, transpiler  # noqa: F401
+from . import contrib, flags, inference, transpiler  # noqa: F401
+from .flags import get_flag, set_flag  # noqa: F401
 from .async_executor import AsyncExecutor, DataFeedDesc  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401

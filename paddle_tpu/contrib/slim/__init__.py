@@ -1,0 +1,7 @@
+"""Model compression (reference python/paddle/fluid/contrib/slim/):
+magnitude pruning here, quantization in contrib/quantize (the reference
+splits them the same way; its distillation scaffolding was config-driven
+glue around ordinary program composition and has no separate machinery to
+rebuild)."""
+
+from .prune import Pruner, sensitivity  # noqa: F401

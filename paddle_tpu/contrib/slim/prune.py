@@ -1,0 +1,98 @@
+"""Magnitude pruning (reference contrib/slim/prune/ pruner +
+prune_strategy): zero the smallest-|w| fraction of each parameter and
+keep it zero through further training by masking after every update op.
+
+TPU shape: the mask lives as a persistable var; a multiply appended after
+the param's update op re-applies it inside the SAME compiled train step
+(no separate mask pass at runtime)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...core.program import Program, default_main_program
+from ...core.scope import Scope, global_scope
+
+__all__ = ["Pruner", "sensitivity"]
+
+UPDATE_OP_TYPES = {
+    "sgd", "momentum", "lars_momentum", "adagrad", "adam", "adamax",
+    "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "lamb",
+}
+
+
+class Pruner:
+    """ratio-based magnitude pruner (slim MagnitudePruner analog)."""
+
+    def __init__(self, ratios: Dict[str, float]):
+        self.ratios = dict(ratios)
+        self.masks: Dict[str, str] = {}
+
+    def prune(self, program: Optional[Program] = None,
+              scope: Optional[Scope] = None,
+              startup_program: Optional[Program] = None) -> List[str]:
+        """Compute masks from current weights, zero the pruned entries, and
+        append mask re-application after each update op. Returns the mask
+        var names."""
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        block = program.global_block()
+
+        for pname, ratio in self.ratios.items():
+            w = np.asarray(scope.find_var(pname))
+            k = int(np.floor(w.size * ratio))
+            mask = np.ones_like(w)
+            if k > 0:
+                thresh = np.partition(np.abs(w).ravel(), k - 1)[k - 1]
+                mask = (np.abs(w) > thresh).astype(w.dtype)
+            mname = pname + "@PRUNE_MASK"
+            block.create_var(name=mname, shape=w.shape, dtype=str(w.dtype),
+                             persistable=True, stop_gradient=True)
+            scope.set_var(mname, mask)
+            scope.set_var(pname, w * mask)
+            self.masks[pname] = mname
+
+        # re-mask after every update that writes a pruned param
+        new_ops = []
+        for op in block.ops:
+            new_ops.append(op)
+            if (op.type in UPDATE_OP_TYPES and op.input("Param")
+                    and op.input("Param")[0] in self.masks):
+                pname = op.input("Param")[0]
+                from ...core.program import Operator
+
+                new_ops.append(Operator(
+                    block, "elementwise_mul",
+                    {"X": [pname], "Y": [self.masks[pname]]},
+                    {"Out": [pname]}, {"__op_role__": "optimize"}))
+        block.ops = new_ops
+        program._bump()
+        return list(self.masks.values())
+
+    def density(self, scope: Optional[Scope] = None) -> Dict[str, float]:
+        scope = scope or global_scope()
+        out = {}
+        for pname in self.ratios:
+            w = np.asarray(scope.find_var(pname))
+            out[pname] = float((w != 0).mean())
+        return out
+
+
+def sensitivity(program, scope, executor, param_name: str, eval_fn,
+                ratios: Sequence[float] = (0.1, 0.3, 0.5, 0.7, 0.9)):
+    """Prune-and-eval sweep for one param (slim sensitive_prune_strategy
+    analog): returns {ratio: eval_fn()} with weights restored afterwards."""
+    saved = np.asarray(scope.find_var(param_name)).copy()
+    out = {}
+    for r in ratios:
+        w = saved.copy()
+        k = int(np.floor(w.size * r))
+        if k > 0:
+            thresh = np.partition(np.abs(w).ravel(), k - 1)[k - 1]
+            w = w * (np.abs(w) > thresh)
+        scope.set_var(param_name, w)
+        out[r] = eval_fn()
+    scope.set_var(param_name, saved)
+    return out

@@ -131,7 +131,17 @@ class Executor:
             scope.set_var(RNG_VAR, new_rng)
 
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            out = [np.asarray(v) for v in fetches]
+            from ..flags import get_flag
+
+            if get_flag("check_nan_inf"):
+                for name, v in zip(plan.fetch_names, out):
+                    if np.issubdtype(v.dtype, np.floating) and \
+                            not np.isfinite(v).all():
+                        raise FloatingPointError(
+                            "NaN/Inf in fetched var %r (FLAGS_check_nan_inf)"
+                            % name)
+            return out
         return list(fetches)
 
     def close(self):

@@ -1,0 +1,245 @@
+"""IR graph framework: a mutable op/var graph over a Program + pass
+registry.
+
+Analog of /root/reference/paddle/fluid/framework/ir/ (ir::Graph graph.h:72,
+ir::Node node.h:48, ir::Pass pass.h:32, pass registry, graph_viz_pass.cc,
+graph_to_program_pass.cc — 79 files). The reference's ~25 fusion passes
+(conv+bn, fc fuse, seq ops...) exist to hand-fuse kernels; under
+whole-program XLA those fusions are the compiler's job, so the pass zoo
+here is structural: visualization, dead-op elimination, is_test rewrites —
+and a stable substrate for program-rewriting tools (the quantize and
+distribute transpilers do their surgery at the program level today and
+can move onto this)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set
+
+from .program import Operator, Program
+
+__all__ = ["Node", "Graph", "Pass", "register_pass", "get_pass", "all_passes",
+           "graph_to_program"]
+
+
+class Node:
+    """Op node or var node (ir::Node, node.h:48)."""
+
+    def __init__(self, kind: str, name: str, op: Optional[Operator] = None,
+                 var=None):
+        assert kind in ("op", "var")
+        self.kind = kind
+        self.name = name
+        self.op = op
+        self.var = var
+        self.inputs: List["Node"] = []   # producers (var) / consumed vars (op)
+        self.outputs: List["Node"] = []
+
+    def is_op(self) -> bool:
+        return self.kind == "op"
+
+    def is_var(self) -> bool:
+        return self.kind == "var"
+
+    def __repr__(self):
+        return "%sNode(%s)" % ("Op" if self.is_op() else "Var", self.name)
+
+
+class Graph:
+    """Bipartite op/var dependency graph of a Program's global block
+    (ir::Graph, graph.h:72). Mutations happen on the node lists; call
+    graph_to_program to materialize back (graph_to_program_pass analog)."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.op_nodes: List[Node] = []
+        self.var_nodes: Dict[str, Node] = {}
+        block = program.global_block()
+        for name, var in block.vars.items():
+            self.var_nodes[name] = Node("var", name, var=var)
+        for op in block.ops:
+            onode = Node("op", op.type, op=op)
+            self.op_nodes.append(onode)
+            for n in op.input_names():
+                vn = self._var(n)
+                onode.inputs.append(vn)
+                vn.outputs.append(onode)
+            for n in op.output_names():
+                vn = self._var(n)
+                onode.outputs.append(vn)
+                vn.inputs.append(onode)
+
+    def _var(self, name: str) -> Node:
+        if name not in self.var_nodes:
+            self.var_nodes[name] = Node("var", name)
+        return self.var_nodes[name]
+
+    def all_op_nodes(self) -> List[Node]:
+        return list(self.op_nodes)
+
+    def all_var_nodes(self) -> List[Node]:
+        return list(self.var_nodes.values())
+
+    def remove_op_node(self, node: Node):
+        self.op_nodes.remove(node)
+        for vn in node.inputs:
+            vn.outputs = [o for o in vn.outputs if o is not node]
+        for vn in node.outputs:
+            vn.inputs = [i for i in vn.inputs if i is not node]
+
+    def topology_sort(self) -> List[Node]:
+        """Dependency-ordered op nodes; raises on cycles
+        (the SSA-graph validity check of multi_devices_graph_check_pass)."""
+        indeg = {id(n): 0 for n in self.op_nodes}
+        succs: Dict[int, List[Node]] = {id(n): [] for n in self.op_nodes}
+        produced_by: Dict[str, Node] = {}
+        for onode in self.op_nodes:
+            for vn in onode.outputs:
+                produced_by.setdefault(vn.name, onode)
+        for onode in self.op_nodes:
+            for vn in onode.inputs:
+                prod = produced_by.get(vn.name)
+                if prod is not None and prod is not onode:
+                    succs[id(prod)].append(onode)
+                    indeg[id(onode)] += 1
+        # stable order: keep program order among ready nodes
+        ready = [n for n in self.op_nodes if indeg[id(n)] == 0]
+        out: List[Node] = []
+        while ready:
+            n = ready.pop(0)
+            out.append(n)
+            for s in succs[id(n)]:
+                indeg[id(s)] -= 1
+                if indeg[id(s)] == 0:
+                    ready.append(s)
+        if len(out) != len(self.op_nodes):
+            raise RuntimeError("graph has a cycle (or dangling dependency)")
+        return out
+
+    def to_dot(self) -> str:
+        """graph_viz_pass.cc analog: GraphViz DOT text."""
+        lines = ["digraph G {", "  rankdir=TB;"]
+        ids: Dict[int, str] = {}
+        for i, n in enumerate(self.op_nodes):
+            ids[id(n)] = "op_%d" % i
+            lines.append('  op_%d [label="%s" shape=box style=filled '
+                         'fillcolor=lightblue];' % (i, n.op.type))
+        for i, (name, vn) in enumerate(sorted(self.var_nodes.items())):
+            if not vn.inputs and not vn.outputs:
+                continue
+            ids[id(vn)] = "var_%d" % i
+            persist = vn.var is not None and getattr(vn.var, "persistable",
+                                                     False)
+            lines.append('  var_%d [label="%s" shape=ellipse%s];'
+                         % (i, name,
+                            " style=filled fillcolor=lightgrey"
+                            if persist else ""))
+        for onode in self.op_nodes:
+            for vn in onode.inputs:
+                if id(vn) in ids:
+                    lines.append("  %s -> %s;" % (ids[id(vn)], ids[id(onode)]))
+            for vn in onode.outputs:
+                if id(vn) in ids:
+                    lines.append("  %s -> %s;" % (ids[id(onode)], ids[id(vn)]))
+        lines.append("}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- passes
+class Pass:
+    """Graph transform (ir::Pass, pass.h:32). Subclass or register a
+    callable; apply returns the (possibly same) Graph."""
+
+    name = "pass"
+
+    def apply(self, graph: Graph) -> Graph:
+        raise NotImplementedError
+
+
+_PASSES: Dict[str, Callable[[], Pass]] = {}
+
+
+def register_pass(name: str):
+    """REGISTER_PASS analog."""
+
+    def deco(cls):
+        cls.name = name
+        _PASSES[name] = cls
+        return cls
+
+    return deco
+
+
+def get_pass(name: str) -> Pass:
+    if name not in _PASSES:
+        raise KeyError("pass %r not registered (known: %s)"
+                       % (name, sorted(_PASSES)))
+    return _PASSES[name]()
+
+
+def all_passes() -> List[str]:
+    return sorted(_PASSES)
+
+
+def graph_to_program(graph: Graph) -> Program:
+    """graph_to_program_pass analog: rebuild a Program with the graph's
+    surviving ops in dependency order."""
+    prog = graph.program.clone()
+    block = prog.global_block()
+    block.ops = [n.op for n in graph.topology_sort()]
+    prog._bump()
+    return prog
+
+
+@register_pass("graph_viz_pass")
+class GraphVizPass(Pass):
+    """Writes DOT to self.dot_path (graph_viz_pass.cc)."""
+
+    def __init__(self, dot_path: str = "/tmp/program_graph.dot"):
+        self.dot_path = dot_path
+
+    def apply(self, graph: Graph) -> Graph:
+        with open(self.dot_path, "w") as f:
+            f.write(graph.to_dot())
+        return graph
+
+
+@register_pass("dead_code_elimination_pass")
+class DeadCodeEliminationPass(Pass):
+    """Remove ops whose outputs are never consumed and not persistable /
+    fetched (the useful core of the reference's memory_optimize family
+    that XLA does not already subsume: trimming the op list itself).
+    Set self.keep to protect fetch targets."""
+
+    def __init__(self, keep: Optional[Set[str]] = None):
+        self.keep = set(keep or ())
+
+    def apply(self, graph: Graph) -> Graph:
+        changed = True
+        while changed:
+            changed = False
+            for onode in list(graph.op_nodes):
+                if onode.op.attrs.get("__op_role__") in ("optimize", "dist"):
+                    continue  # side-effecting roles stay
+                live = False
+                for vn in onode.outputs:
+                    persist = vn.var is not None and getattr(
+                        vn.var, "persistable", False)
+                    if vn.name in self.keep or persist or vn.outputs:
+                        live = True
+                        break
+                if not live:
+                    graph.remove_op_node(onode)
+                    changed = True
+        return graph
+
+
+@register_pass("is_test_pass")
+class IsTestPass(Pass):
+    """Flip train-mode attrs for inference (the reference's is_test_pass)."""
+
+    def apply(self, graph: Graph) -> Graph:
+        for onode in graph.op_nodes:
+            if "is_test" in onode.op.attrs or onode.op.type in (
+                    "dropout", "batch_norm"):
+                onode.op.attrs["is_test"] = True
+        return graph

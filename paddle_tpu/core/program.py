@@ -195,7 +195,9 @@ class Parameter(Variable):
         self.trainable = kw.pop("trainable", True)
         self.regularizer = kw.pop("regularizer", None)
         self.gradient_clip_attr = kw.pop("gradient_clip_attr", None)
-        self.do_model_average = kw.pop("do_model_average", False)
+        # reference ParamAttr defaults do_model_average=True (params join
+        # ModelAverage unless explicitly opted out)
+        self.do_model_average = kw.pop("do_model_average", True)
         kw.setdefault("persistable", True)
         kw.setdefault("stop_gradient", not self.trainable)
         super().__init__(block, name, shape, dtype, **kw)

@@ -48,6 +48,7 @@ __all__ = [
     "FtrlOptimizer",
     "Lamb",
     "LambOptimizer",
+    "ModelAverage",
 ]
 
 
@@ -430,3 +431,84 @@ AdadeltaOptimizer = Adadelta
 RMSPropOptimizer = RMSProp
 FtrlOptimizer = Ftrl
 LambOptimizer = Lamb
+
+
+class ModelAverage:
+    """Running average of parameters, swapped in for evaluation
+    (reference optimizer.py ModelAverage:1485: per-param sum accumulators
+    updated each step; apply() temporarily replaces params with
+    sum/num_updates, restore() puts the trained values back).
+
+    Usage (reference contract):
+        opt.minimize(loss)
+        model_average = ModelAverage(0.15)      # after minimize
+        ... train ...
+        with model_average.apply(exe, scope):   # eval with averaged params
+            ... run test program ...
+    """
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        from .core.program import default_main_program
+        from .layer_helper import LayerHelper
+
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._params = []
+        helper = LayerHelper(name or "model_average")
+        block = default_main_program().global_block()
+        for p in block.all_parameters():
+            if not p.trainable or getattr(p, "do_model_average", True) is False:
+                continue
+            s = helper.create_global_variable(
+                name=unique_name.generate(p.name + "_sum"), shape=p.shape,
+                dtype=p.dtype, initializer=Constant(0.0))
+            n = helper.create_global_variable(
+                name=unique_name.generate(p.name + "_numacc"), shape=[1],
+                dtype="float32", initializer=Constant(0.0))
+            # in-step accumulation: sum += param, num += 1 (the reference's
+            # _append_average_accumulate_op)
+            block.append_op("sum", {"X": [s, p]}, {"Out": [s]},
+                            {"__op_role__": "optimize"})
+            block.append_op("increment", {"X": [n]}, {"Out": [n]},
+                            {"step": 1.0, "__op_role__": "optimize"})
+            self._params.append((p, s, n))
+        default_main_program()._bump()
+
+    def _swap(self, scope):
+        import numpy as np
+
+        self._saved = {}
+        for p, s, n in self._params:
+            self._saved[p.name] = scope.find_var(p.name)
+            cnt = max(float(np.asarray(scope.find_var(n.name))[0]), 1.0)
+            avg = np.asarray(scope.find_var(s.name)) / cnt
+            scope.set_var(p.name, avg.astype(p.dtype))
+
+    def restore(self, executor=None, scope=None):
+        from .core.scope import global_scope
+
+        scope = scope or global_scope()
+        for p, _s, _n in self._params:
+            scope.set_var(p.name, self._saved[p.name])
+        self._saved = {}
+
+    def apply(self, executor=None, scope=None, need_restore=True):
+        """Context manager: params hold their averaged values inside."""
+        import contextlib
+
+        from .core.scope import global_scope
+
+        scope = scope or global_scope()
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._swap(scope)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor, scope)
+
+        return _ctx()

@@ -1,0 +1,147 @@
+"""IR graph framework + slim pruning + ModelAverage + flags tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import ir
+from paddle_tpu.core.scope import scope_guard
+
+
+def _small_net(main, startup):
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu",
+                            param_attr=fluid.ParamAttr(name="w1"))
+        dead = fluid.layers.fc(x, size=3)  # never consumed
+        pred = fluid.layers.fc(h, size=1, param_attr=fluid.ParamAttr(name="w2"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return x, y, loss, dead
+
+
+def test_graph_build_and_topology(fresh_programs):
+    main, startup, scope = fresh_programs
+    _small_net(main, startup)
+    g = ir.Graph(main)
+    ops = g.topology_sort()
+    assert len(ops) == len(main.global_block().ops)
+    # every producer precedes its consumers
+    seen = set()
+    for onode in ops:
+        for vn in onode.inputs:
+            for prod in vn.inputs:
+                assert id(prod) in seen or prod is onode
+        seen.add(id(onode))
+
+
+def test_dot_output(fresh_programs, tmp_path):
+    main, startup, scope = fresh_programs
+    _small_net(main, startup)
+    g = ir.Graph(main)
+    p = ir.get_pass("graph_viz_pass")
+    p.dot_path = str(tmp_path / "g.dot")
+    p.apply(g)
+    dot = open(p.dot_path).read()
+    assert dot.startswith("digraph") and "mul" in dot and "->" in dot
+
+
+def test_dead_code_elimination(fresh_programs):
+    main, startup, scope = fresh_programs
+    x, y, loss, dead = _small_net(main, startup)
+    n_before = len(main.global_block().ops)
+    g = ir.Graph(main)
+    p = ir.get_pass("dead_code_elimination_pass")
+    p.keep = {loss.name}
+    g = p.apply(g)
+    pruned = ir.graph_to_program(g)
+    n_after = len(pruned.global_block().ops)
+    assert n_after < n_before
+    types_alive = [op.type for op in pruned.global_block().ops]
+    # the dead fc branch (mul + add) is gone; the live path survives
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        lv, = exe.run(pruned,
+                      feed={"x": np.ones((2, 4), np.float32),
+                            "y": np.zeros((2, 1), np.float32)},
+                      fetch_list=[loss.name], scope=scope)
+    assert np.isfinite(lv).all()
+
+
+def test_pruner_masks_and_density(fresh_programs):
+    from paddle_tpu.contrib.slim import Pruner
+
+    main, startup, scope = fresh_programs
+    x, y, loss, _ = _small_net(main, startup)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        pruner = Pruner({"w1": 0.5})
+        pruner.prune(main, scope)
+        d0 = pruner.density(scope)["w1"]
+        assert d0 <= 0.51
+        X = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        Y = X.sum(1, keepdims=True).astype(np.float32)
+        for _ in range(5):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss.name],
+                    scope=scope)
+        # pruned entries stay zero through training
+        d5 = pruner.density(scope)["w1"]
+        assert d5 <= d0 + 1e-6
+
+
+def test_model_average(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(0.15)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 4).astype(np.float32)
+        Y = X.sum(1, keepdims=True).astype(np.float32)
+        ws = []
+        for _ in range(5):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss.name],
+                    scope=scope)
+            ws.append(np.asarray(scope.find_var("w")).copy())
+        trained = np.asarray(scope.find_var("w")).copy()
+        with ma.apply(exe, scope):
+            np.testing.assert_allclose(np.asarray(scope.find_var("w")),
+                                       np.mean(ws, axis=0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(scope.find_var("w")), trained)
+
+
+def test_flags():
+    assert fluid.get_flag("cpu_deterministic") is True
+    fluid.set_flag("v", 3)
+    assert fluid.get_flag("v") == 3
+    fluid.set_flag("v", 0)
+    with pytest.raises(KeyError):
+        fluid.set_flag("nonexistent_flag", 1)
+    assert "rpc_deadline" in fluid.flags.all_flags()
+
+
+def test_check_nan_inf_flag(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        out = fluid.layers.log(x)  # log(-1) = nan
+    fluid.set_flag("check_nan_inf", True)
+    try:
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            with pytest.raises(FloatingPointError):
+                exe.run(main, feed={"x": np.array([[-1.0, 1.0]], np.float32)},
+                        fetch_list=[out.name], scope=scope)
+    finally:
+        fluid.set_flag("check_nan_inf", False)
