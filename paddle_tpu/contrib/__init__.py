@@ -2,5 +2,6 @@
 (reference python/paddle/fluid/contrib/ — slim/, quantize/,
 int8_inference/; SURVEY §2.8)."""
 
+from . import mixed_precision  # noqa: F401
 from . import quantize  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401

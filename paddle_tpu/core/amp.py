@@ -1,0 +1,93 @@
+"""Automatic mixed precision: a lowering-time dtype policy.
+
+TPU-first AMP: master weights and optimizer state stay float32 in the Scope;
+when a Program has AMP enabled, each op's lowering sees its floating inputs
+cast per a three-way policy (bf16 compute / f32 numerics / passthrough), so
+the whole forward+backward runs in bfloat16 on the MXU while reductions,
+softmax/losses, norm statistics and the optimizer update run in float32.
+
+bfloat16 shares float32's exponent range, so no loss scaling is needed —
+this is why the TPU design diverges from GPU fp16 AMP (the reference only
+has fp16 *data* support, /root/reference/paddle/fluid/platform/float16.h,
+and no AMP training loop at all).
+
+Because grad ops are the jax.vjp of their forward lowering (core/autodiff.py)
+and this policy is applied uniformly in lower_op, the backward pass computes
+in exactly the dtypes the forward did: activations/grads flow bf16,
+parameter gradients are upcast at the optimizer boundary (FP32_OPS).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+
+__all__ = ["BF16_OPS", "FP32_OPS", "apply_amp_policy"]
+
+# Compute ops: cast every floating input to bf16. Dots/convs hit the MXU at
+# bf16 rate; elementwise/activation ops halve their HBM traffic; the f32
+# master weight's cast is fused into the consuming matmul by XLA.
+BF16_OPS = frozenset({
+    "mul", "matmul", "matmul_v2", "bmm", "dot",
+    "conv2d", "conv2d_transpose", "conv3d", "depthwise_conv2d",
+    "fused_attention",
+    "lookup_table", "lookup_table_v2",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow",
+    "relu", "relu6", "gelu", "tanh", "sigmoid", "silu", "swish",
+    "leaky_relu", "elu", "brelu", "soft_relu", "softplus", "softsign",
+    "hard_sigmoid", "hard_swish", "mish", "stanh", "tanh_shrink",
+    "hard_shrink", "thresholded_relu", "prelu", "maxout",
+    "pool2d", "pool2d_with_index", "pad", "pad2d",
+    "dropout", "scale",
+    "gru", "lstm", "row_conv",
+    "sequence_conv", "sequence_pool",
+    "affine_channel", "im2sequence",
+})
+
+# Numerically sensitive ops: cast every floating input to f32 (exp/log and
+# large reductions, norm statistics, losses, and the optimizer update against
+# f32 master state).
+FP32_OPS = frozenset({
+    "softmax", "log_softmax", "sequence_softmax",
+    "softmax_with_cross_entropy", "cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "bpr_loss", "huber_loss",
+    "smooth_l1_loss", "log_loss", "square_error_cost", "margin_rank_loss",
+    "rank_loss", "nce", "hierarchical_sigmoid", "warpctc",
+    "linear_chain_crf", "crf_decoding",
+    "layer_norm", "batch_norm", "group_norm", "lrn", "norm",
+    "squared_l2_norm", "clip_by_norm",
+    "mean", "reduce_mean", "reduce_sum", "reduce_prod",
+    "exp", "log", "sqrt", "rsqrt", "pow", "reciprocal", "cumsum",
+    "cos_sim", "edit_distance",
+    # optimizer family: reads f32 master params/moments, upcasts bf16 grads
+    "sgd", "momentum", "lars_momentum", "adagrad", "adam", "adamax",
+    "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "lamb",
+})
+# Everything else passes its input dtypes through untouched (reshape,
+# transpose, concat, sum-of-grads, control flow, comparisons, metrics, io...).
+
+
+def _cast_ins(ins: Dict[str, List[Any]], dtype) -> Dict[str, List[Any]]:
+    out = {}
+    for slot, vals in ins.items():
+        out[slot] = [
+            v.astype(dtype)
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
+            and v.dtype != dtype else v
+            for v in vals
+        ]
+    return out
+
+
+def apply_amp_policy(op_type: str, ins: Dict[str, List[Any]]):
+    """Cast `ins` per the policy for `op_type` (grad ops follow their
+    forward op's class so jax.vjp re-traces see consistent dtypes)."""
+    base = op_type[:-5] if op_type.endswith("_grad") else op_type
+    if base in BF16_OPS:
+        return _cast_ins(ins, jnp.bfloat16)
+    if base in FP32_OPS:
+        return _cast_ins(ins, jnp.float32)
+    return ins

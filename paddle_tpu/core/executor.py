@@ -91,8 +91,7 @@ class Executor:
         feed_vals = {}
         for name, val in feed.items():
             var = block.vars.get(name)
-            dt = as_jax_dtype(var.dtype) if var is not None else None
-            feed_vals[name] = jnp.asarray(val, dtype=dt)
+            feed_vals[name] = _feed_to_device(name, val, var)
 
         key = self._cache_key(program, feed_vals, fetch_names)
         plan = self._cache.get(key)
@@ -261,12 +260,14 @@ def analyze_block(program: Program, feed_names, fetch_names, scope):
     const_state = [n for n in external if n not in seen_w]
     pure_written = [n for n in written if n not in external]
 
+    amp = bool(getattr(program, "amp", False))
+
     def step(feeds, const_vals, mut_vals, rng):
         env: Dict[str, Any] = {}
         env.update(zip(const_state, const_vals))
         env.update(zip(mut_state, mut_vals))
         env.update(zip(feed_names, feeds))
-        ctx = LowerContext(block, rng)
+        ctx = LowerContext(block, rng, amp=amp)
         lower_block(ctx, block, env)
         fetches = [env[n] for n in fetch_names]
         new_mut = [env[n] for n in mut_state]
@@ -276,6 +277,27 @@ def analyze_block(program: Program, feed_names, fetch_names, scope):
 
     return (feed_names, fetch_names, const_state, mut_state, pure_written,
             needs_rng, step)
+
+
+def _feed_to_device(name: str, val, var):
+    """Convert one feed to its on-device dtype. int64 ids narrow to int32
+    (x64 stays off — see as_jax_dtype) with an explicit range check instead
+    of jnp's silent truncation warning."""
+    if var is not None and var.dtype in ("int64", "uint64"):
+        arr = np.asarray(val)
+        if arr.dtype.itemsize == 8 and arr.size:
+            dev_dt = "int32" if var.dtype == "int64" else "uint32"
+            info = np.iinfo(dev_dt)
+            lo, hi = arr.min(), arr.max()
+            if lo < info.min or hi > info.max:
+                raise OverflowError(
+                    "feed %r has values in [%d, %d], outside the device %s "
+                    "range [%d, %d]; ids this large need the distributed "
+                    "sparse table path (distributed/transpiler.py)"
+                    % (name, lo, hi, dev_dt, info.min, info.max))
+        return jnp.asarray(arr, dtype=as_jax_dtype(var.dtype))
+    dt = as_jax_dtype(var.dtype) if var is not None else None
+    return jnp.asarray(val, dtype=dt)
 
 
 def _require(scope: Scope, name: str):

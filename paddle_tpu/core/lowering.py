@@ -26,10 +26,11 @@ class LowerContext:
     the owning block (for sub-block control flow), and mode flags."""
 
     def __init__(self, block: Optional[Block] = None, rng: Optional[jax.Array] = None,
-                 is_test: bool = False):
+                 is_test: bool = False, amp: bool = False):
         self.block = block
         self._rng = rng
         self.is_test = is_test
+        self.amp = amp
         self.rng_used = False
 
     def next_rng(self) -> jax.Array:
@@ -47,12 +48,12 @@ class LowerContext:
         return self._rng
 
     def sub(self, block: Block) -> "LowerContext":
-        c = LowerContext(block, self._rng, self.is_test)
+        c = LowerContext(block, self._rng, self.is_test, self.amp)
         return c
 
     def pure(self) -> "LowerContext":
         """Context for re-tracing a forward lowering inside a vjp: no RNG."""
-        return LowerContext(self.block, None, self.is_test)
+        return LowerContext(self.block, None, self.is_test, self.amp)
 
 
 def lower_op(ctx: LowerContext, op, env: Dict[str, Any]) -> None:
@@ -60,6 +61,10 @@ def lower_op(ctx: LowerContext, op, env: Dict[str, Any]) -> None:
     ins: Dict[str, List[Any]] = {}
     for slot, names in op.inputs.items():
         ins[slot] = [env[n] if n else None for n in names]
+    if ctx.amp:
+        from .amp import apply_amp_policy
+
+        ins = apply_amp_policy(op.type, ins)
     attrs = op.attrs
     if opdef.needs_env:
         attrs = dict(op.attrs)
@@ -94,6 +99,16 @@ def lower_block(ctx: LowerContext, block: Block, env: Dict[str, Any]) -> None:
 
 
 def as_jax_dtype(dtype: str):
+    """Program dtype -> on-device dtype.
+
+    int64 is an API-boundary type: jax runs with x64 disabled (the TPU-native
+    choice — 64-bit integer lanes waste VPU width), so id/index vars are
+    int32 on device. The Executor range-checks int64 feeds at the boundary
+    (executor._feed_to_device), replacing the reference's genuinely-64-bit
+    lookup_table ids (/root/reference/paddle/fluid/operators/lookup_table_op.cc)
+    with a checked narrowing."""
     if dtype == "bool":
         return jnp.bool_
+    if dtype in ("int64", "uint64"):
+        return jnp.dtype(dtype.replace("64", "32"))
     return jnp.dtype(dtype)

@@ -360,10 +360,19 @@ class Program:
         self._version = 0  # bumped on any mutation; keys the compile cache
         self._op_role = "forward"
         self._is_distributed = False
+        self.amp = False  # bf16 compute policy (core/amp.py); set via set_amp
 
     # ---- mutation tracking ----
     def _bump(self):
         self._version += 1
+
+    def set_amp(self, enabled: bool = True) -> "Program":
+        """Enable bfloat16 mixed-precision lowering for this program (f32
+        master weights stay in the Scope; see core/amp.py). Returns self."""
+        if self.amp != bool(enabled):
+            self.amp = bool(enabled)
+            self._bump()
+        return self
 
     @property
     def version(self) -> int:
@@ -398,6 +407,7 @@ class Program:
 
         p = Program()
         p.random_seed = self.random_seed
+        p.amp = self.amp
         p.blocks = []
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)
@@ -452,6 +462,7 @@ class Program:
     def to_dict(self):
         return {
             "random_seed": self.random_seed,
+            "amp": self.amp,
             "blocks": [b.to_dict() for b in self.blocks],
         }
 

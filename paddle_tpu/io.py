@@ -160,6 +160,7 @@ def _program_from_dict(d) -> Program:
 
     p = Program()
     p.random_seed = d.get("random_seed")
+    p.amp = bool(d.get("amp", False))
     p.blocks = []
     for bd in d["blocks"]:
         b = Block(p, bd["idx"], bd["parent_idx"])

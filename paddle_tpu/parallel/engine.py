@@ -23,8 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.executor import RNG_VAR, analyze_block
-from ..core.lowering import as_jax_dtype
+from ..core.executor import RNG_VAR, _feed_to_device, analyze_block
 from ..core.program import Program, Variable
 from ..core.scope import Scope, global_scope
 from .sharding import ShardingRules
@@ -89,8 +88,7 @@ class ParallelEngine:
         feed_vals = {}
         for name, val in feed.items():
             var = block.vars.get(name)
-            dt = as_jax_dtype(var.dtype) if var is not None else None
-            feed_vals[name] = jnp.asarray(val, dtype=dt)
+            feed_vals[name] = _feed_to_device(name, val, var)
 
         key = self._cache_key(feed_vals, fetch_names)
         plan = self._cache.get(key)

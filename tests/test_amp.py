@@ -1,0 +1,109 @@
+"""bf16 mixed-precision (core/amp.py + contrib.mixed_precision).
+
+The reference has fp16 *data* support only
+(/root/reference/paddle/fluid/platform/float16.h) and no AMP loop; the TPU
+build's AMP is a lowering-time dtype policy: bf16 compute, f32 master
+weights/optimizer state, f32 numerics for losses/norms/reductions.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _mlp_program(amp):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[32], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=64, act="relu")
+        logits = fluid.layers.fc(h, size=10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        opt = fluid.optimizer.Adam(learning_rate=1e-2)
+        if amp:
+            opt = fluid.contrib.mixed_precision.decorate(opt)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, scope, steps=40):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(0)
+    X = rs.rand(128, 32).astype("float32")
+    Y = (X.sum(1) * 3 % 10).astype("int64").reshape(-1, 1)
+    out = []
+    for _ in range(steps):
+        (v,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss],
+                       scope=scope)
+        out.append(float(v))
+    return out
+
+
+class TestAmp:
+    def test_converges_and_masters_stay_f32(self, fresh_programs):
+        _, _, scope = fresh_programs
+        main, startup, loss = _mlp_program(amp=True)
+        losses = _train(main, startup, loss, scope)
+        assert losses[-1] < 0.5 * losses[0]
+        for p in main.global_block().all_parameters():
+            v = scope.find_var(p.name)
+            assert np.asarray(v).dtype == np.float32, p.name
+
+    def test_matches_f32_training(self, fresh_programs):
+        _, _, scope = fresh_programs
+        main, startup, loss = _mlp_program(amp=False)
+        ref = _train(main, startup, loss, scope)
+
+        from paddle_tpu.core.scope import Scope
+
+        scope2 = Scope()
+        main2, startup2, loss2 = _mlp_program(amp=True)
+        got = _train(main2, startup2, loss2, scope2)
+        # same trajectory within bf16 tolerance (first step near-exact)
+        assert abs(got[0] - ref[0]) < 2e-2
+        assert abs(got[-1] - ref[-1]) < 0.3
+
+    def test_program_version_bumps_and_clone_carries_amp(self):
+        p = fluid.Program()
+        v0 = p.version
+        p.set_amp(True)
+        assert p.version == v0 + 1 and p.amp
+        p.set_amp(True)  # idempotent: no extra recompile
+        assert p.version == v0 + 1
+        assert p.clone().amp is True
+
+    def test_decorate_passthrough_attrs(self):
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(learning_rate=0.1), init_loss_scaling=128.0)
+        assert opt.loss_scaling == 128.0
+        assert opt._lr == 0.1  # delegated
+
+
+class TestInt64Boundary:
+    def test_int64_feed_narrowly_cast(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[4], dtype="int64")
+            emb = fluid.layers.embedding(ids, size=[50, 8])
+            loss = fluid.layers.mean(emb)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        feed = np.array([[1, 2, 3, 49]] * 2, dtype=np.int64)
+        (v,) = exe.run(main, feed={"ids": feed}, fetch_list=[loss],
+                       scope=scope)
+        assert np.isfinite(v).all()
+
+    def test_out_of_range_ids_rejected(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(ids, size=[50, 8])
+            fluid.layers.mean(emb)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        bad = np.array([[2 ** 40]], dtype=np.int64)
+        with pytest.raises(OverflowError, match="int32 range"):
+            exe.run(main, feed={"ids": bad}, fetch_list=[], scope=scope)
