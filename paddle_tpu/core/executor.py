@@ -46,6 +46,7 @@ class _Plan:
         self.pure_written = pure_written    # written-only persistables
         self.needs_rng = needs_rng
         self.fn = fn
+        self.cost = None  # cost_analysis() result, filled on first request
 
 
 class Executor:
@@ -82,31 +83,8 @@ class Executor:
             run_pserver_loop(ops0[0].attrs, scope, executor=self)
             return []
 
-        feed = feed or {}
-        fetch_names = [
-            v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
-        ]
-
-        block = program.global_block()
-        feed_vals = {}
-        for name, val in feed.items():
-            var = block.vars.get(name)
-            feed_vals[name] = _feed_to_device(name, val, var)
-
-        key = self._cache_key(program, feed_vals, fetch_names)
-        plan = self._cache.get(key)
-        if plan is None:
-            plan = self._prepare(program, feed_vals, fetch_names, scope)
-            self._cache[key] = plan
-
-        const_state = [_require(scope, n) for n in plan.const_state]
-        mut_state = [_require(scope, n) for n in plan.mut_state]
-        rng = scope.find_var(RNG_VAR)
-        if rng is None:
-            seed = program.random_seed if program.random_seed is not None else 0
-            rng = jax.random.PRNGKey(seed)
-
-        feeds = [feed_vals[n] for n in plan.feed_names]
+        plan, feeds, const_state, mut_state, rng = self._gather(
+            program, feed, fetch_list, scope)
         from ..profiler import RecordEvent, is_profiler_enabled
 
         if is_profiler_enabled():
@@ -142,6 +120,60 @@ class Executor:
                             % name)
             return out
         return list(fetches)
+
+    def cost_analysis(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+    ) -> Dict[str, float]:
+        """XLA cost analysis (flops, bytes accessed, ...) of the compiled
+        step for this (program, feed-signature) — the whole-program analog
+        of the reference's per-op profiler tables and
+        contrib/memory_usage_calc.py. Returns the compiler's own estimate,
+        so benchmark MFU numbers don't rely on hand-derived formulas.
+        Cached per plan: repeat calls with the same signature are free."""
+        from ..compiler import CompiledProgram
+
+        if isinstance(program, CompiledProgram):
+            program = program._program
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        plan, feeds, const_state, mut_state, rng = self._gather(
+            program, feed, fetch_list, scope)
+        if plan.cost is None:
+            cost = plan.fn.lower(
+                feeds, const_state, mut_state, rng).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):  # one dict per computation
+                cost = cost[0] if cost else {}
+            plan.cost = dict(cost or {})
+        return dict(plan.cost)
+
+    def _gather(self, program, feed, fetch_list, scope):
+        """Shared run()/cost_analysis() plumbing: feed conversion, plan
+        cache lookup, and state/RNG argument gathering."""
+        feed = feed or {}
+        fetch_names = [
+            v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
+        ]
+        block = program.global_block()
+        feed_vals = {
+            n: _feed_to_device(n, v, block.vars.get(n)) for n, v in feed.items()
+        }
+        key = self._cache_key(program, feed_vals, fetch_names)
+        plan = self._cache.get(key)
+        if plan is None:
+            plan = self._prepare(program, feed_vals, fetch_names, scope)
+            self._cache[key] = plan
+        const_state = [_require(scope, n) for n in plan.const_state]
+        mut_state = [_require(scope, n) for n in plan.mut_state]
+        rng = scope.find_var(RNG_VAR)
+        if rng is None:
+            seed = program.random_seed if program.random_seed is not None else 0
+            rng = jax.random.PRNGKey(seed)
+        feeds = [feed_vals[n] for n in plan.feed_names]
+        return plan, feeds, const_state, mut_state, rng
 
     def close(self):
         """Release cached executables and tell any connected pservers this
@@ -283,6 +315,9 @@ def _feed_to_device(name: str, val, var):
     """Convert one feed to its on-device dtype. int64 ids narrow to int32
     (x64 stays off — see as_jax_dtype) with an explicit range check instead
     of jnp's silent truncation warning."""
+    want = as_jax_dtype(var.dtype) if var is not None else None
+    if isinstance(val, jax.Array) and (want is None or val.dtype == want):
+        return val  # already on device at the right dtype: no host round-trip
     if var is not None and var.dtype in ("int64", "uint64"):
         arr = np.asarray(val)
         if arr.dtype.itemsize == 8 and arr.size:
@@ -295,9 +330,8 @@ def _feed_to_device(name: str, val, var):
                     "range [%d, %d]; ids this large need the distributed "
                     "sparse table path (distributed/transpiler.py)"
                     % (name, lo, hi, dev_dt, info.min, info.max))
-        return jnp.asarray(arr, dtype=as_jax_dtype(var.dtype))
-    dt = as_jax_dtype(var.dtype) if var is not None else None
-    return jnp.asarray(val, dtype=dt)
+        return jnp.asarray(arr, dtype=want)
+    return jnp.asarray(val, dtype=want)
 
 
 def _require(scope: Scope, name: str):

@@ -197,4 +197,4 @@ def _sampling_id(ctx, ins, attrs):
     seed = attrs.get("seed", 0)
     key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
     return {"Out": [jax.random.categorical(key, jnp.log(x + 1e-20), axis=-1)
-                    .astype(jnp.int64)]}
+                    .astype(jnp.int32)]}

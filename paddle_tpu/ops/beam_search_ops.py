@@ -50,8 +50,8 @@ def _beam_search(ctx, ins, attrs):
 
     flat = total.reshape(B, K * V)
     top_s, top_i = lax.top_k(flat, beam)              # [B, beam]
-    parent = (top_i // V).astype(jnp.int64)
-    ids = (top_i % V).astype(jnp.int64)
+    parent = (top_i // V).astype(jnp.int32)
+    ids = (top_i % V).astype(jnp.int32)
     return {"selected_ids": [ids], "selected_scores": [top_s],
             "parent_idx": [parent]}
 
@@ -76,7 +76,7 @@ def _beam_search_decode(ctx, ins, attrs):
     init = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None], (B, K))
     _, toks = lax.scan(back, init, (ids, parents), reverse=True)
     # toks: [T, B, beam] tokens along each final beam's ancestry
-    sentences = jnp.transpose(toks, (1, 2, 0)).astype(jnp.int64)  # [B,beam,T]
+    sentences = jnp.transpose(toks, (1, 2, 0)).astype(jnp.int32)  # [B,beam,T]
     return {"SentenceIds": [sentences],
             "SentenceScores": [scores[-1]]}  # final cumulative beam scores
 

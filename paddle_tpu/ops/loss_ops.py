@@ -290,7 +290,7 @@ def _crf_decoding(ctx, ins, attrs):
     # path_rest[k] is the label at position k+1; the final carry is position 0
     path = jnp.concatenate([first[None], path_rest], axis=0).T  # [B, T]
     mask = jnp.arange(T)[None, :] < length[:, None]
-    return {"ViterbiPath": [jnp.where(mask, path, 0).astype(jnp.int64)]}
+    return {"ViterbiPath": [jnp.where(mask, path, 0).astype(jnp.int32)]}
 
 
 @register_op("edit_distance", no_grad=True)

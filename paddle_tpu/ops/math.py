@@ -187,12 +187,12 @@ _logical("logical_not", jnp.logical_not, unary=True)
 
 @register_op("arg_max", no_grad=True)
 def _arg_max(ctx, ins, attrs):
-    return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+    return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int32)]}
 
 
 @register_op("arg_min", no_grad=True)
 def _arg_min(ctx, ins, attrs):
-    return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+    return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int32)]}
 
 
 @register_op("argsort", no_grad=True)
@@ -200,7 +200,7 @@ def _argsort(ctx, ins, attrs):
     x = ins["X"][0]
     axis = attrs.get("axis", -1)
     idx = jnp.argsort(x, axis=axis)
-    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)], "Indices": [idx.astype(jnp.int32)]}
 
 
 @register_op("cumsum")

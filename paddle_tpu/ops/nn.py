@@ -404,7 +404,7 @@ def _top_k(ctx, ins, attrs):
     x = ins["X"][0]
     k = attrs.get("k", 1)
     vals, idx = lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int32)]}
 
 
 @register_op("maxout", diff_inputs=["X"])
