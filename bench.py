@@ -8,7 +8,9 @@ The reference prints examples/sec from benchmark/fluid/fluid_benchmark.py
 vs_baseline anchors on this repo's own round-1 measurements where they
 exist and on 1.0 for first-time measurements. MFU uses XLA's own
 cost_analysis() flop count for the compiled train step (no hand-derived
-formulas) against the chip's peak bf16 FLOP/s.
+formulas) against the chip's peak bf16 FLOP/s (the "precision" field
+records the compute dtype; XLA's default TPU matmul precision runs f32
+dots at bf16 rate, so the bf16 peak is the comparable denominator).
 
 All workloads train with bf16 AMP (f32 master weights) — the TPU-native
 configuration; run with --fp32 to disable.
@@ -88,6 +90,7 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
         peak = peak_flops()
         rec = {
             "metric": name,
+            "precision": "bf16_amp" if amp else "f32",
             "value": round(throughput, 1),
             "unit": unit,
             "vs_baseline": round(throughput / ROUND1[name], 3)

@@ -143,8 +143,15 @@ class Executor:
         plan, feeds, const_state, mut_state, rng = self._gather(
             program, feed, fetch_list, scope)
         if plan.cost is None:
-            cost = plan.fn.lower(
-                feeds, const_state, mut_state, rng).compile().cost_analysis()
+            lowered = plan.fn.lower(feeds, const_state, mut_state, rng)
+            try:
+                # pre-optimization estimate: avoids a second full XLA
+                # compile (run() already compiled via the jit cache, which
+                # AOT .compile() cannot reuse); dot/conv flops are the same
+                # pre- and post-fusion
+                cost = lowered.cost_analysis()
+            except Exception:
+                cost = lowered.compile().cost_analysis()
             if isinstance(cost, (list, tuple)):  # one dict per computation
                 cost = cost[0] if cost else {}
             plan.cost = dict(cost or {})
@@ -293,22 +300,110 @@ def analyze_block(program: Program, feed_names, fetch_names, scope):
     pure_written = [n for n in written if n not in external]
 
     amp = bool(getattr(program, "amp", False))
+    accum = int(getattr(program, "grad_accum_steps", 1))
+
+    if accum > 1:
+        step = _accum_step(program, block, feed_names, fetch_names,
+                           const_state, mut_state, pure_written, amp, accum)
+    else:
+        def step(feeds, const_vals, mut_vals, rng):
+            env: Dict[str, Any] = {}
+            env.update(zip(const_state, const_vals))
+            env.update(zip(mut_state, mut_vals))
+            env.update(zip(feed_names, feeds))
+            ctx = LowerContext(block, rng, amp=amp)
+            lower_block(ctx, block, env)
+            fetches = [env[n] for n in fetch_names]
+            new_mut = [env[n] for n in mut_state]
+            new_pure = [env[n] for n in pure_written]
+            out_rng = ctx.final_rng() if ctx.rng_used else rng
+            return fetches, new_mut, new_pure, out_rng
+
+    return (feed_names, fetch_names, const_state, mut_state, pure_written,
+            needs_rng, step)
+
+
+def _accum_step(program, block, feed_names, fetch_names, const_state,
+                mut_state, pure_written, amp, k):
+    """Gradient-accumulation step: lax.scan the compute ops (forward +
+    backward) over k microbatch slices of the feeds, average the float
+    values crossing into the optimize-role ops (the gradients), and run
+    those ops once. TPU-native analog of the reference's
+    ir/multi_batch_merge_pass.cc (which clones the forward k times and
+    inserts grad-averaging ops into the graph instead)."""
+    from .lowering import lower_ops
+
+    scan_ops = [op for op in block.ops
+                if op.attrs.get("__op_role__") != "optimize"]
+    apply_ops = [op for op in block.ops
+                 if op.attrs.get("__op_role__") == "optimize"]
+
+    written_scan = {n for op in scan_ops for n in op.output_names()}
+    read_apply = {n for op in apply_ops for n in op.input_names()}
+    # values flowing compute -> update (gradients, plus anything else the
+    # apply side reads that the scan side computes)
+    boundary = sorted(read_apply & written_scan)
+    scan_fetch = [n for n in fetch_names
+                  if n in written_scan and n not in boundary]
+    scan_pure = [n for n in pure_written if n in written_scan]
+    ys_names = boundary + scan_fetch + scan_pure
 
     def step(feeds, const_vals, mut_vals, rng):
-        env: Dict[str, Any] = {}
+        mb_feeds = []
+        mb_size = None
+        for name, f in zip(feed_names, feeds):
+            b = f.shape[0] if f.ndim else 0
+            if f.ndim == 0 or b % k:
+                raise ValueError(
+                    "feed %r batch dim %s is not divisible by "
+                    "gradient accumulation steps %d" % (name, b, k))
+            mb_size = b // k
+            mb_feeds.append(f.reshape((k, b // k) + f.shape[1:]))
+
+        def body(carry, xs):
+            rng_c, mut_c = carry
+            env = {}
+            env.update(zip(const_state, const_vals))
+            env.update(zip(mut_state, mut_c))
+            env.update(zip(feed_names, xs))
+            ctx = LowerContext(block, rng_c, amp=amp)
+            lower_ops(ctx, scan_ops, env)
+            new_rng = ctx.final_rng() if ctx.rng_used else rng_c
+            new_mut = [env.get(n, m) for n, m in zip(mut_state, mut_c)]
+            ys = [env[n] for n in ys_names]
+            return (new_rng, new_mut), ys
+
+        (rng, scan_mut), ys = jax.lax.scan(body, (rng, list(mut_vals)),
+                                           mb_feeds)
+
+        env = {}
         env.update(zip(const_state, const_vals))
-        env.update(zip(mut_state, mut_vals))
-        env.update(zip(feed_names, feeds))
+        env.update(zip(mut_state, scan_mut))
+        env.update(zip(feed_names, feeds))  # full batch, if apply reads one
+        for name, stacked in zip(ys_names, ys):
+            # per-example fetches ([k, mb, ...] with a batch leading dim)
+            # concatenate back to full-batch order; gradients and scalar
+            # float fetches average over microbatches (the global-batch
+            # mean, since each microbatch loss is a mean); stateful
+            # leftovers (counters, metric states) keep the last value
+            if name in scan_fetch and stacked.ndim >= 2 and \
+                    stacked.shape[1] == mb_size:
+                env[name] = stacked.reshape((-1,) + stacked.shape[2:])
+            elif name not in scan_pure and \
+                    jnp.issubdtype(stacked.dtype, jnp.floating):
+                env[name] = jnp.mean(stacked, axis=0)
+            else:
+                env[name] = stacked[-1]
+
         ctx = LowerContext(block, rng, amp=amp)
-        lower_block(ctx, block, env)
+        lower_ops(ctx, apply_ops, env)
         fetches = [env[n] for n in fetch_names]
         new_mut = [env[n] for n in mut_state]
         new_pure = [env[n] for n in pure_written]
         out_rng = ctx.final_rng() if ctx.rng_used else rng
         return fetches, new_mut, new_pure, out_rng
 
-    return (feed_names, fetch_names, const_state, mut_state, pure_written,
-            needs_rng, step)
+    return step
 
 
 def _feed_to_device(name: str, val, var):

@@ -84,11 +84,9 @@ def lower_op(ctx: LowerContext, op, env: Dict[str, Any]) -> None:
                 env[name] = val
 
 
-def lower_block(ctx: LowerContext, block: Block, env: Dict[str, Any]) -> None:
-    """Run every op's lowering in program order, mutating `env`
-    (name -> traced value). This is the whole-program analog of
-    Executor::RunPreparedContext's op loop."""
-    for op in block.ops:
+def lower_ops(ctx: LowerContext, ops, env: Dict[str, Any]) -> None:
+    """Lower a specific op sequence in order, mutating `env`."""
+    for op in ops:
         try:
             lower_op(ctx, op, env)
         except Exception as e:
@@ -96,6 +94,13 @@ def lower_block(ctx: LowerContext, block: Block, env: Dict[str, Any]) -> None:
                 "while lowering op %r (inputs=%s outputs=%s): %s: %s"
                 % (op.type, op.inputs, op.outputs, type(e).__name__, e)
             ) from e
+
+
+def lower_block(ctx: LowerContext, block: Block, env: Dict[str, Any]) -> None:
+    """Run every op's lowering in program order, mutating `env`
+    (name -> traced value). This is the whole-program analog of
+    Executor::RunPreparedContext's op loop."""
+    lower_ops(ctx, block.ops, env)
 
 
 def as_jax_dtype(dtype: str):

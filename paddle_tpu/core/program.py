@@ -220,6 +220,11 @@ class Operator:
         self.inputs: Dict[str, List[str]] = _slot_names(inputs)
         self.outputs: Dict[str, List[str]] = _slot_names(outputs)
         self.attrs: Dict[str, Any] = dict(attrs or {})
+        # ops built under Program.op_role_guard inherit that role (the
+        # reference threads op_role the same way, framework.py op_role attr)
+        role = getattr(block.program, "_op_role", None)
+        if role and role != "forward":
+            self.attrs.setdefault("__op_role__", role)
 
     def input_names(self) -> List[str]:
         return [n for ns in self.inputs.values() for n in ns if n]
@@ -361,10 +366,43 @@ class Program:
         self._op_role = "forward"
         self._is_distributed = False
         self.amp = False  # bf16 compute policy (core/amp.py); set via set_amp
+        self.grad_accum_steps = 1  # microbatch scan count (set_gradient_accumulation)
 
     # ---- mutation tracking ----
     def _bump(self):
         self._version += 1
+
+    def op_role_guard(self, role: str):
+        """Context manager: ops appended inside get __op_role__=`role`
+        (used by LR schedulers and apply-side builders so the gradient-
+        accumulation partition can tell update logic from compute)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            prev = self._op_role
+            self._op_role = role
+            try:
+                yield
+            finally:
+                self._op_role = prev
+
+        return _guard()
+
+    def set_gradient_accumulation(self, num_microbatches: int) -> "Program":
+        """Split each fed batch into `num_microbatches` slices, run
+        forward+backward per slice under an in-step lax.scan, average the
+        gradients, and apply the optimizer once — the TPU-native analog of
+        the reference's multi_batch_merge pass
+        (/root/reference/paddle/fluid/framework/ir/multi_batch_merge_pass.cc).
+        The fed batch's leading dim must be divisible by num_microbatches."""
+        k = int(num_microbatches)
+        if k < 1:
+            raise ValueError("num_microbatches must be >= 1, got %d" % k)
+        if getattr(self, "grad_accum_steps", 1) != k:
+            self.grad_accum_steps = k
+            self._bump()
+        return self
 
     def set_amp(self, enabled: bool = True) -> "Program":
         """Enable bfloat16 mixed-precision lowering for this program (f32
@@ -408,6 +446,7 @@ class Program:
         p = Program()
         p.random_seed = self.random_seed
         p.amp = self.amp
+        p.grad_accum_steps = self.grad_accum_steps
         p.blocks = []
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)
@@ -463,6 +502,7 @@ class Program:
         return {
             "random_seed": self.random_seed,
             "amp": self.amp,
+            "grad_accum_steps": self.grad_accum_steps,
             "blocks": [b.to_dict() for b in self.blocks],
         }
 

@@ -161,6 +161,7 @@ def _program_from_dict(d) -> Program:
     p = Program()
     p.random_seed = d.get("random_seed")
     p.amp = bool(d.get("amp", False))
+    p.grad_accum_steps = int(d.get("grad_accum_steps", 1))
     p.blocks = []
     for bd in d["blocks"]:
         b = Block(p, bd["idx"], bd["parent_idx"])

@@ -5,8 +5,10 @@ same XLA computation as the optimizer update."""
 
 from __future__ import annotations
 
+import functools
 import math
 
+from ..core.program import default_main_program
 from ..initializer import Constant
 from ..layer_helper import LayerHelper
 from .nn import elementwise_div, elementwise_max, elementwise_min, scale
@@ -27,6 +29,19 @@ __all__ = [
 _COUNTER_NAME = "@LR_DECAY_COUNTER@"
 
 
+def _optimize_role(fn):
+    """LR-schedule ops carry the optimize role: under gradient accumulation
+    the schedule (and its step counter) must advance once per applied step,
+    not once per microbatch (core/executor._accum_step)."""
+
+    @functools.wraps(fn)
+    def wrap(*args, **kwargs):
+        with default_main_program().op_role_guard("optimize"):
+            return fn(*args, **kwargs)
+
+    return wrap
+
+
 def _decay_step_counter(begin=0):
     helper = LayerHelper("global_step_counter")
     counter = helper.create_global_variable(
@@ -40,6 +55,7 @@ def _decay_step_counter(begin=0):
     return counter
 
 
+@_optimize_role
 def noam_decay(d_model, warmup_steps, learning_rate=1.0):
     step = _decay_step_counter(1)
     helper = LayerHelper("noam_decay")
@@ -61,6 +77,7 @@ def _rpow(var, p):
     return out
 
 
+@_optimize_role
 def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     step = _decay_step_counter()
     div = scale(step, 1.0 / decay_steps)
@@ -81,10 +98,12 @@ def _exp_of(v):
     return out
 
 
+@_optimize_role
 def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     return exponential_decay(learning_rate, decay_steps, math.exp(-decay_rate), staircase)
 
 
+@_optimize_role
 def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     step = _decay_step_counter()
     div = scale(step, 1.0 / decay_steps)
@@ -96,6 +115,7 @@ def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     return scale(out, learning_rate)
 
 
+@_optimize_role
 def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4, power=1.0,
                      cycle=False):
     step = _decay_step_counter()
@@ -106,6 +126,7 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4, power=1
     return scale(poly, learning_rate - end_learning_rate, end_learning_rate)
 
 
+@_optimize_role
 def piecewise_decay(boundaries, values):
     """Step-function schedule via nested where ops."""
     from .nn import less_than, where
@@ -118,6 +139,7 @@ def piecewise_decay(boundaries, values):
     return lr
 
 
+@_optimize_role
 def cosine_decay(learning_rate, step_each_epoch, epochs):
     step = _decay_step_counter()
     frac = scale(step, 1.0 / (step_each_epoch * epochs))
@@ -129,6 +151,7 @@ def cosine_decay(learning_rate, step_each_epoch, epochs):
     return scale(scale(c, 0.5, 0.5), learning_rate)
 
 
+@_optimize_role
 def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     from .nn import less_than, where
 

@@ -102,15 +102,22 @@ class Optimizer:
         return append_backward(loss, parameter_list, no_grad_set)
 
     def apply_gradients(self, params_grads) -> List:
-        params_grads = append_gradient_clip_ops(params_grads)
-        params_grads = append_regularization_ops(params_grads, self.regularization)
-        self._create_lr_var()
-        self._create_accumulators(params_grads)
-        ops = []
-        for p, g in params_grads:
-            if g is None:
-                continue
-            ops.append(self._append_optimize_op(p, g))
+        # everything appended here (clip chains, regularizers, lr plumbing,
+        # update ops) is update logic: tag it so the gradient-accumulation
+        # partition (core/executor._accum_step) runs it once per applied
+        # step, after the microbatch scan
+        prog = default_main_program()
+        with prog.op_role_guard("optimize"):
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(
+                params_grads, self.regularization)
+            self._create_lr_var()
+            self._create_accumulators(params_grads)
+            ops = []
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                ops.append(self._append_optimize_op(p, g))
         return ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
