@@ -1232,11 +1232,13 @@ def fused_attention(q, k, v, bias=None, scale=1.0, dropout=0.0, name=None):
     this from matmul+softmax layer calls — SURVEY §5."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
+    mask = helper.create_variable_for_type_inference(q.dtype)
+    mask.stop_gradient = True
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias is not None:
         inputs["Bias"] = [bias]
     helper.append_op(type="fused_attention", inputs=inputs,
-                     outputs={"Out": [out]},
+                     outputs={"Out": [out], "Mask": [mask]},
                      attrs={"scale": float(scale), "dropout": float(dropout)})
     out.shape = q.shape
     return out

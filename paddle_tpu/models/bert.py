@@ -40,7 +40,7 @@ def _bert_embed(src_ids, sent_ids, cfg, seq_len, is_test):
 
 
 def build(cfg=None, seq_len=128, max_mask=20, is_test=False,
-          use_fused_attention=False):
+          use_fused_attention=True):
     """MLM training graph. Feeds: src_ids/sent_ids [B,S] int64,
     input_mask [B,S] float (1=real token), mask_pos [B,max_mask] int64
     (flattened B*S positions), mask_label [B,max_mask] int64 (pad rows

@@ -160,7 +160,7 @@ def _causal_bias(seq_len):
 
 
 def build(cfg=None, seq_len=64, is_test=False, label_smooth_eps=0.1,
-          use_fused_attention=False):
+          use_fused_attention=True):
     """Full training graph. Returns (avg_cost, feeds)."""
     cfg = cfg or base_config()
     src = layers.data("src_ids", [seq_len], dtype="int64")

@@ -1,16 +1,26 @@
-"""Fused attention: Pallas TPU kernel + custom VJP.
+"""Flash attention: blocked-KV online-softmax Pallas kernels + custom VJP.
 
 The reference has NO fused attention op — attention is composed from
 matmul/softmax/elementwise layer calls (SURVEY §5, e.g.
 /root/reference/python/paddle/fluid/tests/unittests/dist_transformer.py).
-This op is the TPU-first upgrade slot: the forward is one Pallas kernel
-(scores never round-trip to HBM; softmax runs in VMEM against the MXU
-matmuls), the backward recomputes scores under XLA (flash-style
-rematerialisation — trades FLOPs for HBM, SURVEY §7 hard-parts list).
+This op is the TPU-first upgrade slot, implementing the FlashAttention-2
+scheme end to end:
+
+  forward:  grid (B*H, Sq/bq, Sk/bk) with the K axis innermost; running
+            max/denominator/accumulator live in VMEM scratch, so VMEM use
+            is O(bq*bk + bq*D + bk*D) regardless of S, and the [Sq,Sk]
+            score matrix never exists in HBM. Saves the logsumexp rows.
+  backward: two Pallas kernels re-deriving the probabilities from the
+            saved logsumexp — dK/dV sweeps query blocks per key block,
+            dQ sweeps key blocks per query block, with
+            delta = rowsum(dO*O) precomputed outside.
 
 Layout: q,k,v [B, H, S, D]; bias broadcastable [B|1, H|1, Sq|1, Sk],
-additive (-1e9 at masked positions). On non-TPU backends the kernel runs
-in interpret mode (tests) so numerics match the TPU path.
+additive (-1e9 at masked positions). The bias is treated as a constant
+mask: its cotangent is zero (real uses are padding/causal masks; a model
+needing trainable bias gradients uses the layer-composed path). On
+non-TPU backends the kernels run in interpret mode (tests) so numerics
+match the TPU path.
 """
 
 from __future__ import annotations
@@ -20,36 +30,298 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-from ..core.registry import register_op
+from ..core.registry import register_grad_lowering, register_op
 
 __all__ = ["flash_attention"]
 
-_BQ = 256  # query block rows per kernel instance
+_BQ = 128  # query rows per block
+_BK = 128  # key rows per block
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale, have_bias):
-    q = q_ref[0]                      # [bq, D]
-    k = k_ref[0]                      # [S, D]
-    v = v_ref[0]                      # [S, D]
-    s = jax.lax.dot_general(
-        q.astype(jnp.float32), k.astype(jnp.float32),
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale                         # [bq, S]
-    if have_bias:
-        b = b_ref[0, 0]               # [bq|1, S]
-        s = s + b.astype(jnp.float32)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.dot(p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
-    o_ref[0] = (o / l).astype(o_ref.dtype)
+def _use_interpret() -> bool:
+    """Pallas interpret mode off only on real TPU backends (including the
+    'axon' PJRT tunnel, whose platform name is not 'tpu')."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return True
+    plat = dev.platform.lower()
+    return not (plat in ("tpu", "axon") or "tpu" in dev.device_kind.lower())
+_NEG = -1e30
+
+
+def _blocks(S, b):
+    b = min(b, S)
+    if S % b:
+        b = S  # ragged sequence lengths fall back to one block
+    return b, S // b
+
+
+def _bias_spec_and_operand(bias, H, bq, bk, iq_pos, ik_pos):
+    """BlockSpec + reshaped operand for a broadcastable bias.
+
+    iq_pos/ik_pos say which grid axes carry the q/k block indices (the
+    forward and the two backward kernels order their grids differently)."""
+    Bb, Hb, Sqb, Skb = bias.shape
+    blk_q = bq if Sqb > 1 else 1
+    blk_k = bk if Skb > 1 else 1
+
+    def bias_map(*idx, Bb=Bb, Hb=Hb, Sqb=Sqb, Skb=Skb, H=H):
+        bh = idx[0]
+        b = (bh // H) if Bb > 1 else 0
+        h = (bh % H) if Hb > 1 else 0
+        return (b, h,
+                idx[iq_pos] if Sqb > 1 else 0,
+                idx[ik_pos] if Skb > 1 else 0)
+
+    return pl.BlockSpec((1, 1, blk_q, blk_k), bias_map), bias
+
+
+# --------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, nk):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)          # [bq, D]
+    k = k_ref[0].astype(jnp.float32)          # [bk, D]
+    v = v_ref[0].astype(jnp.float32)          # [bk, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if b_ref is not None:
+        s = s + b_ref[0, 0].astype(jnp.float32)
+
+    m_prev = m_ref[...]                       # [bq, 1]
+    l_prev = l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                    # [bq, bk]
+    l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[...] = m_new
+    acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+        p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+
+
+def _forward_pallas(q, k, v, bias, scale):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    bq, nq = _blocks(S, _BQ)
+    bk, nk = _blocks(Sk, _BK)
+    qf, kf, vf = (t.reshape(B * H, t.shape[2], D) for t in (q, k, v))
+    grid = (B * H, nq, nk)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),
+    ]
+    operands = [qf, kf, vf]
+    if bias is not None:
+        spec, opnd = _bias_spec_and_operand(bias, H, bq, bk, 1, 2)
+        in_specs.append(spec)
+        operands.append(opnd)
+        kern = functools.partial(_fwd_kernel, scale=scale, nk=nk)
+    else:
+        def kern(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
+            _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                        acc, m, l, scale=scale, nk=nk)
+
+    out, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(*operands)
+    return out.reshape(B, H, S, D), lse
+
+
+# -------------------------------------------------------------- backward
+def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, nq):
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0].astype(jnp.float32)          # [bq, D]
+    k = k_ref[0].astype(jnp.float32)          # [bk, D]
+    v = v_ref[0].astype(jnp.float32)          # [bk, D]
+    g = g_ref[0].astype(jnp.float32)          # [bq, D]
+    lse = lse_ref[0][:, None]                 # [bq, 1]
+    delta = d_ref[0][:, None]                 # [bq, 1]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if b_ref is not None:
+        s = s + b_ref[0, 0].astype(jnp.float32)
+    p = jnp.exp(s - lse)                      # [bq, bk]
+
+    # dv += p^T g ; dp = g v^T ; ds = p*(dp - delta)*scale ; dk += ds^T q
+    dv_acc[...] += jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dk_acc[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
+               dq_ref, dq_acc, *, scale, nk):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = d_ref[0][:, None]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if b_ref is not None:
+        s = s + b_ref[0, 0].astype(jnp.float32)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale             # [bq, bk]
+    dq_acc[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _backward_pallas(q, k, v, bias, o, lse, g, scale):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    bq, nq = _blocks(S, _BQ)
+    bk, nk = _blocks(Sk, _BK)
+    qf, kf, vf = (t.reshape(B * H, t.shape[2], D) for t in (q, k, v))
+    gf = g.reshape(B * H, S, D)
+    of = o.reshape(B * H, S, D)
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)                   # [BH, S]
+    interp = _use_interpret()
+
+    # dK/dV: one key block per (bh, ik), sweep query blocks innermost
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, ik, iq: (bh, iq, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
+    ]
+    operands = [qf, kf, vf]
+    if bias is not None:
+        spec, opnd = _bias_spec_and_operand(bias, H, bq, bk, 2, 1)
+        in_specs.append(spec)
+        operands.append(opnd)
+        kern = functools.partial(_dkv_kernel, scale=scale, nq=nq)
+    else:
+        def kern(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref,
+                 dk_ref, dv_ref, dka, dva):
+            _dkv_kernel(q_ref, k_ref, v_ref, None, g_ref, lse_ref, d_ref,
+                        dk_ref, dv_ref, dka, dva, scale=scale, nq=nq)
+    in_specs += [
+        pl.BlockSpec((1, bq, D), lambda bh, ik, iq: (bh, iq, 0)),
+        pl.BlockSpec((1, bq), lambda bh, ik, iq: (bh, iq)),
+        pl.BlockSpec((1, bq), lambda bh, ik, iq: (bh, iq)),
+    ]
+    operands += [gf, lse, delta]
+    dk, dv = pl.pallas_call(
+        kern,
+        grid=(B * H, nk, nq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interp,
+    )(*operands)
+
+    # dQ: one query block per (bh, iq), sweep key blocks innermost
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),
+    ]
+    operands = [qf, kf, vf]
+    if bias is not None:
+        spec, opnd = _bias_spec_and_operand(bias, H, bq, bk, 1, 2)
+        in_specs.append(spec)
+        operands.append(opnd)
+        kern = functools.partial(_dq_kernel, scale=scale, nk=nk)
+    else:
+        def kern(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dq_ref, dqa):
+            _dq_kernel(q_ref, k_ref, v_ref, None, g_ref, lse_ref, d_ref,
+                       dq_ref, dqa, scale=scale, nk=nk)
+    in_specs += [
+        pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+        pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+    ]
+    operands += [gf, lse, delta]
+    dq = pl.pallas_call(
+        kern,
+        grid=(B * H, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interp,
+    )(*operands)
+
+    shape = (B, H, S, D)
+    kshape = (B, H, Sk, D)
+    return dq.reshape(shape), dk.reshape(kshape), dv.reshape(kshape)
 
 
 def _attention_reference(q, k, v, bias, scale):
-    """Plain-XLA attention used for the recompute backward (and as the
-    numeric contract for the kernel)."""
+    """Plain-XLA attention: the numeric contract for the kernels."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
@@ -57,73 +329,21 @@ def _attention_reference(q, k, v, bias, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
 
 
-def _forward_pallas(q, k, v, bias, scale):
-    B, H, S, D = q.shape
-    bq = min(_BQ, S)
-    if S % bq != 0:
-        bq = S
-    qf = q.reshape(B * H, S, D)
-    kf = k.reshape(B * H, S, D)
-    vf = v.reshape(B * H, S, D)
-    grid = (B * H, S // bq)
-
-    in_specs = [
-        pl.BlockSpec((1, bq, D), lambda bh, iq: (bh, iq, 0)),
-        pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
-        pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
-    ]
-    operands = [qf, kf, vf]
-    have_bias = bias is not None
-    if have_bias:
-        Bb, Hb, Sqb, Skb = bias.shape
-        bias_bq = bq if Sqb > 1 else 1
-
-        def bias_map(bh, iq, Bb=Bb, Hb=Hb, Sqb=Sqb, H=H):
-            b = (bh // H) if Bb > 1 else 0
-            h = (bh % H) if Hb > 1 else 0
-            return (b, h, iq if Sqb > 1 else 0, 0)
-
-        in_specs.append(pl.BlockSpec((1, 1, bias_bq, Skb), bias_map))
-        operands.append(bias.reshape(Bb, Hb, Sqb, Skb))
-
-    kern = functools.partial(_attn_kernel, scale=scale, have_bias=have_bias)
-    if not have_bias:
-        kern = lambda q_ref, k_ref, v_ref, o_ref: _attn_kernel(  # noqa: E731
-            q_ref, k_ref, v_ref, None, o_ref, scale=scale, have_bias=False)
-
-    out = pl.pallas_call(
-        kern,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-        interpret=jax.default_backend() != "tpu",
-    )(*operands)
-    return out.reshape(B, H, S, D)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def flash_attention(q, k, v, bias, scale):
-    return _forward_pallas(q, k, v, bias, scale)
+    out, _ = _forward_pallas(q, k, v, bias, scale)
+    return out
 
 
 def _fa_fwd(q, k, v, bias, scale):
-    return _forward_pallas(q, k, v, bias, scale), (q, k, v, bias)
+    out, lse = _forward_pallas(q, k, v, bias, scale)
+    return out, (q, k, v, bias, out, lse)
 
 
 def _fa_bwd(scale, res, g):
-    q, k, v, bias = res
-    # recompute-based backward: vjp of the XLA reference (scores live only
-    # inside this fused backward computation)
-    def f(q, k, v, bias):
-        return _attention_reference(q, k, v, bias, scale)
-
-    if bias is None:
-        _, vjp = jax.vjp(lambda a, b, c: f(a, b, c, None), q, k, v)
-        dq, dk, dv = vjp(g)
-        return dq, dk, dv, None
-    _, vjp = jax.vjp(f, q, k, v, bias)
-    dq, dk, dv, db = vjp(g)
+    q, k, v, bias, o, lse = res
+    dq, dk, dv = _backward_pallas(q, k, v, bias, o, lse, g, scale)
+    db = None if bias is None else jnp.zeros_like(bias)
     return dq, dk, dv, db
 
 
@@ -138,11 +358,34 @@ def _fused_attention(ctx, ins, attrs):
     bias = (ins.get("Bias") or [None])[0]
     scale = attrs.get("scale", 1.0)
     dropout = attrs.get("dropout", 0.0)
+    if bias is not None:
+        bias = bias.astype(jnp.float32)  # mask bias adds in f32 in-kernel
     out = flash_attention(q, k, v, bias, scale)
-    if dropout:
+    if dropout and not ctx.is_test:
         # dropout on the *output* (weights-dropout does not commute with the
-        # fused kernel; divergence from the layer-composed path documented)
+        # fused kernel; divergence from the layer-composed path documented).
+        # The mask is a saved output so the grad op can replay it without
+        # RNG (same pattern as the dropout op, ops/nn.py).
         keep = 1.0 - dropout
-        mask = jax.random.bernoulli(ctx.next_rng(), keep, out.shape)
-        out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
-    return {"Out": [out]}
+        mask = jax.random.bernoulli(
+            ctx.next_rng(), keep, out.shape).astype(out.dtype) / keep
+    else:
+        mask = jnp.ones_like(out)
+    return {"Out": [out * mask], "Mask": [mask]}
+
+
+@register_grad_lowering("fused_attention")
+def _fused_attention_grad(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = (ins.get("Bias") or [None])[0]
+    mask = (ins.get("Mask") or [None])[0]
+    g = ins["Out@GRAD"][0]
+    if mask is not None:
+        g = (g * mask).astype(q.dtype)
+    if bias is not None:
+        bias = bias.astype(jnp.float32)
+    scale = attrs.get("scale", 1.0)
+    _, vjp = jax.vjp(
+        lambda a, b, c: flash_attention(a, b, c, bias, scale), q, k, v)
+    dq, dk, dv = vjp(g.astype(q.dtype))
+    return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}

@@ -48,6 +48,56 @@ def test_flash_attention_grads():
                                    atol=1e-5, rtol=1e-5)
 
 
+def test_flash_attention_long_context_blocked():
+    # S=2048 >> the 128-row block: exercises the online-softmax accumulation
+    # across 16 KV blocks (VMEM-bounded; the [S,S] scores never materialize)
+    rs = np.random.RandomState(2)
+    B, H, S, D = 1, 1, 2048, 32
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
+               for _ in range(3))
+    causal = jnp.asarray(
+        np.triu(np.full((S, S), -1e9, dtype="float32"), 1)[None, None])
+    out = flash_attention(q, k, v, causal, D ** -0.5)
+    ref = _attention_reference(q, k, v, causal, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_bf16():
+    rs = np.random.RandomState(3)
+    B, H, S, D = 2, 2, 256, 32
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D)).astype(jnp.bfloat16)
+               for _ in range(3))
+    out = flash_attention(q, k, v, None, D ** -0.5)
+    ref = _attention_reference(q, k, v, None, D ** -0.5)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref, dtype=np.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+def test_flash_attention_grads_blocked_with_bias():
+    # multi-block backward: the two Pallas grad kernels vs the XLA vjp
+    rs = np.random.RandomState(4)
+    B, H, S, D = 1, 2, 256, 16
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
+               for _ in range(3))
+    bias = jnp.asarray(
+        np.where(rs.rand(B, 1, 1, S) > 0.2, 0, -1e9).astype("float32"))
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, bias, D ** -0.5) ** 2).sum()
+
+    def g(q, k, v):
+        return (_attention_reference(q, k, v, bias, D ** -0.5) ** 2).sum()
+
+    got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
 def test_transformer_with_fused_attention_trains():
     cfg = dict(d_model=32, d_ff=64, n_head=4, n_layer=2, src_vocab=100,
                trg_vocab=100, max_length=16, dropout=0.0)
