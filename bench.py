@@ -38,8 +38,11 @@ PEAKS = {
     "v2": 45e12,
 }
 
-# round-1 measurements (BENCH_r01.json): the self-baseline this repo beats
-ROUND1 = {"transformer_base_train_tokens_per_sec_per_chip": 103605.4}
+# Self-baseline: best committed measurement per workload from earlier
+# rounds (the reference ships no absolute numbers — BASELINE.md). Round 1
+# committed only the transformer (BENCH_r01.json); the others anchor on
+# 1.0 until their first committed number, then get pinned here.
+BASELINES = {"transformer_base_train_tokens_per_sec_per_chip": 103605.4}
 
 
 def peak_flops():
@@ -93,8 +96,8 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             "precision": "bf16_amp" if amp else "f32",
             "value": round(throughput, 1),
             "unit": unit,
-            "vs_baseline": round(throughput / ROUND1[name], 3)
-            if name in ROUND1 else 1.0,
+            "vs_baseline": round(throughput / BASELINES[name], 3)
+            if name in BASELINES else 1.0,
             "tflops_per_sec": round(achieved / 1e12, 2),
             "mfu": round(achieved / peak, 4) if peak else None,
         }
@@ -247,9 +250,23 @@ def main():
     args = ap.parse_args()
 
     names = [args.only] if args.only else list(WORKLOADS)
+    failures = 0
     for name in names:
-        WORKLOADS[name](not args.fp32, args.quick)
-    return 0
+        # one bad workload costs one row, never the whole file (the
+        # round-2 lesson: a single kernel regression zeroed all five)
+        try:
+            WORKLOADS[name](not args.fp32, args.quick)
+        except Exception as exc:  # noqa: BLE001
+            import traceback
+
+            failures += 1
+            tb = traceback.format_exc().strip().splitlines()
+            print(json.dumps({
+                "metric": name,
+                "error": f"{type(exc).__name__}: {exc}"[:400],
+                "traceback_tail": " | ".join(tb[-3:])[:400],
+            }), flush=True)
+    return 1 if failures == len(names) else 0
 
 
 if __name__ == "__main__":

@@ -194,7 +194,7 @@ class Executor:
     # -------------------------------------------------------------- prepare
     def _cache_key(self, program, feed_vals, fetch_names):
         sig = tuple(sorted((n, v.shape, str(v.dtype)) for n, v in feed_vals.items()))
-        return (id(program), program.version, sig, tuple(fetch_names))
+        return (program._serial, program.version, sig, tuple(fetch_names))
 
     def _prepare(self, program: Program, feed_vals, fetch_names, scope) -> _Plan:
         feed_names = sorted(feed_vals)
@@ -343,6 +343,13 @@ def _accum_step(program, block, feed_names, fetch_names, const_state,
     # values flowing compute -> update (gradients, plus anything else the
     # apply side reads that the scan side computes)
     boundary = sorted(read_apply & written_scan)
+    # gradients are exactly the backward-role outputs (append_backward tags
+    # every grad op — core/backward.py); only those get microbatch-averaged.
+    # Other crossing values (metric/counter state an optimize op happens to
+    # read) keep their final-microbatch value instead of a silent average.
+    grad_names = {n for op in scan_ops
+                  if op.attrs.get("__op_role__") == "backward"
+                  for n in op.output_names()}
     scan_fetch = [n for n in fetch_names
                   if n in written_scan and n not in boundary]
     scan_pure = [n for n in pure_written if n in written_scan]
@@ -381,15 +388,19 @@ def _accum_step(program, block, feed_names, fetch_names, const_state,
         env.update(zip(mut_state, scan_mut))
         env.update(zip(feed_names, feeds))  # full batch, if apply reads one
         for name, stacked in zip(ys_names, ys):
-            # per-example fetches ([k, mb, ...] with a batch leading dim)
-            # concatenate back to full-batch order; gradients and scalar
-            # float fetches average over microbatches (the global-batch
-            # mean, since each microbatch loss is a mean); stateful
+            # gradients average over microbatches (the global-batch mean,
+            # since each microbatch loss is a mean); per-example fetches
+            # ([k, mb, ...]) concatenate back to full-batch order; scalar
+            # float fetches average (reported global-batch mean); stateful
             # leftovers (counters, metric states) keep the last value
             if name in scan_fetch and stacked.ndim >= 2 and \
                     stacked.shape[1] == mb_size:
+                # per-example concat wins over grad-averaging: a fetched
+                # *activation* gradient keeps its full-batch examples
                 env[name] = stacked.reshape((-1,) + stacked.shape[2:])
-            elif name not in scan_pure and \
+            elif name in grad_names:
+                env[name] = jnp.mean(stacked, axis=0)
+            elif name in scan_fetch and \
                     jnp.issubdtype(stacked.dtype, jnp.floating):
                 env[name] = jnp.mean(stacked, axis=0)
             else:

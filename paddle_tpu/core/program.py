@@ -14,6 +14,7 @@ never carry kernels — only lowering rules registered in core.registry.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -358,10 +359,15 @@ class Program:
     """The whole program: a list of Blocks (reference framework.py:2349 /
     ProgramDesc framework.proto:184). block 0 is the global block."""
 
+    _next_serial = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed: Optional[int] = None
+        # process-unique identity for compile caches: id() can be reused
+        # after GC, aliasing a stale compiled plan to a new Program
+        self._serial = next(Program._next_serial)
         self._version = 0  # bumped on any mutation; keys the compile cache
         self._op_role = "forward"
         self._is_distributed = False

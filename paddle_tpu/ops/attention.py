@@ -15,12 +15,27 @@ scheme end to end:
             dQ sweeps key blocks per query block, with
             delta = rowsum(dO*O) precomputed outside.
 
+Mosaic layout notes (the round-2 lesson): every operand/output block's
+last two dims must be (8,128)-divisible or equal to the array dims. The
+per-row logsumexp/delta vectors therefore travel as rank-3 [B*H, S, 1]
+arrays with (1, bq, 1) blocks — minor dim equal to the array's minor dim
+of 1 is Mosaic-legal and verified on TPU v5e — never as rank-2 [B*H, S]
+with (1, bq) blocks (1 is neither 8-divisible nor equal to B*H).
+``_assert_mosaic_ok`` re-implements that rule and gates every
+pallas_call here, including in interpret mode, so the CPU test suite
+fails on any spec real TPU lowering would reject.
+
+Ragged sequence lengths are padded to the block size with key-side
+additive masking (-1e9) rather than falling back to whole-sequence
+blocks, keeping VMEM bounded for any S.
+
 Layout: q,k,v [B, H, S, D]; bias broadcastable [B|1, H|1, Sq|1, Sk],
-additive (-1e9 at masked positions). The bias is treated as a constant
-mask: its cotangent is zero (real uses are padding/causal masks; a model
-needing trainable bias gradients uses the layer-composed path). On
-non-TPU backends the kernels run in interpret mode (tests) so numerics
-match the TPU path.
+additive (-1e9 at masked positions). By default the bias is a constant
+mask (stop_gradient applied, so its cotangent is semantically zero);
+pass ``bias_grad=True`` for a trainable bias (e.g. relative position) —
+the dK/dV kernel then also emits the per-block score gradients, reduced
+to the bias' broadcast shape. On non-TPU backends the kernels run in
+interpret mode (tests) so numerics match the TPU path.
 """
 
 from __future__ import annotations
@@ -38,6 +53,7 @@ __all__ = ["flash_attention"]
 
 _BQ = 128  # query rows per block
 _BK = 128  # key rows per block
+_MASK = -1e9  # additive mask for padded key columns
 
 
 def _use_interpret() -> bool:
@@ -49,18 +65,89 @@ def _use_interpret() -> bool:
         return True
     plat = dev.platform.lower()
     return not (plat in ("tpu", "axon") or "tpu" in dev.device_kind.lower())
+
+
 _NEG = -1e30
 
 
-def _blocks(S, b):
-    b = min(b, S)
-    if S % b:
-        b = S  # ragged sequence lengths fall back to one block
-    return b, S // b
+def _assert_mosaic_ok(block_shape, array_shape, what):
+    """Mirror of Mosaic's _check_block_mappings rule (jax/_src/pallas/
+    mosaic/lowering.py): the last two block dims must be divisible by
+    (8, 128) respectively or equal to the corresponding array dims.
+
+    Runs on every backend — including interpret mode — so the CPU test
+    suite rejects block specs that real-TPU lowering would refuse."""
+    if len(block_shape) < 2 or len(array_shape) < 2:
+        return
+    b2, b1 = block_shape[-2], block_shape[-1]
+    a2, a1 = array_shape[-2], array_shape[-1]
+    if not ((b2 % 8 == 0 or b2 == a2) and (b1 % 128 == 0 or b1 == a1)):
+        raise ValueError(
+            f"Mosaic-illegal BlockSpec for {what}: block {tuple(block_shape)} "
+            f"on array {tuple(array_shape)} — last two block dims must be "
+            f"divisible by (8, 128) or equal to the array dims")
+
+
+def _checked_pallas_call(kern, *, grid, in_specs, operands, out_specs,
+                         out_shape, scratch_shapes, interpret):
+    single_out = not isinstance(out_specs, (list, tuple))
+    specs = list(out_specs) if not single_out else [out_specs]
+    shapes = list(out_shape) if not single_out else [out_shape]
+    for i, (sp, op) in enumerate(zip(in_specs, operands)):
+        _assert_mosaic_ok(sp.block_shape, op.shape, f"inputs[{i}]")
+    for i, (sp, sh) in enumerate(zip(specs, shapes)):
+        _assert_mosaic_ok(sp.block_shape, sh.shape, f"outputs[{i}]")
+    return pl.pallas_call(
+        kern, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, scratch_shapes=scratch_shapes,
+        interpret=interpret)(*operands)
+
+
+def _ceil_to(n, b):
+    return -(-n // b) * b
+
+
+def _pad_len(S, blk):
+    """Padded length: multiples of blk when blocked, else S (a single
+    block equal to the array dims is Mosaic-legal for any S)."""
+    return _ceil_to(S, blk) if S > blk else S
+
+
+def _pad_axis(x, axis, to, value=0.0):
+    S = x.shape[axis]
+    if S == to:
+        return x
+    cfg = [(0, 0)] * x.ndim
+    cfg[axis] = (0, to - S)
+    return jnp.pad(x, cfg, constant_values=value)
+
+
+def _pad_bias(bias, Sq, Sqp, Sk, Skp):
+    """Pad/construct the additive bias so padded key columns are masked.
+
+    Padded *query* rows need no masking (their outputs/grads are sliced
+    off, and zero padding in g kills their dK/dV contributions)."""
+    if Skp != Sk:
+        if bias is None:
+            col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, Skp), 3)
+            bias = jnp.where(col < Sk, 0.0, _MASK).astype(jnp.float32)
+        else:
+            if bias.shape[3] == 1:  # key-broadcast bias: materialize to mask
+                bias = jnp.broadcast_to(
+                    bias, bias.shape[:3] + (Sk,))
+            pad = [(0, 0)] * 4
+            pad[3] = (0, Skp - bias.shape[3])
+            bias = jnp.pad(bias, pad, constant_values=_MASK)
+    if bias is not None and bias.shape[2] > 1 and bias.shape[2] != Sqp:
+        # mask padded *query* rows too: keeps exp(s - lse) at exactly 0
+        # for them in the backward kernels (their grads are sliced off,
+        # but a large positive trainable bias could otherwise overflow)
+        bias = _pad_axis(bias, 2, Sqp, _MASK)
+    return bias
 
 
 def _bias_spec_and_operand(bias, H, bq, bk, iq_pos, ik_pos):
-    """BlockSpec + reshaped operand for a broadcastable bias.
+    """BlockSpec + operand for a broadcastable bias.
 
     iq_pos/ik_pos say which grid axes carry the q/k block indices (the
     forward and the two backward kernels order their grids differently)."""
@@ -112,14 +199,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
     def _emit():
         l = l_ref[...]
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+        lse_ref[0] = m_ref[...] + jnp.log(l)  # [bq, 1]
 
 
 def _forward_pallas(q, k, v, bias, scale):
     B, H, S, D = q.shape
     Sk = k.shape[2]
-    bq, nq = _blocks(S, _BQ)
-    bk, nk = _blocks(Sk, _BK)
+    Sp, Skp = _pad_len(S, _BQ), _pad_len(Sk, _BK)
+    bias = _pad_bias(bias, S, Sp, Sk, Skp)
+    q = _pad_axis(q, 2, Sp)
+    k, v = _pad_axis(k, 2, Skp), _pad_axis(v, 2, Skp)
+    bq, nq = min(_BQ, Sp), Sp // min(_BQ, Sp)
+    bk, nk = min(_BK, Skp), Skp // min(_BK, Skp)
     qf, kf, vf = (t.reshape(B * H, t.shape[2], D) for t in (q, k, v))
     grid = (B * H, nq, nk)
 
@@ -139,17 +230,18 @@ def _forward_pallas(q, k, v, bias, scale):
             _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
                         acc, m, l, scale=scale, nk=nk)
 
-    out, lse = pl.pallas_call(
+    out, lse = _checked_pallas_call(
         kern,
         grid=grid,
         in_specs=in_specs,
+        operands=operands,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+            pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, S), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Sp, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sp, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -157,13 +249,13 @@ def _forward_pallas(q, k, v, bias, scale):
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(*operands)
-    return out.reshape(B, H, S, D), lse
+    )
+    return out[:, :S].reshape(B, H, S, D), lse[:, :S, 0]
 
 
 # -------------------------------------------------------------- backward
 def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, nq):
+                dk_ref, dv_ref, ds_ref, dk_acc, dv_acc, *, scale, nq):
     iq = pl.program_id(2)
 
     @pl.when(iq == 0)
@@ -175,8 +267,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
     k = k_ref[0].astype(jnp.float32)          # [bk, D]
     v = v_ref[0].astype(jnp.float32)          # [bk, D]
     g = g_ref[0].astype(jnp.float32)          # [bq, D]
-    lse = lse_ref[0][:, None]                 # [bq, 1]
-    delta = d_ref[0][:, None]                 # [bq, 1]
+    lse = lse_ref[0]                          # [bq, 1]
+    delta = d_ref[0]                          # [bq, 1]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -192,6 +284,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
     ds = p * (dp - delta) * scale
     dk_acc[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
+    if ds_ref is not None:
+        # raw score gradient (pre-scale is ds/scale; bias adds after the
+        # scale, so its cotangent is ds without the trailing *scale)
+        ds_ref[0] = p * (dp - delta)
 
     @pl.when(iq == nq - 1)
     def _emit():
@@ -211,8 +307,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     g = g_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = d_ref[0][:, None]
+    lse = lse_ref[0]                          # [bq, 1]
+    delta = d_ref[0]                          # [bq, 1]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -229,16 +325,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _backward_pallas(q, k, v, bias, o, lse, g, scale):
+def _backward_pallas(q, k, v, bias, o, lse, g, scale, want_db=False):
     B, H, S, D = q.shape
     Sk = k.shape[2]
-    bq, nq = _blocks(S, _BQ)
-    bk, nk = _blocks(Sk, _BK)
+    Sp, Skp = _pad_len(S, _BQ), _pad_len(Sk, _BK)
+    bias = _pad_bias(bias, S, Sp, Sk, Skp)
+    q = _pad_axis(q, 2, Sp)
+    k, v = _pad_axis(k, 2, Skp), _pad_axis(v, 2, Skp)
+    bq, nq = min(_BQ, Sp), Sp // min(_BQ, Sp)
+    bk, nk = min(_BK, Skp), Skp // min(_BK, Skp)
     qf, kf, vf = (t.reshape(B * H, t.shape[2], D) for t in (q, k, v))
-    gf = g.reshape(B * H, S, D)
-    of = o.reshape(B * H, S, D)
+    gf = _pad_axis(g.reshape(B * H, S, D), 1, Sp)
+    of = _pad_axis(o.reshape(B * H, S, D), 1, Sp)
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
-                    axis=-1)                   # [BH, S]
+                    axis=-1, keepdims=True)    # [BH, Sp, 1]
+    # padded lse rows pair with zero g rows, so their p values are
+    # harmless (ds and p^T g both vanish); zero-fill keeps exp() finite
+    lse3 = _pad_axis(lse[:, :, None], 1, Sp)
     interp = _use_interpret()
 
     # dK/dV: one key block per (bh, ik), sweep query blocks innermost
@@ -248,40 +351,65 @@ def _backward_pallas(q, k, v, bias, o, lse, g, scale):
         pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
     ]
     operands = [qf, kf, vf]
-    if bias is not None:
+    has_bias = bias is not None
+    if has_bias:
         spec, opnd = _bias_spec_and_operand(bias, H, bq, bk, 2, 1)
         in_specs.append(spec)
         operands.append(opnd)
-        kern = functools.partial(_dkv_kernel, scale=scale, nq=nq)
-    else:
-        def kern(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref,
-                 dk_ref, dv_ref, dka, dva):
-            _dkv_kernel(q_ref, k_ref, v_ref, None, g_ref, lse_ref, d_ref,
-                        dk_ref, dv_ref, dka, dva, scale=scale, nq=nq)
+
+    def dkv_kern(*refs):
+        i = 3 + int(has_bias)
+        q_r, k_r, v_r = refs[0], refs[1], refs[2]
+        b_r = refs[3] if has_bias else None
+        g_r, lse_r, d_r = refs[i], refs[i + 1], refs[i + 2]
+        outs = refs[i + 3:]
+        if want_db:
+            dk_r, dv_r, ds_r, dka, dva = outs
+        else:
+            dk_r, dv_r, dka, dva = outs
+            ds_r = None
+        _dkv_kernel(q_r, k_r, v_r, b_r, g_r, lse_r, d_r,
+                    dk_r, dv_r, ds_r, dka, dva, scale=scale, nq=nq)
+
     in_specs += [
         pl.BlockSpec((1, bq, D), lambda bh, ik, iq: (bh, iq, 0)),
-        pl.BlockSpec((1, bq), lambda bh, ik, iq: (bh, iq)),
-        pl.BlockSpec((1, bq), lambda bh, ik, iq: (bh, iq)),
+        pl.BlockSpec((1, bq, 1), lambda bh, ik, iq: (bh, iq, 0)),
+        pl.BlockSpec((1, bq, 1), lambda bh, ik, iq: (bh, iq, 0)),
     ]
-    operands += [gf, lse, delta]
-    dk, dv = pl.pallas_call(
-        kern,
+    operands += [gf, lse3, delta]
+    out_specs = [
+        pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B * H, Skp, D), k.dtype),
+        jax.ShapeDtypeStruct((B * H, Skp, D), v.dtype),
+    ]
+    if want_db:
+        # per-block score grads, written once per grid cell (O(S^2) HBM —
+        # only materialized when a trainable bias asks for it)
+        out_specs.append(
+            pl.BlockSpec((1, bq, bk), lambda bh, ik, iq: (bh, iq, ik)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, Sp, Skp), jnp.float32))
+    res = _checked_pallas_call(
+        dkv_kern,
         grid=(B * H, nk, nq),
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
-        ],
+        operands=operands,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interp,
-    )(*operands)
+    )
+    if want_db:
+        dk, dv, ds_full = res
+    else:
+        dk, dv = res
+        ds_full = None
 
     # dQ: one query block per (bh, iq), sweep key blocks innermost
     in_specs = [
@@ -290,7 +418,7 @@ def _backward_pallas(q, k, v, bias, o, lse, g, scale):
         pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),
     ]
     operands = [qf, kf, vf]
-    if bias is not None:
+    if has_bias:
         spec, opnd = _bias_spec_and_operand(bias, H, bq, bk, 1, 2)
         in_specs.append(spec)
         operands.append(opnd)
@@ -301,23 +429,38 @@ def _backward_pallas(q, k, v, bias, o, lse, g, scale):
                        dq_ref, dqa, scale=scale, nk=nk)
     in_specs += [
         pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
-        pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
-        pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+        pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),
     ]
-    operands += [gf, lse, delta]
-    dq = pl.pallas_call(
+    operands += [gf, lse3, delta]
+    dq = _checked_pallas_call(
         kern,
         grid=(B * H, nq, nk),
         in_specs=in_specs,
+        operands=operands,
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sp, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interp,
-    )(*operands)
+    )
 
-    shape = (B, H, S, D)
-    kshape = (B, H, Sk, D)
-    return dq.reshape(shape), dk.reshape(kshape), dv.reshape(kshape)
+    dq = dq[:, :S].reshape(B, H, S, D)
+    dk = dk[:, :Sk].reshape(B, H, Sk, D)
+    dv = dv[:, :Sk].reshape(B, H, Sk, D)
+    db = None
+    if want_db:
+        ds_full = ds_full[:, :S, :Sk].reshape(B, H, S, Sk)
+        db = ds_full
+    return dq, dk, dv, db
+
+
+def _reduce_to_bias_shape(ds, bias_shape):
+    """Sum the full [B,H,Sq,Sk] score grad down to a broadcastable bias."""
+    axes = tuple(i for i, (d, b) in enumerate(zip(ds.shape, bias_shape))
+                 if b == 1 and d != 1)
+    if axes:
+        ds = jnp.sum(ds, axis=axes, keepdims=True)
+    return ds
 
 
 def _attention_reference(q, k, v, bias, scale):
@@ -330,24 +473,61 @@ def _attention_reference(q, k, v, bias, scale):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def flash_attention(q, k, v, bias, scale):
+def _fa_maskbias(q, k, v, bias, scale):
     out, _ = _forward_pallas(q, k, v, bias, scale)
     return out
 
 
-def _fa_fwd(q, k, v, bias, scale):
+def _fa_maskbias_fwd(q, k, v, bias, scale):
     out, lse = _forward_pallas(q, k, v, bias, scale)
     return out, (q, k, v, bias, out, lse)
 
 
-def _fa_bwd(scale, res, g):
+def _fa_maskbias_bwd(scale, res, g):
     q, k, v, bias, o, lse = res
-    dq, dk, dv = _backward_pallas(q, k, v, bias, o, lse, g, scale)
+    dq, dk, dv, _ = _backward_pallas(q, k, v, bias, o, lse, g, scale)
+    # bias enters through stop_gradient (see flash_attention), so this
+    # zero cotangent is discarded upstream — it is structural, not a
+    # silently-wrong trainable-bias gradient.
     db = None if bias is None else jnp.zeros_like(bias)
     return dq, dk, dv, db
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+_fa_maskbias.defvjp(_fa_maskbias_fwd, _fa_maskbias_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fa_trainbias(q, k, v, bias, scale):
+    out, _ = _forward_pallas(q, k, v, bias, scale)
+    return out
+
+
+def _fa_trainbias_fwd(q, k, v, bias, scale):
+    out, lse = _forward_pallas(q, k, v, bias, scale)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _fa_trainbias_bwd(scale, res, g):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv, ds = _backward_pallas(q, k, v, bias, o, lse, g, scale,
+                                      want_db=True)
+    db = _reduce_to_bias_shape(ds, bias.shape).astype(bias.dtype)
+    return dq, dk, dv, db
+
+
+_fa_trainbias.defvjp(_fa_trainbias_fwd, _fa_trainbias_bwd)
+
+
+def flash_attention(q, k, v, bias=None, scale=1.0, bias_grad=False):
+    """Fused attention. ``bias`` is a constant additive mask by default
+    (non-differentiable: stop_gradient is applied); pass
+    ``bias_grad=True`` to get the true bias cotangent, at the cost of an
+    O(Sq*Sk) score-gradient buffer in the backward pass."""
+    if bias is None:
+        return _fa_maskbias(q, k, v, None, scale)
+    if bias_grad:
+        return _fa_trainbias(q, k, v, bias, scale)
+    return _fa_maskbias(q, k, v, jax.lax.stop_gradient(bias), scale)
 
 
 @register_op("fused_attention", diff_inputs=["Q", "K", "V"], uses_rng=True)

@@ -131,7 +131,8 @@ class ParallelEngine:
     # -------------------------------------------------------------- prepare
     def _cache_key(self, feed_vals, fetch_names):
         sig = tuple(sorted((n, v.shape, str(v.dtype)) for n, v in feed_vals.items()))
-        return (id(self.program), self.program.version, sig, tuple(fetch_names))
+        return (self.program._serial, self.program.version, sig,
+                tuple(fetch_names))
 
     def _prepare(self, feed_vals, fetch_names, scope) -> _ParallelPlan:
         (feed_names, fetch_names, const_state, mut_state, pure_written,
