@@ -231,7 +231,10 @@ def analyze_block(program: Program, feed_names, fetch_names, scope):
         writes = list(op.output_names())
         if "sub_block" in op.attrs:
             sub = program.block(op.attrs["sub_block"])
-            sub_produced = set()
+            # names bound by the op itself inside its body (e.g. the
+            # recurrent op's per-step inputs and pre-state slots) are not
+            # external reads
+            sub_produced = set(op.attrs.get("__sub_bound__", ()))
             for sop in sub.ops:
                 r, w = op_effects(sop)
                 reads.extend(n for n in r if n not in sub_produced)

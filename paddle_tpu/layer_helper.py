@@ -57,7 +57,11 @@ class LayerHelper:
             Constant(0.0) if is_bias else Xavier()
         )
         shape = [int(s) for s in shape]
-        p = self.block.create_parameter(
+        # parameters always live in the global block (reference
+        # framework.py create_parameter does the same): a parameter
+        # created inside an RNN/conditional sub-block must be visible to
+        # append_backward and the executor's state analysis
+        p = self.main_program.global_block().create_parameter(
             name=name,
             shape=shape,
             dtype=dtype,

@@ -1,8 +1,9 @@
 """layers: user-facing op-builder API (reference: python/paddle/fluid/layers)."""
 
 from . import (control_flow, decode, detection, io, learning_rate_scheduler,
-               loss, metric_op, nn, ops, sequence, tensor)
+               loss, metric_op, nn, ops, rnn_blocks, sequence, tensor)
 from .control_flow import *  # noqa: F401,F403
+from .rnn_blocks import *  # noqa: F401,F403
 from .decode import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403

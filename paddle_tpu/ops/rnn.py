@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.registry import register_op
+from ..core.registry import register_grad_lowering, register_op
 
 _ACT = {
     "sigmoid": jax.nn.sigmoid,
@@ -126,3 +126,137 @@ def _gru(ctx, ins, attrs):
     if reverse:
         hs = hs[::-1]
     return {"Hidden": [jnp.swapaxes(hs, 0, 1)]}
+
+
+# ----------------------------------------------------------------- recurrent
+def _block_uses_rng(block):
+    """Recursive: nested While/cond sub-blocks count (matches the
+    executor's op_uses_rng)."""
+    from ..core.registry import get_op, has_op
+
+    for op in block.ops:
+        if has_op(op.type) and get_op(op.type).uses_rng:
+            return True
+        if "sub_block" in op.attrs and _block_uses_rng(
+                block.program.block(op.attrs["sub_block"])):
+            return True
+    return False
+
+
+def _run_recurrent_scan(ctx, block, xs, inits, params, length, attrs, rng,
+                        use_rng):
+    """The scan shared by the forward lowering and its grad re-trace."""
+    from ..core.lowering import lower_block
+
+    step_in = attrs["step_in_names"]
+    pre = attrs["pre_state_names"]
+    nxt = attrs["next_state_names"]
+    souts = attrs["step_out_names"]
+    pnames = attrs["param_names"]
+    time_major = attrs.get("time_major", True)
+
+    if not time_major:
+        xs = [jnp.swapaxes(x, 0, 1) for x in xs]
+    if not xs:
+        raise ValueError("recurrent op needs at least one step input")
+    B = inits[0].shape[0] if inits else xs[0].shape[1]
+
+    def body(carry, inp):
+        t, xt = inp
+        states, rng_c = carry
+        local = dict(zip(pnames, params))
+        local.update(zip(pre, states))
+        local.update(zip(step_in, xt))
+        sub_ctx = ctx.sub(block)
+        sub_ctx._rng = rng_c
+        lower_block(sub_ctx, block, local)
+        new_states = [local[n] for n in nxt]
+        ys = [local[n] for n in souts]
+        if length is not None:
+            alive = t < length  # [B]
+            new_states = [
+                jnp.where(alive.reshape((B,) + (1,) * (s.ndim - 1)), s, old)
+                for s, old in zip(new_states, states)]
+            ys = [jnp.where(alive.reshape((B,) + (1,) * (y.ndim - 1)),
+                            y, jnp.zeros_like(y)) for y in ys]
+        new_rng = sub_ctx.final_rng() if use_rng else rng_c
+        return (tuple(new_states), new_rng), tuple(ys)
+
+    T = xs[0].shape[0]
+    ts = jnp.arange(T)
+    (final_states, _), ys = lax.scan(
+        body, (tuple(inits), rng), (ts, tuple(xs)))
+    ys = list(ys)
+    if not time_major:
+        ys = [jnp.swapaxes(y, 0, 1) for y in ys]
+    return ys, list(final_states)
+
+
+@register_op("recurrent", diff_inputs=["inputs", "initial_states",
+                                       "parameters"], uses_rng=True)
+def _recurrent(ctx, ins, attrs):
+    """User-programmable RNN: lax.scan whose body lowers a sub-block.
+
+    Reference analog: operators/recurrent_op.cc (StaticRNN's 'recurrent'
+    op, which re-runs its sub-block per step in a nested step scope) and
+    the While+TensorArray machinery DynamicRNN assembles
+    (python/paddle/fluid/layers/control_flow.py:1394). Here both compile
+    to ONE differentiable lax.scan:
+
+      carry  = state tensors (pre_state_names -> next_state_names)
+      xs     = step inputs sliced on the time axis
+      ys     = step outputs, stacked back on the time axis
+      params = every external var the sub-block reads (explicit op
+               inputs, so append_backward reaches weights used inside)
+
+    With a SequenceLength input (DynamicRNN), finished rows freeze their
+    state and emit zeros — the masked-dense LoD contract (SURVEY §5).
+    time_major=False transposes [B, T, ...] <-> [T, B, ...] at the
+    boundary so the scan always walks the leading axis.
+
+    UsedRng records the key the step bodies consumed, so the custom grad
+    lowering can replay identical randomness (same pattern as dropout's
+    saved mask, ops/nn.py).
+    """
+    block = ctx.block.program.block(attrs["sub_block"])
+    xs = list(ins.get("inputs") or [])
+    inits = list(ins.get("initial_states") or [])
+    params = list(ins.get("parameters") or [])
+    length = (ins.get("SequenceLength") or [None])[0]
+    use_rng = _block_uses_rng(block)
+    rng0 = ctx.next_rng() if use_rng else jnp.zeros((2,), jnp.uint32)
+    ys, finals = _run_recurrent_scan(ctx, block, xs, inits, params, length,
+                                     attrs, rng0, use_rng)
+    return {"outputs": ys, "final_states": finals, "UsedRng": [rng0]}
+
+
+@register_grad_lowering("recurrent")
+def _recurrent_grad(ctx, ins, attrs):
+    """Differentiate the whole scan with jax.vjp, replaying the forward's
+    saved rng so in-body randomness (dropout masks) matches exactly."""
+    block = ctx.block.program.block(attrs["sub_block"])
+    xs = list(ins.get("inputs") or [])
+    inits = list(ins.get("initial_states") or [])
+    params = list(ins.get("parameters") or [])
+    length = (ins.get("SequenceLength") or [None])[0]
+    rng_saved = (ins.get("UsedRng") or [jnp.zeros((2,), jnp.uint32)])[0]
+    use_rng = _block_uses_rng(block)
+
+    def f(xs_d, inits_d, params_d):
+        ys, finals = _run_recurrent_scan(
+            ctx, block, list(xs_d), list(inits_d), list(params_d), length,
+            attrs, rng_saved, use_rng)
+        return tuple(ys), tuple(finals)
+
+    (ys, finals), vjp = jax.vjp(f, tuple(xs), tuple(inits), tuple(params))
+    g_ys = tuple(
+        g if g is not None else jnp.zeros_like(y)
+        for y, g in zip(ys, ins.get("outputs@GRAD") or [None] * len(ys)))
+    g_fs = tuple(
+        g if g is not None else jnp.zeros_like(s)
+        for s, g in zip(finals,
+                        ins.get("final_states@GRAD") or [None] * len(finals)))
+    dxs, dinits, dparams = vjp((g_ys, g_fs))
+    return {"inputs@GRAD": list(dxs),
+            "initial_states@GRAD": list(dinits),
+            "parameters@GRAD": list(dparams)}
