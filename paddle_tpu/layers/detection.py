@@ -14,6 +14,10 @@ __all__ = [
     "multiclass_nms",
     "roi_align",
     "roi_pool",
+    "yolov3_loss",
+    "anchor_generator",
+    "density_prior_box",
+    "generate_proposals",
 ]
 
 
@@ -107,3 +111,114 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
                             "pooled_width": pooled_width,
                             "spatial_scale": spatial_scale})
     return out
+
+
+def yolov3_loss(x, gtbox, gtlabel, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, name=None):
+    """reference detection.py:510; lowering in ops/detection_ops.py
+    (vectorized yolov3_loss_op.h). Returns per-image loss [N]."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    obj_mask = helper.create_variable_for_type_inference("float32",
+                                                         stop_gradient=True)
+    match = helper.create_variable_for_type_inference("int32",
+                                                      stop_gradient=True)
+    helper.append_op(
+        type="yolov3_loss",
+        inputs={"X": [x], "GTBox": [gtbox], "GTLabel": [gtlabel]},
+        outputs={"Loss": [loss], "ObjectnessMask": [obj_mask],
+                 "GTMatchMask": [match]},
+        attrs={"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+               "class_num": int(class_num),
+               "ignore_thresh": float(ignore_thresh),
+               "downsample_ratio": int(downsample_ratio)})
+    loss.shape = (x.shape[0],)
+    return loss
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    """reference detection.py:1603. Anchors/Variances [H, W, A, 4]."""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference("float32",
+                                                        stop_gradient=True)
+    vars_ = helper.create_variable_for_type_inference("float32",
+                                                      stop_gradient=True)
+    anchor_sizes = list(anchor_sizes or [64.0, 128.0, 256.0, 512.0])
+    aspect_ratios = list(aspect_ratios or [0.5, 1.0, 2.0])
+    stride = list(stride or [16.0, 16.0])
+    helper.append_op(
+        type="anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [vars_]},
+        attrs={"anchor_sizes": anchor_sizes, "aspect_ratios": aspect_ratios,
+               "variances": list(variance), "stride": stride,
+               "offset": float(offset)})
+    A = len(anchor_sizes) * len(aspect_ratios)
+    h, w = input.shape[2], input.shape[3]
+    anchors.shape = vars_.shape = (h, w, A, 4)
+    return anchors, vars_
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """reference detection.py:1231. Boxes/Variances [H, W, P, 4] (or
+    [H*W*P, 4] flattened)."""
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference("float32",
+                                                      stop_gradient=True)
+    vars_ = helper.create_variable_for_type_inference("float32",
+                                                      stop_gradient=True)
+    densities = [int(d) for d in (densities or [])]
+    fixed_sizes = [float(s) for s in (fixed_sizes or [])]
+    fixed_ratios = [float(r) for r in (fixed_ratios or [1.0])]
+    if len(fixed_sizes) != len(densities):
+        raise ValueError(
+            "density_prior_box: fixed_sizes (%d) and densities (%d) must "
+            "pair up one-to-one" % (len(fixed_sizes), len(densities)))
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [vars_]},
+        attrs={"densities": densities, "fixed_sizes": fixed_sizes,
+               "fixed_ratios": fixed_ratios, "variances": list(variance),
+               "clip": bool(clip), "step_w": float(steps[0]),
+               "step_h": float(steps[1]), "offset": float(offset),
+               "flatten_to_2d": bool(flatten_to_2d)})
+    P = sum(len(fixed_ratios) * d * d for d in densities)
+    h, w = input.shape[2], input.shape[3]
+    if flatten_to_2d:
+        boxes.shape = vars_.shape = (h * w * P, 4)
+    else:
+        boxes.shape = vars_.shape = (h, w, P, 4)
+    return boxes, vars_
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """reference detection.py:1975. Dense divergence: fixed-shape
+    [N, post_nms_top_n, 4] rois + [N, post_nms_top_n, 1] probs,
+    zero-padded (valid rows have prob > 0), instead of ragged LoD."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(scores.dtype,
+                                                     stop_gradient=True)
+    probs = helper.create_variable_for_type_inference(scores.dtype,
+                                                      stop_gradient=True)
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs]},
+        attrs={"pre_nms_topN": int(pre_nms_top_n),
+               "post_nms_topN": int(post_nms_top_n),
+               "nms_thresh": float(nms_thresh), "min_size": float(min_size),
+               "eta": float(eta)})
+    n = scores.shape[0]
+    rois.shape = (n, int(post_nms_top_n), 4)
+    probs.shape = (n, int(post_nms_top_n), 1)
+    return rois, probs

@@ -11,6 +11,7 @@ of the reference's LoD-shaped output.
 from __future__ import annotations
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
@@ -194,6 +195,40 @@ def _box_coder(ctx, ins, attrs):
     return {"OutputBox": [out]}
 
 
+def _greedy_nms(boxes, valid, thresh, eta=1.0, plus_one=False):
+    """Reference NMS (generate_proposals_op.cc:248 / multiclass_nms_op.cc):
+    walk candidates in score order (boxes pre-sorted descending), keep one
+    iff its IoU with every previously-kept box is <= the threshold, which
+    decays by eta after each kept box while eta < 1 and threshold > 0.5.
+    `plus_one` selects the pixel (+1) box convention (normalized=False).
+    Returns the keep mask."""
+    n = boxes.shape[0]
+    off = 1.0 if plus_one else 0.0
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0] + off, 0) * jnp.maximum(
+        boxes[:, 3] - boxes[:, 1] + off, 0)
+    idxs = jnp.arange(n)
+
+    def body(i, state):
+        keep, thr = state
+        ix1 = jnp.maximum(boxes[i, 0], boxes[:, 0])
+        iy1 = jnp.maximum(boxes[i, 1], boxes[:, 1])
+        ix2 = jnp.minimum(boxes[i, 2], boxes[:, 2])
+        iy2 = jnp.minimum(boxes[i, 3], boxes[:, 3])
+        inter = jnp.maximum(ix2 - ix1 + off, 0) * jnp.maximum(
+            iy2 - iy1 + off, 0)
+        iou = inter / jnp.maximum(area[i] + area - inter, 1e-10)
+        prior = keep & (idxs < i)
+        mx = jnp.max(jnp.where(prior, iou, 0.0))
+        ok = (mx <= thr) & valid[i]
+        keep = keep.at[i].set(ok)
+        thr = jnp.where(ok & (eta < 1.0) & (thr > 0.5), thr * eta, thr)
+        return keep, thr
+
+    keep, _ = lax.fori_loop(
+        0, n, body, (jnp.zeros((n,), bool), jnp.float32(thresh)))
+    return keep
+
+
 @register_op("multiclass_nms", no_grad=True)
 def _multiclass_nms(ctx, ins, attrs):
     """multiclass_nms_op.cc, static-shape redesign: greedy per-class NMS
@@ -210,34 +245,19 @@ def _multiclass_nms(ctx, ins, attrs):
     nms_thresh = float(attrs.get("nms_threshold", 0.3))
     nms_top_k = int(attrs.get("nms_top_k", 64))
     keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_eta = float(attrs.get("nms_eta", 1.0))
     background = int(attrs.get("background_label", -1))
     B, C, M = scores.shape
     nms_top_k = min(nms_top_k, M)
 
-    def area(b):
-        return jnp.maximum(b[..., 2] - b[..., 0], 0) * jnp.maximum(
-            b[..., 3] - b[..., 1], 0)
-
     def one_class(bx, s_row, c):
-        # top-k by score, then greedy suppression
+        # top-k by score, then greedy suppression (shared NMS helper)
         s = jnp.where(s_row >= score_thresh, s_row, -1.0)
         top_s, top_i = lax.top_k(s, nms_top_k)
         cand = bx[top_i]                       # [K, 4]
-        ar = area(cand)
-        keep = jnp.ones((nms_top_k,), bool)
-
-        def body(i, keep):
-            ix1 = jnp.maximum(cand[i, 0], cand[:, 0])
-            iy1 = jnp.maximum(cand[i, 1], cand[:, 1])
-            ix2 = jnp.minimum(cand[i, 2], cand[:, 2])
-            iy2 = jnp.minimum(cand[i, 3], cand[:, 3])
-            inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
-            iou = inter / jnp.maximum(ar[i] + ar - inter, 1e-10)
-            sup = (iou > nms_thresh) & (jnp.arange(nms_top_k) > i)
-            return jnp.where(sup & keep[i], False, keep)
-
-        keep = lax.fori_loop(0, nms_top_k, body, keep)
-        valid = keep & (top_s > -1.0) & (c != background)
+        keep = _greedy_nms(cand, (top_s > -1.0) & (c != background),
+                           nms_thresh, eta=nms_eta)
+        valid = keep
         return jnp.concatenate([
             jnp.where(valid, c.astype(cand.dtype), -1.0)[:, None],
             jnp.where(valid, top_s, -1.0)[:, None],
@@ -360,3 +380,322 @@ def _affine_channel(ctx, ins, attrs):
     scale = ins["Scale"][0].reshape(1, -1, *([1] * (x.ndim - 2)))
     bias = ins["Bias"][0].reshape(1, -1, *([1] * (x.ndim - 2)))
     return {"Out": [x * scale + bias]}
+
+
+@register_op("anchor_generator", no_grad=True)
+def _anchor_generator(ctx, ins, attrs):
+    """anchor_generator_op.h AnchorGeneratorOpKernel, vectorized: RPN
+    anchors per feature-map cell for every (aspect_ratio, anchor_size)
+    pair. Output Anchors/Variances [H, W, num_anchors, 4] (xyxy)."""
+    x = ins["Input"][0]                       # [N, C, H, W]
+    H, W = x.shape[2], x.shape[3]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64.0, 128.0, 256.0])]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [0.5, 1.0, 2.0])]
+    stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    sw, sh = stride[0], stride[1]
+
+    xc = jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1)  # [W]
+    yc = jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1)  # [H]
+
+    ws, hs = [], []
+    for ar in ratios:
+        base_w = float(np.round(np.sqrt(sw * sh / ar)))
+        base_h = float(np.round(base_w * ar))
+        for size in sizes:
+            ws.append(size / sw * base_w)
+            hs.append(size / sh * base_h)
+    ws = jnp.asarray(ws, jnp.float32)          # [A]
+    hs = jnp.asarray(hs, jnp.float32)
+    A = ws.shape[0]
+
+    x_ctr = jnp.broadcast_to(xc[None, :, None], (H, W, A))
+    y_ctr = jnp.broadcast_to(yc[:, None, None], (H, W, A))
+    anchors = jnp.stack([
+        x_ctr - 0.5 * (ws - 1), y_ctr - 0.5 * (hs - 1),
+        x_ctr + 0.5 * (ws - 1), y_ctr + 0.5 * (hs - 1)], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, A, 4))
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+@register_op("density_prior_box", no_grad=True)
+def _density_prior_box(ctx, ins, attrs):
+    """density_prior_box_op.h: SSD priors densified per fixed_size — a
+    density x density sub-grid of centers per cell, one box per
+    fixed_ratio. Boxes/Variances [H, W, num_priors, 4] normalized xyxy
+    (or [H*W*num_priors, 4] with flatten_to_2d)."""
+    x = ins["Input"][0]                       # [N, C, H, W] feature map
+    img = ins["Image"][0]                     # [N, C, IH, IW]
+    H, W = x.shape[2], x.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in attrs.get("densities", [])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(attrs.get("clip", False))
+    offset = float(attrs.get("offset", 0.5))
+    step_w = float(attrs.get("step_w", 0.0)) or IW / W
+    step_h = float(attrs.get("step_h", 0.0)) or IH / H
+    step_avg = int((step_w + step_h) * 0.5)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w   # [W]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h   # [H]
+
+    # per-prior (dx-shift, dy-shift, box_w, box_h), ordered exactly like
+    # the reference loops: size -> ratio -> di -> dj
+    shifts_x, shifts_y, bws, bhs = [], [], [], []
+    for size, density in zip(fixed_sizes, densities):
+        shift = step_avg // density
+        for r in fixed_ratios:
+            sq = float(np.sqrt(r))
+            bw, bh = size * sq, size / sq
+            for di in range(density):
+                for dj in range(density):
+                    shifts_x.append(-step_avg / 2.0 + shift / 2.0 + dj * shift)
+                    shifts_y.append(-step_avg / 2.0 + shift / 2.0 + di * shift)
+                    bws.append(bw)
+                    bhs.append(bh)
+    sx = jnp.asarray(shifts_x, jnp.float32)    # [P]
+    sy = jnp.asarray(shifts_y, jnp.float32)
+    bw = jnp.asarray(bws, jnp.float32)
+    bh = jnp.asarray(bhs, jnp.float32)
+    P = sx.shape[0]
+
+    px = cx[None, :, None] + sx[None, None, :]          # [1, W, P]
+    py = cy[:, None, None] + sy[None, None, :]          # [H, 1, P]
+    px = jnp.broadcast_to(px, (H, W, P))
+    py = jnp.broadcast_to(py, (H, W, P))
+    boxes = jnp.stack([
+        jnp.maximum((px - bw / 2) / IW, 0.0),
+        jnp.maximum((py - bh / 2) / IH, 0.0),
+        jnp.minimum((px + bw / 2) / IW, 1.0),
+        jnp.minimum((py + bh / 2) / IH, 1.0)], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), (H, W, P, 4))
+    if attrs.get("flatten_to_2d"):
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+def _sce(x, label):
+    """Numerically-stable sigmoid cross entropy (yolov3_loss_op.h
+    SigmoidCrossEntropy): max(x,0) - x*z + log(1 + exp(-|x|))."""
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register_op("yolov3_loss", diff_inputs=["X"])
+def _yolov3_loss(ctx, ins, attrs):
+    """yolov3_loss_op.h Yolov3LossKernel, vectorized (no scalar loops):
+
+    - every prediction decodes to a box; its best IoU against the valid
+      gt boxes decides the ignore mask (> ignore_thresh -> objectness
+      ignored)
+    - every gt box matches its best shape-only anchor; if that anchor is
+      in anchor_mask, the (cell, mask) slot takes location (sce for x/y,
+      L2 for w/h, scaled by 2-w*h), objectness=1, and class sce losses,
+      applied via one-hot scatter-adds so the whole loss is one fused
+      XLA program differentiable in X
+    - Loss [N]; ObjectnessMask [N, mask, H, W] (1 pos, -1 ignored, 0
+      neg); GTMatchMask [N, B] (matched mask index or -1)
+    """
+    x = ins["X"][0]                            # [N, C, H, W] f32
+    gt_box = ins["GTBox"][0]                   # [N, B, 4] cx,cy,w,h (0..1)
+    gt_label = ins["GTLabel"][0]               # [N, B] int
+    anchors = [int(a) for a in attrs["anchors"]]
+    anchor_mask = [int(a) for a in attrs["anchor_mask"]]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+
+    N, C, H, W = x.shape
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    B = gt_box.shape[1]
+    input_size = downsample * H
+    xf = x.astype(jnp.float32).reshape(N, mask_num, 5 + class_num, H, W)
+    gt_box = gt_box.astype(jnp.float32)
+
+    aw = jnp.asarray(anchors[0::2], jnp.float32)          # [an_num]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    m_aw = aw[jnp.asarray(anchor_mask)]                   # [mask]
+    m_ah = ah[jnp.asarray(anchor_mask)]
+
+    # ---- decode every prediction to a normalized box (GetYoloBox)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    px = (gx + jax.nn.sigmoid(xf[:, :, 0])) / W           # [N, mask, H, W]
+    py = (gy + jax.nn.sigmoid(xf[:, :, 1])) / H
+    pw = jnp.exp(xf[:, :, 2]) * m_aw[None, :, None, None] / input_size
+    ph = jnp.exp(xf[:, :, 3]) * m_ah[None, :, None, None] / input_size
+
+    gt_valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)  # [N, B]
+
+    def iou_cwh(x1, y1, w1, h1, x2, y2, w2, h2):
+        lx = jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+        rx = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+        ly = jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+        ry = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+        iw = jnp.maximum(rx - lx, 0.0)
+        ih = jnp.maximum(ry - ly, 0.0)
+        inter = iw * ih
+        return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+    # ignore mask: best IoU of each prediction vs valid gts
+    iou_pg = iou_cwh(
+        px[..., None], py[..., None], pw[..., None], ph[..., None],
+        gt_box[:, None, None, None, :, 0], gt_box[:, None, None, None, :, 1],
+        gt_box[:, None, None, None, :, 2], gt_box[:, None, None, None, :, 3])
+    iou_pg = jnp.where(gt_valid[:, None, None, None, :], iou_pg, 0.0)
+    best_iou = jnp.max(iou_pg, axis=-1) if B else jnp.zeros_like(px)
+    ignore = best_iou > ignore_thresh                     # [N, mask, H, W]
+
+    # ---- gt -> best shape-only anchor (over ALL anchors)
+    an_iou = iou_cwh(
+        0.0, 0.0, gt_box[..., 2][..., None], gt_box[..., 3][..., None],
+        0.0, 0.0, (aw / input_size)[None, None, :],
+        (ah / input_size)[None, None, :])                 # [N, B, an_num]
+    best_n = jnp.argmax(an_iou, axis=-1)                  # [N, B]
+    mask_lut = -jnp.ones((an_num,), jnp.int32)
+    for mi, a in enumerate(anchor_mask):
+        mask_lut = mask_lut.at[a].set(mi)
+    match = jnp.where(gt_valid, mask_lut[best_n], -1)     # [N, B]
+
+    gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    matched = match >= 0                                  # [N, B]
+    mslot = jnp.maximum(match, 0)
+
+    # per-gt location + class loss read from the matched slot
+    bi = jnp.arange(N)[:, None]
+    tx = gt_box[..., 0] * W - gi
+    ty = gt_box[..., 1] * H - gj
+    tw = jnp.log(jnp.maximum(
+        gt_box[..., 2] * input_size / aw[best_n], 1e-9))
+    th = jnp.log(jnp.maximum(
+        gt_box[..., 3] * input_size / ah[best_n], 1e-9))
+    scale = 2.0 - gt_box[..., 2] * gt_box[..., 3]
+
+    pred = xf[bi, mslot, :, gj, gi]                       # [N, B, 5+cls]
+    loc = (_sce(pred[..., 0], tx) + _sce(pred[..., 1], ty)
+           + 0.5 * (pred[..., 2] - tw) ** 2
+           + 0.5 * (pred[..., 3] - th) ** 2) * scale
+    onehot = jax.nn.one_hot(gt_label.astype(jnp.int32), class_num)
+    cls = jnp.sum(_sce(pred[..., 5:], onehot), axis=-1)   # [N, B]
+    per_gt = jnp.where(matched, loc + cls, 0.0)
+
+    # objectness mask: scatter True at matched slots over the ignore base.
+    # Unmatched gts redirect to an out-of-bounds index (mode="drop") so a
+    # padding gt whose clipped cell collides with a real match can never
+    # erase it (scatter set with duplicate indices is order-undefined).
+    flat_idx = (mslot * H + gj) * W + gi                  # [N, B]
+    safe_idx = jnp.where(matched, flat_idx, mask_num * H * W)
+    pos = jax.vmap(lambda idx: jnp.zeros(
+        (mask_num * H * W,), bool).at[idx].set(True, mode="drop"))(
+        safe_idx).reshape(N, mask_num, H, W)
+    obj_mask = jnp.where(pos, 1.0, jnp.where(ignore, -1.0, 0.0))
+
+    conf = xf[:, :, 4]                                    # [N, mask, H, W]
+    obj_loss = jnp.where(
+        obj_mask > 0.5, _sce(conf, 1.0),
+        jnp.where(obj_mask > -0.5, _sce(conf, 0.0), 0.0))
+
+    loss = jnp.sum(per_gt, axis=1) + jnp.sum(obj_loss, axis=(1, 2, 3))
+    return {"Loss": [loss.astype(x.dtype)],
+            "ObjectnessMask": [obj_mask.astype(jnp.float32)],
+            "GTMatchMask": [match.astype(jnp.int32)]}
+
+
+@register_op("generate_proposals", no_grad=True)
+def _generate_proposals(ctx, ins, attrs):
+    """generate_proposals_op.cc ProposalForOneImage, static-shape: per
+    image, top pre_nms_topN scores -> decode deltas against anchors ->
+    clip to image -> min_size filter -> greedy NMS -> top post_nms_topN.
+
+    Dense divergence from the LoD reference: outputs are fixed-shape
+    [N, post_nms_topN, 4] / [N, post_nms_topN, 1] zero-padded (a row is
+    valid iff its prob > 0) instead of LoD-concatenated ragged lists."""
+    scores = ins["Scores"][0]       # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]   # [N, A*4, H, W]
+    im_info = ins["ImInfo"][0]      # [N, 3] (h, w, scale)
+    anchors = ins["Anchors"][0]     # [H, W, A, 4] xyxy
+    variances = ins["Variances"][0]
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.5))
+    min_size = float(attrs.get("min_size", 0.1))
+    eta = float(attrs.get("eta", 1.0))
+
+    N, A, H, W = scores.shape
+    M = A * H * W
+    pre_n = min(pre_n, M)
+    # [A,H,W] entry (a,h,w) pairs with anchors[h,w,a] and deltas[a*4..]
+    anc = jnp.transpose(anchors, (2, 0, 1, 3)).reshape(M, 4)
+    var = jnp.transpose(variances, (2, 0, 1, 3)).reshape(M, 4)
+    dl = deltas.reshape(N, A, 4, H, W).transpose(0, 1, 3, 4, 2).reshape(
+        N, M, 4)
+    sc = scores.reshape(N, M)
+
+    aw = anc[:, 2] - anc[:, 0] + 1.0
+    ah = anc[:, 3] - anc[:, 1] + 1.0
+    acx = anc[:, 0] + aw * 0.5
+    acy = anc[:, 1] + ah * 0.5
+
+    def one_image(s, d, info):
+        top_s, top_i = lax.top_k(s, pre_n)
+        d = d[top_i]
+        cw, ch, ccx, ccy = aw[top_i], ah[top_i], acx[top_i], acy[top_i]
+        v = var[top_i]
+        # BoxCoder (generate_proposals_op.cc:69): variance-scaled decode
+        # with the reference's bbox_clip_default on dw/dh
+        clip_val = jnp.log(1000.0 / 16.0)
+        cx = v[:, 0] * d[:, 0] * cw + ccx
+        cy = v[:, 1] * d[:, 1] * ch + ccy
+        bw = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], clip_val)) * cw
+        bh = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], clip_val)) * ch
+        x1 = cx - bw / 2
+        y1 = cy - bh / 2
+        x2 = cx + bw / 2 - 1
+        y2 = cy + bh / 2 - 1
+        # ClipTiledBoxes
+        x1 = jnp.clip(x1, 0, info[1] - 1)
+        y1 = jnp.clip(y1, 0, info[0] - 1)
+        x2 = jnp.clip(x2, 0, info[1] - 1)
+        y2 = jnp.clip(y2, 0, info[0] - 1)
+        # FilterBoxes (generate_proposals_op.cc:154): min_size compares in
+        # ORIGINAL image scale ((x2-x1)/im_scale + 1), center inside image
+        ms = jnp.maximum(min_size, 1.0)
+        ww = x2 - x1 + 1
+        hh = y2 - y1 + 1
+        ws_orig = (x2 - x1) / info[2] + 1
+        hs_orig = (y2 - y1) / info[2] + 1
+        cxx = x1 + ww / 2
+        cyy = y1 + hh / 2
+        ok = (ws_orig >= ms) & (hs_orig >= ms) & (cxx <= info[1]) & \
+            (cyy <= info[0])
+        s_f = jnp.where(ok, top_s, -jnp.inf)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        # greedy adaptive NMS over score order (shared helper, +1 pixel
+        # convention like the reference's JaccardOverlap(..., false))
+        order = jnp.argsort(-s_f)
+        boxes = boxes[order]
+        s_f = s_f[order]
+        keep = _greedy_nms(boxes, jnp.isfinite(s_f), nms_thresh, eta=eta,
+                           plus_one=True)
+        s_k = jnp.where(keep, s_f, -jnp.inf)
+        k = min(post_n, pre_n)
+        out_s, out_i = lax.top_k(s_k, k)
+        out_b = boxes[out_i]
+        valid = jnp.isfinite(out_s)
+        out_b = jnp.where(valid[:, None], out_b, 0.0)
+        out_s = jnp.where(valid, out_s, 0.0)
+        if k < post_n:
+            out_b = jnp.pad(out_b, ((0, post_n - k), (0, 0)))
+            out_s = jnp.pad(out_s, ((0, post_n - k),))
+        return out_b, out_s[:, None]
+
+    rois, probs = jax.vmap(one_image)(sc, dl, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs]}

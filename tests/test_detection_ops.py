@@ -7,6 +7,9 @@ import pytest
 
 from op_test import OpTest, _OpProgram, _as_feed
 
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
 
 def _r(*shape, seed=0):
     return np.random.RandomState(seed).rand(*shape).astype(np.float32)
@@ -161,3 +164,360 @@ def test_affine_channel():
     OpTest.check_grad("affine_channel",
                       {"X": [x], "Scale": [scale], "Bias": [bias]}, {},
                       {"Out": 1}, wrt=["X", "Scale", "Bias"])
+
+
+# ---------------------------------------------------------------- round 3 ops
+def _np_anchor_generator(H, W, sizes, ratios, stride, offset):
+    """Direct transcription of anchor_generator_op.h loops."""
+    A = len(sizes) * len(ratios)
+    out = np.zeros((H, W, A, 4), "float32")
+    sw, sh = stride
+    for h in range(H):
+        for w in range(W):
+            xc = w * sw + offset * (sw - 1)
+            yc = h * sh + offset * (sh - 1)
+            i = 0
+            for ar in ratios:
+                base_w = round(np.sqrt(sw * sh / ar))
+                base_h = round(base_w * ar)
+                for s in sizes:
+                    aw = s / sw * base_w
+                    ah = s / sh * base_h
+                    out[h, w, i] = [xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+                                    xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1)]
+                    i += 1
+    return out
+
+
+def test_anchor_generator_matches_numpy(fresh_programs):
+    main, startup, scope = fresh_programs
+    H, W = 5, 7
+    sizes, ratios, stride = [32.0, 64.0], [0.5, 1.0, 2.0], [16.0, 16.0]
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2, 8, H, W], append_batch_size=False)
+        anc, var = layers.anchor_generator(
+            x, anchor_sizes=sizes, aspect_ratios=ratios, stride=stride,
+            offset=0.5)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    a, v = exe.run(main, feed={"x": np.zeros((2, 8, H, W), "float32")},
+                   fetch_list=[anc, var], scope=scope)
+    want = _np_anchor_generator(H, W, sizes, ratios, stride, 0.5)
+    np.testing.assert_allclose(a, want, rtol=1e-5, atol=1e-4)
+    assert v.shape == (H, W, 6, 4)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], rtol=1e-6)
+
+
+def _np_density_prior_box(H, W, IH, IW, sizes, ratios, densities, offset):
+    """Transcription of density_prior_box_op.h loops."""
+    step_w, step_h = IW / W, IH / H
+    step_avg = int((step_w + step_h) * 0.5)
+    P = sum(len(ratios) * d * d for d in densities)
+    out = np.zeros((H, W, P, 4), "float32")
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            i = 0
+            for s, dens in zip(sizes, densities):
+                shift = step_avg // dens
+                for r in ratios:
+                    bw = s * np.sqrt(r)
+                    bh = s / np.sqrt(r)
+                    dcx = cx - step_avg / 2.0 + shift / 2.0
+                    dcy = cy - step_avg / 2.0 + shift / 2.0
+                    for di in range(dens):
+                        for dj in range(dens):
+                            px = dcx + dj * shift
+                            py = dcy + di * shift
+                            out[h, w, i] = [
+                                max((px - bw / 2) / IW, 0),
+                                max((py - bh / 2) / IH, 0),
+                                min((px + bw / 2) / IW, 1),
+                                min((py + bh / 2) / IH, 1)]
+                            i += 1
+    return out
+
+
+def test_density_prior_box_matches_numpy(fresh_programs):
+    main, startup, scope = fresh_programs
+    H, W, IH, IW = 4, 4, 64, 64
+    sizes, ratios, densities = [32.0, 48.0], [1.0, 2.0], [2, 1]
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [1, 8, H, W], append_batch_size=False)
+        img = layers.data("img", [1, 3, IH, IW], append_batch_size=False)
+        boxes, var = layers.density_prior_box(
+            x, img, densities=densities, fixed_sizes=sizes,
+            fixed_ratios=ratios, offset=0.5)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    b, v = exe.run(main, feed={"x": np.zeros((1, 8, H, W), "float32"),
+                               "img": np.zeros((1, 3, IH, IW), "float32")},
+                   fetch_list=[boxes, var], scope=scope)
+    want = _np_density_prior_box(H, W, IH, IW, sizes, ratios, densities, 0.5)
+    np.testing.assert_allclose(b, want, rtol=1e-5, atol=1e-5)
+
+
+def _np_yolov3_loss(x, gtbox, gtlabel, anchors, anchor_mask, class_num,
+                    ignore_thresh, downsample):
+    """Direct transcription of yolov3_loss_op.h (scalar loops)."""
+    def sce(p, t):
+        return max(p, 0) - p * t + np.log1p(np.exp(-abs(p)))
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    def iou(b1, b2):
+        lx = max(b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+        rx = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2)
+        ly = max(b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+        ry = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2)
+        inter = max(rx - lx, 0) * max(ry - ly, 0)
+        return inter / max(b1[2] * b1[3] + b2[2] * b2[3] - inter, 1e-10)
+
+    N, C, H, W = x.shape
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    B = gtbox.shape[1]
+    input_size = downsample * H
+    xr = x.reshape(N, mask_num, 5 + class_num, H, W)
+    loss = np.zeros(N)
+    for i in range(N):
+        # ignore mask via best pred-gt iou
+        for j in range(mask_num):
+            for k in range(H):
+                for l in range(W):
+                    px = (l + sig(xr[i, j, 0, k, l])) / W
+                    py = (k + sig(xr[i, j, 1, k, l])) / H
+                    pw = np.exp(xr[i, j, 2, k, l]) * anchors[
+                        2 * anchor_mask[j]] / input_size
+                    ph = np.exp(xr[i, j, 3, k, l]) * anchors[
+                        2 * anchor_mask[j] + 1] / input_size
+                    best = 0.0
+                    for t in range(B):
+                        if gtbox[i, t, 2] <= 0 or gtbox[i, t, 3] <= 0:
+                            continue
+                        best = max(best, iou([px, py, pw, ph], gtbox[i, t]))
+                    conf = xr[i, j, 4, k, l]
+                    if best > ignore_thresh:
+                        continue  # ignored
+                    # negative unless later marked positive; handle after
+                    loss[i] += sce(conf, 0.0)
+        for t in range(B):
+            if gtbox[i, t, 2] <= 0 or gtbox[i, t, 3] <= 0:
+                continue
+            gt = gtbox[i, t]
+            gi, gj = int(gt[0] * W), int(gt[1] * H)
+            best_iou, best_n = 0.0, 0
+            for a in range(an_num):
+                ab = [0, 0, anchors[2 * a] / input_size,
+                      anchors[2 * a + 1] / input_size]
+                v = iou(ab, [0, 0, gt[2], gt[3]])
+                if v > best_iou:
+                    best_iou, best_n = v, a
+            if best_n not in anchor_mask:
+                continue
+            mi = anchor_mask.index(best_n)
+            tx = gt[0] * W - gi
+            ty = gt[1] * H - gj
+            tw = np.log(gt[2] * input_size / anchors[2 * best_n])
+            th = np.log(gt[3] * input_size / anchors[2 * best_n + 1])
+            scale = 2.0 - gt[2] * gt[3]
+            p = xr[i, mi, :, gj, gi]
+            loss[i] += (sce(p[0], tx) + sce(p[1], ty)
+                        + 0.5 * (p[2] - tw) ** 2
+                        + 0.5 * (p[3] - th) ** 2) * scale
+            # positive conf: it was counted as negative above (obj buffer
+            # in the reference flips it); subtract the sce(conf,0) term
+            # only if it wasn't ignored
+            px = (gi + sig(p[0])) / W
+            py = (gj + sig(p[1])) / H
+            pw = np.exp(p[2]) * anchors[2 * best_n] / input_size
+            ph = np.exp(p[3]) * anchors[2 * best_n + 1] / input_size
+            best = 0.0
+            for tt in range(B):
+                if gtbox[i, tt, 2] <= 0 or gtbox[i, tt, 3] <= 0:
+                    continue
+                best = max(best, iou([px, py, pw, ph], gtbox[i, tt]))
+            if best <= ignore_thresh:
+                loss[i] -= sce(p[4], 0.0)
+            loss[i] += sce(p[4], 1.0)
+            for c in range(class_num):
+                loss[i] += sce(p[5 + c], 1.0 if c == gtlabel[i, t] else 0.0)
+    return loss
+
+
+def test_yolov3_loss_matches_numpy(fresh_programs):
+    main, startup, scope = fresh_programs
+    N, B, H, W, class_num = 2, 3, 4, 4, 5
+    anchors = [10, 13, 16, 30, 33, 23]
+    anchor_mask = [0, 1, 2]
+    C = len(anchor_mask) * (5 + class_num)
+    rs = np.random.RandomState(7)
+    xv = rs.randn(N, C, H, W).astype("float32") * 0.5
+    gt = rs.rand(N, B, 4).astype("float32")
+    gt[:, :, 2:] = gt[:, :, 2:] * 0.3 + 0.05
+    gt[:, :, :2] = gt[:, :, :2] * 0.8 + 0.1
+    gt[1, 2, 2] = 0.0  # invalid gt box
+    lbl = rs.randint(0, class_num, (N, B)).astype("int64")
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [N, C, H, W], append_batch_size=False)
+        gtbox = layers.data("gtbox", [N, B, 4], append_batch_size=False)
+        gtlabel = layers.data("gtlabel", [N, B], dtype="int64",
+                              append_batch_size=False)
+        loss = layers.yolov3_loss(x, gtbox, gtlabel, anchors, anchor_mask,
+                                  class_num, 0.7, 32)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": xv, "gtbox": gt, "gtlabel": lbl},
+                     fetch_list=[loss], scope=scope)
+    want = _np_yolov3_loss(xv, gt, lbl, anchors, anchor_mask, class_num,
+                           0.7, 32)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_yolov3_loss_trains(fresh_programs):
+    main, startup, scope = fresh_programs
+    N, B, H, W, class_num = 2, 2, 4, 4, 3
+    anchors = [10, 13, 16, 30]
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [N, 8, H, W], append_batch_size=False)
+        gtbox = layers.data("gtbox", [N, B, 4], append_batch_size=False)
+        gtlabel = layers.data("gtlabel", [N, B], dtype="int64",
+                              append_batch_size=False)
+        feat = layers.conv2d(img, num_filters=2 * (5 + class_num),
+                             filter_size=3, padding=1)
+        loss = layers.mean(layers.yolov3_loss(
+            feat, gtbox, gtlabel, anchors, [0, 1], class_num, 0.7, 32))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(8)
+    feed = {
+        "img": rs.randn(N, 8, H, W).astype("float32"),
+        "gtbox": (rs.rand(N, B, 4) * 0.4 + 0.2).astype("float32"),
+        "gtlabel": rs.randint(0, class_num, (N, B)).astype("int64"),
+    }
+    ls = [float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+          for _ in range(12)]
+    assert np.isfinite(ls).all()
+    assert ls[-1] < ls[0]
+
+
+def test_generate_proposals_shapes_and_nms(fresh_programs):
+    main, startup, scope = fresh_programs
+    N, H, W = 1, 8, 8
+    post_n = 10
+    A = 2  # 1 aspect_ratio x 2 anchor_sizes
+    main2, startup2 = main, startup
+    with fluid.program_guard(main2, startup2):
+        sc = layers.data("sc", [N, A, H, W], append_batch_size=False)
+        bd = layers.data("bd", [N, A * 4, H, W], append_batch_size=False)
+        info = layers.data("info", [N, 3], append_batch_size=False)
+        feat = layers.data("feat", [N, 8, H, W], append_batch_size=False)
+        anc, var = layers.anchor_generator(
+            feat, anchor_sizes=[16.0, 32.0], aspect_ratios=[1.0],
+            stride=[8.0, 8.0])
+        rois, probs = layers.generate_proposals(
+            sc, bd, info, anc, var, pre_nms_top_n=50, post_nms_top_n=post_n,
+            nms_thresh=0.7, min_size=2.0)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup2, scope=scope)
+    rs = np.random.RandomState(9)
+    r, p = exe.run(main2, feed={
+        "sc": rs.rand(N, A, H, W).astype("float32"),
+        "bd": (rs.randn(N, A * 4, H, W) * 0.2).astype("float32"),
+        "info": np.array([[64.0, 64.0, 1.0]], "float32"),
+        "feat": np.zeros((N, 8, H, W), "float32"),
+    }, fetch_list=[rois, probs], scope=scope)
+    assert r.shape == (N, post_n, 4) and p.shape == (N, post_n, 1)
+    valid = p[0, :, 0] > 0
+    assert valid.sum() >= 1
+    rb = r[0][valid]
+    # boxes inside the image
+    assert (rb[:, 0] >= 0).all() and (rb[:, 2] <= 63).all()
+    assert (rb[:, 1] >= 0).all() and (rb[:, 3] <= 63).all()
+    # kept boxes pairwise IoU below the threshold
+    def iou(a, b):
+        ix = max(0, min(a[2], b[2]) - max(a[0], b[0]) + 1)
+        iy = max(0, min(a[3], b[3]) - max(a[1], b[1]) + 1)
+        inter = ix * iy
+        aa = (a[2] - a[0] + 1) * (a[3] - a[1] + 1)
+        bb = (b[2] - b[0] + 1) * (b[3] - b[1] + 1)
+        return inter / (aa + bb - inter)
+    for i in range(len(rb)):
+        for j in range(i + 1, len(rb)):
+            assert iou(rb[i], rb[j]) <= 0.7 + 1e-5
+    # probs sorted descending over valid rows
+    pv = p[0, valid, 0]
+    assert (np.diff(pv) <= 1e-6).all()
+
+
+def test_yolov3_padding_gt_cannot_erase_match(fresh_programs):
+    """Regression: an invalid padding gt whose clipped cell collides with
+    a real match must not erase the positive objectness slot."""
+    main, startup, scope = fresh_programs
+    N, B, H, W, class_num = 1, 2, 4, 4, 2
+    anchors = [10, 13]
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [N, 1 * (5 + class_num), H, W],
+                        append_batch_size=False)
+        gtbox = layers.data("gtbox", [N, B, 4], append_batch_size=False)
+        gtlabel = layers.data("gtlabel", [N, B], dtype="int64",
+                              append_batch_size=False)
+        loss = layers.yolov3_loss(x, gtbox, gtlabel, anchors, [0],
+                                  class_num, 0.7, 32)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    xv = np.zeros((N, 7, H, W), "float32")
+    # gt0: valid box at cell (0,0); gt1: padding (w=h=0) -> clips to (0,0)
+    gt = np.array([[[0.1, 0.1, 0.2, 0.3], [0.0, 0.0, 0.0, 0.0]]], "float32")
+    lbl = np.zeros((N, B), "int64")
+    (got,) = exe.run(main, feed={"x": xv, "gtbox": gt, "gtlabel": lbl},
+                     fetch_list=[loss], scope=scope)
+    want = _np_yolov3_loss(xv, gt, lbl, anchors, [0], class_num, 0.7, 32)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_generate_proposals_min_size_uses_original_scale(fresh_programs):
+    """FilterBoxes compares sizes in the ORIGINAL image scale:
+    (x2-x1)/im_scale + 1 >= max(min_size, 1)."""
+    main, startup, scope = fresh_programs
+    N, A, H, W = 1, 1, 2, 2
+    with fluid.program_guard(main, startup):
+        sc = layers.data("sc", [N, A, H, W], append_batch_size=False)
+        bd = layers.data("bd", [N, A * 4, H, W], append_batch_size=False)
+        info = layers.data("info", [N, 3], append_batch_size=False)
+        feat = layers.data("feat", [N, 4, H, W], append_batch_size=False)
+        anc, var = layers.anchor_generator(
+            feat, anchor_sizes=[16.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        rois, probs = layers.generate_proposals(
+            sc, bd, info, anc, var, pre_nms_top_n=4, post_nms_top_n=4,
+            nms_thresh=0.9, min_size=16.0)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    feed = {
+        "sc": np.ones((N, A, H, W), "float32"),
+        "bd": np.zeros((N, A * 4, H, W), "float32"),
+        "feat": np.zeros((N, 4, H, W), "float32"),
+    }
+    # anchors are 16x16 (width 15 in x2-x1 terms). im_scale=2: size in
+    # original scale is 15/2+1 = 8.5 < 16 -> ALL filtered out.
+    feed["info"] = np.array([[64.0, 64.0, 2.0]], "float32")
+    _, p2 = exe.run(main, feed=feed, fetch_list=[rois, probs], scope=scope)
+    assert (p2 == 0).all()
+    # im_scale=1: 15/1+1 = 16 >= 16 -> proposals survive
+    feed["info"] = np.array([[64.0, 64.0, 1.0]], "float32")
+    _, p1 = exe.run(main, feed=feed, fetch_list=[rois, probs], scope=scope)
+    assert (p1 > 0).any()
+
+
+def test_density_prior_box_length_mismatch_raises(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [1, 4, 2, 2], append_batch_size=False)
+        img = layers.data("img", [1, 3, 32, 32], append_batch_size=False)
+        with pytest.raises(ValueError, match="one-to-one"):
+            layers.density_prior_box(x, img, densities=[2, 2],
+                                     fixed_sizes=[16.0], fixed_ratios=[1.0])
