@@ -30,7 +30,8 @@ from . import (  # noqa: F401
     profiler,
     regularizer,
 )
-from . import contrib, flags, inference, transpiler  # noqa: F401
+from . import contrib, flags, inference, reader, transpiler  # noqa: F401
+from .reader import batch  # noqa: F401  (paddle.batch top-level parity)
 from .flags import get_flag, set_flag  # noqa: F401
 from .async_executor import AsyncExecutor, DataFeedDesc  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
