@@ -18,7 +18,7 @@ from typing import Callable, Dict, List, Optional, Set
 from .program import Operator, Program
 
 __all__ = ["Node", "Graph", "Pass", "register_pass", "get_pass", "all_passes",
-           "graph_to_program"]
+           "graph_to_program", "PatternMatcher"]
 
 
 class Node:
@@ -86,6 +86,79 @@ class Graph:
         for vn in node.outputs:
             vn.inputs = [i for i in vn.inputs if i is not node]
 
+    def create_var_node(self, name: str, **var_kw) -> Node:
+        """Create a var in the program's global block and its node."""
+        var = self.program.global_block().create_var(name=name, **var_kw)
+        node = self._var(name)
+        node.var = var
+        return node
+
+    def insert_op_node(self, type: str, inputs, outputs, attrs=None) -> Node:
+        """Create an Operator (not yet placed — topology_sort orders it)
+        and wire its var edges. Input/output vars must already have
+        nodes (create_var_node for fresh ones)."""
+        block = self.program.global_block()
+        op = Operator(block, type, inputs, outputs, attrs or {})
+        onode = Node("op", type, op=op)
+        self.op_nodes.append(onode)
+        for n in op.input_names():
+            vn = self._var(n)
+            onode.inputs.append(vn)
+            vn.outputs.append(onode)
+        for n in op.output_names():
+            vn = self._var(n)
+            onode.outputs.append(vn)
+            vn.inputs.append(onode)
+        return onode
+
+    def rewire_input(self, op_node: Node, slot: str, old: str, new: str):
+        """Point op_node's `slot` entry from var `old` to var `new`,
+        updating both the Operator and the graph edges."""
+        names = op_node.op.inputs.get(slot) or []
+        op_node.op.inputs[slot] = [new if n == old else n for n in names]
+        old_vn = self._var(old)
+        new_vn = self._var(new)
+        if old not in (n for ns in op_node.op.inputs.values() for n in ns):
+            op_node.inputs = [v for v in op_node.inputs if v is not old_vn]
+            old_vn.outputs = [o for o in old_vn.outputs if o is not op_node]
+        if new_vn not in op_node.inputs:
+            op_node.inputs.append(new_vn)
+        if op_node not in new_vn.outputs:
+            new_vn.outputs.append(op_node)
+
+    def materialize(self) -> Program:
+        """Write the surviving ops back into THIS graph's program,
+        mutating the caller's program object (in-place graph_to_program).
+
+        Unlike topology_sort (which assumes SSA-ish programs and reports
+        a cycle on in-place updates like `sgd ParamOut=param` feeding an
+        earlier read of `param`), this preserves the original program
+        order for surviving ops and splices each NEW op immediately
+        before its first consumer (or after its last producer when
+        nothing consumes it) — the order an in-place insertion would
+        have produced."""
+        block = self.program.global_block()
+        old_pos = {id(op): i for i, op in enumerate(block.ops)}
+        alive = {id(n.op) for n in self.op_nodes}
+        order = [op for op in block.ops if id(op) in alive]
+        new_nodes = [n for n in self.op_nodes if id(n.op) not in old_pos]
+        for node in new_nodes:
+            pos = {id(op): i for i, op in enumerate(order)}
+            consumers = [pos[id(c.op)] for vn in node.outputs
+                         for c in vn.outputs
+                         if c is not node and id(c.op) in pos]
+            if consumers:
+                at = min(consumers)
+            else:
+                producers = [pos[id(p.op)] for vn in node.inputs
+                             for p in vn.inputs
+                             if p is not node and id(p.op) in pos]
+                at = max(producers) + 1 if producers else len(order)
+            order.insert(at, node.op)
+        block.ops = order
+        self.program._bump()
+        return self.program
+
     def topology_sort(self) -> List[Node]:
         """Dependency-ordered op nodes; raises on cycles
         (the SSA-graph validity check of multi_devices_graph_check_pass)."""
@@ -142,6 +215,115 @@ class Graph:
                     lines.append("  %s -> %s;" % (ids[id(onode)], ids[id(vn)]))
         lines.append("}")
         return "\n".join(lines)
+
+
+# --------------------------------------------------------------- matching
+class _PDNode:
+    """One pattern role (PDNode, graph_pattern_detector.h:80)."""
+
+    def __init__(self, name: str, kind: str, op_type=None, pred=None):
+        self.name = name
+        self.kind = kind
+        self.op_type = op_type
+        self.pred = pred
+
+    def accepts(self, node: Node) -> bool:
+        if node.kind != self.kind:
+            return False
+        if self.op_type is not None and node.op.type != self.op_type:
+            return False
+        return self.pred is None or bool(self.pred(node))
+
+
+class PatternMatcher:
+    """Small subgraph pattern matcher — the spirit of the reference's
+    GraphPatternDetector (framework/ir/graph_pattern_detector.h), sized
+    for this repo's structural patterns: declare op/var roles, connect
+    them with (optionally slot-constrained) feeds edges, and match()
+    yields one {role: Node} dict per subgraph occurrence.
+
+        pm = PatternMatcher()
+        w = pm.new_var("w", pred=lambda n: isinstance(n.var, Parameter))
+        c = pm.new_op("conv", op_type="conv2d")
+        pm.feeds(w, c, slot="Filter")
+        for m in pm.match(graph): ...
+    """
+
+    def __init__(self):
+        self._nodes: List[_PDNode] = []
+        self._edges: List[tuple] = []  # (src_name, dst_name, slot)
+
+    def new_op(self, name: str, op_type=None, pred=None) -> _PDNode:
+        n = _PDNode(name, "op", op_type=op_type, pred=pred)
+        self._nodes.append(n)
+        return n
+
+    def new_var(self, name: str, pred=None) -> _PDNode:
+        n = _PDNode(name, "var", pred=pred)
+        self._nodes.append(n)
+        return n
+
+    def feeds(self, src: _PDNode, dst: _PDNode, slot: Optional[str] = None):
+        """src is consumed by dst (var->op, slot-checked) or produced by
+        it (op->var, slot-checked on outputs)."""
+        self._edges.append((src.name, dst.name, slot))
+
+    def _edge_ok(self, graph, sname, dname, slot, bound) -> bool:
+        if sname not in bound or dname not in bound:
+            return True  # checked once both ends are bound
+        s, d = bound[sname], bound[dname]
+        if s.is_var() and d.is_op():
+            if d not in s.outputs:
+                return False
+            if slot is not None and s.name not in (
+                    d.op.inputs.get(slot) or []):
+                return False
+            return True
+        if s.is_op() and d.is_var():
+            if s not in d.inputs:
+                return False
+            if slot is not None and d.name not in (
+                    s.op.outputs.get(slot) or []):
+                return False
+            return True
+        return False
+
+    def match(self, graph: Graph) -> List[Dict[str, Node]]:
+        """All bindings, backtracking role by role; a graph node binds at
+        most one role per match."""
+        roles = list(self._nodes)
+        results: List[Dict[str, Node]] = []
+        pools = {
+            "op": graph.all_op_nodes(),
+            "var": [v for v in graph.all_var_nodes()],
+        }
+
+        def pool_for(role, bound):
+            """Narrow candidates via an edge to an already-bound role —
+            keeps matching near-linear instead of all-nodes x all-nodes."""
+            for s, d, _slot in self._edges:
+                if s == role.name and d in bound:
+                    return bound[d].inputs
+                if d == role.name and s in bound:
+                    return bound[s].outputs
+            return pools[role.kind]
+
+        def extend(i: int, bound: Dict[str, Node]):
+            if i == len(roles):
+                results.append(dict(bound))
+                return
+            role = roles[i]
+            for cand in pool_for(role, bound):
+                if cand in bound.values() or not role.accepts(cand):
+                    continue
+                bound[role.name] = cand
+                if all(self._edge_ok(graph, s, d, sl, bound)
+                       for s, d, sl in self._edges):
+                    extend(i + 1, bound)
+                del bound[role.name]
+
+        extend(0, {})
+        return results
 
 
 # ---------------------------------------------------------------- passes
@@ -235,11 +417,15 @@ class DeadCodeEliminationPass(Pass):
 
 @register_pass("is_test_pass")
 class IsTestPass(Pass):
-    """Flip train-mode attrs for inference (the reference's is_test_pass)."""
+    """Flip train-mode attrs for inference (the reference's is_test_pass),
+    expressed as a PatternMatcher client: match every train-mode op role
+    and rewrite its attr."""
 
     def apply(self, graph: Graph) -> Graph:
-        for onode in graph.op_nodes:
-            if "is_test" in onode.op.attrs or onode.op.type in (
-                    "dropout", "batch_norm"):
-                onode.op.attrs["is_test"] = True
+        pm = PatternMatcher()
+        pm.new_op("train_op", pred=lambda n: (
+            "is_test" in n.op.attrs
+            or n.op.type in ("dropout", "batch_norm")))
+        for m in pm.match(graph):
+            m["train_op"].op.attrs["is_test"] = True
         return graph

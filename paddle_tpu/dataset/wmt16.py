@@ -105,9 +105,12 @@ def validation(src_dict_size, trg_dict_size, src_lang="en"):
 
 def get_dict(lang, dict_size, reverse=False):
     _check_lang(lang)
-    names = ["<s>", "<e>", "<unk>"] + [
-        "%s%d" % (lang, i) for i in range(_RESERVED, dict_size)]
-    d = {w: i for i, w in enumerate(names)}
+    if _data_dir():
+        d = _load_dict(lang, dict_size)
+    else:
+        names = ["<s>", "<e>", "<unk>"] + [
+            "%s%d" % (lang, i) for i in range(_RESERVED, dict_size)]
+        d = {w: i for i, w in enumerate(names)}
     if reverse:
         d = {v: k for k, v in d.items()}
     return d

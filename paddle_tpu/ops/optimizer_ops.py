@@ -209,3 +209,48 @@ def _lamb(ctx, ins, attrs):
         "Beta1PowOut": [b1p * b1],
         "Beta2PowOut": [b2p * b2],
     }
+
+
+@register_op("average_accumulates", no_grad=True)
+def _average_accumulates(ctx, ins, attrs):
+    """ModelAverage accumulator update (average_accumulates_op.h): per
+    step sum_1 += param; every kMaxNumAccumulates updates sum_1 rolls
+    into sum_2 (precision guard); when the accumulate count reaches
+    min(max_average_window, num_updates*average_window) (and at least
+    min_average_window), sums roll into sum_3 and the count restarts —
+    so apply() averages over roughly the trailing window only.
+
+    One deliberate divergence: the rolls use the post-add sums, so the
+    current step's param is never dropped (the reference zeroes
+    out_sum_1 after writing in_sum_1+param, losing one sample per roll).
+    """
+    p = ins["param"][0].astype(jnp.float32)
+    s1 = ins["in_sum_1"][0]
+    s2 = ins["in_sum_2"][0]
+    s3 = ins["in_sum_3"][0]
+    na = ins["in_num_accumulates"][0]          # [1] int
+    ona = ins["in_old_num_accumulates"][0]
+    nu = ins["in_num_updates"][0]
+    rate = float(attrs.get("average_window", 0.0))
+    max_w = int(attrs.get("max_average_window", 10000))
+    min_w = int(attrs.get("min_average_window", 10000))
+    k_max = 16384
+
+    nu = nu + 1
+    na = na + 1
+    s1 = s1 + p
+    roll = (nu % k_max) == 0
+    s2 = jnp.where(roll, s2 + s1, s2)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(
+        jnp.asarray(max_w, nu.dtype),
+        (nu.astype(jnp.float32) * rate).astype(nu.dtype))
+    trigger = (na >= min_w) & (na >= window)
+    s3 = jnp.where(trigger, s1 + s2, s3)
+    s1 = jnp.where(trigger, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(trigger, jnp.zeros_like(s2), s2)
+    ona = jnp.where(trigger, na, ona)
+    na = jnp.where(trigger, jnp.zeros_like(na), na)
+    return {"out_sum_1": [s1], "out_sum_2": [s2], "out_sum_3": [s3],
+            "out_num_accumulates": [na], "out_old_num_accumulates": [ona],
+            "out_num_updates": [nu]}

@@ -441,10 +441,14 @@ LambOptimizer = Lamb
 
 
 class ModelAverage:
-    """Running average of parameters, swapped in for evaluation
-    (reference optimizer.py ModelAverage:1485: per-param sum accumulators
-    updated each step; apply() temporarily replaces params with
-    sum/num_updates, restore() puts the trained values back).
+    """Windowed running average of parameters, swapped in for evaluation
+    (reference optimizer.py ModelAverage:1485 + average_accumulates_op.h:
+    per param sum_1/sum_2/sum_3 and num/old_num/num_updates accumulators;
+    when the accumulate count passes min(max_average_window,
+    num_updates*average_window_rate) the sums roll into sum_3 and the
+    count restarts, so apply() replaces each param with
+    (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates) — the mean
+    over roughly the trailing window, not the whole history).
 
     Usage (reference contract):
         opt.minimize(loss)
@@ -468,36 +472,48 @@ class ModelAverage:
         for p in block.all_parameters():
             if not p.trainable or getattr(p, "do_model_average", True) is False:
                 continue
-            s = helper.create_global_variable(
-                name=unique_name.generate(p.name + "_sum"), shape=p.shape,
-                dtype=p.dtype, initializer=Constant(0.0))
-            n = helper.create_global_variable(
-                name=unique_name.generate(p.name + "_numacc"), shape=[1],
-                dtype="float32", initializer=Constant(0.0))
-            # in-step accumulation: sum += param, num += 1 (the reference's
-            # _append_average_accumulate_op)
-            block.append_op("sum", {"X": [s, p]}, {"Out": [s]},
-                            {"__op_role__": "optimize"})
-            block.append_op("increment", {"X": [n]}, {"Out": [n]},
-                            {"step": 1.0, "__op_role__": "optimize"})
-            self._params.append((p, s, n))
+            sums = [helper.create_global_variable(
+                name=unique_name.generate("%s_sum_%d" % (p.name, i)),
+                shape=p.shape, dtype="float32", initializer=Constant(0.0))
+                for i in (1, 2, 3)]
+            counters = [helper.create_global_variable(
+                name=unique_name.generate(p.name + "_" + nm), shape=[1],
+                dtype="int64", initializer=Constant(0.0))
+                for nm in ("numacc", "old_numacc", "num_updates")]
+            na, ona, nu = counters
+            block.append_op(
+                "average_accumulates",
+                {"param": [p], "in_sum_1": [sums[0]], "in_sum_2": [sums[1]],
+                 "in_sum_3": [sums[2]], "in_num_accumulates": [na],
+                 "in_old_num_accumulates": [ona], "in_num_updates": [nu]},
+                {"out_sum_1": [sums[0]], "out_sum_2": [sums[1]],
+                 "out_sum_3": [sums[2]], "out_num_accumulates": [na],
+                 "out_old_num_accumulates": [ona], "out_num_updates": [nu]},
+                {"average_window": float(average_window_rate),
+                 "min_average_window": int(min_average_window),
+                 "max_average_window": int(max_average_window),
+                 "__op_role__": "optimize"})
+            self._params.append((p, sums, na, ona))
         default_main_program()._bump()
 
     def _swap(self, scope):
         import numpy as np
 
         self._saved = {}
-        for p, s, n in self._params:
+        for p, sums, na, ona in self._params:
             self._saved[p.name] = scope.find_var(p.name)
-            cnt = max(float(np.asarray(scope.find_var(n.name))[0]), 1.0)
-            avg = np.asarray(scope.find_var(s.name)) / cnt
+            cnt = float(np.asarray(scope.find_var(na.name))[0]
+                        + np.asarray(scope.find_var(ona.name))[0])
+            total = sum(np.asarray(scope.find_var(s.name), dtype=np.float64)
+                        for s in sums)
+            avg = total / max(cnt, 1.0)
             scope.set_var(p.name, avg.astype(p.dtype))
 
     def restore(self, executor=None, scope=None):
         from .core.scope import global_scope
 
         scope = scope or global_scope()
-        for p, _s, _n in self._params:
+        for p, *_ in self._params:
             scope.set_var(p.name, self._saved[p.name])
         self._saved = {}
 

@@ -124,11 +124,8 @@ def test_mq2007_formats():
         assert float(lab) == 1.0
     for scores, feats in list(mq2007.train("listwise")())[:5]:
         assert feats.shape == (len(scores), mq2007.FEATURE_DIM)
-    # pairwise pairs are orderable by the latent model: a linear scorer
-    # should rank hi above lo far more often than chance
-    w = np.random.RandomState(0).randn(mq2007.FEATURE_DIM)  # random probe
+    # pairwise pairs are orderable by the TRUE latent weights
     pairs = list(mq2007.train("pairwise")())[:200]
-    # with the TRUE latent weights the margin is positive
     from paddle_tpu.dataset.mq2007 import _w
 
     correct = sum(1 for _, hi, lo in pairs if hi @ _w() > lo @ _w())

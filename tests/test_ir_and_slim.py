@@ -145,3 +145,138 @@ def test_check_nan_inf_flag(fresh_programs):
                         fetch_list=[out.name], scope=scope)
     finally:
         fluid.set_flag("check_nan_inf", False)
+
+
+def test_pattern_matcher_finds_slot_edges(fresh_programs):
+    """PatternMatcher (graph_pattern_detector.h analog): find every
+    Parameter feeding a mul's Y slot."""
+    from paddle_tpu.core.ir import Graph, PatternMatcher
+    from paddle_tpu.core.program import Parameter
+
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=3)
+        _ = fluid.layers.fc(h, size=2)
+    g = Graph(main)
+    pm = PatternMatcher()
+    w = pm.new_var("w", pred=lambda n: isinstance(n.var, Parameter))
+    op = pm.new_op("mul", op_type="mul")
+    pm.feeds(w, op, slot="Y")
+    matches = pm.match(g)
+    assert len(matches) == 2  # one per fc's mul
+    for m in matches:
+        assert m["w"].name in (m["mul"].op.inputs.get("Y") or [])
+    # slot constraint is real: X-slot pattern must NOT match parameters
+    pm2 = PatternMatcher()
+    w2 = pm2.new_var("w", pred=lambda n: isinstance(n.var, Parameter))
+    op2 = pm2.new_op("mul", op_type="mul")
+    pm2.feeds(w2, op2, slot="X")
+    assert pm2.match(g) == []
+
+
+def test_quantize_pass_via_registry(fresh_programs):
+    """quantize_pass runs through the pass registry and rewires the
+    graph; the program then trains (QAT) like the transpiler path."""
+    from paddle_tpu.core.ir import Graph, get_pass
+
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=6, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+    g = Graph(main)
+    p = get_pass("quantize_pass")
+    p.startup = startup
+    p.apply(g)
+    g.materialize()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    quant_ops = [op for op in main.global_block().ops
+                 if op.type.startswith("fake_quantize")]
+    assert len(quant_ops) >= 4  # 2 weights + 2 activations
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        xs = rs.randn(32, 8).astype("float32")
+        ys = (xs[:, :1] * 0.5).astype("float32")
+        ls = [float(exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss], scope=scope)[0])
+              for _ in range(20)]
+        assert np.isfinite(ls).all() and ls[-1] < ls[0]
+
+
+def test_model_average_windowed(fresh_programs):
+    """Numeric check vs a numpy transcription of average_accumulates_op.h:
+    with a small window the average covers only the trailing updates."""
+    main, startup, scope = fresh_programs
+    rate, min_w, max_w = 0.5, 2, 4
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(
+            rate, min_average_window=min_w, max_average_window=max_w)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 4).astype(np.float32)
+        Y = X.sum(1, keepdims=True).astype(np.float32)
+
+        # numpy window model (post-add roll semantics, see op docstring)
+        s1 = s2 = s3 = 0.0
+        na = ona = nu = 0
+        ws = []
+        for step in range(13):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss.name],
+                    scope=scope)
+            w_now = np.asarray(scope.find_var("w")).copy()
+            ws.append(w_now)
+            nu += 1
+            na += 1
+            s1 = s1 + w_now
+            if na >= min_w and na >= min(max_w, int(nu * rate)):
+                s3 = s1 + s2
+                s1 = 0.0
+                s2 = 0.0
+                ona, na = na, 0
+        want = (s1 + s2 + s3) / max(na + ona, 1)
+        with ma.apply(exe, scope):
+            got = np.asarray(scope.find_var("w"))
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # windowed mean must differ from the all-history mean here
+        assert not np.allclose(want, np.mean(ws, axis=0), rtol=1e-4)
+
+
+def test_quantize_after_minimize_preserves_order(fresh_programs):
+    """materialize() must tolerate in-place optimizer updates (sgd writes
+    ParamOut=param, which a naive topo sort reads as a cycle)."""
+    main, startup, scope = fresh_programs
+    from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    QuantizeTranspiler().training_transpile(main, startup)
+    ops = [op.type for op in main.global_block().ops]
+    # fake-quant ops inserted before their consumers, optimizer ops last
+    assert any(t.startswith("fake_quantize") for t in ops)
+    assert ops.index("mul") > min(i for i, t in enumerate(ops)
+                                  if t.startswith("fake_quantize"))
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        X = np.random.RandomState(0).randn(16, 4).astype("float32")
+        (lv,) = exe.run(main, feed={"x": X, "y": X[:, :1]},
+                        fetch_list=[loss.name], scope=scope)
+        assert np.isfinite(float(lv))
