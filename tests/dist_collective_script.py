@@ -1,0 +1,64 @@
+"""Collective-mode (nccl2-analog) cluster worker: 2 jax.distributed
+processes x 4 virtual CPU devices = one 8-device global mesh.
+
+Reference analog: nccl2-mode test_dist_mnist.py — trainer processes
+bootstrap comms from the PADDLE_* env contract (gen_nccl_id) and
+all-reduce gradients; here parallel/env.init_parallel_env feeds
+jax.distributed.initialize and the ParallelEngine's mesh spans both
+processes, with the XLA partitioner inserting the cross-host psum.
+"""
+
+import json
+import os
+import sys
+
+# MUST precede jax import: per-process virtual device count
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.parallel.env import ParallelEnv, init_parallel_env  # noqa: E402
+from paddle_tpu.parallel.engine import ParallelEngine  # noqa: E402
+import dist_lr_script as lrm  # noqa: E402
+
+
+def main():
+    penv = init_parallel_env(ParallelEnv())
+    assert len(jax.devices()) == 4 * penv.world_size, jax.devices()
+
+    main_prog, startup, loss = lrm.build()
+    # collective mode: the transpiler validates/records topology but the
+    # program needs no surgery (grad all-reduce is the mesh partitioner's)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.mode = "nccl2"
+    t = fluid.DistributeTranspiler(cfg)
+    t.transpile(trainer_id=penv.rank,
+                program=main_prog,
+                pservers="",
+                trainers=",".join(penv.trainer_endpoints),
+                sync_mode=True,
+                startup_program=startup)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    engine = ParallelEngine(main_prog, loss_name=loss.name)
+    losses = []
+    for step in range(lrm.STEPS):
+        X, Y = lrm.data(step)  # every process feeds the same global batch
+        lv, = engine.run(feed={"x": X, "y": Y}, fetch_list=[loss.name])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    out = os.environ.get("LOSS_OUT")
+    if out:
+        with open(out, "w") as f:
+            json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
