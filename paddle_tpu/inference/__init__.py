@@ -137,3 +137,9 @@ def _rewrite_for_inference(program: Program) -> Program:
 def create_paddle_predictor(config: AnalysisConfig) -> Predictor:
     """CreatePaddlePredictor (api/paddle_api.h:335) analog."""
     return Predictor(config)
+
+
+def create_predictor_from_dir(model_dir: str) -> Predictor:
+    """Entry for the native C serving shim (native/serving.cc): build a
+    Predictor from a save_inference_model directory with defaults."""
+    return Predictor(AnalysisConfig(model_dir=model_dir))

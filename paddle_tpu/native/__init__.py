@@ -18,14 +18,27 @@ _BUILD_LOCK = threading.Lock()
 _LIBS = {}
 
 
+def _python_embed_flags():
+    """Include + link flags for libs that embed CPython (serving.cc)."""
+    out = subprocess.run(
+        ["python3-config", "--includes", "--ldflags", "--embed"],
+        check=True, capture_output=True, text=True).stdout
+    return out.split()
+
+
+_EXTRA_FLAGS = {"serving": _python_embed_flags}
+
+
 def _build(name: str) -> str:
     src = os.path.join(_DIR, name + ".cc")
     so = os.path.join(_DIR, "lib" + name + ".so")
     with _BUILD_LOCK:
         if (not os.path.exists(so)
                 or os.path.getmtime(so) < os.path.getmtime(src)):
-            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-pthread", src, "-o", so]
+            extra = _EXTRA_FLAGS.get(name)
+            cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                    "-pthread", src] + (extra() if extra else [])
+                   + ["-o", so])
             subprocess.run(cmd, check=True, capture_output=True)
     return so
 

@@ -1,0 +1,225 @@
+// Native serving entry: a thin C ABI over the inference engine.
+//
+// Reference analog: paddle/fluid/inference/api/paddle_api.h:199 (the C++
+// deployment API: CreatePaddlePredictor + PaddlePredictor::Run) and
+// inference/capi. The reference's predictor is a 20.9k-LoC native engine
+// because it owns graph optimization and kernel dispatch; here XLA owns
+// both, so the native surface is deliberately thin: it embeds CPython,
+// drives paddle_tpu.inference (load -> prune -> AOT compile per shape
+// bucket), and marshals float32 buffers across the C boundary. A C/C++
+// deployment process links this .so and never touches Python itself.
+//
+//   void*  pd_predictor_create(const char* model_dir);
+//   int    pd_predictor_run(h, names, data, shapes, ndims, n_inputs,
+//                           out_data, out_shapes, out_ndims, max_outputs);
+//          -> number of outputs (buffers owned by the library until the
+//             next run/destroy), or -1 (see pd_last_error()).
+//   void   pd_predictor_destroy(void* h);
+//   const char* pd_last_error(void);
+//
+// Build: g++ -shared -fPIC serving.cc $(python3-config --includes
+//        --ldflags --embed)  (native/__init__.py does this on first use.)
+
+#include <Python.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error(const std::string& msg) { g_error = msg; }
+
+void set_py_error(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = where;
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+struct Predictor {
+  PyObject* predictor;                  // paddle_tpu.inference.Predictor
+  std::vector<std::vector<float>> out_bufs;
+  std::vector<std::vector<long long>> out_shapes;
+};
+
+bool ensure_python() {
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  if (!Py_IsInitialized()) return false;
+  // Deployment hook: PD_SERVING_PYINIT holds a statement to run before
+  // the framework imports (e.g. pinning the jax backend:
+  //   import jax; jax.config.update("jax_platforms", "cpu")
+  // — env vars alone can be too late once plugins self-register).
+  const char* init = std::getenv("PD_SERVING_PYINIT");
+  if (init != nullptr && PyRun_SimpleString(init) != 0) {
+    set_error(std::string("PD_SERVING_PYINIT failed: ") + init);
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* pd_last_error(void) { return g_error.c_str(); }
+
+void* pd_predictor_create(const char* model_dir) {
+  if (!ensure_python()) {
+    set_error("CPython failed to initialize");
+    return nullptr;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  void* result = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod == nullptr) {
+    set_py_error("import paddle_tpu.inference failed");
+  } else {
+    PyObject* out = PyObject_CallMethod(
+        mod, "create_predictor_from_dir", "s", model_dir);
+    if (out == nullptr) {
+      set_py_error("create_predictor_from_dir failed");
+    } else {
+      Predictor* p = new Predictor();
+      p->predictor = out;  // owned reference
+      result = p;
+    }
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gil);
+  return result;
+}
+
+int pd_predictor_run(void* handle, const char** names,
+                     const float** data, const long long** shapes,
+                     const int* ndims, int n_inputs,
+                     const float** out_data, const long long** out_shapes,
+                     int* out_ndims, int max_outputs) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  if (p == nullptr) {
+    set_error("null predictor");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int n_out = -1;
+  PyObject* np = nullptr;
+  PyObject* feed = nullptr;
+  PyObject* outs = nullptr;
+  do {
+    np = PyImport_ImportModule("numpy");
+    if (np == nullptr) {
+      set_py_error("import numpy failed");
+      break;
+    }
+    feed = PyDict_New();
+    bool ok = true;
+    for (int i = 0; i < n_inputs && ok; ++i) {
+      long long numel = 1;
+      PyObject* shape = PyTuple_New(ndims[i]);
+      for (int d = 0; d < ndims[i]; ++d) {
+        numel *= shapes[i][d];
+        PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(shapes[i][d]));
+      }
+      PyObject* mv = PyMemoryView_FromMemory(
+          reinterpret_cast<char*>(const_cast<float*>(data[i])),
+          numel * static_cast<long long>(sizeof(float)), PyBUF_READ);
+      PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", mv,
+                                           "float32");
+      PyObject* arr = flat == nullptr
+          ? nullptr
+          : PyObject_CallMethod(flat, "reshape", "O", shape);
+      if (arr == nullptr) {
+        set_py_error("building input array failed");
+        ok = false;
+      } else {
+        PyDict_SetItemString(feed, names[i], arr);
+      }
+      Py_XDECREF(arr);
+      Py_XDECREF(flat);
+      Py_XDECREF(mv);
+      Py_DECREF(shape);
+    }
+    if (!ok) break;
+
+    outs = PyObject_CallMethod(p->predictor, "run", "(O)", feed);
+    if (outs == nullptr) {
+      set_py_error("predictor.run failed");
+      break;
+    }
+    Py_ssize_t n = PySequence_Length(outs);
+    if (n > max_outputs) {
+      set_error("more outputs than max_outputs");
+      break;
+    }
+    p->out_bufs.assign(n, {});
+    p->out_shapes.assign(n, {});
+    bool copied = true;
+    for (Py_ssize_t i = 0; i < n && copied; ++i) {
+      PyObject* item = PySequence_GetItem(outs, i);
+      PyObject* f32 = PyObject_CallMethod(np, "ascontiguousarray", "Os",
+                                          item, "float32");
+      PyObject* ravel =
+          f32 == nullptr ? nullptr
+                         : PyObject_CallMethod(f32, "tobytes", nullptr);
+      PyObject* shape = f32 == nullptr
+          ? nullptr
+          : PyObject_GetAttrString(f32, "shape");
+      if (ravel == nullptr || shape == nullptr) {
+        set_py_error("marshaling output failed");
+        copied = false;
+      } else {
+        char* buf = nullptr;
+        Py_ssize_t len = 0;
+        PyBytes_AsStringAndSize(ravel, &buf, &len);
+        p->out_bufs[i].resize(len / sizeof(float));
+        std::memcpy(p->out_bufs[i].data(), buf, len);
+        Py_ssize_t nd = PyTuple_Size(shape);
+        for (Py_ssize_t d = 0; d < nd; ++d) {
+          p->out_shapes[i].push_back(
+              PyLong_AsLongLong(PyTuple_GetItem(shape, d)));
+        }
+        out_data[i] = p->out_bufs[i].data();
+        out_shapes[i] = p->out_shapes[i].data();
+        out_ndims[i] = static_cast<int>(nd);
+      }
+      Py_XDECREF(shape);
+      Py_XDECREF(ravel);
+      Py_XDECREF(f32);
+      Py_XDECREF(item);
+    }
+    if (copied) n_out = static_cast<int>(n);
+  } while (false);
+  Py_XDECREF(outs);
+  Py_XDECREF(feed);
+  Py_XDECREF(np);
+  PyGILState_Release(gil);
+  return n_out;
+}
+
+void pd_predictor_destroy(void* handle) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  if (p == nullptr) return;
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_XDECREF(p->predictor);
+    PyGILState_Release(gil);
+  }
+  delete p;
+}
+
+}  // extern "C"
