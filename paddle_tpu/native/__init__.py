@@ -19,11 +19,21 @@ _LIBS = {}
 
 
 def _python_embed_flags():
-    """Include + link flags for libs that embed CPython (serving.cc)."""
-    out = subprocess.run(
-        ["python3-config", "--includes", "--ldflags", "--embed"],
-        check=True, capture_output=True, text=True).stdout
-    return out.split()
+    """Include + link flags for libs that embed CPython (serving.cc),
+    derived from THE RUNNING interpreter via sysconfig — a PATH
+    python3-config could belong to a different installation and link the
+    wrong libpython."""
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
+    flags = ["-I" + inc]
+    if libdir:
+        flags += ["-L" + libdir, "-Wl,-rpath," + libdir]
+    flags += ["-lpython" + ver, "-ldl", "-lm"]
+    return flags
 
 
 _EXTRA_FLAGS = {"serving": _python_embed_flags}

@@ -24,6 +24,7 @@
 
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -40,14 +41,18 @@ void set_py_error(const char* where) {
   if (value != nullptr) {
     PyObject* s = PyObject_Str(value);
     if (s != nullptr) {
-      msg += ": ";
-      msg += PyUnicode_AsUTF8(s);
+      const char* text = PyUnicode_AsUTF8(s);
+      if (text != nullptr) {
+        msg += ": ";
+        msg += text;
+      }
       Py_DECREF(s);
     }
   }
   Py_XDECREF(type);
   Py_XDECREF(value);
   Py_XDECREF(tb);
+  PyErr_Clear();  // str()/encode failures must not leak into the caller
   set_error(msg);
 }
 
@@ -57,7 +62,10 @@ struct Predictor {
   std::vector<std::vector<long long>> out_shapes;
 };
 
+std::mutex g_init_mutex;
+
 bool ensure_python() {
+  std::lock_guard<std::mutex> lock(g_init_mutex);
   if (Py_IsInitialized()) return true;
   Py_InitializeEx(0);
   if (!Py_IsInitialized()) return false;
@@ -66,11 +74,15 @@ bool ensure_python() {
   //   import jax; jax.config.update("jax_platforms", "cpu")
   // — env vars alone can be too late once plugins self-register).
   const char* init = std::getenv("PD_SERVING_PYINIT");
+  bool ok = true;
   if (init != nullptr && PyRun_SimpleString(init) != 0) {
     set_error(std::string("PD_SERVING_PYINIT failed: ") + init);
-    return false;
+    ok = false;
   }
-  return true;
+  // Release the GIL the initializing thread holds, so other threads'
+  // PyGILState_Ensure can acquire it (multithreaded C servers).
+  PyEval_SaveThread();
+  return ok;
 }
 
 }  // namespace
