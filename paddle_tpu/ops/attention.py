@@ -49,7 +49,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..core.registry import register_grad_lowering, register_op
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _BQ = 128  # query rows per block
 _BK = 128  # key rows per block
@@ -97,6 +97,14 @@ def _checked_pallas_call(kern, *, grid, in_specs, operands, out_specs,
         _assert_mosaic_ok(sp.block_shape, op.shape, f"inputs[{i}]")
     for i, (sp, sh) in enumerate(zip(specs, shapes)):
         _assert_mosaic_ok(sp.block_shape, sh.shape, f"outputs[{i}]")
+    # under shard_map, outputs vary over every mesh axis an operand does
+    # (ring attention runs these kernels per shard)
+    vma = frozenset().union(*(getattr(jax.typeof(x), "vma", frozenset())
+                              for x in operands))
+    if vma:
+        shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
+                  for s in shapes]
+        out_shape = shapes if not single_out else shapes[0]
     return pl.pallas_call(
         kern, grid=grid, in_specs=in_specs, out_specs=out_specs,
         out_shape=out_shape, scratch_shapes=scratch_shapes,
@@ -325,7 +333,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _backward_pallas(q, k, v, bias, o, lse, g, scale, want_db=False):
+def _backward_pallas(q, k, v, bias, o, lse, g, scale, want_db=False,
+                     g_lse=None):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     Sp, Skp = _pad_len(S, _BQ), _pad_len(Sk, _BK)
@@ -339,6 +348,11 @@ def _backward_pallas(q, k, v, bias, o, lse, g, scale, want_db=False):
     of = _pad_axis(o.reshape(B * H, S, D), 1, Sp)
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)    # [BH, Sp, 1]
+    if g_lse is not None:
+        # lse cotangent: dlse_i/ds_ij = p_ij, so ds gains +p*g_lse_i —
+        # algebraically a -g_lse shift of delta (ds = p*(dp - delta))
+        delta = delta - _pad_axis(
+            g_lse.reshape(B * H, S, 1).astype(jnp.float32), 1, Sp)
     # padded lse rows pair with zero g rows, so their p values are
     # harmless (ds and p^T g both vanish); zero-fill keeps exp() finite
     lse3 = _pad_axis(lse[:, :, None], 1, Sp)
@@ -516,6 +530,42 @@ def _fa_trainbias_bwd(scale, res, g):
 
 
 _fa_trainbias.defvjp(_fa_trainbias_fwd, _fa_trainbias_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fa_with_lse(q, k, v, bias, scale):
+    return _forward_pallas(q, k, v, bias, scale)
+
+
+def _fa_with_lse_fwd(q, k, v, bias, scale):
+    out, lse = _forward_pallas(q, k, v, bias, scale)
+    return (out, lse), (q, k, v, bias, out, lse)
+
+
+def _fa_with_lse_bwd(scale, res, gs):
+    q, k, v, bias, o, lse = res
+    g_out, g_lse = gs
+    dq, dk, dv, _ = _backward_pallas(q, k, v, bias, o, lse,
+                                     g_out.astype(q.dtype), scale,
+                                     g_lse=g_lse)
+    db = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, db
+
+
+_fa_with_lse.defvjp(_fa_with_lse_fwd, _fa_with_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, bias=None, scale=1.0):
+    """Fused attention returning (out [B,H,S,D], lse [B,H,S] row
+    log-sum-exps). The lse output is differentiable (its cotangent folds
+    into the backward's delta shift), which lets callers merge partial
+    attentions over key shards with logaddexp weights —
+    parallel/ring_attention.py's flash path builds on this. bias is a
+    constant mask here (stop_gradient)."""
+    bias = None if bias is None else jax.lax.stop_gradient(bias)
+    out, lse = _fa_with_lse(q, k, v, bias, scale)
+    B, H, S, _ = q.shape
+    return out, lse.reshape(B, H, S)
 
 
 def flash_attention(q, k, v, bias=None, scale=1.0, bias_grad=False):
