@@ -1,0 +1,128 @@
+// ASan/UBSan driver over the native tensor_store + datafeed C APIs
+// (SURVEY §5 race-defense/sanitizer CI row; reference runs its C++ unit
+// tests under sanitizer toolchains). Compiled by test_sanitizers.py with
+// -fsanitize=address,undefined against the .cc sources and run as a
+// standalone process; any sanitizer report fails the test.
+
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* ts_write_begin(const char* path);
+int ts_write_add(void* h, const char* name, int dtype, int ndim,
+                 const int64_t* dims, const void* data, int64_t nbytes);
+int ts_write_end(void* h);
+void* ts_read_open(const char* path);
+int ts_read_count(void* h);
+const char* ts_read_name(void* h, int i);
+int ts_read_dtype(void* h, int i);
+int ts_read_ndim(void* h, int i);
+void ts_read_dims(void* h, int i, int64_t* out);
+const void* ts_read_data(void* h, int i);
+int64_t ts_read_nbytes(void* h, int i);
+void ts_read_close(void* h);
+
+void* mdf_create(const char* files_csv, int batch_size, int n_slots,
+                 const int* types, const int* widths, int n_threads,
+                 int epochs, long long pad_value, int queue_cap);
+void mdf_start(void* h);
+void* mdf_next_batch(void* h);
+int mdf_batch_rows(void* b);
+const void* mdf_batch_data(void* b, int slot, int is_int);
+void mdf_batch_free(void* b);
+void mdf_destroy(void* h);
+}
+
+#define CHECK(cond)                                              \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      std::fprintf(stderr, "CHECK failed at %d: %s\n", __LINE__, \
+                   #cond);                                       \
+      return 1;                                                  \
+    }                                                            \
+  } while (0)
+
+static int test_tensor_store(const std::string& dir) {
+  std::string path = dir + "/t.ptck";
+  float fdata[6] = {1.f, 2.f, 3.f, 4.f, 5.f, 6.f};
+  int64_t fdims[2] = {2, 3};
+  int64_t idata[4] = {7, 8, 9, 10};
+  int64_t idims[1] = {4};
+
+  void* w = ts_write_begin(path.c_str());
+  CHECK(w != nullptr);
+  CHECK(ts_write_add(w, "wf", /*f32=*/0, 2, fdims, fdata, sizeof(fdata)));
+  CHECK(ts_write_add(w, "wi", /*i64=*/1, 1, idims, idata, sizeof(idata)));
+  CHECK(ts_write_end(w));
+
+  void* r = ts_read_open(path.c_str());
+  CHECK(r != nullptr);
+  CHECK(ts_read_count(r) == 2);
+  CHECK(std::strcmp(ts_read_name(r, 0), "wf") == 0);
+  CHECK(ts_read_ndim(r, 0) == 2);
+  int64_t dims[2] = {0, 0};
+  ts_read_dims(r, 0, dims);
+  CHECK(dims[0] == 2 && dims[1] == 3);
+  CHECK(ts_read_nbytes(r, 0) == (int64_t)sizeof(fdata));
+  CHECK(std::memcmp(ts_read_data(r, 0), fdata, sizeof(fdata)) == 0);
+  CHECK(std::memcmp(ts_read_data(r, 1), idata, sizeof(idata)) == 0);
+  ts_read_close(r);
+  std::printf("tensor_store ok\n");
+  return 0;
+}
+
+static int test_datafeed(const std::string& dir) {
+  std::string f = dir + "/feed.txt";
+  {
+    std::ofstream out(f);
+    // 2 slots per line: int slot (<=3 ids), float slot (2 values)
+    out << "3 1 2 3 2 0.5 0.25\n";
+    out << "1 9 2 1.0 2.0\n";
+    out << "2 4 5 2 3.5 4.5\n";
+    out << "1 6 2 5.5 6.5\n";
+  }
+  int types[2] = {0, 1};
+  int widths[2] = {3, 2};
+  void* h = mdf_create(f.c_str(), /*batch=*/2, 2, types, widths,
+                       /*threads=*/2, /*epochs=*/1, /*pad=*/0,
+                       /*queue_cap=*/4);
+  CHECK(h != nullptr);
+  mdf_start(h);
+  int total_rows = 0;
+  void* b;
+  while ((b = mdf_next_batch(h)) != nullptr) {
+    int rows = mdf_batch_rows(b);
+    total_rows += rows;
+    const int64_t* ints = (const int64_t*)mdf_batch_data(b, 0, 1);
+    const float* floats = (const float*)mdf_batch_data(b, 1, 0);
+    CHECK(ints != nullptr && floats != nullptr);
+    for (int i = 0; i < rows * widths[0]; ++i) {
+      CHECK(ints[i] >= 0 && ints[i] <= 9);
+    }
+    for (int i = 0; i < rows * widths[1]; ++i) {
+      CHECK(floats[i] >= 0.0f && floats[i] <= 6.5f);
+    }
+    mdf_batch_free(b);
+  }
+  mdf_destroy(h);
+  CHECK(total_rows == 4);
+  std::printf("datafeed ok\n");
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: asan_driver <tmpdir>\n");
+    return 2;
+  }
+  if (test_tensor_store(argv[1])) return 1;
+  if (test_datafeed(argv[1])) return 1;
+  std::printf("ASAN DRIVER OK\n");
+  return 0;
+}

@@ -1,0 +1,101 @@
+// TSan driver over the threaded PS transport (native/ps_service.cc):
+// a server plus two concurrent client threads doing set/get/send/barrier
+// traffic — the exact lock/queue paths the Python cluster tests exercise,
+// but under ThreadSanitizer so data races fail deterministically
+// (SURVEY §5 race-defense CI row).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* ps_server_create(int port, int num_trainers, int sync);
+int ps_server_port(void* h);
+void ps_server_start(void* h);
+void ps_server_stop(void* h);
+void ps_server_destroy(void* h);
+void ps_server_set_var(void* h, const char* name, int dtype, int ndim,
+                       const int64_t* dims, const void* data);
+void* ps_server_pop_async(void* h, int timeout_ms);
+
+int ps_batch_count(void* b);
+const char* ps_batch_name(void* b, int i);
+void ps_batch_free(void* b);
+
+void* ps_client_create(const char* host, int port, int trainer_id);
+void ps_client_destroy(void* h);
+int ps_client_connect(void* h);
+int ps_client_send_var(void* h, const char* name, int dtype, int ndim,
+                       const int64_t* dims, int64_t nrows,
+                       const int64_t* rows, const void* data,
+                       int64_t nbytes);
+void* ps_client_get_var(void* h, const char* name);
+int ps_client_complete(void* h);
+}
+
+#define CHECK(cond)                                              \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      std::fprintf(stderr, "CHECK failed at %d: %s\n", __LINE__, \
+                   #cond);                                       \
+      std::exit(1);                                              \
+    }                                                            \
+  } while (0)
+
+int main() {
+  void* server = ps_server_create(/*port=*/0, /*num_trainers=*/2,
+                                  /*sync=*/0);
+  CHECK(server != nullptr);
+  ps_server_start(server);
+  int port = ps_server_port(server);
+  CHECK(port > 0);
+
+  float w[8];
+  for (int i = 0; i < 8; ++i) w[i] = 0.125f * i;
+  int64_t dims[1] = {8};
+  ps_server_set_var(server, "w", /*f32=*/0, 1, dims, w);
+
+  auto client_fn = [&](int tid) {
+    void* c = ps_client_create("127.0.0.1", port, tid);
+    CHECK(c != nullptr);
+    CHECK(ps_client_connect(c) == 1);  // returns bool success
+    for (int round = 0; round < 5; ++round) {
+      void* got = ps_client_get_var(c, "w");
+      CHECK(got != nullptr);
+      CHECK(ps_batch_count(got) == 1);
+      CHECK(std::strcmp(ps_batch_name(got, 0), "w") == 0);
+      ps_batch_free(got);
+      float g[8];
+      for (int i = 0; i < 8; ++i) g[i] = 0.01f * (tid + 1) * i;
+      char name[32];
+      std::snprintf(name, sizeof(name), "w@GRAD.t%d", tid);
+      CHECK(ps_client_send_var(c, name, 0, 1, dims, 0, nullptr, g,
+                         sizeof(g)) == 1);
+    }
+    ps_client_complete(c);
+    ps_client_destroy(c);
+  };
+
+  std::thread t0(client_fn, 0);
+  std::thread t1(client_fn, 1);
+
+  // drain the async grad queue concurrently with the senders
+  int drained = 0;
+  while (drained < 10) {
+    void* b = ps_server_pop_async(server, 2000);
+    if (b == nullptr) break;
+    drained += ps_batch_count(b);
+    ps_batch_free(b);
+  }
+
+  t0.join();
+  t1.join();
+  CHECK(drained == 10);
+  ps_server_stop(server);
+  ps_server_destroy(server);
+  std::printf("TSAN DRIVER OK\n");
+  return 0;
+}
