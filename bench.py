@@ -240,6 +240,33 @@ WORKLOADS = {
 }
 
 
+def _probe_backend(timeout_s=None):
+    """Fail fast (with a diagnosable JSON row) if jax backend init hangs —
+    a wedged TPU tunnel blocks inside a C call that no KeyboardInterrupt
+    reaches, so a watchdog thread + os._exit is the only way out."""
+    import threading
+
+    timeout_s = timeout_s or int(
+        os.environ.get("PADDLE_TPU_BENCH_INIT_TIMEOUT", "300"))
+    ok = []
+
+    def probe():
+        import jax
+
+        ok.append(str(jax.devices()))
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not ok:
+        print(json.dumps({
+            "metric": "backend_init",
+            "error": "jax backend init did not complete within %ds "
+                     "(TPU tunnel unreachable/wedged)" % timeout_s,
+        }), flush=True)
+        os._exit(1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", choices=sorted(WORKLOADS), default=None,
@@ -248,6 +275,7 @@ def main():
     ap.add_argument("--quick", action="store_true",
                     help="tiny batches (smoke test)")
     args = ap.parse_args()
+    _probe_backend()
 
     names = [args.only] if args.only else list(WORKLOADS)
     failures = 0
