@@ -142,7 +142,8 @@ class ParallelEngine:
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
         feed_shardings = {
-            n: NamedSharding(mesh, self.rules.feed_spec(feed_vals[n].shape, mesh))
+            n: NamedSharding(mesh, self.rules.feed_spec(
+                feed_vals[n].shape, mesh, name=n))
             for n in feed_names
         }
         state_shardings = {}

@@ -21,14 +21,24 @@ __all__ = ["ShardingRules", "P"]
 
 class ShardingRules:
     def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None,
-                 data_axis: str = "data"):
+                 data_axis: str = "data",
+                 feed_rules: Optional[Sequence[Tuple[str, P]]] = None):
         self.rules: List[Tuple[re.Pattern, P]] = [
             (re.compile(pat), spec) for pat, spec in (rules or [])
+        ]
+        # per-feed specs by name pattern (e.g. sequence parallelism:
+        # ids [B, S] as P("data", "seq")); unmatched feeds batch-shard
+        self.feed_rules: List[Tuple[re.Pattern, P]] = [
+            (re.compile(pat), spec) for pat, spec in (feed_rules or [])
         ]
         self.data_axis = data_axis
 
     def add(self, pattern: str, spec: P) -> "ShardingRules":
         self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def add_feed(self, pattern: str, spec: P) -> "ShardingRules":
+        self.feed_rules.append((re.compile(pattern), spec))
         return self
 
     def spec_for(self, name: str, shape, mesh: Mesh) -> P:
@@ -41,10 +51,17 @@ class ShardingRules:
                 break
         return P()
 
-    def feed_spec(self, shape, mesh: Mesh) -> P:
-        """Batch-shard feeds on dim 0 (FeedAndSplitTensorIntoLocalScopes
-        analog, parallel_executor.cc:468): the user feeds the global batch
-        and it is split across the data axis of the mesh."""
+    def feed_spec(self, shape, mesh: Mesh, name: str = "") -> P:
+        """Spec for one feed. A matching feed_rule wins (sequence/context
+        parallelism shards the time axis too); otherwise batch-shard on
+        dim 0 (FeedAndSplitTensorIntoLocalScopes analog,
+        parallel_executor.cc:468): the user feeds the global batch and it
+        is split across the data axis of the mesh."""
+        for pat, spec in self.feed_rules:
+            if name and pat.search(name):
+                if _divides(spec, shape, mesh):
+                    return spec
+                break
         if self.data_axis not in mesh.axis_names:
             return P()
         n = mesh.shape[self.data_axis]
