@@ -228,3 +228,106 @@ def test_label_semantic_roles_crf(fresh_programs):
                                  "length": LEN[:B]},
                      fetch_list=[decode.name], scope=scope)
     assert (d == GOLD[:B]).mean() > 0.9, (d == GOLD[:B]).mean()
+
+
+def test_image_classification_cifar_conv_bn(fresh_programs):
+    """tests/book/test_image_classification.py analog: conv+bn resnet-ish
+    blocks on cifar10, trains to better-than-chance accuracy."""
+    main, startup, scope = fresh_programs
+    from paddle_tpu.dataset import cifar
+
+    def conv_bn(x, ch, filter_size, stride, padding, act="relu"):
+        c = layers.conv2d(x, num_filters=ch, filter_size=filter_size,
+                          stride=stride, padding=padding, act=None,
+                          bias_attr=False)
+        return layers.batch_norm(c, act=act)
+
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [3, 32, 32])
+        lbl = layers.data("lbl", [1], dtype="int64")
+        t = conv_bn(img, 16, 3, 1, 1)
+        t = conv_bn(t, 32, 3, 2, 1)
+        t = conv_bn(t, 32, 3, 2, 1)
+        pool = layers.pool2d(t, pool_size=8, pool_type="avg")
+        probs = layers.fc(pool, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(probs, lbl))
+        acc = layers.accuracy(probs, lbl)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rows = list(cifar.train10(n=512)())
+        accs = []
+        for epoch in range(4):
+            for i in range(0, 512, 64):
+                batch = rows[i:i + 64]
+                feed = {
+                    "img": np.stack([b[0] for b in batch]).reshape(
+                        -1, 3, 32, 32),
+                    "lbl": np.array([[b[1]] for b in batch], "int64"),
+                }
+                lv, av = exe.run(main, feed=feed, fetch_list=[loss, acc],
+                                 scope=scope)
+                accs.append(float(av))
+        assert np.mean(accs[-4:]) > 0.5, accs[-4:]  # chance = 0.1
+
+
+def test_recommender_system(fresh_programs):
+    """tests/book/test_recommender_system.py analog: user/movie towers
+    (embeddings + fc) -> cos_sim -> scale to rating; trains on the
+    movielens reader until square error drops."""
+    main, startup, scope = fresh_programs
+    from paddle_tpu.dataset import movielens
+
+    B = 64
+    with fluid.program_guard(main, startup):
+        uid = layers.data("uid", [1], dtype="int64")
+        gender = layers.data("gender", [1], dtype="int64")
+        age = layers.data("age", [1], dtype="int64")
+        job = layers.data("job", [1], dtype="int64")
+        mid = layers.data("mid", [1], dtype="int64")
+        score = layers.data("score", [1])
+
+        def tower(parts):
+            feats = []
+            for var, size in parts:
+                emb = layers.embedding(var, size=[size, 16])
+                feats.append(layers.reshape(emb, shape=[-1, 16]))
+            return layers.fc(layers.concat(feats, axis=1), size=32,
+                             act="tanh")
+
+        usr = tower([(uid, movielens.max_user_id() + 1),
+                     (gender, 2),
+                     (age, len(movielens.age_table)),
+                     (job, movielens.max_job_id() + 1)])
+        mov = tower([(mid, movielens.max_movie_id() + 1)])
+        sim = layers.cos_sim(usr, mov)
+        pred = layers.scale(sim, scale=5.0)
+        loss = layers.mean(layers.square_error_cost(pred, score))
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rows = list(movielens.train()())[:1024]
+
+        def feed_of(batch):
+            cols = list(zip(*[(r[0], r[1], r[2], r[3], r[4], r[7][0])
+                              for r in batch]))
+            return {
+                "uid": np.array(cols[0], "int64")[:, None],
+                "gender": np.array(cols[1], "int64")[:, None],
+                "age": np.array(cols[2], "int64")[:, None],
+                "job": np.array(cols[3], "int64")[:, None],
+                "mid": np.array(cols[4], "int64")[:, None],
+                "score": np.array(cols[5], "float32")[:, None],
+            }
+
+        losses = []
+        for epoch in range(6):
+            for i in range(0, 1024, B):
+                (lv,) = exe.run(main, feed=feed_of(rows[i:i + B]),
+                                fetch_list=[loss], scope=scope)
+                losses.append(float(lv))
+        assert np.isfinite(losses).all()
+        assert np.mean(losses[-4:]) < 0.5 * np.mean(losses[:4]), (
+            np.mean(losses[:4]), np.mean(losses[-4:]))
