@@ -40,11 +40,18 @@ def _bert_embed(src_ids, sent_ids, cfg, seq_len, is_test):
 
 
 def build(cfg=None, seq_len=128, max_mask=20, is_test=False,
-          use_fused_attention=True):
+          use_fused_attention=None):
     """MLM training graph. Feeds: src_ids/sent_ids [B,S] int64,
     input_mask [B,S] float (1=real token), mask_pos [B,max_mask] int64
     (flattened B*S positions), mask_label [B,max_mask] int64 (pad rows
-    point at position 0 with weight 0 via mask_weight)."""
+    point at position 0 with weight 0 via mask_weight).
+    use_fused_attention defaults to the PADDLE_TPU_FUSED_ATTENTION env
+    flag (default on) so hardware A/B runs need no code edit."""
+    if use_fused_attention is None:
+        import os
+
+        use_fused_attention = os.environ.get(
+            "PADDLE_TPU_FUSED_ATTENTION", "1") != "0"
     cfg = cfg or base_config()
     src_ids = layers.data("src_ids", [seq_len], dtype="int64")
     sent_ids = layers.data("sent_ids", [seq_len], dtype="int64")

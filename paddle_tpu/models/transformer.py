@@ -160,8 +160,16 @@ def _causal_bias(seq_len):
 
 
 def build(cfg=None, seq_len=64, is_test=False, label_smooth_eps=0.1,
-          use_fused_attention=True):
-    """Full training graph. Returns (avg_cost, feeds)."""
+          use_fused_attention=None):
+    """Full training graph. Returns (avg_cost, feeds).
+
+    use_fused_attention defaults to the PADDLE_TPU_FUSED_ATTENTION env
+    flag (default on) so hardware A/B runs need no code edit."""
+    if use_fused_attention is None:
+        import os
+
+        use_fused_attention = os.environ.get(
+            "PADDLE_TPU_FUSED_ATTENTION", "1") != "0"
     cfg = cfg or base_config()
     src = layers.data("src_ids", [seq_len], dtype="int64")
     trg = layers.data("trg_ids", [seq_len], dtype="int64")
