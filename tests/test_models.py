@@ -82,6 +82,19 @@ def test_resnet50_builds_and_steps():
     assert np.all(np.isfinite(ls))
 
 
+def test_se_resnext_builds_and_steps():
+    from paddle_tpu.models import se_resnext
+
+    def feed():
+        return {"img": RS.rand(2, 3, 32, 32).astype("float32"),
+                "label": RS.randint(0, 10, (2, 1)).astype("int64")}
+
+    ls = _train(lambda: se_resnext.build(class_dim=10,
+                                         image_shape=(3, 32, 32)),
+                feed, steps=2, lr=1e-4)
+    assert np.all(np.isfinite(ls))
+
+
 def test_mnist_model_builds():
     def feed():
         return {"img": RS.rand(8, 784).astype("float32"),
