@@ -11,8 +11,9 @@ hand-built as op handles (SURVEY §2.9).
 from .engine import ParallelEngine
 from .sharding import ShardingRules
 from .env import init_parallel_env, ParallelEnv
+from .moe import moe_apply
 from .pipeline import pipeline_apply
 from .ring_attention import ring_attention
 
 __all__ = ["ParallelEngine", "ShardingRules", "init_parallel_env",
-           "ParallelEnv", "pipeline_apply", "ring_attention"]
+           "ParallelEnv", "moe_apply", "pipeline_apply", "ring_attention"]

@@ -1,0 +1,85 @@
+"""Expert parallelism: top-1 switch-style MoE over an 'expert' mesh axis.
+
+The reference (Fluid v1.3) has no mixture-of-experts; this is the
+TPU-first 'ep' extension completing the dp/tp/sp/pp/ep set: experts are
+sharded one-per-device over a mesh axis, tokens route to their expert
+with lax.all_to_all (the ICI shuffle), compute their expert FFN locally,
+and shuffle back. Capacity is static (XLA needs static shapes): each
+device sends up to `capacity` tokens per expert; overflow tokens drop to
+zero contribution, exactly the Switch-Transformer discipline.
+
+Differentiable end to end (all_to_all transposes to the reverse
+shuffle); the router's load-balancing aux loss follows Switch (mean
+fraction x mean probability per expert).
+
+Use under shard_map with expert weights sharded on the axis:
+
+    fn = shard_map(lambda w1, b1, w2, b2, x: moe_apply(...),
+                   mesh, in_specs=(P("expert"), ..., P()), out_specs=P())
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["moe_apply"]
+
+
+def moe_apply(expert_params, gate_w, x, axis_name, capacity=None):
+    """Route tokens to per-device experts and back.
+
+    expert_params: pytree with leading expert dim sharded on `axis_name`
+        (each device sees its slice of size 1); applied as
+        h = relu(x @ w1 + b1); y = h @ w2 + b2 for (w1, b1, w2, b2).
+    gate_w: [D, E] router weights (replicated).
+    x: [T, D] local tokens (the data may also be sharded on another axis).
+    capacity: max tokens each device routes to EACH expert (static);
+        default ceil(2 * T / E).
+
+    Returns ([T, D] outputs, aux_loss scalar).
+    """
+    E = int(lax.psum(1, axis_name))
+    T, D = x.shape
+    capacity = int(capacity or -(-2 * T // E))
+
+    logits = x @ gate_w                      # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [T] top-1 routing
+    gate = jnp.max(probs, axis=-1)           # [T] the chosen prob
+
+    # Switch aux loss: E * mean(fraction_per_expert * prob_per_expert)
+    onehot = jax.nn.one_hot(expert_idx, E)
+    frac = jnp.mean(onehot, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+
+    # position of each token within its expert's send buffer
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # [T, E]
+    pos = (jnp.sum(pos_in_expert, axis=-1) - 1).astype(jnp.int32)
+    keep = pos < capacity
+
+    # scatter tokens into the [E, capacity, D] send buffer
+    buf = jnp.zeros((E, capacity, D), x.dtype)
+    safe_e = jnp.where(keep, expert_idx, 0)
+    safe_p = jnp.where(keep, pos, 0)
+    buf = buf.at[safe_e, safe_p].add(
+        jnp.where(keep[:, None], x, 0.0))
+
+    # all_to_all: dim 0 (expert) scatters, tokens from every device
+    # gather on the expert's device -> [E, capacity, D] = per-source rows
+    recv = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+    w1, b1, w2, b2 = jax.tree.map(lambda p: p[0], expert_params)
+    h = jax.nn.relu(recv.reshape(-1, D) @ w1 + b1)
+    y = (h @ w2 + b2).reshape(E, capacity, D)
+
+    # shuffle results back to the token owners
+    back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                    # [E, capacity, D]
+
+    out = back[safe_e, safe_p]                           # [T, D]
+    out = jnp.where(keep[:, None], out, 0.0)
+    return out * gate[:, None], aux
