@@ -10,10 +10,13 @@
 // deployment process links this .so and never touches Python itself.
 //
 //   void*  pd_predictor_create(const char* model_dir);
-//   int    pd_predictor_run(h, names, data, shapes, ndims, n_inputs,
-//                           out_data, out_shapes, out_ndims, max_outputs);
-//          -> number of outputs (buffers owned by the library until the
-//             next run/destroy), or -1 (see pd_last_error()).
+//   int    pd_predictor_run_ex(h, names, data, dtypes, shapes, ndims,
+//                              n_inputs, out_data, out_shapes, out_ndims,
+//                              max_outputs);
+//          dtype codes (native/dtypes.py): 0=f32, 1=i64, 3=i32
+//          -> number of outputs (f32 buffers owned by the library until
+//             the next run/destroy), or -1 (see pd_last_error()).
+//   int    pd_predictor_run(...);  // float32-only convenience wrapper
 //   void   pd_predictor_destroy(void* h);
 //   const char* pd_last_error(void);
 //
@@ -117,11 +120,31 @@ void* pd_predictor_create(const char* model_dir) {
   return result;
 }
 
-int pd_predictor_run(void* handle, const char** names,
-                     const float** data, const long long** shapes,
-                     const int* ndims, int n_inputs,
-                     const float** out_data, const long long** out_shapes,
-                     int* out_ndims, int max_outputs) {
+// dtype codes follow native/dtypes.py: 0=float32, 1=int64, 3=int32.
+static const char* dtype_name(int code) {
+  switch (code) {
+    case 0: return "float32";
+    case 1: return "int64";
+    case 3: return "int32";
+    default: return nullptr;
+  }
+}
+
+static int dtype_size(int code) {
+  switch (code) {
+    case 0: return 4;
+    case 1: return 8;
+    case 3: return 4;
+    default: return 0;
+  }
+}
+
+int pd_predictor_run_ex(void* handle, const char** names,
+                        const void** data, const int* dtypes,
+                        const long long** shapes, const int* ndims,
+                        int n_inputs, const float** out_data,
+                        const long long** out_shapes, int* out_ndims,
+                        int max_outputs) {
   Predictor* p = static_cast<Predictor*>(handle);
   if (p == nullptr) {
     set_error("null predictor");
@@ -141,6 +164,12 @@ int pd_predictor_run(void* handle, const char** names,
     feed = PyDict_New();
     bool ok = true;
     for (int i = 0; i < n_inputs && ok; ++i) {
+      const char* dt = dtype_name(dtypes[i]);
+      if (dt == nullptr) {
+        set_error("unsupported input dtype code");
+        ok = false;
+        break;
+      }
       long long numel = 1;
       PyObject* shape = PyTuple_New(ndims[i]);
       for (int d = 0; d < ndims[i]; ++d) {
@@ -148,10 +177,10 @@ int pd_predictor_run(void* handle, const char** names,
         PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(shapes[i][d]));
       }
       PyObject* mv = PyMemoryView_FromMemory(
-          reinterpret_cast<char*>(const_cast<float*>(data[i])),
-          numel * static_cast<long long>(sizeof(float)), PyBUF_READ);
-      PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", mv,
-                                           "float32");
+          reinterpret_cast<char*>(const_cast<void*>(data[i])),
+          numel * static_cast<long long>(dtype_size(dtypes[i])),
+          PyBUF_READ);
+      PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", mv, dt);
       PyObject* arr = flat == nullptr
           ? nullptr
           : PyObject_CallMethod(flat, "reshape", "O", shape);
@@ -221,6 +250,19 @@ int pd_predictor_run(void* handle, const char** names,
   Py_XDECREF(np);
   PyGILState_Release(gil);
   return n_out;
+}
+
+int pd_predictor_run(void* handle, const char** names,
+                     const float** data, const long long** shapes,
+                     const int* ndims, int n_inputs,
+                     const float** out_data, const long long** out_shapes,
+                     int* out_ndims, int max_outputs) {
+  // float32-only convenience wrapper over pd_predictor_run_ex
+  std::vector<int> dtypes(n_inputs, 0);
+  return pd_predictor_run_ex(handle, names,
+                             reinterpret_cast<const void**>(data),
+                             dtypes.data(), shapes, ndims, n_inputs,
+                             out_data, out_shapes, out_ndims, max_outputs);
 }
 
 void pd_predictor_destroy(void* handle) {
