@@ -131,6 +131,34 @@ def bench_transformer(amp, quick):
                          "tokens/sec", batch * seq, build, feed, amp, quick=quick)
 
 
+def bench_transformer_long(amp, quick):
+    """Long-context variant (S=1024): the fused flash-attention path's
+    showcase — the composed path materializes [S, S] scores per head."""
+    import paddle_tpu.models.transformer as transformer
+
+    seq, batch = 1024, (2 if quick else 32)
+    cfg = transformer.base_config()
+    cfg["max_length"] = seq
+
+    def build():
+        loss, _ = transformer.build(cfg, seq_len=seq)
+        import paddle_tpu as fluid
+
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        return loss
+
+    def feed():
+        rs = np.random.RandomState(0)
+        return {
+            "src_ids": rs.randint(1, cfg["src_vocab"], (batch, seq)).astype("int64"),
+            "trg_ids": rs.randint(1, cfg["trg_vocab"], (batch, seq)).astype("int64"),
+            "lbl_ids": rs.randint(1, cfg["trg_vocab"], (batch, seq)).astype("int64"),
+        }
+
+    return _run_workload("transformer_base_s1024_train_tokens_per_sec_per_chip",
+                         "tokens/sec", batch * seq, build, feed, amp, quick=quick)
+
+
 def bench_resnet50(amp, quick):
     import paddle_tpu.models.resnet as resnet
 
@@ -233,6 +261,7 @@ def bench_deepfm(amp, quick):
 
 WORKLOADS = {
     "transformer": bench_transformer,
+    "transformer_long": bench_transformer_long,
     "resnet50": bench_resnet50,
     "vgg16": bench_vgg16,
     "bert": bench_bert,
