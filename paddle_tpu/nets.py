@@ -6,7 +6,7 @@ from __future__ import annotations
 from . import layers
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "glu",
-           "scaled_dot_product_attention"]
+           "scaled_dot_product_attention", "sequence_conv_pool"]
 
 
 def simple_img_conv_pool(input, num_filters, filter_size, pool_size, pool_stride,
@@ -69,3 +69,16 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     if dropout_rate:
         weights = layers.dropout(weights, dropout_rate)
     return layers.matmul(weights, values)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, length=None,
+                       param_attr=None, act="sigmoid", pool_type="max",
+                       bias_attr=None):
+    """reference nets.py:248 — sequence_conv + sequence_pool over a
+    padded [B, T, D] batch (`length` replaces LoD, the sequence-family
+    contract of layers/sequence.py)."""
+    conv = layers.sequence_conv(input, num_filters=num_filters,
+                                filter_size=filter_size, length=length,
+                                param_attr=param_attr, act=act,
+                                bias_attr=bias_attr)
+    return layers.sequence_pool(conv, pool_type=pool_type, length=length)
