@@ -185,9 +185,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32)          # [bq, D]
-    k = k_ref[0].astype(jnp.float32)          # [bk, D]
-    v = v_ref[0].astype(jnp.float32)          # [bk, D]
+    # dots run at the INPUT dtype (bf16 hits the MXU at full rate) with
+    # f32 accumulation; only the softmax state is explicitly f32
+    q = q_ref[0]                              # [bq, D]
+    k = k_ref[0]                              # [bk, D]
+    v = v_ref[0]                              # [bk, D]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if b_ref is not None:
@@ -197,11 +199,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
     l_prev = l_ref[...]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                    # [bq, bk]
+    p = jnp.exp(s - m_new)                    # [bq, bk] f32
     l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
     m_ref[...] = m_new
-    acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
-        p, v, preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
     def _emit():
@@ -271,10 +274,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0].astype(jnp.float32)          # [bq, D]
-    k = k_ref[0].astype(jnp.float32)          # [bk, D]
-    v = v_ref[0].astype(jnp.float32)          # [bk, D]
-    g = g_ref[0].astype(jnp.float32)          # [bq, D]
+    q = q_ref[0]                              # [bq, D]
+    k = k_ref[0]                              # [bk, D]
+    v = v_ref[0]                              # [bk, D]
+    g = g_ref[0]                              # [bq, D]
     lse = lse_ref[0]                          # [bq, 1]
     delta = d_ref[0]                          # [bq, 1]
 
@@ -282,16 +285,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
                             preferred_element_type=jnp.float32) * scale
     if b_ref is not None:
         s = s + b_ref[0, 0].astype(jnp.float32)
-    p = jnp.exp(s - lse)                      # [bq, bk]
+    p = jnp.exp(s - lse)                      # [bq, bk] f32
 
     # dv += p^T g ; dp = g v^T ; ds = p*(dp - delta)*scale ; dk += ds^T q
-    dv_acc[...] += jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
-                                       preferred_element_type=jnp.float32)
+    dv_acc[...] += jax.lax.dot_general(
+        p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta) * scale
-    dk_acc[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                       preferred_element_type=jnp.float32)
+    dk_acc[...] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     if ds_ref is not None:
         # raw score gradient (pre-scale is ds/scale; bias adds after the
         # scale, so its cotangent is ds without the trailing *scale)
@@ -311,10 +316,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    g = g_ref[0]
     lse = lse_ref[0]                          # [bq, 1]
     delta = d_ref[0]                          # [bq, 1]
 
@@ -325,8 +330,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale             # [bq, bk]
-    dq_acc[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale             # [bq, bk] f32
+    dq_acc[...] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
     def _emit():
