@@ -10,7 +10,8 @@ import random as _random
 import threading
 
 __all__ = ["batch", "shuffle", "buffered", "cache", "map_readers",
-           "xmap_readers", "chain", "compose", "firstn"]
+           "xmap_readers", "chain", "compose", "firstn",
+           "multiprocess_reader"]
 
 
 def batch(reader, batch_size, drop_last=False):
@@ -150,3 +151,38 @@ def firstn(reader, n):
         yield from itertools.islice(reader(), n)
 
     return firstn_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """reference reader/decorator.py:338 — run several readers
+    concurrently and interleave their samples. The reference forks
+    processes (GIL-bound cv2 decoding); here readers drive jax/numpy
+    which release the GIL, so worker THREADS give the same overlap
+    without fork-vs-PJRT hazards (documented divergence)."""
+    import queue as _queue
+    import threading
+
+    def reader():
+        q = _queue.Queue(maxsize=queue_size)
+        sentinel = object()
+
+        def work(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+            finally:
+                q.put(sentinel)
+
+        threads = [threading.Thread(target=work, args=(r,), daemon=True)
+                   for r in readers]
+        for t in threads:
+            t.start()
+        done = 0
+        while done < len(readers):
+            item = q.get()
+            if item is sentinel:
+                done += 1
+            else:
+                yield item
+
+    return reader
