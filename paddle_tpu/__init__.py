@@ -34,7 +34,8 @@ from . import contrib, flags, inference, reader, transpiler  # noqa: F401
 from .reader import batch  # noqa: F401  (paddle.batch top-level parity)
 from .flags import get_flag, set_flag  # noqa: F401
 from .async_executor import AsyncExecutor, DataFeedDesc  # noqa: F401
-from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                       ExecutionStrategy, ParallelExecutor)
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .core.executor import Executor  # noqa: F401
 from .core.place import CPUPlace, CUDAPlace, TPUPlace, is_compiled_with_tpu  # noqa: F401

@@ -87,3 +87,43 @@ class CompiledProgram:
                 places=self._places,
             )
         return self._engine.run(feed, fetch_list, scope, return_numpy)
+
+
+class ParallelExecutor:
+    """User-facing multi-device executor (reference
+    parallel_executor.py:81 — deprecated there in favor of
+    CompiledProgram, kept for API parity). Wraps the mesh ParallelEngine:
+    feeds split over the data axis, one SPMD executable per feed
+    signature."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .core.program import default_main_program
+        from .core.scope import global_scope
+        from .parallel.engine import ParallelEngine
+
+        self._program = main_program or default_main_program()
+        self._scope = scope or global_scope()
+        self._engine = ParallelEngine(self._program, loss_name=loss_name,
+                                      build_strategy=build_strategy)
+
+    @property
+    def device_count(self):
+        return self._engine.device_count
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        if isinstance(feed, (list, tuple)):
+            # per-device pre-split feeds: concatenate back to the global
+            # batch (the engine re-splits over the mesh)
+            import numpy as np
+
+            merged = {}
+            for d in feed:
+                for k, v in d.items():
+                    merged.setdefault(k, []).append(v)
+            feed = {k: np.concatenate(v, axis=0) for k, v in merged.items()}
+        return self._engine.run(feed or {}, fetch_list, self._scope,
+                                return_numpy)

@@ -151,3 +151,24 @@ def test_sequence_parallel_feed_rules():
     sp = run(True)
     np.testing.assert_allclose(single, sp, rtol=1e-4, atol=1e-5)
     assert single[-1] < single[0]
+
+
+def test_parallel_executor_api():
+    """fluid.ParallelExecutor parity wrapper (reference
+    parallel_executor.py:81): dict feeds split over the mesh; a list of
+    per-device dicts concatenates back to the global batch."""
+    main, startup, loss = _build_mlp_program()
+    scope = fluid.core.scope.Scope()
+    with fluid.core.scope.scope_guard(scope):
+        fluid.Executor(fluid.TPUPlace()).run(startup, scope=scope)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main, scope=scope)
+        assert pe.device_count == len(jax.devices())
+        x, y = next(iter(_batches(1)))
+        (l1,) = pe.run([loss.name], feed={"x": x, "y": y})
+        half = len(x) // 2
+        (l2,) = pe.run([loss.name],
+                       feed=[{"x": x[:half], "y": y[:half]},
+                             {"x": x[half:], "y": y[half:]}])
+        assert np.isfinite(float(np.asarray(l1).reshape(-1)[0]))
+        assert np.isfinite(float(np.asarray(l2).reshape(-1)[0]))
