@@ -13,7 +13,8 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+           "ParallelExecutor"]
 
 
 class BuildStrategy:
@@ -105,7 +106,15 @@ class ParallelExecutor:
         from .parallel.engine import ParallelEngine
 
         self._program = main_program or default_main_program()
+        if scope is None and share_vars_from is not None:
+            # reference semantics: a test-program executor reuses the
+            # training executor's variables; here vars live in the scope
+            scope = share_vars_from._scope
         self._scope = scope or global_scope()
+        build_strategy = build_strategy or BuildStrategy()
+        build_strategy.num_trainers = num_trainers
+        build_strategy.trainer_id = trainer_id
+        self._exec_strategy = exec_strategy
         self._engine = ParallelEngine(self._program, loss_name=loss_name,
                                       build_strategy=build_strategy)
 
@@ -116,10 +125,19 @@ class ParallelExecutor:
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else feed_dict
         if isinstance(feed, (list, tuple)):
-            # per-device pre-split feeds: concatenate back to the global
-            # batch (the engine re-splits over the mesh)
+            # per-device pre-split feeds: validate per the reference
+            # contract, then concatenate back to the global batch (the
+            # engine re-splits over the mesh)
             import numpy as np
 
+            if len(feed) != self.device_count:
+                raise ValueError(
+                    "Feed a list of tensor, the list should be the same "
+                    "size as places (%d), got %d"
+                    % (self.device_count, len(feed)))
+            if any(not isinstance(d, dict) for d in feed):
+                raise TypeError(
+                    "Each element of feed list should be a dict")
             merged = {}
             for d in feed:
                 for k, v in d.items():

@@ -166,9 +166,19 @@ def test_parallel_executor_api():
         assert pe.device_count == len(jax.devices())
         x, y = next(iter(_batches(1)))
         (l1,) = pe.run([loss.name], feed={"x": x, "y": y})
-        half = len(x) // 2
-        (l2,) = pe.run([loss.name],
-                       feed=[{"x": x[:half], "y": y[:half]},
-                             {"x": x[half:], "y": y[half:]}])
+        per = len(x) // pe.device_count
+        split = [{"x": x[i * per:(i + 1) * per],
+                  "y": y[i * per:(i + 1) * per]}
+                 for i in range(pe.device_count)]
+        (l2,) = pe.run([loss.name], feed=split)
         assert np.isfinite(float(np.asarray(l1).reshape(-1)[0]))
         assert np.isfinite(float(np.asarray(l2).reshape(-1)[0]))
+        # reference contract: list length must equal device_count
+        import pytest
+
+        with pytest.raises(ValueError, match="same size as places"):
+            pe.run([loss.name], feed=split[:2])
+        # share_vars_from adopts the training executor's scope
+        pe2 = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                     main_program=main, share_vars_from=pe)
+        assert pe2._scope is scope
