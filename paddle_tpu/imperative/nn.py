@@ -136,3 +136,36 @@ class Embedding(Layer):
     def forward(self, ids: VarBase) -> VarBase:
         return trace_op("lookup_table",
                         {"Ids": [ids], "W": [self._w]}, {})["Out"][0]
+
+
+class GRUUnit(Layer):
+    """Single GRU step layer (reference imperative/nn.py:474). `size` is
+    3 * hidden_dim, matching the graph-mode layers.gru_unit contract."""
+
+    def __init__(self, name_scope: str, size: int, param_attr=None,
+                 bias_attr=None, activation="tanh",
+                 gate_activation="sigmoid", origin_mode=False,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._activation = activation
+        self._gate_activation = gate_activation
+        self._origin_mode = origin_mode
+        self._w: Optional[VarBase] = None
+        self._b: Optional[VarBase] = None
+
+    def forward(self, input: VarBase, hidden: VarBase):
+        D = self._size // 3
+        if self._w is None:
+            self._w = self.create_parameter("w", (D, 3 * D), self._dtype)
+            self._b = self.create_parameter("b", (1, 3 * D), self._dtype,
+                                            initializer=0.0)
+        outs = trace_op(
+            "gru_unit",
+            {"Input": [input], "HiddenPrev": [hidden], "Weight": [self._w],
+             "Bias": [self._b]},
+            {"activation": self._activation,
+             "gate_activation": self._gate_activation,
+             "origin_mode": self._origin_mode})
+        return (outs["Hidden"][0], outs["ResetHiddenPrev"][0],
+                outs["Gate"][0])

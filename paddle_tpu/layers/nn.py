@@ -25,6 +25,7 @@ __all__ = [
     "fused_attention",
     "dynamic_lstm",
     "dynamic_gru",
+    "gru_unit",
     "conv2d",
     "conv2d_transpose",
     "conv3d",
@@ -1292,6 +1293,34 @@ def dynamic_lstm(
         hidden.shape = out_shape
         cell.shape = out_shape
     return hidden, cell
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """Single GRU step (reference nn.py:1042 / gru_unit_op.cc). `input`
+    is the pre-projected [B, 3D] gates (size = 3*D), `hidden` [B, D].
+    Returns (new_hidden, reset_hidden_prev, gate)."""
+    helper = LayerHelper("gru_unit", bias_attr=bias_attr)
+    D = size // 3
+    w = helper.create_parameter(param_attr, [D, 3 * D], input.dtype)
+    inputs = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    b = helper.create_parameter(bias_attr, [1, 3 * D], input.dtype,
+                                is_bias=True)
+    if b is not None:
+        inputs["Bias"] = [b]
+    new_h = helper.create_variable_for_type_inference(input.dtype)
+    reset_h = helper.create_variable_for_type_inference(input.dtype)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="gru_unit", inputs=inputs,
+        outputs={"Hidden": [new_h], "ResetHiddenPrev": [reset_h],
+                 "Gate": [gate]},
+        attrs={"activation": activation, "gate_activation": gate_activation,
+               "origin_mode": origin_mode})
+    new_h.shape = reset_h.shape = hidden.shape
+    gate.shape = input.shape
+    return new_h, reset_h, gate
 
 
 def dynamic_gru(

@@ -260,3 +260,35 @@ def _recurrent_grad(ctx, ins, attrs):
     return {"inputs@GRAD": list(dxs),
             "initial_states@GRAD": list(dinits),
             "parameters@GRAD": list(dparams)}
+
+
+@register_op("gru_unit", diff_inputs=["Input", "HiddenPrev", "Weight",
+                                      "Bias"])
+def _gru_unit(ctx, ins, attrs):
+    """Single GRU step (gru_unit_op.cc:125): u/r gates from the
+    pre-projected input + HiddenPrev@W[:, :2D]; candidate from
+    (r*HiddenPrev)@W[:, 2D:]; h = (1-u)*h_prev + u*c (origin_mode
+    flips the mix, matching the reference attr)."""
+    x = ins["Input"][0]                        # [B, 3D]
+    h_prev = ins["HiddenPrev"][0]              # [B, D]
+    w = ins["Weight"][0]                       # [D, 3D]
+    b = (ins.get("Bias") or [None])[0]         # [1, 3D]
+    D = h_prev.shape[-1]
+    act = _ACT[attrs.get("activation", "tanh")]
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    origin = bool(attrs.get("origin_mode", False))
+
+    g = x if b is None else x + b
+    g = g.astype(jnp.float32)
+    gates = g[:, :2 * D] + h_prev @ w[:, :2 * D]
+    u = gate_act(gates[:, :D])
+    r = gate_act(gates[:, D:])
+    reset_h = r * h_prev
+    c = act(g[:, 2 * D:] + reset_h @ w[:, 2 * D:])
+    if origin:
+        h = u * h_prev + (1.0 - u) * c
+    else:
+        h = (1.0 - u) * h_prev + u * c
+    return {"Hidden": [h.astype(h_prev.dtype)],
+            "ResetHiddenPrev": [reset_h.astype(h_prev.dtype)],
+            "Gate": [jnp.concatenate([u, r, c], axis=-1).astype(x.dtype)]}

@@ -137,3 +137,33 @@ def test_conditional_block(fresh_programs):
     (v,) = exe.run(main, feed={"x": np.array([0.1], "float32")},
                    fetch_list=[out], scope=scope)
     assert float(v) == -1.0
+
+
+def test_gru_unit_matches_numpy(fresh_programs):
+    """gru_unit single step vs numpy (gru_unit_op.cc math, default
+    mode h' = (1-u)h + uc)."""
+    main, startup, scope = fresh_programs
+    B, D = 4, 6
+    rs = np.random.RandomState(0)
+    xin = rs.randn(B, 3 * D).astype("float32")
+    h0 = rs.randn(B, D).astype("float32")
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3 * D])
+        h = layers.data("h", [D])
+        nh, rh, g = layers.gru_unit(
+            x, h, size=3 * D, param_attr=fluid.ParamAttr(name="gw"),
+            bias_attr=fluid.ParamAttr(name="gb"))
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    got, rgot = exe.run(main, feed={"x": xin, "h": h0},
+                        fetch_list=[nh, rh], scope=scope)
+    W = np.asarray(scope.find_var("gw"))
+    bb = np.asarray(scope.find_var("gb"))
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    gg = xin + bb
+    ur = gg[:, :2 * D] + h0 @ W[:, :2 * D]
+    u, r = sig(ur[:, :D]), sig(ur[:, D:])
+    c = np.tanh(gg[:, 2 * D:] + (r * h0) @ W[:, 2 * D:])
+    np.testing.assert_allclose(got, (1 - u) * h0 + u * c, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(rgot, r * h0, rtol=1e-5, atol=1e-5)
