@@ -30,7 +30,11 @@ from . import (  # noqa: F401
     profiler,
     regularizer,
 )
-from . import contrib, flags, inference, reader, transpiler  # noqa: F401
+from . import (contrib, flags, imperative, inference,  # noqa: F401
+               learning_rate_decay, lod_tensor, reader, recordio_writer,
+               transpiler)
+from .lod_tensor import (LoDTensor, LoDTensorArray, Tensor,  # noqa: F401
+                         create_lod_tensor, create_random_int_lodtensor)
 from .reader import batch  # noqa: F401  (paddle.batch top-level parity)
 from .flags import get_flag, set_flag  # noqa: F401
 from .async_executor import AsyncExecutor, DataFeedDesc  # noqa: F401
@@ -38,8 +42,10 @@ from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy, ParallelExecutor)
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .core.executor import Executor  # noqa: F401
-from .core.place import CPUPlace, CUDAPlace, TPUPlace, is_compiled_with_tpu  # noqa: F401
+from .core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa: F401
+                         TPUPlace, is_compiled_with_tpu)
 from .core.program import (  # noqa: F401
+    name_scope,
     Program,
     Variable,
     default_main_program,

@@ -2,6 +2,9 @@
 (reference python/paddle/fluid/contrib/ — slim/, quantize/,
 int8_inference/; SURVEY §2.8)."""
 
-from . import mixed_precision  # noqa: F401
+from . import inferencer, mixed_precision, trainer  # noqa: F401
+from .inferencer import Inferencer  # noqa: F401
+from .trainer import (BeginEpochEvent, BeginStepEvent,  # noqa: F401
+                      EndEpochEvent, EndStepEvent, Trainer)
 from . import quantize  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401

@@ -51,6 +51,12 @@ class TPUPlace(Place):
 CUDAPlace = TPUPlace
 
 
+class CUDAPinnedPlace(Place):
+    """Pinned host memory place (reference place.h). Host staging is
+    PJRT's job here; the class exists for API parity and feeds behave
+    like CPUPlace."""
+
+
 def is_compiled_with_tpu() -> bool:
     import jax
 

@@ -68,6 +68,29 @@ class UniqueNameGenerator:
 unique_name = UniqueNameGenerator()
 
 
+_NAME_SCOPE_STACK = threading.local()
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Debug-name nesting for ops (reference framework.py name_scope):
+    layers created inside get `scope1/scope2/...` prefixed unique names.
+    Purely cosmetic — grouping for visualization/profiling."""
+    stack = getattr(_NAME_SCOPE_STACK, "stack", None)
+    if stack is None:
+        stack = _NAME_SCOPE_STACK.stack = []
+    stack.append(str(prefix or "scope"))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def current_name_scope() -> str:
+    stack = getattr(_NAME_SCOPE_STACK, "stack", None) or []
+    return "/".join(stack)
+
+
 def _normalize_dtype(dtype) -> str:
     if dtype is None:
         return "float32"

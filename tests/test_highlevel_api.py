@@ -1,0 +1,135 @@
+"""High-level API tests: contrib Trainer/Inferencer (the reference's
+book-test driver pair), lod_tensor utilities, recordio round-trip,
+name_scope."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_trainer_and_inferencer(tmp_path):
+    """reference book tests' structure: Trainer(train_func,
+    optimizer_func).train(...) -> save_params -> Inferencer.infer."""
+    from paddle_tpu.contrib import EndStepEvent, Inferencer, Trainer
+
+    def train_func():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="tw"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        return [loss]
+
+    def optimizer_func():
+        return fluid.optimizer.SGD(learning_rate=0.1)
+
+    rs = np.random.RandomState(0)
+    W = np.linspace(-1, 1, 4).astype("float32")[:, None]
+
+    def reader():
+        for _ in range(8):
+            X = rs.randn(16, 4).astype("float32")
+            yield [(X[i], X[i] @ W) for i in range(16)]
+
+    seen = []
+
+    def handler(event):
+        if isinstance(event, EndStepEvent):
+            seen.append(float(np.asarray(event.metrics[0]).reshape(-1)[0]))
+
+    t = Trainer(train_func, optimizer_func)
+    t.train(num_epochs=3, event_handler=handler, reader=reader,
+            feed_order=["x", "y"])
+    assert len(seen) == 24
+    assert seen[-1] < 0.3 * seen[0]
+    test_metrics = t.test(reader, feed_order=["x", "y"])
+    assert test_metrics[0] < 0.5 * seen[0]
+
+    params = str(tmp_path / "params")
+    t.save_params(params)
+
+    def infer_func():
+        x = layers.data("x", [4])
+        return layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="tw"))
+
+    inf = Inferencer(infer_func, params)
+    X = rs.randn(8, 4).astype("float32")
+    (got,) = inf.infer({"x": X})
+    # trained weights approximate W
+    np.testing.assert_allclose(got, X @ W, atol=0.4)
+
+
+def test_trainer_stop():
+    from paddle_tpu.contrib import BeginStepEvent, Trainer
+
+    def train_func():
+        x = layers.data("x", [2])
+        y = layers.data("y", [1])
+        return [layers.mean(layers.square_error_cost(
+            layers.fc(x, size=1), y))]
+
+    steps = []
+
+    def handler(event):
+        if isinstance(event, BeginStepEvent):
+            steps.append(event.step)
+            if event.step >= 1:
+                t.stop()
+
+    def reader():
+        for _ in range(10):
+            yield [(np.zeros(2, "float32"), np.zeros(1, "float32"))] * 4
+
+    t = Trainer(train_func, lambda: fluid.optimizer.SGD(0.1))
+    t.train(num_epochs=1, event_handler=handler, reader=reader,
+            feed_order=["x", "y"])
+    assert steps == [0, 1]  # stopped after the second step began
+
+
+def test_lod_tensor_utils():
+    data = np.arange(12).reshape(6, 2)
+    t = fluid.create_lod_tensor(data, [[3, 1, 2]])
+    assert t.lod() == [[0, 3, 4, 6]]
+    assert t.recursive_sequence_lengths() == [[3, 1, 2]]
+    padded, lens = t.to_padded(pad_value=-1)
+    assert padded.shape == (3, 3, 2)
+    assert list(lens) == [3, 1, 2]
+    assert (padded[1, 1:] == -1).all()
+    # nested-list form
+    t2 = fluid.create_lod_tensor([[[1], [2]], [[3]]], [])
+    assert t2.recursive_sequence_lengths() == [[2, 1]]
+    with pytest.raises(ValueError):
+        fluid.create_lod_tensor(data, [[4, 4]])
+    r = fluid.create_random_int_lodtensor([[2, 3]], [1], low=0, high=9)
+    assert np.asarray(r).shape == (5, 1)
+
+
+def test_recordio_roundtrip(tmp_path):
+    from paddle_tpu import recordio_writer
+
+    path = str(tmp_path / "data.rec")
+
+    def reader():
+        for i in range(20):
+            yield (np.full((3,), i, "float32"), i)
+
+    n = recordio_writer.convert_reader_to_recordio_file(path, reader)
+    assert n == 20
+    back = list(recordio_writer.recordio_reader(path)())
+    assert len(back) == 20
+    np.testing.assert_array_equal(back[7][0], np.full((3,), 7, "float32"))
+    assert back[7][1] == 7
+
+
+def test_name_scope_nests():
+    with fluid.name_scope("encoder"):
+        from paddle_tpu.core.program import current_name_scope
+
+        assert current_name_scope() == "encoder"
+        with fluid.name_scope("layer1"):
+            assert current_name_scope() == "encoder/layer1"
+        assert current_name_scope() == "encoder"
+    from paddle_tpu.core.program import current_name_scope
+
+    assert current_name_scope() == ""
