@@ -12,7 +12,7 @@ from __future__ import annotations
 from functools import reduce as _reduce
 from operator import mul as _mul
 
-from ..core.program import Variable
+from ..core.program import Variable, unique_name
 from ..initializer import Constant, Normal, Xavier
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
@@ -26,6 +26,28 @@ __all__ = [
     "dynamic_lstm",
     "dynamic_gru",
     "gru_unit",
+    "selu",
+    "multiplex",
+    "space_to_depth",
+    "shuffle_channel",
+    "crop",
+    "pad_constant_like",
+    "dice_loss",
+    "mean_iou",
+    "add_position_encoding",
+    "bilinear_tensor_product",
+    "lstm_unit",
+    "teacher_student_sigmoid_loss",
+    "npair_loss",
+    "gaussian_random_batch_size_like",
+    "random_crop",
+    "image_resize_short",
+    "sequence_reshape",
+    "lod_reset",
+    "merge_selected_rows",
+    "get_tensor_from_selected_rows",
+    "autoincreased_step_counter",
+    "sum",
     "conv2d",
     "conv2d_transpose",
     "conv3d",
@@ -1356,3 +1378,282 @@ def dynamic_gru(
     if input.shape is not None:
         hidden.shape = tuple(input.shape[:-1]) + (size,)
     return hidden
+
+
+# ------------------------------------------------------- misc tail (round 3)
+def selu(x, scale=None, alpha=None, name=None):
+    """reference nn.py selu."""
+    helper = LayerHelper("selu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    if alpha is not None:
+        attrs["alpha"] = float(alpha)
+    helper.append_op(type="selu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs=attrs)
+    out.shape = x.shape
+    return out
+
+
+def multiplex(inputs, index):
+    """reference nn.py multiplex: out[i] = inputs[index[i]][i]."""
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
+    out.shape = inputs[0].shape
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    helper = LayerHelper("space_to_depth", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="space_to_depth", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"blocksize": int(blocksize)})
+    n, c, h, w = x.shape
+    b = int(blocksize)
+    out.shape = (n, c * b * b, h // b, w // b)
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    helper = LayerHelper("shuffle_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="shuffle_channel", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"group": int(group)})
+    out.shape = x.shape
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """reference nn.py crop (static shape/offsets form)."""
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    shape = [int(s) for s in shape]
+    offsets = [int(o) for o in (offsets or [0] * len(shape))]
+    helper.append_op(type="crop", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"shape": shape, "offsets": offsets})
+    out.shape = tuple(shape)
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op(type="pad_constant_like",
+                     inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+                     attrs={"pad_value": float(pad_value)})
+    out.shape = x.shape
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference nn.py dice_loss (input: probs [..., C], label ints)."""
+    helper = LayerHelper("dice_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="dice_loss_op",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Out": [out]},
+                     attrs={"epsilon": float(epsilon)})
+    out.shape = (1,)
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """reference nn.py mean_iou -> (mean_iou, out_wrong, out_correct)."""
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32",
+                                                     stop_gradient=True)
+    wrong = helper.create_variable_for_type_inference("int32",
+                                                      stop_gradient=True)
+    correct = helper.create_variable_for_type_inference("int32",
+                                                        stop_gradient=True)
+    helper.append_op(type="mean_iou",
+                     inputs={"Predictions": [input], "Labels": [label]},
+                     outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                              "OutCorrect": [correct]},
+                     attrs={"num_classes": int(num_classes)})
+    miou.shape = (1,)
+    wrong.shape = correct.shape = (int(num_classes),)
+    return miou, wrong, correct
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    helper = LayerHelper("add_position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="add_position_encoding", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"alpha": float(alpha), "beta": float(beta)})
+    out.shape = input.shape
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference nn.py bilinear_tensor_product: out_k = x W_k y^T + b."""
+    helper = LayerHelper("bilinear_tensor_product", name=name,
+                         bias_attr=bias_attr, act=act)
+    dx, dy = x.shape[-1], y.shape[-1]
+    w = helper.create_parameter(param_attr, [int(size), dx, dy], x.dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    b = helper.create_parameter(bias_attr, [int(size)], x.dtype,
+                                is_bias=True)
+    if b is not None:
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    out.shape = (x.shape[0], int(size))
+    return helper.append_activation(out, act)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference nn.py lstm_unit: fc([x, h_prev]) -> one LSTM cell step;
+    returns (hidden, cell)."""
+    helper = LayerHelper("lstm_unit", name=name)
+    D = hidden_t_prev.shape[-1]
+    gates = fc(input=[x_t, hidden_t_prev], size=4 * D,
+               param_attr=param_attr, bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": [gates], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": float(forget_bias)})
+    c.shape = h.shape = cell_t_prev.shape
+    return h, c
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="teacher_student_sigmoid_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]})
+    out.shape = (input.shape[0], 1) if input.shape else None
+    return out
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    helper = LayerHelper("npair_loss")
+    out = helper.create_variable_for_type_inference(anchor.dtype)
+    helper.append_op(type="npair_loss_op",
+                     inputs={"Anchor": [anchor], "Positive": [positive],
+                             "Labels": [labels]},
+                     outputs={"Out": [out]},
+                     attrs={"l2_reg": float(l2_reg)})
+    out.shape = (1,)
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="gaussian_random_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": [int(s) for s in shape],
+                            "input_dim_idx": int(input_dim_idx),
+                            "output_dim_idx": int(output_dim_idx),
+                            "mean": float(mean), "std": float(std),
+                            "dtype": dtype})
+    s = list(int(v) for v in shape)
+    if input.shape:
+        s[output_dim_idx] = input.shape[input_dim_idx]
+    out.shape = tuple(s)
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    """reference nn.py random_crop (trailing dims cropped to shape)."""
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="random_crop", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": [int(s) for s in shape]})
+    lead = tuple(x.shape[:len(x.shape) - len(shape)]) if x.shape else ()
+    out.shape = lead + tuple(int(s) for s in shape)
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference nn.py image_resize_short: resize so the SHORT spatial
+    side equals out_short_len (NCHW, static shapes)."""
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    out_h = int(round(h * out_short_len / short))
+    out_w = int(round(w * out_short_len / short))
+    op_type = ("bilinear_interp" if resample.upper() == "BILINEAR"
+               else "nearest_interp")
+    helper = LayerHelper("image_resize_short")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type=op_type, inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"out_h": out_h, "out_w": out_w,
+                            "align_corners": False})
+    out.shape = (input.shape[0], input.shape[1], out_h, out_w)
+    return out
+
+
+def sequence_reshape(input, new_dim, length=None):
+    """reference sequence_reshape_op.cc, masked-dense form: [B, T, D] ->
+    [B, T*D//new_dim, new_dim]; lengths scale by D/new_dim."""
+    helper = LayerHelper("sequence_reshape")
+    B, T, D = input.shape
+    out = reshape(input, shape=[B, T * D // int(new_dim), int(new_dim)])
+    if length is None:
+        return out
+    from .tensor import cast as _cast
+
+    scaled = scale(_cast(length, "float32"), scale=D / float(new_dim))
+    return out, _cast(scaled, "int64")
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """LoD travels as explicit length vars in this design
+    (layers/sequence.py contract): the data is returned unchanged and
+    the caller adopts `y`/target lengths where it passes lengths. Kept
+    for reference API parity (lod_reset_op.cc)."""
+    return x
+
+
+def merge_selected_rows(x, name=None):
+    """SelectedRows are dense here (sparse grads densify in the
+    transpiler); identity for parity (merge_selected_rows_op.cc)."""
+    return x
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """See merge_selected_rows: dense passthrough."""
+    return x
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference nn.py autoincreased_step_counter: a persistable int64
+    counter bumped once per executed step."""
+    helper = LayerHelper("step_counter")
+    counter = helper.create_global_variable(
+        name=counter_name or unique_name.generate("@STEP_COUNTER@"),
+        shape=[1], dtype="int64",
+        initializer=Constant(float(begin - step)))
+    helper.append_op(type="increment_counter", inputs={"X": [counter]},
+                     outputs={"Out": [counter]}, attrs={"step": int(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def sum(x):
+    """reference nn.py sum: elementwise sum of a list of tensors."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    helper = LayerHelper("sum")
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op(type="sum", inputs={"X": list(xs)},
+                     outputs={"Out": [out]})
+    out.shape = xs[0].shape
+    return out

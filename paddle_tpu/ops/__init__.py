@@ -16,6 +16,7 @@ from . import (  # noqa: F401
     loss_ops,
     math,
     metrics,
+    misc_ops,
     nn,
     quant_ops,
     rnn,

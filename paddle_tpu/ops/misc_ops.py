@@ -1,0 +1,215 @@
+"""Miscellaneous op lowerings closing the layers/nn.py __all__ tail.
+
+Reference analogs named per op; each is a direct jnp/lax lowering (no
+kernels to port — XLA fuses these into neighbors).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+_SELU_SCALE = 1.0507009873554805
+_SELU_ALPHA = 1.6732632423543772
+
+
+@register_op("selu", diff_inputs=["X"])
+def _selu(ctx, ins, attrs):
+    """selu_op.cc: scale * (max(0,x) + min(0, alpha*(exp(x)-1)))."""
+    x = ins["X"][0]
+    scale = float(attrs.get("scale", _SELU_SCALE))
+    alpha = float(attrs.get("alpha", _SELU_ALPHA))
+    out = scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+    return {"Out": [out]}
+
+
+@register_op("multiplex", diff_inputs=["X"])
+def _multiplex(ctx, ins, attrs):
+    """multiplex_op.cc: out[i] = X[ids[i]][i] — row-wise candidate
+    select."""
+    xs = jnp.stack(ins["X"], axis=0)         # [C, B, ...]
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)  # [B]
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": [xs[ids, rows]]}
+
+
+@register_op("space_to_depth", diff_inputs=["X"])
+def _space_to_depth(ctx, ins, attrs):
+    """space_to_depth_op.cc: NCHW [N,C,H,W] -> [N, C*b*b, H/b, W/b]."""
+    x = ins["X"][0]
+    b = int(attrs["blocksize"])
+    N, C, H, W = x.shape
+    x = x.reshape(N, C, H // b, b, W // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": [x.reshape(N, C * b * b, H // b, W // b)]}
+
+
+@register_op("shuffle_channel", diff_inputs=["X"])
+def _shuffle_channel(ctx, ins, attrs):
+    """shuffle_channel_op.cc: group-interleave channels."""
+    x = ins["X"][0]
+    g = int(attrs.get("group", 1))
+    N, C, H, W = x.shape
+    x = x.reshape(N, g, C // g, H, W).transpose(0, 2, 1, 3, 4)
+    return {"Out": [x.reshape(N, C, H, W)]}
+
+
+@register_op("pad_constant_like", diff_inputs=["Y"])
+def _pad_constant_like(ctx, ins, attrs):
+    """pad_constant_like_op.cc: pad Y at the end to X's shape."""
+    x, y = ins["X"][0], ins["Y"][0]
+    val = float(attrs.get("pad_value", 0.0))
+    cfg = [(0, int(xs - ys)) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, cfg, constant_values=val)]}
+
+
+@register_op("dice_loss_op", diff_inputs=["X"])
+def _dice_loss(ctx, ins, attrs):
+    """nn.py dice_loss composition: 1 - 2*|p∩l| / (|p|+|l|)."""
+    x = ins["X"][0]
+    label = ins["Label"][0]
+    eps = float(attrs.get("epsilon", 1e-5))
+    lab = jax.nn.one_hot(label.reshape(label.shape[:-1]).astype(jnp.int32),
+                         x.shape[-1], dtype=x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * lab, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(lab, axis=reduce_dims)
+    return {"Out": [jnp.mean(1.0 - (2.0 * inter + eps) / (union + eps))]}
+
+
+@register_op("mean_iou", no_grad=True)
+def _mean_iou(ctx, ins, attrs):
+    """mean_iou_op.cc: mean intersection-over-union over classes."""
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    n = int(attrs["num_classes"])
+    inter = jnp.zeros((n,), jnp.float32).at[
+        jnp.where(pred == label, pred, n)].add(1.0, mode="drop")
+    pred_c = jnp.zeros((n,), jnp.float32).at[pred].add(1.0, mode="drop")
+    lab_c = jnp.zeros((n,), jnp.float32).at[label].add(1.0, mode="drop")
+    union = pred_c + lab_c - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                      1.0)
+    wrong = (lab_c - inter).astype(jnp.int32)
+    correct = inter.astype(jnp.int32)
+    return {"OutMeanIou": [miou], "OutWrong": [wrong],
+            "OutCorrect": [correct]}
+
+
+@register_op("add_position_encoding", diff_inputs=["X"])
+def _add_position_encoding(ctx, ins, attrs):
+    """add_position_encoding_op.cc: alpha*x + beta*sincos_pe, x [B,T,D]."""
+    x = ins["X"][0]
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    half = D // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return {"Out": [alpha * x + beta * pe[None].astype(x.dtype)]}
+
+
+@register_op("bilinear_tensor_product", diff_inputs=["X", "Y", "Weight",
+                                                     "Bias"])
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """bilinear_tensor_product_op.cc: out_k = x W_k y^T + b_k."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    b = (ins.get("Bias") or [None])[0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if b is not None:
+        out = out + b
+    return {"Out": [out]}
+
+
+@register_op("lstm_unit", diff_inputs=["X", "C_prev"])
+def _lstm_unit(ctx, ins, attrs):
+    """lstm_unit_op.cc: one cell step from pre-projected gates [B,4D]
+    (order i, f, c, o) with forget_bias."""
+    x = ins["X"][0]
+    c_prev = ins["C_prev"][0]
+    fb = float(attrs.get("forget_bias", 0.0))
+    i, f, c, o = jnp.split(x, 4, axis=-1)
+    new_c = c_prev * jax.nn.sigmoid(f + fb) + jax.nn.sigmoid(i) * jnp.tanh(c)
+    new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+    return {"C": [new_c], "H": [new_h]}
+
+
+@register_op("teacher_student_sigmoid_loss", diff_inputs=["X"])
+def _tssl(ctx, ins, attrs):
+    """teacher_student_sigmoid_loss_op.cc: sce(x, z) + sce(x, z') with
+    the encoded label convention (-2/-1 = no teacher, clk 0/1;
+    [0,1)=teacher z' clk 0; [1,2]=1+z' clk 1)."""
+    x = ins["X"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1).astype(jnp.float32)
+
+    def sce(v, z):
+        return jnp.maximum(v, 0.0) - v * z + jnp.log1p(jnp.exp(-jnp.abs(v)))
+
+    z = jnp.where(label <= -1.0, jnp.where(label <= -2.0 + 1e-6, 0.0, 1.0),
+                  jnp.where(label < 1.0, 0.0, 1.0))
+    teacher = jnp.where(label < -1.0 + 1e-6, 0.0,
+                        jnp.where(label < 1.0, label, label - 1.0))
+    has_teacher = label >= 0.0
+    loss = sce(x, z) + jnp.where(has_teacher, sce(x, teacher), 0.0)
+    return {"Y": [loss[:, None]]}
+
+
+@register_op("npair_loss_op", diff_inputs=["Anchor", "Positive"])
+def _npair_loss(ctx, ins, attrs):
+    """nn.py npair_loss composition: softmax CE over anchor-positive
+    similarities + l2 regularization."""
+    a = ins["Anchor"][0]
+    p = ins["Positive"][0]
+    labels = ins["Labels"][0].reshape(-1).astype(jnp.float32)
+    reg = float(attrs.get("l2_reg", 0.002))
+    B = a.shape[0]
+    sim = a @ p.T                                  # [B, B]
+    same = (labels[:, None] == labels[None, :]).astype(jnp.float32)
+    tgt = same / jnp.maximum(jnp.sum(same, axis=1, keepdims=True), 1.0)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+    l2 = jnp.mean(jnp.sum(a * a, axis=1) + jnp.sum(p * p, axis=1)) * reg
+    return {"Out": [ce + l2]}
+
+
+@register_op("gaussian_random_batch_size_like", no_grad=True, uses_rng=True)
+def _grbsl(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    shape[int(attrs.get("output_dim_idx", 0))] = x.shape[
+        int(attrs.get("input_dim_idx", 0))]
+    mean = float(attrs.get("mean", 0.0))
+    std = float(attrs.get("std", 1.0))
+    out = mean + std * jax.random.normal(ctx.next_rng(), tuple(shape))
+    return {"Out": [out.astype(attrs.get("dtype", "float32"))]}
+
+
+@register_op("random_crop", no_grad=True, uses_rng=True)
+def _random_crop(ctx, ins, attrs):
+    """random_crop_op.cc: random spatial crop per example (trailing dims
+    cropped to `shape`)."""
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    nd = len(shape)
+    lead = x.shape[:x.ndim - nd]
+    rng = ctx.next_rng()
+    maxs = jnp.asarray([x.shape[x.ndim - nd + i] - shape[i]
+                        for i in range(nd)])
+    offs = (jax.random.uniform(rng, (nd,)) * (maxs + 1)).astype(jnp.int32)
+    starts = [0] * len(lead) + [offs[i] for i in range(nd)]
+    sizes = list(lead) + shape
+    out = lax.dynamic_slice(x, starts, sizes)
+    return {"Out": [out]}
+
+
+@register_op("increment_counter", no_grad=True)
+def _increment_counter(ctx, ins, attrs):
+    """autoincreased_step_counter backing op: counter += step."""
+    x = ins["X"][0]
+    return {"Out": [x + int(attrs.get("step", 1))]}

@@ -1,0 +1,152 @@
+"""Round-3 layers/nn.py tail: numeric checks vs numpy for the misc op
+batch (reference unittests test_selu_op, test_multiplex_op,
+test_space_to_depth_op, test_mean_iou, test_bilinear_tensor_product_op,
+test_lstm_unit_op analogs)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            fetches = build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        return exe.run(main, feed=feeds, fetch_list=list(fetches),
+                       scope=scope), scope
+
+
+def test_selu_matches_numpy():
+    x = np.random.RandomState(0).randn(4, 5).astype("float32")
+    (out,), _ = _run(
+        lambda: [layers.selu(layers.data("x", [4, 5],
+                                         append_batch_size=False))],
+        {"x": x})
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    want = scale * np.where(x > 0, x, alpha * (np.exp(x) - 1))
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multiplex_selects_rows():
+    rs = np.random.RandomState(1)
+    m1, m2 = rs.randn(3, 4).astype("float32"), rs.randn(3, 4).astype("float32")
+    ids = np.array([1, 0, 1], "int32")
+    (out,), _ = _run(
+        lambda: [layers.multiplex(
+            [layers.data("m1", [3, 4], append_batch_size=False),
+             layers.data("m2", [3, 4], append_batch_size=False)],
+            layers.data("ids", [3], dtype="int32",
+                        append_batch_size=False))],
+        {"m1": m1, "m2": m2, "ids": ids})
+    want = np.stack([m2[0], m1[1], m2[2]])
+    np.testing.assert_allclose(out, want)
+
+
+def test_space_to_depth_roundtrip_values():
+    x = np.arange(2 * 2 * 4 * 4, dtype="float32").reshape(2, 2, 4, 4)
+    (out,), _ = _run(
+        lambda: [layers.space_to_depth(
+            layers.data("x", [2, 2, 4, 4], append_batch_size=False), 2)],
+        {"x": x})
+    assert out.shape == (2, 8, 2, 2)
+    # block (0,0) of channel 0 lands in the first depth slice
+    assert out[0, 0, 0, 0] == x[0, 0, 0, 0]
+
+
+def test_mean_iou_matches_numpy():
+    rs = np.random.RandomState(2)
+    pred = rs.randint(0, 3, 32).astype("int32")
+    lab = rs.randint(0, 3, 32).astype("int32")
+    (miou, wrong, correct), _ = _run(
+        lambda: list(layers.mean_iou(
+            layers.data("p", [32], dtype="int32", append_batch_size=False),
+            layers.data("l", [32], dtype="int32", append_batch_size=False),
+            3)),
+        {"p": pred, "l": lab})
+    ious = []
+    for c in range(3):
+        inter = np.sum((pred == c) & (lab == c))
+        union = np.sum(pred == c) + np.sum(lab == c) - inter
+        if union > 0:
+            ious.append(inter / union)
+    np.testing.assert_allclose(float(miou), np.mean(ious), rtol=1e-5)
+
+
+def test_bilinear_tensor_product_and_grads():
+    rs = np.random.RandomState(3)
+    x = rs.randn(5, 4).astype("float32")
+    y = rs.randn(5, 3).astype("float32")
+
+    def build():
+        a = layers.data("a", [5, 4], append_batch_size=False)
+        b = layers.data("b", [5, 3], append_batch_size=False)
+        out = layers.bilinear_tensor_product(
+            a, b, size=6, param_attr=fluid.ParamAttr(name="btw"))
+        return [out]
+
+    (out,), scope = _run(build, {"a": x, "b": y})
+    W = np.asarray(scope.find_var("btw"))
+    want = np.einsum("bi,kij,bj->bk", x, W, y)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_lstm_unit_matches_numpy():
+    rs = np.random.RandomState(4)
+    xt = rs.randn(3, 6).astype("float32")
+    hp = rs.randn(3, 5).astype("float32")
+    cp = rs.randn(3, 5).astype("float32")
+
+    params = {}
+
+    def build():
+        h, c = layers.lstm_unit(
+            layers.data("xt", [3, 6], append_batch_size=False),
+            layers.data("hp", [3, 5], append_batch_size=False),
+            layers.data("cp", [3, 5], append_batch_size=False),
+            forget_bias=1.0)
+        from paddle_tpu.core.program import default_main_program
+
+        for p in default_main_program().global_block().all_parameters():
+            params[tuple(p.shape)] = p.name
+        return [h, c]
+
+    (h, c), scope = _run(build, {"xt": xt, "hp": hp, "cp": cp})
+    Wx = np.asarray(scope.find_var(params[(6, 20)]))
+    Wh = np.asarray(scope.find_var(params[(5, 20)]))
+    b = np.asarray(scope.find_var(params[(20,)]))
+    g = xt @ Wx + hp @ Wh + b
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    i, f, cc, o = np.split(g, 4, axis=-1)
+    want_c = cp * sig(f + 1.0) + sig(i) * np.tanh(cc)
+    want_h = np.tanh(want_c) * sig(o)
+    np.testing.assert_allclose(c, want_c, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(h, want_h, rtol=1e-4, atol=1e-4)
+
+
+def test_npair_and_tssl_finite():
+    rs = np.random.RandomState(5)
+    (np_loss,), _ = _run(
+        lambda: [layers.npair_loss(
+            layers.data("a", [6, 8], append_batch_size=False),
+            layers.data("p", [6, 8], append_batch_size=False),
+            layers.data("l", [6], dtype="int64",
+                        append_batch_size=False))],
+        {"a": rs.randn(6, 8).astype("float32"),
+         "p": rs.randn(6, 8).astype("float32"),
+         "l": rs.randint(0, 3, 6).astype("int64")})
+    assert np.isfinite(float(np_loss))
+    (t_loss,), _ = _run(
+        lambda: [layers.teacher_student_sigmoid_loss(
+            layers.data("x", [8, 1], append_batch_size=False),
+            layers.data("lab", [8, 1], append_batch_size=False))],
+        {"x": rs.randn(8, 1).astype("float32"),
+         "lab": np.array([[-2], [-1], [0.3], [1.7], [-2], [0.9], [1.1],
+                          [-1]], "float32")})
+    assert np.isfinite(t_loss).all() and t_loss.shape == (8, 1)
