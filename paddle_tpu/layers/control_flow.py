@@ -18,7 +18,10 @@ import contextlib
 from ..layer_helper import LayerHelper
 from .tensor import assign, fill_constant
 
-__all__ = ["increment", "While", "Switch", "cond", "while_loop"]
+__all__ = ["increment", "While", "Switch", "cond", "while_loop",
+           "create_array", "array_write", "array_read", "array_length",
+           "TensorArray", "reorder_lod_tensor_by_rank", "is_empty",
+           "Print"]
 
 
 def increment(x, value=1.0, in_place=True):
@@ -149,3 +152,96 @@ class Switch:
         parent.append_op(type="conditional_block",
                          inputs={"Cond": [not_taken]}, outputs={},
                          attrs={"sub_block": sub.idx})
+
+
+# ---------------------------------------------------- tensor arrays (static)
+class TensorArray:
+    """Build-time LOD_TENSOR_ARRAY (reference framework LoDTensorArray +
+    array ops). The dynamic in-loop uses the reference puts these to
+    (DynamicRNN bodies, beam search) are served by the `recurrent` scan
+    op and the dense beam ops here, so this array is a STATIC build-time
+    container: indices must be Python ints or fill_constant results, and
+    reads/writes unroll into ordinary ops."""
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+        self.items = []
+
+
+def _static_index(i):
+    if isinstance(i, int):
+        return i
+    from ..core.program import default_main_program
+
+    if hasattr(i, "name"):
+        block = default_main_program().current_block()
+        for op in reversed(block.ops):
+            if op.type == "fill_constant" and i.name in op.output_names():
+                return int(op.attrs["value"])
+    raise ValueError(
+        "array index must be a python int or a fill_constant variable at "
+        "build time; data-dependent indices belong inside StaticRNN/"
+        "DynamicRNN (the recurrent op) in this design")
+
+
+def create_array(dtype):
+    """reference control_flow.py create_array."""
+    return TensorArray(dtype)
+
+
+def array_write(x, i, array=None):
+    """reference control_flow.py:783 array_write (static index)."""
+    if array is None:
+        array = create_array(x.dtype)
+    idx = _static_index(i)
+    while len(array.items) <= idx:
+        array.items.append(None)
+    array.items[idx] = x
+    return array
+
+
+def array_read(array, i):
+    """reference control_flow.py:915 array_read (static index)."""
+    idx = _static_index(i)
+    if idx >= len(array.items) or array.items[idx] is None:
+        raise IndexError("array has no element %d" % idx)
+    return array.items[idx]
+
+
+def array_length(array):
+    """reference control_flow.py:999 array_length."""
+    return fill_constant([1], "int64", float(len(array.items)))
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """LoD rank reordering is a no-op under the masked-dense contract
+    (sequences are never sorted; lengths travel separately) — kept for
+    reference API parity (reorder_lod_tensor_by_rank_op.cc)."""
+    return x
+
+
+def is_empty(x, cond=None):
+    """reference control_flow.py is_empty -> bool [1] var."""
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference(
+        "bool", stop_gradient=True)
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    out.shape = (1,)
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference control_flow.py:146 Print: runtime tensor printing from
+    inside the compiled step (jax.debug.print), passthrough value."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="print_op", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or "",
+                            "name": input.name if print_tensor_name else ""})
+    out.shape = input.shape
+    return out

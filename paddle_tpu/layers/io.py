@@ -16,7 +16,9 @@ import threading
 
 from ..core.program import default_main_program, default_startup_program
 
-__all__ = ["data", "PyReader", "py_reader", "double_buffer"]
+__all__ = ["data", "PyReader", "py_reader", "double_buffer",
+           "create_py_reader_by_data", "read_file", "open_files",
+           "random_data_generator", "Preprocessor", "load"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -134,3 +136,123 @@ def double_buffer(reader, place=None, name=None):
             yield item
 
     return buffered
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """reference layers/io.py create_py_reader_by_data: a PyReader bound
+    to existing feed vars."""
+    return PyReader(feed_list=feed_list, capacity=capacity,
+                    use_double_buffer=use_double_buffer)
+
+
+def read_file(reader):
+    """reference layers/io.py read_file: with op-based file readers gone
+    (PyReader feeds the executor directly), this returns the reader's
+    bound feed variables — or a Preprocessor's declared outputs."""
+    if isinstance(reader, Preprocessor):
+        return reader()
+    return list(getattr(reader, "feed_list", []) or [])
+
+
+def open_files(filenames, shapes=None, lod_levels=None, dtypes=None,
+               thread_num=None, buffer_size=None, pass_num=1,
+               is_test=False):
+    """reference layers/io.py open_files over recordio files: returns a
+    PyReader-style generator chaining paddle_tpu.recordio_writer files
+    (the op-based multi-file reader stack is subsumed by PyReader +
+    the native datafeed)."""
+    from ..recordio_writer import recordio_reader
+
+    names = [filenames] if isinstance(filenames, str) else list(filenames)
+
+    def gen():
+        for _ in range(pass_num):
+            for f in names:
+                yield from recordio_reader(f)()
+
+    return gen
+
+
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=True):
+    """reference layers/io.py random_data_generator: an endless reader of
+    uniform random float batches with the given shapes."""
+    import numpy as np
+
+    def gen():
+        while True:
+            yield tuple(np.random.uniform(low, high, s).astype("float32")
+                        for s in shapes)
+
+    return gen
+
+
+class Preprocessor:
+    """reference layers/io.py Preprocessor: declare in-graph transforms
+    over a reader's outputs. Ops built inside block() are ordinary main-
+    program ops; inputs() hands out the reader's feed variables and
+    outputs() records the transformed variables, which read_file() (or
+    calling the preprocessor) then returns to the model builder.
+
+        p = Preprocessor(py_reader)
+        with p.block():
+            img, lbl = p.inputs()
+            p.outputs(scale(img, 1/255.), lbl)
+        img, lbl = p()
+    """
+
+    def __init__(self, reader, name=None):
+        self._reader = reader
+        self._outs = None
+        self._in_block = False
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._in_block = True
+            yield
+            self._in_block = False
+            if self._outs is None:
+                raise ValueError("Preprocessor.block() ended without "
+                                 "outputs()")
+
+        return _ctx()
+
+    def inputs(self):
+        if not self._in_block:
+            raise RuntimeError("inputs() must be called inside block()")
+        return list(getattr(self._reader, "feed_list", []) or [])
+
+    def outputs(self, *outs):
+        if not self._in_block:
+            raise RuntimeError("outputs() must be called inside block()")
+        self._outs = list(outs)
+
+    def __call__(self):
+        if self._outs is None:
+            raise RuntimeError("define the block() transforms first")
+        return list(self._outs)
+
+
+def load(out, file_path, load_as_fp16=False):
+    """reference layers/io.py load: fill `out` from a saved checkpoint
+    file (io.py combined format) — immediate scope load."""
+    import os
+
+    import numpy as np
+
+    from ..core.scope import global_scope
+    from ..io import _load_blob
+
+    _, data = _load_blob(os.path.dirname(file_path) or ".",
+                         os.path.basename(file_path))
+    if out.name not in data:
+        raise RuntimeError("%s lacks variable %r" % (file_path, out.name))
+    arr = np.asarray(data[out.name])
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    global_scope().set_var(out.name, arr)
+    return out

@@ -24,6 +24,7 @@ __all__ = [
     "noam_decay",
     "cosine_decay",
     "linear_lr_warmup",
+    "append_LARS",
 ]
 
 _COUNTER_NAME = "@LR_DECAY_COUNTER@"
@@ -161,3 +162,22 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
         learning_rate = fill_constant([1], "float32", float(learning_rate))
     cond = less_than(step, fill_constant([1], "float32", float(warmup_steps)))
     return where(cond, warm, learning_rate)
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """reference learning_rate_scheduler.py append_LARS: per-parameter
+    local learning rate  lr * ||w|| / (||g|| + wd * ||w||)."""
+    from . import nn as _nn
+    from .tensor import fill_constant
+
+    def _norm(v):
+        return _nn.sqrt(_nn.reduce_sum(_nn.square(v)))
+
+    decayed = []
+    for param, grad in params_grads:
+        w_norm = _norm(param)
+        g_norm = _norm(grad)
+        local = learning_rate * w_norm / (
+            g_norm + weight_decay * w_norm)
+        decayed.append(local)
+    return decayed

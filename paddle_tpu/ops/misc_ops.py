@@ -213,3 +213,20 @@ def _increment_counter(ctx, ins, attrs):
     """autoincreased_step_counter backing op: counter += step."""
     x = ins["X"][0]
     return {"Out": [x + int(attrs.get("step", 1))]}
+
+
+@register_op("is_empty", no_grad=True)
+def _is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.full((1,), x.size == 0)]}
+
+
+@register_op("print_op", diff_inputs=["X"])
+def _print_op(ctx, ins, attrs):
+    """Print layer backing op: jax.debug.print inside the compiled step
+    (the reference's print_op writes to stderr from the interpreter)."""
+    x = ins["X"][0]
+    msg = attrs.get("message") or ""
+    name = attrs.get("name") or ""
+    jax.debug.print(msg + " " + name + " = {x}", x=x)
+    return {"Out": [x]}
