@@ -18,6 +18,15 @@ __all__ = [
     "anchor_generator",
     "density_prior_box",
     "generate_proposals",
+    "bipartite_match",
+    "target_assign",
+    "box_clip",
+    "polygon_box_transform",
+    "ssd_loss",
+    "multi_box_head",
+    "detection_output",
+    "distribute_fpn_proposals",
+    "box_decoder_and_assign",
 ]
 
 
@@ -222,3 +231,227 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
     rois.shape = (n, int(post_nms_top_n), 4)
     probs.shape = (n, int(post_nms_top_n), 1)
     return rois, probs
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """reference detection.py bipartite_match: [B, G, P] (dense batch)
+    -> (match_indices [B, P], match_distance [B, P])."""
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference("int32",
+                                                    stop_gradient=True)
+    dist = helper.create_variable_for_type_inference("float32",
+                                                     stop_gradient=True)
+    helper.append_op(type="bipartite_match",
+                     inputs={"DistMat": [dist_matrix]},
+                     outputs={"ColToRowMatchIndices": [idx],
+                              "ColToRowMatchDist": [dist]},
+                     attrs={"match_type": match_type,
+                            "dist_threshold": float(dist_threshold)})
+    if dist_matrix.shape and len(dist_matrix.shape) == 3:
+        idx.shape = dist.shape = (dist_matrix.shape[0],
+                                  dist_matrix.shape[2])
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0.0, name=None):
+    """reference detection.py target_assign -> (out, out_weight)."""
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    w = helper.create_variable_for_type_inference("float32",
+                                                  stop_gradient=True)
+    helper.append_op(type="target_assign",
+                     inputs={"X": [input],
+                             "MatchIndices": [matched_indices]},
+                     outputs={"Out": [out], "OutWeight": [w]},
+                     attrs={"mismatch_value": float(mismatch_value)})
+    if input.shape and matched_indices.shape:
+        out.shape = (matched_indices.shape[0], matched_indices.shape[1],
+                     input.shape[2])
+        w.shape = out.shape[:2] + (1,)
+    return out, w
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]})
+    out.shape = input.shape
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="polygon_box_transform",
+                     inputs={"Input": [input]}, outputs={"Output": [out]})
+    out.shape = input.shape
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """reference detection.py:877, fused lowering (ops/detection_ops.py
+    ssd_loss): dense gt [B, G, 4]/[B, G] with zero-area padding rows.
+    Returns the per-image normalized loss [B]."""
+    helper = LayerHelper("ssd_loss")
+    loss = helper.create_variable_for_type_inference("float32")
+    inputs = {"Location": [location], "Confidence": [confidence],
+              "GTBox": [gt_box], "GTLabel": [gt_label],
+              "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(type="ssd_loss", inputs=inputs,
+                     outputs={"Loss": [loss]},
+                     attrs={"background_label": int(background_label),
+                            "overlap_threshold": float(overlap_threshold),
+                            "neg_pos_ratio": float(neg_pos_ratio),
+                            "loc_loss_weight": float(loc_loss_weight),
+                            "conf_loss_weight": float(conf_loss_weight)})
+    loss.shape = (location.shape[0],) if location.shape else None
+    return loss
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """reference detection.py:1357: per-feature-map prior boxes + conv
+    loc/conf heads, concatenated over maps. Returns
+    (mbox_locs [B,P,4], mbox_confs [B,P,C], prior_boxes [P,4],
+    variances [P,4])."""
+    from . import nn as _nn
+    from .tensor import concat
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # the reference's min_ratio/max_ratio interpolation
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_maps - 2)) if n_maps > 2 \
+            else 0
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_maps - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_maps - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        stp = steps[i] if steps else 0.0
+        mins_l = [mins] if not isinstance(mins, list) else mins
+        maxs_l = ([maxs] if maxs and not isinstance(maxs, list)
+                  else (maxs or []))
+        boxes, var = prior_box(
+            feat, image, min_sizes=mins_l, max_sizes=maxs_l or None,
+            aspect_ratios=ar, variance=list(variance), flip=flip,
+            clip=clip, steps=(stp, stp), offset=offset)
+        # P_i anchors per cell (same expansion as the prior_box op)
+        ars = [1.0]
+        for r in ar:
+            if all(abs(r - a) > 1e-6 for a in ars):
+                ars.append(r)
+                if flip:
+                    ars.append(1.0 / r)
+        p_i = len(mins_l) * len(ars) + (len(maxs_l) if maxs_l else 0)
+        fh, fw = feat.shape[2], feat.shape[3]
+        num_loc = p_i * 4
+        loc = _nn.conv2d(feat, num_filters=num_loc,
+                         filter_size=kernel_size, padding=pad,
+                         stride=stride)
+        loc = _nn.transpose(loc, perm=[0, 2, 3, 1])
+        loc = _nn.reshape(loc, shape=[loc.shape[0], -1, 4])
+        num_conf = p_i * num_classes
+        conf = _nn.conv2d(feat, num_filters=num_conf,
+                          filter_size=kernel_size, padding=pad,
+                          stride=stride)
+        conf = _nn.transpose(conf, perm=[0, 2, 3, 1])
+        conf = _nn.reshape(conf, shape=[conf.shape[0], -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(_nn.reshape(boxes, shape=[fh * fw * p_i, 4]))
+        vars_all.append(_nn.reshape(var, shape=[fh * fw * p_i, 4]))
+
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    prior_boxes = concat(boxes_all, axis=0)
+    box_vars = concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, prior_boxes, box_vars
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """reference detection.py:206: decode loc against priors then
+    multiclass NMS. Returns the fixed-size padded [B, keep_top_k, 6]
+    result of multiclass_nms (class, score, box)."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    from . import nn as _nn
+
+    scores_t = _nn.transpose(scores, perm=[0, 2, 1])  # [B, C, P]
+    return multiclass_nms(decoded, scores_t,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label,
+                          nms_eta=nms_eta)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    """reference detection.py distribute_fpn_proposals (dense: each level
+    keeps the roi count with zero padding; RestoreIndex maps back)."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference(fpn_rois.dtype,
+                                                      stop_gradient=True)
+            for _ in range(n)]
+    restore = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    helper.append_op(type="distribute_fpn_proposals",
+                     inputs={"FpnRois": [fpn_rois]},
+                     outputs={"MultiFpnRois": outs,
+                              "RestoreIndex": [restore]},
+                     attrs={"min_level": int(min_level),
+                            "max_level": int(max_level),
+                            "refer_level": int(refer_level),
+                            "refer_scale": float(refer_scale)})
+    for o in outs:
+        o.shape = fpn_rois.shape
+    restore.shape = (fpn_rois.shape[0], 1) if fpn_rois.shape else None
+    return outs, restore
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    """reference detection.py box_decoder_and_assign."""
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    decoded = helper.create_variable_for_type_inference(
+        target_box.dtype, stop_gradient=True)
+    assigned = helper.create_variable_for_type_inference(
+        target_box.dtype, stop_gradient=True)
+    helper.append_op(type="box_decoder_and_assign",
+                     inputs={"PriorBox": [prior_box],
+                             "TargetBox": [target_box],
+                             "BoxScore": [box_score]},
+                     outputs={"DecodeBox": [decoded],
+                              "OutputAssignBox": [assigned]},
+                     attrs={"box_clip": float(box_clip)})
+    decoded.shape = target_box.shape
+    if prior_box.shape:
+        assigned.shape = (prior_box.shape[0], 4)
+    return decoded, assigned

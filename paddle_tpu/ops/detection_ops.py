@@ -699,3 +699,249 @@ def _generate_proposals(ctx, ins, attrs):
 
     rois, probs = jax.vmap(one_image)(sc, dl, im_info)
     return {"RpnRois": [rois], "RpnRoiProbs": [probs]}
+
+
+def _pairwise_iou_xyxy(a, b):
+    """[G,4] x [P,4] -> [G,P] IoU (normalized xyxy)."""
+    area = lambda t: jnp.maximum(t[:, 2] - t[:, 0], 0) * jnp.maximum(
+        t[:, 3] - t[:, 1], 0)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    return inter / jnp.maximum(area(a)[:, None] + area(b)[None, :] - inter,
+                               1e-10)
+
+
+def _greedy_bipartite(dist, valid_rows):
+    """bipartite_match_op.cc greedy core: repeatedly take the global max
+    cell, binding one row to one column; returns per-column matched row
+    (-1 unmatched) and distance. dist [G, P], valid_rows [G] bool."""
+    G, P = dist.shape
+    neg = jnp.full_like(dist, -1.0)
+    d = jnp.where(valid_rows[:, None], dist, neg)
+
+    def body(_, state):
+        d_cur, match, mdist = state
+        flat = jnp.argmax(d_cur)
+        gi, pi = flat // P, flat % P
+        best = d_cur[gi, pi]
+        take = best > 0.0
+        match = jnp.where(take, match.at[pi].set(gi.astype(jnp.int32)),
+                          match)
+        mdist = jnp.where(take, mdist.at[pi].set(best), mdist)
+        # retire the row and the column
+        d_cur = jnp.where(take, d_cur.at[gi, :].set(-1.0).at[:, pi].set(-1.0),
+                          d_cur)
+        return d_cur, match, mdist
+
+    match0 = jnp.full((P,), -1, jnp.int32)
+    mdist0 = jnp.zeros((P,), jnp.float32)
+    _, match, mdist = lax.fori_loop(0, G, body, (d, match0, mdist0))
+    return match, mdist
+
+
+@register_op("bipartite_match", no_grad=True)
+def _bipartite_match(ctx, ins, attrs):
+    """bipartite_match_op.cc: DistMat [B, G, P] (dense batch; rows with
+    all-zero distance are padding). match_type='per_prediction' also
+    assigns any unmatched column whose best row distance exceeds
+    dist_threshold (ssd_loss's matching mode)."""
+    dist = ins["DistMat"][0]
+    if dist.ndim == 2:
+        dist = dist[None]
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = float(attrs.get("dist_threshold", 0.5))
+
+    def one(d):
+        valid = jnp.any(d > 0, axis=1)
+        match, mdist = _greedy_bipartite(d, valid)
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_val = jnp.max(d, axis=0)
+            extra = (match < 0) & (best_val >= thresh)
+            match = jnp.where(extra, best_row, match)
+            mdist = jnp.where(extra, best_val, mdist)
+        return match, mdist
+
+    match, mdist = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": [match],
+            "ColToRowMatchDist": [mdist]}
+
+
+@register_op("target_assign", no_grad=True)
+def _target_assign(ctx, ins, attrs):
+    """target_assign_op.cc: per prior p with match[p]=g >= 0, copy
+    X[b, g] into Out[b, p] with weight 1; mismatch keeps `mismatch_value`
+    with weight 0."""
+    x = ins["X"][0]                    # [B, G, K]
+    match = ins["MatchIndices"][0]     # [B, P] int
+    mis = float(attrs.get("mismatch_value", 0.0))
+    B, G, K = x.shape
+    safe = jnp.maximum(match, 0)
+    gathered = jnp.take_along_axis(
+        x, safe[:, :, None].astype(jnp.int32).repeat(K, axis=2), axis=1)
+    hit = (match >= 0)[:, :, None]
+    out = jnp.where(hit, gathered, mis)
+    w = hit.astype(jnp.float32)
+    return {"Out": [out], "OutWeight": [w]}
+
+
+@register_op("box_clip", no_grad=True)
+def _box_clip(ctx, ins, attrs):
+    """box_clip_op.cc: clip [.., 4] xyxy boxes into the image."""
+    x = ins["Input"][0]
+    im = ins["ImInfo"][0]              # [B, 3] h, w, scale
+    h = (im[:, 0] / im[:, 2] - 1.0)
+    w = (im[:, 1] / im[:, 2] - 1.0)
+    shape = (-1,) + (1,) * (x.ndim - 2)
+    hh, ww = h.reshape(shape), w.reshape(shape)
+    out = jnp.stack([
+        jnp.clip(x[..., 0], 0, ww), jnp.clip(x[..., 1], 0, hh),
+        jnp.clip(x[..., 2], 0, ww), jnp.clip(x[..., 3], 0, hh)], axis=-1)
+    return {"Output": [out]}
+
+
+@register_op("polygon_box_transform", no_grad=True)
+def _polygon_box_transform(ctx, ins, attrs):
+    """polygon_box_transform_op.cc: input [N, 8, H, W] offset maps ->
+    absolute quad coordinates (x = 4*w_idx - offset, y = 4*h_idx -
+    offset per the EAST-style geometry)."""
+    x = ins["Input"][0]
+    N, C, H, W = x.shape
+    col = jax.lax.broadcasted_iota(jnp.float32, (H, W), 1) * 4.0
+    row = jax.lax.broadcasted_iota(jnp.float32, (H, W), 0) * 4.0
+    grid = jnp.stack([col, row] * (C // 2), axis=0)  # [C, H, W]
+    return {"Output": [grid[None] - x]}
+
+
+@register_op("ssd_loss", diff_inputs=["Location", "Confidence"])
+def _ssd_loss(ctx, ins, attrs):
+    """ssd_loss (reference detection.py:877 composition, fused): IoU ->
+    per-prediction matching -> encoded loc targets -> smooth_l1 on
+    positives + softmax CE with hard negative mining; per-image
+    normalization by the positive count. Dense gt: [B, G, 4] boxes with
+    zero-area rows as padding, labels [B, G]."""
+    loc = ins["Location"][0]           # [B, P, 4]
+    conf = ins["Confidence"][0]        # [B, P, C]
+    gt_box = ins["GTBox"][0]           # [B, G, 4] normalized xyxy
+    gt_label = ins["GTLabel"][0]       # [B, G] int
+    prior = ins["PriorBox"][0]         # [P, 4]
+    pvar = (ins.get("PriorBoxVar") or [None])[0]
+    background = int(attrs.get("background_label", 0))
+    overlap_t = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    B, P, C = conf.shape
+
+    if pvar is None:
+        pvar = jnp.ones((P, 4), jnp.float32)
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+
+    def one(loc_i, conf_i, gt_i, lab_i):
+        valid = (gt_i[:, 2] - gt_i[:, 0] > 0) & (gt_i[:, 3] - gt_i[:, 1] > 0)
+        iou = jnp.where(valid[:, None], _pairwise_iou_xyxy(gt_i, prior), 0.0)
+        match, _ = _greedy_bipartite(iou, valid)
+        best_row = jnp.argmax(iou, axis=0).astype(jnp.int32)
+        best_val = jnp.max(iou, axis=0)
+        extra = (match < 0) & (best_val >= overlap_t)
+        match = jnp.where(extra, best_row, match)
+        pos = match >= 0
+        g = jnp.maximum(match, 0)
+
+        # encoded location targets (encode_center_size w/ prior var)
+        gb = gt_i[g]
+        gw = gb[:, 2] - gb[:, 0]
+        gh = gb[:, 3] - gb[:, 1]
+        gcx = gb[:, 0] + gw * 0.5
+        gcy = gb[:, 1] + gh * 0.5
+        tx = (gcx - pcx) / pw / pvar[:, 0]
+        ty = (gcy - pcy) / ph / pvar[:, 1]
+        tw = jnp.log(jnp.maximum(gw / pw, 1e-10)) / pvar[:, 2]
+        th = jnp.log(jnp.maximum(gh / ph, 1e-10)) / pvar[:, 3]
+        tgt = jnp.stack([tx, ty, tw, th], axis=1)
+        diff = loc_i - tgt
+        ad = jnp.abs(diff)
+        smooth = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5)
+        loc_loss = jnp.sum(jnp.where(pos[:, None], smooth, 0.0))
+
+        labels = jnp.where(pos, lab_i[g].astype(jnp.int32), background)
+        logp = jax.nn.log_softmax(conf_i.astype(jnp.float32), axis=-1)
+        ce = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        npos = jnp.sum(pos)
+        nneg = jnp.minimum((neg_ratio * npos).astype(jnp.int32),
+                           P - npos).astype(jnp.int32)
+        neg_ce = jnp.where(pos, -jnp.inf, ce)
+        sorted_neg = jnp.sort(neg_ce)[::-1]
+        rank = jnp.arange(P)
+        neg_loss = jnp.sum(jnp.where(rank < nneg,
+                                     jnp.where(jnp.isfinite(sorted_neg),
+                                               sorted_neg, 0.0), 0.0))
+        pos_loss = jnp.sum(jnp.where(pos, ce, 0.0))
+        total = (conf_w * (pos_loss + neg_loss) + loc_w * loc_loss)
+        return total / jnp.maximum(npos.astype(jnp.float32), 1.0)
+
+    loss = jax.vmap(one)(loc, conf.astype(jnp.float32),
+                         gt_box.astype(jnp.float32), gt_label)
+    return {"Loss": [loss]}
+
+
+@register_op("distribute_fpn_proposals", no_grad=True)
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """distribute_fpn_proposals_op.cc: assign each roi to an FPN level by
+    sqrt-area; dense outputs keep the roi count per level with zero
+    padding plus index maps (RestoreIndex)."""
+    rois = ins["FpnRois"][0]           # [N, 4]
+    min_l = int(attrs["min_level"])
+    max_l = int(attrs["max_level"])
+    canon_s = float(attrs.get("refer_scale", 224))
+    canon_l = int(attrs.get("refer_level", 4))
+    N = rois.shape[0]
+    scale = jnp.sqrt(jnp.maximum(
+        (rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1]), 1e-10))
+    lvl = jnp.floor(canon_l + jnp.log2(scale / canon_s + 1e-10))
+    lvl = jnp.clip(lvl, min_l, max_l).astype(jnp.int32)
+    outs = []
+    for l in range(min_l, max_l + 1):
+        sel = (lvl == l)
+        order = jnp.argsort(~sel)      # selected rois first, stable
+        gathered = rois[order]
+        outs.append(jnp.where(
+            (jnp.arange(N) < jnp.sum(sel))[:, None], gathered, 0.0))
+    restore = jnp.argsort(jnp.argsort(lvl, stable=True), stable=True)
+    return {"MultiFpnRois": outs,
+            "RestoreIndex": [restore.astype(jnp.int32)[:, None]]}
+
+
+@register_op("box_decoder_and_assign", no_grad=True)
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """box_decoder_and_assign_op.cc: decode per-class deltas against
+    prior boxes, then assign each roi its best-scoring class's box."""
+    prior = ins["PriorBox"][0]         # [N, 4]
+    deltas = ins["TargetBox"][0]       # [N, C*4]
+    scores = ins["BoxScore"][0]        # [N, C]
+    weights = [float(w) for w in attrs.get("box_clip", [])] or None
+    clip = float(attrs.get("box_clip", 4.135)) if not isinstance(
+        attrs.get("box_clip", 4.135), (list, tuple)) else 4.135
+    N, C = scores.shape
+    d = deltas.reshape(N, C, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    cx = d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = d[..., 1] * ph[:, None] + pcy[:, None]
+    bw = jnp.exp(jnp.minimum(d[..., 2], clip)) * pw[:, None]
+    bh = jnp.exp(jnp.minimum(d[..., 3], clip)) * ph[:, None]
+    decoded = jnp.stack([cx - bw / 2, cy - bh / 2,
+                         cx + bw / 2 - 1, cy + bh / 2 - 1], axis=-1)
+    best = jnp.argmax(scores[:, 1:], axis=1) + 1  # skip background col 0
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, 2), axis=1)[:, 0]
+    return {"DecodeBox": [decoded.reshape(N, C * 4)],
+            "OutputAssignBox": [assigned]}

@@ -1,0 +1,125 @@
+"""SSD detection stack tests (reference test_ssd_loss.py /
+test_bipartite_match_op.py / test_target_assign_op.py analogs, dense
+batch contract)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(build, feeds):
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            fetches = build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        return exe.run(main, feed=feeds, fetch_list=list(fetches),
+                       scope=scope)
+
+
+def test_bipartite_match_greedy():
+    # 2 gts x 4 priors; greedy max matching then per_prediction fill
+    dist = np.array([[[0.9, 0.1, 0.2, 0.0],
+                      [0.8, 0.7, 0.1, 0.0]]], "float32")
+    (idx, md) = _run(
+        lambda: list(layers.bipartite_match(
+            layers.data("d", [1, 2, 4], append_batch_size=False),
+            match_type="per_prediction", dist_threshold=0.15)),
+        {"d": dist})
+    # greedy: (g0,p0)=0.9 taken; then g1 best remaining p1=0.7
+    assert idx[0, 0] == 0 and idx[0, 1] == 1
+    # per_prediction: p2 best row is g0 (0.2 >= 0.15) -> matched 0
+    assert idx[0, 2] == 0
+    assert idx[0, 3] == -1  # below threshold
+    np.testing.assert_allclose(md[0, :2], [0.9, 0.7], rtol=1e-6)
+
+
+def test_target_assign_scatter():
+    x = np.arange(12, dtype="float32").reshape(1, 3, 4)  # 3 gts, K=4
+    match = np.array([[2, -1, 0]], "int32")
+    (out, w) = _run(
+        lambda: list(layers.target_assign(
+            layers.data("x", [1, 3, 4], append_batch_size=False),
+            layers.data("m", [1, 3], dtype="int32",
+                        append_batch_size=False),
+            mismatch_value=9.0)),
+        {"x": x, "m": match})
+    np.testing.assert_allclose(out[0, 0], x[0, 2])
+    np.testing.assert_allclose(out[0, 1], [9.0] * 4)
+    np.testing.assert_allclose(out[0, 2], x[0, 0])
+    np.testing.assert_allclose(w[0, :, 0], [1.0, 0.0, 1.0])
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -5.0, 70.0, 30.0]]], "float32")
+    info = np.array([[40.0, 60.0, 1.0]], "float32")
+    (out,) = _run(
+        lambda: [layers.box_clip(
+            layers.data("b", [1, 1, 4], append_batch_size=False),
+            layers.data("i", [1, 3], append_batch_size=False))],
+        {"b": boxes, "i": info})
+    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 59.0, 29.0])
+
+
+def test_distribute_fpn_proposals_levels():
+    rois = np.array([[0, 0, 16, 16],        # tiny -> min level
+                     [0, 0, 500, 500],      # huge -> max level
+                     [0, 0, 224, 224]], "float32")
+    def build():
+        r = layers.data("r", [3, 4], append_batch_size=False)
+        outs, restore = layers.distribute_fpn_proposals(r, 2, 5, 4, 224)
+        return outs + [restore]
+
+    res = _run(build, {"r": rois})
+    lvl2, lvl3, lvl4, lvl5, restore = res
+    np.testing.assert_allclose(lvl2[0], rois[0])      # tiny roi at level 2
+    np.testing.assert_allclose(lvl5[0], rois[1])      # huge roi at level 5
+    np.testing.assert_allclose(lvl4[0], rois[2])      # canonical at level 4
+    assert restore.shape == (3, 1)
+
+
+def test_ssd_pipeline_trains(fresh_programs):
+    """multi_box_head -> ssd_loss trains; detection_output emits the
+    fixed-size NMS result."""
+    main, startup, scope = fresh_programs
+    from paddle_tpu.core.scope import scope_guard
+
+    B, C = 2, 4
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [B, 3, 64, 64], append_batch_size=False)
+        f1 = layers.conv2d(img, num_filters=8, filter_size=3, stride=8,
+                           padding=1)
+        f2 = layers.conv2d(f1, num_filters=8, filter_size=3, stride=2,
+                           padding=1)
+        locs, confs, pri, pvar = layers.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=C,
+            aspect_ratios=[[2.0], [2.0]], min_sizes=[12.0, 24.0],
+            max_sizes=[24.0, 48.0], flip=True)
+        gtb = layers.data("gtb", [B, 3, 4], append_batch_size=False)
+        gtl = layers.data("gtl", [B, 3], dtype="int64",
+                          append_batch_size=False)
+        loss = layers.reduce_mean(layers.ssd_loss(
+            locs, confs, gtb, gtl, pri, pvar))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+        dets = layers.detection_output(locs, layers.softmax(confs), pri,
+                                       pvar, keep_top_k=10)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        gt = np.zeros((B, 3, 4), "float32")
+        gt[:, :2] = rs.rand(B, 2, 4).astype("float32") * 0.4
+        gt[:, :2, 2:] = gt[:, :2, :2] + 0.3
+        feed = {"img": rs.randn(B, 3, 64, 64).astype("float32"),
+                "gtb": gt,
+                "gtl": rs.randint(1, C, (B, 3)).astype("int64")}
+        ls = [float(exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)[0]) for _ in range(8)]
+        (d,) = exe.run(main, feed=feed, fetch_list=[dets], scope=scope)
+    assert np.isfinite(ls).all() and ls[-1] < ls[0]
+    assert d.shape == (B, 10, 6)
