@@ -63,7 +63,8 @@ def test_box_clip():
             layers.data("b", [1, 1, 4], append_batch_size=False),
             layers.data("i", [1, 3], append_batch_size=False))],
         {"b": boxes, "i": info})
-    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 59.0, 29.0])
+    # clip to [0, w-1]x[0, h-1] = [0,59]x[0,39]; y2=30 is in bounds
+    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 59.0, 30.0])
 
 
 def test_distribute_fpn_proposals_levels():
