@@ -26,6 +26,17 @@ __all__ = [
     "dynamic_lstm",
     "dynamic_gru",
     "gru_unit",
+    "pool3d",
+    "adaptive_pool3d",
+    "conv3d_transpose",
+    "ctc_greedy_decoder",
+    "spectral_norm",
+    "affine_grid",
+    "grid_sampler",
+    "sequence_scatter",
+    "data_norm",
+    "sampled_softmax_with_cross_entropy",
+    "im2sequence",
     "selu",
     "multiplex",
     "space_to_depth",
@@ -1656,4 +1667,206 @@ def sum(x):
     helper.append_op(type="sum", inputs={"X": list(xs)},
                      outputs={"Out": [out]})
     out.shape = xs[0].shape
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    """reference nn.py pool3d (NCDHW)."""
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    trip = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+    helper.append_op(type="pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": trip(pool_size),
+                            "strides": trip(pool_stride),
+                            "paddings": trip(pool_padding),
+                            "global_pooling": global_pooling,
+                            "exclusive": exclusive})
+    n, c, d, h, w = input.shape
+    if global_pooling:
+        out.shape = (n, c, 1, 1, 1)
+    else:
+        k, s, p = trip(pool_size), trip(pool_stride), trip(pool_padding)
+        dims = [(v + 2 * p[i] - k[i]) // s[i] + 1
+                for i, v in enumerate((d, h, w))]
+        out.shape = (n, c) + tuple(dims)
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ps = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    helper.append_op(type="adaptive_pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"ksize": ps, "pooling_type": pool_type})
+    out.shape = tuple(input.shape[:2]) + tuple(ps)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """reference nn.py conv3d_transpose (NCDHW)."""
+    helper = LayerHelper("conv3d_transpose", name=name,
+                         bias_attr=bias_attr, act=act)
+    c = input.shape[1]
+    trip = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+    k = trip(filter_size)
+    w = helper.create_parameter(param_attr,
+                                [c, num_filters] + k, input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="conv3d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"strides": trip(stride),
+                            "paddings": trip(padding)})
+    n, _, d, h, wd = input.shape
+    s, p = trip(stride), trip(padding)
+    dims = [s[i] * (v - 1) + k[i] - 2 * p[i]
+            for i, v in enumerate((d, h, wd))]
+    out.shape = (n, num_filters) + tuple(dims)
+    out = helper.append_bias_op(out, dim_start=1, size=num_filters)
+    return helper.append_activation(out, act)
+
+
+def ctc_greedy_decoder(input, blank, length=None, name=None):
+    """reference nn.py ctc_greedy_decoder, masked-dense: probs [B,T,C]
+    (+ length [B]) -> (decoded ids [B,T] padded -1, lengths [B])."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    out = helper.create_variable_for_type_inference("int32",
+                                                    stop_gradient=True)
+    olen = helper.create_variable_for_type_inference("int64",
+                                                     stop_gradient=True)
+    ins = {"Input": [input]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="ctc_greedy_decoder", inputs=ins,
+                     outputs={"Out": [out], "OutLength": [olen]},
+                     attrs={"blank": int(blank)})
+    out.shape = tuple(input.shape[:2]) if input.shape else None
+    olen.shape = (input.shape[0],) if input.shape else None
+    return out, olen
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", name=name)
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    h = weight.shape[dim] if weight.shape else 1
+    u = helper.create_global_variable(
+        name=unique_name.generate("spectral_norm_u"), shape=[h],
+        dtype="float32", initializer=Constant(1.0))
+    helper.append_op(type="spectral_norm",
+                     inputs={"Weight": [weight], "U": [u]},
+                     outputs={"Out": [out], "UOut": [u]},
+                     attrs={"dim": int(dim), "power_iters": int(power_iters),
+                            "eps": float(eps)})
+    out.shape = weight.shape
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    """reference nn.py affine_grid: theta [N,2,3] -> grid [N,H,W,2]."""
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    shape = [int(s) for s in (out_shape if isinstance(out_shape,
+                                                      (list, tuple))
+                              else list(out_shape))]
+    helper.append_op(type="affine_grid", inputs={"Theta": [theta]},
+                     outputs={"Output": [out]},
+                     attrs={"output_shape": shape})
+    out.shape = (theta.shape[0], shape[-2], shape[-1], 2)
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="grid_sampler",
+                     inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]})
+    out.shape = tuple(x.shape[:2]) + tuple(grid.shape[1:3])
+    return out
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    """reference sequence_scatter (masked-dense; length gates steps)."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_scatter", inputs=ins,
+                     outputs={"Out": [out]})
+    out.shape = input.shape
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    """reference nn.py data_norm: normalization by running batch
+    statistics (CTR models; no learned affine)."""
+    helper = LayerHelper("data_norm", name=name)
+    D = input.shape[-1]
+    size_v = helper.create_global_variable(
+        name=unique_name.generate("data_norm_size"), shape=[D],
+        dtype="float32", initializer=Constant(1e-4))
+    sum_v = helper.create_global_variable(
+        name=unique_name.generate("data_norm_sum"), shape=[D],
+        dtype="float32", initializer=Constant(0.0))
+    sq_v = helper.create_global_variable(
+        name=unique_name.generate("data_norm_sq"), shape=[D],
+        dtype="float32", initializer=Constant(1e-4))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype)
+    scales = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="data_norm",
+                     inputs={"X": [input], "BatchSize": [size_v],
+                             "BatchSum": [sum_v],
+                             "BatchSquareSum": [sq_v]},
+                     outputs={"Y": [out], "BatchSizeOut": [size_v],
+                              "BatchSumOut": [sum_v],
+                              "BatchSquareSumOut": [sq_v],
+                              "Means": [means], "Scales": [scales]},
+                     attrs={"epsilon": float(epsilon)})
+    out.shape = input.shape
+    return helper.append_activation(out, act)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference nn.py sampled_softmax_with_cross_entropy (uniform
+    sampler)."""
+    helper = LayerHelper("sampled_softmax")
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(type="sampled_softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"num_samples": int(num_samples)})
+    loss.shape = (logits.shape[0], 1) if logits.shape else None
+    return loss
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """reference nn.py im2sequence (op lowering pre-existing in ops/nn.py)."""
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pair = lambda v: [v] * 2 if isinstance(v, int) else list(v)
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": pair(filter_size),
+                            "strides": pair(stride),
+                            "paddings": pair(padding) * 2})
     return out

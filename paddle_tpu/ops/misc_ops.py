@@ -230,3 +230,235 @@ def _print_op(ctx, ins, attrs):
     name = attrs.get("name") or ""
     jax.debug.print(msg + " " + name + " = {x}", x=x)
     return {"Out": [x]}
+
+
+@register_op("pool3d", diff_inputs=["X"])
+def _pool3d(ctx, ins, attrs):
+    """pool_op.cc 3D variant: NCDHW max/avg pooling."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    k = [int(v) for v in attrs.get("ksize", [2, 2, 2])]
+    s = [int(v) for v in attrs.get("strides", [1, 1, 1])]
+    p = [int(v) for v in attrs.get("paddings", [0, 0, 0])]
+    if attrs.get("global_pooling", False):
+        k = list(x.shape[2:])
+        s = [1, 1, 1]
+        p = [0, 0, 0]
+    window = (1, 1) + tuple(k)
+    strides = (1, 1) + tuple(s)
+    padding = [(0, 0), (0, 0)] + [(v, v) for v in p]
+    if ptype == "max":
+        out = lax.reduce_window(x, -float("inf"), lax.max, window, strides,
+                                padding)
+    else:
+        tot = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if attrs.get("exclusive", True) and any(p):
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                    strides, padding)
+            out = tot / cnt
+        else:
+            out = tot / (k[0] * k[1] * k[2])
+    return {"Out": [out]}
+
+
+@register_op("adaptive_pool3d", diff_inputs=["X"])
+def _adaptive_pool3d(ctx, ins, attrs):
+    """pool_op.cc adaptive 3D: output spatial dims fixed; implemented by
+    even splitting (sizes must divide, the common use)."""
+    x = ins["X"][0]
+    out_dhw = [int(v) for v in attrs["ksize"]]
+    ptype = attrs.get("pooling_type", "max")
+    N, C, D, H, W = x.shape
+    od, oh, ow = out_dhw
+    x6 = x.reshape(N, C, od, D // od, oh, H // oh, ow, W // ow)
+    red = (3, 5, 7)
+    out = jnp.max(x6, axis=red) if ptype == "max" else jnp.mean(x6, axis=red)
+    return {"Out": [out]}
+
+
+@register_op("conv3d_transpose", diff_inputs=["Input", "Filter"])
+def _conv3d_transpose(ctx, ins, attrs):
+    """conv_transpose_op.cc 3D: NCDHW gradient-style transpose conv."""
+    x = ins["Input"][0]
+    w = ins["Filter"][0]                      # [Cin, Cout, KD, KH, KW]
+    s = [int(v) for v in attrs.get("strides", [1, 1, 1])]
+    p = [int(v) for v in attrs.get("paddings", [0, 0, 0])]
+    # explicit pads of (k-1-p) give the reference semantics
+    # out = (in-1)*s + k - 2p (jax only auto-transposes 'SAME'/'VALID');
+    # jax reads the declared-I slot as OUTPUT channels, so swap first
+    tp = [(w.shape[2 + i] - 1 - p[i], w.shape[2 + i] - 1 - p[i])
+          for i in range(3)]
+    out = lax.conv_transpose(x, jnp.swapaxes(w, 0, 1), strides=s,
+                             padding=tp,
+                             dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+                             transpose_kernel=True)
+    return {"Out": [out]}
+
+
+@register_op("ctc_greedy_decoder", no_grad=True)
+def _ctc_greedy_decoder(ctx, ins, attrs):
+    """ctc_align_op.cc greedy path, masked-dense: probs [B, T, C] +
+    Length [B] -> argmax, collapse repeats, drop blanks; output padded
+    with -1 plus decoded lengths."""
+    probs = ins["Input"][0]
+    length = (ins.get("Length") or [None])[0]
+    blank = int(attrs.get("blank", 0))
+    B, T, C = probs.shape
+    ids = jnp.argmax(probs, axis=-1).astype(jnp.int32)     # [B, T]
+    t_idx = jnp.arange(T)[None, :]
+    alive = t_idx < (length[:, None] if length is not None
+                     else jnp.full((B, 1), T))
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), ids[:, :-1]],
+                           axis=1)
+    keep = alive & (ids != blank) & (ids != prev)
+    # compact kept ids to the front, pad with -1
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compact = jnp.take_along_axis(ids, order, axis=1)
+    nkeep = jnp.sum(keep, axis=1)
+    out = jnp.where(jnp.arange(T)[None, :] < nkeep[:, None], compact, -1)
+    return {"Out": [out], "OutLength": [nkeep.astype(jnp.int64)]}
+
+
+@register_op("spectral_norm", diff_inputs=["Weight"])
+def _spectral_norm(ctx, ins, attrs):
+    """spectral_norm_op.cc: weight / sigma_max via power iteration on
+    the [dim, -1] reshape. Like the reference, `U` is persistent state
+    warmed across steps (UOut), so power_iters=1 converges over
+    training; gradient flows through weight only (u/v stop_gradient)."""
+    w = ins["Weight"][0]
+    u_state = (ins.get("U") or [None])[0]
+    dim = int(attrs.get("dim", 0))
+    iters = int(attrs.get("power_iters", 1))
+    eps = float(attrs.get("eps", 1e-12))
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)  # [H, W]
+
+    def norm(v):
+        return v / (jnp.linalg.norm(v) + eps)
+
+    u = (norm(jnp.ones((mat.shape[0],), mat.dtype))
+         if u_state is None else u_state)
+    for _ in range(max(iters, 1)):
+        v = norm(lax.stop_gradient(mat).T @ u)
+        u = norm(lax.stop_gradient(mat) @ v)
+    u = lax.stop_gradient(u)
+    v = lax.stop_gradient(v)
+    sigma = u @ mat @ v
+    out = w / sigma
+    return {"Out": [out], "UOut": [u]}
+
+
+@register_op("affine_grid", diff_inputs=["Theta"])
+def _affine_grid(ctx, ins, attrs):
+    """affine_grid_op.cc: theta [N, 2, 3] -> sampling grid [N, H, W, 2]
+    over the [-1, 1] normalized output lattice."""
+    theta = ins["Theta"][0]
+    H, W = [int(v) for v in attrs["output_shape"]][-2:]
+    ys = jnp.linspace(-1.0, 1.0, H)
+    xs = jnp.linspace(-1.0, 1.0, W)
+    gx, gy = jnp.meshgrid(xs, ys)                 # [H, W]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)          # [N, H, W, 2]
+    return {"Output": [grid]}
+
+
+@register_op("grid_sampler", diff_inputs=["X", "Grid"])
+def _grid_sampler(ctx, ins, attrs):
+    """grid_sample_op.cc: bilinear sample x [N,C,H,W] at grid [N,Ho,Wo,2]
+    ([-1,1] normalized, zero padding outside)."""
+    x = ins["X"][0]
+    grid = ins["Grid"][0]
+    N, C, H, W = x.shape
+    gx = (grid[..., 0] + 1.0) * (W - 1) / 2.0     # [N, Ho, Wo]
+    gy = (grid[..., 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+
+    def gather(img, yy, xx):
+        inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]                        # [C, Ho, Wo]
+        return jnp.where(inb[None], v, 0.0)
+
+    def one(img, gy_n, gx_n, y0_n, x0_n):
+        dy = (gy_n - y0_n)[None]
+        dx = (gx_n - x0_n)[None]
+        return (gather(img, y0_n, x0_n) * (1 - dy) * (1 - dx)
+                + gather(img, y0_n, x0_n + 1) * (1 - dy) * dx
+                + gather(img, y0_n + 1, x0_n) * dy * (1 - dx)
+                + gather(img, y0_n + 1, x0_n + 1) * dy * dx)
+
+    out = jax.vmap(one)(x, gy, gx, y0, x0)
+    return {"Output": [out]}
+
+
+@register_op("sequence_scatter", diff_inputs=["X", "Updates"])
+def _sequence_scatter(ctx, ins, attrs):
+    """sequence_scatter_op.cc, masked-dense: out = X; for each batch row
+    b and step t < len[b]: out[b, index[b, t]] += updates[b, t]."""
+    x = ins["X"][0]                    # [B, D]
+    idx = ins["Ids"][0].astype(jnp.int32)  # [B, T]
+    upd = ins["Updates"][0]            # [B, T]
+    length = (ins.get("Length") or [None])[0]
+    B, T = idx.shape
+    if length is not None:
+        mask = jnp.arange(T)[None, :] < length[:, None]
+    else:
+        mask = jnp.ones((B, T), bool)
+    upd = jnp.where(mask, upd, 0.0)
+
+    def one(row, ids_r, upd_r):
+        return row.at[ids_r].add(upd_r)
+
+    return {"Out": [jax.vmap(one)(x, idx, upd)]}
+
+
+@register_op("data_norm", diff_inputs=["X"])
+def _data_norm(ctx, ins, attrs):
+    """data_norm_op.cc: normalize by running batch statistics
+    (batch_sum / batch_size, no learned affine); accumulators update
+    like the reference's CTR usage."""
+    x = ins["X"][0]
+    bsize = ins["BatchSize"][0]
+    bsum = ins["BatchSum"][0]
+    bsq = ins["BatchSquareSum"][0]
+    eps = float(attrs.get("epsilon", 1e-4))
+    mean = bsum / bsize
+    var = jnp.maximum(bsq / bsize - mean * mean, eps)
+    out = (x - mean) / jnp.sqrt(var)
+    n = jnp.asarray(x.shape[0], x.dtype)
+    new_size = bsize + n
+    new_sum = bsum + jnp.sum(x, axis=0)
+    new_sq = bsq + jnp.sum(x * x, axis=0)
+    return {"Y": [out], "BatchSizeOut": [new_size],
+            "BatchSumOut": [new_sum], "BatchSquareSumOut": [new_sq],
+            "Means": [mean], "Scales": [1.0 / jnp.sqrt(var)]}
+
+
+@register_op("sampled_softmax_with_cross_entropy", diff_inputs=["Logits"],
+             uses_rng=True)
+def _sampled_softmax(ctx, ins, attrs):
+    """sampled_softmax_with_cross_entropy_op.cc: softmax CE over the true
+    class + num_samples uniformly sampled negatives with the
+    log-probability correction (train-time approximation for huge
+    vocabularies)."""
+    logits = ins["Logits"][0]          # [B, V]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    S = int(attrs.get("num_samples", 100))
+    B, V = logits.shape
+    rng = ctx.next_rng()
+    neg = jax.random.randint(rng, (B, S), 0, V)
+    cols = jnp.concatenate([label[:, None], neg], axis=1)   # [B, 1+S]
+    picked = jnp.take_along_axis(logits, cols, axis=1)
+    # uniform proposal correction: q = S / V per sampled class
+    logq = jnp.log(jnp.asarray(S / V, picked.dtype))
+    adj = picked - jnp.concatenate(
+        [jnp.zeros((B, 1), picked.dtype),
+         jnp.full((B, S), logq, picked.dtype)], axis=1)
+    # mask accidental true-class hits among the negatives
+    hit = cols[:, 1:] == label[:, None]
+    adj = jnp.concatenate(
+        [adj[:, :1], jnp.where(hit, -1e9, adj[:, 1:])], axis=1)
+    loss = -jax.nn.log_softmax(adj, axis=1)[:, 0]
+    return {"Loss": [loss[:, None]]}

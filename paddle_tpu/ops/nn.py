@@ -73,16 +73,25 @@ def _conv2d_transpose(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
-    # paddle filter layout for transpose conv: (in, out/groups, kh, kw) = IOHW
+    # paddle stores the transpose-conv filter as (in, out/groups, kh, kw);
+    # with transpose_kernel=True jax reads the declared-I slot as the
+    # OUTPUT channels, so swap to (out/groups, in, kh, kw) first
+    if groups != 1:
+        raise NotImplementedError(
+            "conv2d_transpose with groups > 1 is not supported yet")
+    # jax only auto-transposes 'SAME'/'VALID' pads; explicit pairs apply
+    # to the dilated conv directly, so the reference semantics
+    # out = (in-1)*s + k_eff - 2p need pads of (k_eff - 1 - p)
+    k_eff = [(w.shape[2 + i] - 1) * dilations[i] + 1 for i in range(2)]
+    tp = [(k_eff[i] - 1 - pads[i], k_eff[i] - 1 - pads[i]) for i in range(2)]
     out = lax.conv_transpose(
         x,
-        w,
+        jnp.swapaxes(w, 0, 1),
         strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        padding=tp,
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "IOHW", "NCHW"),
         transpose_kernel=True,
-        feature_group_count=groups,
     )
     return {"Output": [out]}
 

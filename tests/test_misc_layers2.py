@@ -1,0 +1,177 @@
+"""Round-3 layers batch 3: 3D ops, STN (affine_grid/grid_sampler),
+ctc_greedy_decoder, spectral_norm, sequence_scatter, data_norm, sampled
+softmax — plus the conv2d_transpose adjoint regression (the old lowering
+failed for ANY call: bad kwarg + wrong kernel layout)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(build, feeds):
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            fetches = build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        return exe.run(main, feed=feeds, fetch_list=list(fetches),
+                       scope=scope), scope
+
+
+def test_conv2d_transpose_is_conv_adjoint():
+    """<conv(x;W), y> == <x, conv_transpose(y;W)> with shared storage —
+    pins the transpose_kernel layout fix."""
+    rs = np.random.RandomState(0)
+    Cin, Cout, k, s, p, H = 2, 3, 3, 2, 1, 7
+    x = rs.randn(1, Cin, H, H).astype("float32")
+    W = rs.randn(Cout, Cin, k, k).astype("float32")
+    y = rs.randn(1, Cout, 4, 4).astype("float32")
+
+    def build():
+        xv = layers.data("x", [1, Cin, H, H], append_batch_size=False)
+        cf = layers.conv2d(xv, num_filters=Cout, filter_size=k, stride=s,
+                           padding=p, bias_attr=False,
+                           param_attr=fluid.ParamAttr(name="wf"))
+        yv = layers.data("y", [1, Cout, 4, 4], append_batch_size=False)
+        ct = layers.conv2d_transpose(yv, num_filters=Cin, filter_size=k,
+                                     stride=s, padding=p, bias_attr=False,
+                                     param_attr=fluid.ParamAttr(name="wt"))
+        return [cf, ct]
+
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            cf, ct = build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        scope.set_var("wf", W)
+        scope.set_var("wt", W)
+        fwd, bwd = exe.run(main, feed={"x": x, "y": y},
+                           fetch_list=[cf, ct], scope=scope)
+    lhs = float((fwd * y).sum())
+    rhs = float((x * bwd).sum())
+    assert abs(lhs - rhs) < 1e-2 * max(abs(lhs), 1.0)
+
+
+def test_pool3d_and_conv3d_transpose_shapes():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 3, 4, 8, 8).astype("float32")
+
+    def build():
+        xv = layers.data("x", [2, 3, 4, 8, 8], append_batch_size=False)
+        p3 = layers.pool3d(xv, pool_size=2, pool_stride=2, pool_type="avg")
+        a3 = layers.adaptive_pool3d(xv, [2, 4, 4], pool_type="avg")
+        c3 = layers.conv3d_transpose(xv, num_filters=5, filter_size=2,
+                                     stride=2)
+        return [p3, a3, c3]
+
+    (p3, a3, c3), _ = _run(build, {"x": x})
+    assert p3.shape == (2, 3, 2, 4, 4)
+    np.testing.assert_allclose(p3[0, 0, 0, 0, 0],
+                               x[0, 0, :2, :2, :2].mean(), rtol=1e-5)
+    assert a3.shape == (2, 3, 2, 4, 4)
+    assert c3.shape == (2, 5, 8, 16, 16), c3.shape
+
+
+def test_ctc_greedy_decoder_collapses():
+    # argmax ids per step: [1,1,0,2,2,1] len 6 -> collapse/deblank: 1,2,1
+    probs = np.zeros((1, 6, 3), "float32")
+    for t, c in enumerate([1, 1, 0, 2, 2, 1]):
+        probs[0, t, c] = 1.0
+
+    def build():
+        p = layers.data("p", [1, 6, 3], append_batch_size=False)
+        ln = layers.data("ln", [1], dtype="int64", append_batch_size=False)
+        return list(layers.ctc_greedy_decoder(p, blank=0, length=ln))
+
+    (dec, dlen), _ = _run(build, {"p": probs,
+                                  "ln": np.array([6], "int64")})
+    assert dlen[0] == 3
+    np.testing.assert_array_equal(dec[0, :3], [1, 2, 1])
+    assert (dec[0, 3:] == -1).all()
+
+
+def test_spectral_norm_unit_sigma():
+    """U is persistent state (reference spectral_norm_op.cc): repeated
+    steps warm the power iteration to the top singular vector."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    rs = np.random.RandomState(2)
+    w = rs.randn(4, 6).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            out = layers.spectral_norm(
+                layers.data("w", [4, 6], append_batch_size=False),
+                power_iters=2)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        for _ in range(10):  # warm the persistent u
+            (o,) = exe.run(main, feed={"w": w}, fetch_list=[out],
+                           scope=scope)
+    s = np.linalg.svd(o, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+def test_affine_grid_identity_sampling():
+    rs = np.random.RandomState(3)
+    img = rs.randn(1, 2, 5, 5).astype("float32")
+    theta = np.array([[[1, 0, 0], [0, 1, 0]]], "float32")
+
+    def build():
+        im = layers.data("im", [1, 2, 5, 5], append_batch_size=False)
+        th = layers.data("th", [1, 2, 3], append_batch_size=False)
+        grid = layers.affine_grid(th, [1, 2, 5, 5])
+        return [layers.grid_sampler(im, grid)]
+
+    (out,), _ = _run(build, {"im": img, "th": theta})
+    np.testing.assert_allclose(out, img, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_scatter_adds():
+    base = np.zeros((2, 10), "float32")
+    idx = np.array([[1, 1, 3], [0, 2, 9]], "int64")
+    upd = np.ones((2, 3), "float32")
+    ln = np.array([3, 2], "int64")  # second row's t=2 masked out
+
+    def build():
+        b = layers.data("b", [2, 10], append_batch_size=False)
+        i = layers.data("i", [2, 3], dtype="int64",
+                        append_batch_size=False)
+        u = layers.data("u", [2, 3], append_batch_size=False)
+        l = layers.data("l", [2], dtype="int64", append_batch_size=False)
+        return [layers.sequence_scatter(b, i, u, length=l)]
+
+    (out,), _ = _run(build, {"b": base, "i": idx, "u": upd, "l": ln})
+    np.testing.assert_allclose(out[0], [0, 2, 0, 1, 0, 0, 0, 0, 0, 0])
+    np.testing.assert_allclose(out[1], [1, 0, 1, 0, 0, 0, 0, 0, 0, 0])
+
+
+def test_data_norm_and_sampled_softmax_finite():
+    rs = np.random.RandomState(4)
+
+    def build():
+        dx = layers.data("dx", [6])
+        dn = layers.data_norm(dx)
+        lg = layers.data("lg", [4, 50], append_batch_size=False)
+        lb = layers.data("lb", [4, 1], dtype="int64",
+                         append_batch_size=False)
+        ss = layers.sampled_softmax_with_cross_entropy(lg, lb,
+                                                       num_samples=10)
+        return [dn, ss]
+
+    (dn, ss), _ = _run(build, {
+        "dx": rs.randn(8, 6).astype("float32"),
+        "lg": rs.randn(4, 50).astype("float32"),
+        "lb": rs.randint(0, 50, (4, 1)).astype("int64")})
+    assert np.isfinite(dn).all() and dn.shape == (8, 6)
+    assert np.isfinite(ss).all() and ss.shape == (4, 1)
