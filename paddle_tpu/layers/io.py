@@ -18,7 +18,8 @@ from ..core.program import default_main_program, default_startup_program
 
 __all__ = ["data", "PyReader", "py_reader", "double_buffer",
            "create_py_reader_by_data", "read_file", "open_files",
-           "random_data_generator", "Preprocessor", "load"]
+           "random_data_generator", "Preprocessor", "load",
+           "shuffle", "batch"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -256,3 +257,24 @@ def load(out, file_path, load_as_fp16=False):
         arr = arr.astype(np.float16)
     global_scope().set_var(out.name, arr)
     return out
+
+
+def shuffle(reader, buffer_size):
+    """reference layers/io.py shuffle (op-based reader decorator): works
+    over PyReader generators or plain reader creators here."""
+    from ..reader import shuffle as _shuffle
+
+    if isinstance(reader, PyReader):
+        reader._gen = _shuffle(reader._gen, buffer_size)
+        return reader
+    return _shuffle(reader, buffer_size)
+
+
+def batch(reader, batch_size):
+    """reference layers/io.py batch decorator (see shuffle)."""
+    from ..reader import batch as _batch
+
+    if isinstance(reader, PyReader):
+        reader._gen = _batch(reader._gen, batch_size)
+        return reader
+    return _batch(reader, batch_size)

@@ -26,6 +26,11 @@ __all__ = [
     "dynamic_lstm",
     "dynamic_gru",
     "gru_unit",
+    "dynamic_lstmp",
+    "lstm",
+    "chunk_eval",
+    "hash",
+    "psroi_pool",
     "pool3d",
     "adaptive_pool3d",
     "conv3d_transpose",
@@ -1869,4 +1874,204 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
                      attrs={"kernels": pair(filter_size),
                             "strides": pair(stride),
                             "paddings": pair(padding) * 2})
+    return out
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, length=None):
+    """reference nn.py dynamic_lstmp (projection LSTM; masked-dense:
+    input [B, T, 4D] pre-projected, `length` [B] replaces LoD). Returns
+    (projection [B, T, P], cell [B, T, D])."""
+    helper = LayerHelper("dynamic_lstmp", name=name, bias_attr=bias_attr)
+    D = size // 4
+    w = helper.create_parameter(param_attr, [proj_size, 4 * D], dtype)
+    wp = helper.create_parameter(param_attr, [D, proj_size], dtype)
+    b = helper.create_parameter(bias_attr, [1, 4 * D], dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w], "ProjWeight": [wp]}
+    if b is not None:
+        ins["Bias"] = [b]
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="lstmp", inputs=ins,
+                     outputs={"Projection": [proj], "Cell": [cell]},
+                     attrs={"gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation,
+                            "proj_activation": proj_activation})
+    if input.shape:
+        proj.shape = tuple(input.shape[:2]) + (proj_size,)
+        cell.shape = tuple(input.shape[:2]) + (D,)
+    return proj, cell
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1, length=None):
+    """reference nn.py lstm (the cudnn-style stacked LSTM): composed
+    from fc + the scan lstm op per layer/direction. input [B, T, D_in];
+    init_h/init_c [num_layers*dirs, B, hidden]. Returns
+    (rnn_out [B, T, hidden*dirs], last_h, last_c)."""
+    from .tensor import concat
+
+    dirs = 2 if is_bidirec else 1
+    x = input
+    last_hs, last_cs = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            gates = fc(x, size=4 * hidden_size, num_flatten_dims=2)
+            helper = LayerHelper("lstm_l%d_d%d" % (layer, d), name=name)
+            w = helper.create_parameter(None, [hidden_size, 4 * hidden_size],
+                                        "float32")
+            hid = helper.create_variable_for_type_inference("float32")
+            cell = helper.create_variable_for_type_inference("float32")
+            ins = {"Input": [gates], "Weight": [w]}
+            if length is not None:
+                ins["Length"] = [length]
+            helper.append_op(type="lstm", inputs=ins,
+                             outputs={"Hidden": [hid], "Cell": [cell]},
+                             attrs={"is_reverse": bool(d == 1)})
+            if x.shape:
+                hid.shape = tuple(x.shape[:2]) + (hidden_size,)
+                cell.shape = hid.shape
+            outs.append((hid, cell))
+        x = (outs[0][0] if dirs == 1
+             else concat([h for h, _ in outs], axis=2))
+        if dropout_prob and not is_test:
+            x = dropout(x, dropout_prob=dropout_prob)
+    # last step states of the TOP layer per direction
+    T = input.shape[1] if input.shape else max_len
+    lh, lc = [], []
+    for d, (h, c) in enumerate(outs):
+        idx = 0 if d == 1 else T - 1
+        lh.append(reshape(slice(h, axes=[1], starts=[idx], ends=[idx + 1]),
+                          shape=[-1, hidden_size]))
+        lc.append(reshape(slice(c, axes=[1], starts=[idx], ends=[idx + 1]),
+                          shape=[-1, hidden_size]))
+    last_h = concat(lh, axis=1) if dirs > 1 else lh[0]
+    last_c = concat(lc, axis=1) if dirs > 1 else lc[0]
+    return x, last_h, last_c
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """reference nn.py chunk_eval: chunking precision/recall/F1 over IOB
+    -style tag sequences, via a numpy py_func (metric, no gradients).
+    Dense contract: input/label [B, T] int64 + seq_length [B]."""
+    import numpy as np
+
+    from .decode import py_func
+
+    excluded = set(excluded_chunk_types or [])
+    scheme = chunk_scheme
+
+    def _extract(tags, L):
+        """(type, start, end) chunks from a tag row per scheme."""
+        chunks = []
+        start = None
+        cur_type = None
+        for t in range(int(L)):
+            tag = int(tags[t])
+            if scheme == "plain":
+                ctype = tag
+                begin = cur_type != ctype
+                if begin and cur_type is not None:
+                    chunks.append((cur_type, start, t - 1))
+                if begin:
+                    start, cur_type = t, ctype
+                continue
+            if scheme == "IOB":
+                n = 2
+                tag_kind, ctype = tag % n, tag // n
+                is_begin = tag_kind == 0
+                inside = tag_kind == 1
+            elif scheme == "IOE":
+                n = 2
+                tag_kind, ctype = tag % n, tag // n
+                is_begin = cur_type != ctype
+                inside = True
+            else:  # IOBES
+                n = 4
+                tag_kind, ctype = tag % n, tag // n
+                is_begin = tag_kind in (0, 3)
+                inside = tag_kind in (1, 2)
+            is_o = tag >= num_chunk_types * (2 if scheme in ("IOB", "IOE")
+                                             else 4)
+            if cur_type is not None and (is_o or is_begin
+                                         or ctype != cur_type):
+                chunks.append((cur_type, start, t - 1))
+                cur_type = None
+            if not is_o and (is_begin or (inside and cur_type is None)):
+                start, cur_type = t, ctype
+        if cur_type is not None:
+            chunks.append((cur_type, start, int(L) - 1))
+        return {c for c in chunks if c[0] not in excluded}
+
+    def _metric(inp, lab, lens=None):
+        B, T = inp.shape
+        n_inf = n_lab = n_cor = 0
+        for b in range(B):
+            L = T if lens is None else lens[b]
+            infer = _extract(inp[b], L)
+            gold = _extract(lab[b], L)
+            n_inf += len(infer)
+            n_lab += len(gold)
+            n_cor += len(infer & gold)
+        p = n_inf and n_cor / n_inf or 0.0
+        r = n_lab and n_cor / n_lab or 0.0
+        f1 = (p + r) and 2 * p * r / (p + r) or 0.0
+        # int32: the embedded host callback cannot emit 64-bit results
+        # while jax x64 is off
+        return (np.float32(p), np.float32(r), np.float32(f1),
+                np.int32(n_inf), np.int32(n_lab), np.int32(n_cor))
+
+    helper = LayerHelper("chunk_eval")
+    outs = [helper.create_variable_for_type_inference(dt,
+                                                      stop_gradient=True)
+            for dt in ("float32", "float32", "float32", "int32", "int32",
+                       "int32")]
+    for o in outs:
+        o.shape = (1,)
+    xs = [input, label] + ([seq_length] if seq_length is not None else [])
+    py_func(_metric, xs, outs)
+    return tuple(outs)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference nn.py hash (xxhash replaced by a multiplicative mixer —
+    bucketing behavior, not hash-value parity; see ops/misc_ops.py)."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    helper.append_op(type="hash_op", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"num_hash": int(num_hash),
+                            "mod_by": int(hash_size)})
+    if input.shape:
+        out.shape = tuple(input.shape) + (int(num_hash),)
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_batch=None, name=None):
+    """reference nn.py psroi_pool (position-sensitive ROI average)."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        ins["RoisBatch"] = [rois_batch]
+    helper.append_op(type="psroi_pool", inputs=ins,
+                     outputs={"Out": [out]},
+                     attrs={"output_channels": int(output_channels),
+                            "spatial_scale": float(spatial_scale),
+                            "pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width)})
+    if rois.shape:
+        out.shape = (rois.shape[0], int(output_channels),
+                     int(pooled_height), int(pooled_width))
     return out

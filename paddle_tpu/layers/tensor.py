@@ -34,6 +34,7 @@ __all__ = [
     "isfinite",
     "has_inf",
     "has_nan",
+    "tensor_array_to_tensor",
 ]
 
 
@@ -265,3 +266,22 @@ def has_inf(x):
 
 def has_nan(x):
     return isfinite(x)
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """reference tensor.py tensor_array_to_tensor over the static
+    TensorArray (layers/control_flow.py): stack or concat the items.
+    Returns (tensor, sizes_var)."""
+    items = list(getattr(input, "items", input))
+    if any(i is None for i in items):
+        raise ValueError("tensor array has unwritten slots")
+    from .nn import stack as _stack
+
+    if use_stack:
+        out = _stack(items, axis=axis)
+        sizes = [1] * len(items)
+    else:
+        out = concat(items, axis=axis)
+        sizes = [i.shape[axis] if i.shape else -1 for i in items]
+    sz = fill_constant([len(items)], "int32", 0.0)
+    return out, sz

@@ -462,3 +462,65 @@ def _sampled_softmax(ctx, ins, attrs):
         [adj[:, :1], jnp.where(hit, -1e9, adj[:, 1:])], axis=1)
     loss = -jax.nn.log_softmax(adj, axis=1)[:, 0]
     return {"Loss": [loss[:, None]]}
+
+
+@register_op("hash_op", no_grad=True)
+def _hash_op(ctx, ins, attrs):
+    """hash_op.cc API shape: ids [N, T] -> [N, T, num_hash] bucketed
+    hashes. Deliberate divergence: a multiplicative mixer replaces
+    xxhash (no exact hash-value parity; distributional behavior only)."""
+    x = ins["X"][0].astype(jnp.uint32)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 1))
+    primes = jnp.asarray(
+        [2654435761 + 40503 * k for k in range(num_hash)], jnp.uint32)
+    mixed = x[..., None] * primes + jnp.asarray(
+        [k * 2246822519 for k in range(num_hash)], jnp.uint32)
+    mixed = mixed ^ (mixed >> 15)
+    out = (mixed % jnp.uint32(mod_by)).astype(jnp.int64)
+    return {"Out": [out]}
+
+
+@register_op("psroi_pool", diff_inputs=["X"])
+def _psroi_pool(ctx, ins, attrs):
+    """psroi_pool_op.cc: position-sensitive average ROI pooling — bin
+    (i, j) of output channel c averages input channel c*ph*pw + i*pw + j
+    over that bin's spatial extent."""
+    x = ins["X"][0]                           # [B, C*ph*pw, H, W]
+    rois = ins["ROIs"][0]                     # [N, 4]
+    roi_batch = (ins.get("RoisBatch") or [None])[0]
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    out_c = int(attrs["output_channels"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    B, C, H, W = x.shape
+    N = rois.shape[0]
+    rb = (jnp.zeros((N,), jnp.int32) if roi_batch is None
+          else roi_batch.astype(jnp.int32))
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one(roi, b):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        img = x[b].reshape(out_c, ph, pw, H, W)
+
+        def bin_val(i, j):
+            by1 = y1 + rh * i / ph
+            by2 = y1 + rh * (i + 1) / ph
+            bx1 = x1 + rw * j / pw
+            bx2 = x1 + rw * (j + 1) / pw
+            my = ((ys >= jnp.floor(by1)) & (ys < jnp.ceil(by2)))
+            mx = ((xs >= jnp.floor(bx1)) & (xs < jnp.ceil(bx2)))
+            m = my[:, None] & mx[None, :]
+            cnt = jnp.maximum(jnp.sum(m), 1)
+            vals = img[:, i, j]                   # [out_c, H, W]
+            return jnp.sum(jnp.where(m[None], vals, 0.0),
+                           axis=(1, 2)) / cnt
+        cols = [[bin_val(i, j) for j in range(pw)] for i in range(ph)]
+        return jnp.stack([jnp.stack(r, axis=1) for r in cols], axis=1)
+
+    out = jax.vmap(one)(rois.astype(jnp.float32), rb)  # [N, out_c, ph, pw]
+    return {"Out": [out]}

@@ -292,3 +292,43 @@ def _gru_unit(ctx, ins, attrs):
     return {"Hidden": [h.astype(h_prev.dtype)],
             "ResetHiddenPrev": [reset_h.astype(h_prev.dtype)],
             "Gate": [jnp.concatenate([u, r, c], axis=-1).astype(x.dtype)]}
+
+
+@register_op("lstmp", diff_inputs=["Input", "Weight", "ProjWeight", "Bias",
+                                   "H0", "C0"])
+def _lstmp(ctx, ins, attrs):
+    """lstmp_op.cc (LSTM with recurrent projection): gates read the
+    PROJECTED hidden r [B, P]; r = proj_act(h @ W_proj)."""
+    x = ins["Input"][0]                      # [B, S, 4D]
+    w = ins["Weight"][0]                     # [P, 4D]
+    wp = ins["ProjWeight"][0]                # [D, P]
+    b = (ins.get("Bias") or [None])[0]
+    length = (ins.get("Length") or [None])[0]
+    B, S, four_d = x.shape
+    D = four_d // 4
+    P = wp.shape[1]
+    r0 = (ins.get("H0") or [None])[0]
+    c0 = (ins.get("C0") or [None])[0]
+    r0 = jnp.zeros((B, P), x.dtype) if r0 is None else r0
+    c0 = jnp.zeros((B, D), x.dtype) if c0 is None else c0
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    proj_act = _ACT[attrs.get("proj_activation", "tanh")]
+
+    def step(carry, xt):
+        r, c = carry
+        g = xt + r @ w
+        if b is not None:
+            g = g + b.reshape(1, -1)
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        c2 = f * c + i * cand_act(gg)
+        h2 = o * cell_act(c2)
+        r2 = proj_act(h2 @ wp)
+        return (r2, c2), (r2, c2)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    _, (rs_, cs) = _mask_scan(step, (r0, c0), xs, length, B, S)
+    return {"Projection": [jnp.swapaxes(rs_, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)]}

@@ -1,0 +1,103 @@
+"""Round-3 layers batch 4: projected/stacked LSTMs, chunk_eval,
+hash, psroi_pool, tensor_array_to_tensor, io shuffle/batch wrappers."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_dynamic_lstmp_and_stacked_lstm(fresh_programs):
+    main, startup, scope = fresh_programs
+    from paddle_tpu.core.scope import scope_guard
+
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2, 6, 12], append_batch_size=False)
+        proj, cell = layers.dynamic_lstmp(x, size=12, proj_size=5)
+        xin = layers.data("xi", [2, 6, 8], append_batch_size=False)
+        ih = layers.data("ih", [1, 2, 7], append_batch_size=False)
+        ic = layers.data("ic", [1, 2, 7], append_batch_size=False)
+        rnn_out, lh, lc = layers.lstm(xin, ih, ic, 6, hidden_size=7,
+                                      num_layers=2, is_bidirec=True)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        outs = exe.run(main, feed={
+            "x": rs.randn(2, 6, 12).astype("float32"),
+            "xi": rs.randn(2, 6, 8).astype("float32"),
+            "ih": np.zeros((1, 2, 7), "float32"),
+            "ic": np.zeros((1, 2, 7), "float32")},
+            fetch_list=[proj, cell, rnn_out, lh], scope=scope)
+    assert outs[0].shape == (2, 6, 5)
+    assert outs[1].shape == (2, 6, 3)
+    assert outs[2].shape == (2, 6, 14)       # bidirectional concat
+    assert outs[3].shape == (2, 14)
+    assert all(np.isfinite(o).all() for o in outs)
+
+
+def test_chunk_eval_iob(fresh_programs):
+    main, startup, scope = fresh_programs
+    from paddle_tpu.core.scope import scope_guard
+
+    with fluid.program_guard(main, startup):
+        tags = layers.data("tg", [2, 8], dtype="int64",
+                           append_batch_size=False)
+        labs = layers.data("lb", [2, 8], dtype="int64",
+                           append_batch_size=False)
+        p, r, f1, ni, nl, nc = layers.chunk_eval(tags, labs, "IOB", 3)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        # type t: B=2t, I=2t+1; O=6
+        gold = np.array([[0, 1, 6, 2, 3, 6, 4, 6],
+                         [6, 0, 1, 1, 6, 6, 6, 6]], "int64")
+        pred = gold.copy()
+        pred[0, 6] = 6  # drop one chunk from the prediction
+        f1v, niv, nlv, ncv = exe.run(
+            main, feed={"tg": pred, "lb": gold},
+            fetch_list=[f1, ni, nl, nc], scope=scope)
+    assert nlv[0] == 4 and niv[0] == 3 and ncv[0] == 3
+    np.testing.assert_allclose(float(f1v[0]), 2 * (1.0 * 0.75) / 1.75,
+                               rtol=1e-5)
+
+
+def test_hash_and_psroi_shapes(fresh_programs):
+    main, startup, scope = fresh_programs
+    from paddle_tpu.core.scope import scope_guard
+
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [2, 4], dtype="int64",
+                          append_batch_size=False)
+        h = layers.hash(ids, hash_size=100, num_hash=2)
+        feat = layers.data("ft", [1, 8, 6, 6], append_batch_size=False)
+        rois = layers.data("rs", [3, 4], append_batch_size=False)
+        pp = layers.psroi_pool(feat, rois, output_channels=2,
+                               spatial_scale=1.0, pooled_height=2,
+                               pooled_width=2)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rs_ = np.random.RandomState(0)
+        hv, pv = exe.run(main, feed={
+            "ids": rs_.randint(0, 1000, (2, 4)).astype("int64"),
+            "ft": rs_.randn(1, 8, 6, 6).astype("float32"),
+            "rs": np.array([[0, 0, 4, 4], [1, 1, 5, 5], [2, 0, 6, 3]],
+                           "float32")},
+            fetch_list=[h, pp], scope=scope)
+    assert hv.shape == (2, 4, 2) and (hv >= 0).all() and (hv < 100).all()
+    # determinism
+    assert pv.shape == (3, 2, 2, 2) and np.isfinite(pv).all()
+
+
+def test_io_shuffle_batch_wrappers():
+    from paddle_tpu.layers.io import batch as io_batch
+    from paddle_tpu.layers.io import shuffle as io_shuffle
+
+    def gen():
+        yield from range(10)
+
+    shuffled = list(io_shuffle(gen, 5)())
+    assert sorted(shuffled) == list(range(10))
+    batched = list(io_batch(gen, 4)())
+    assert [len(b) for b in batched] == [4, 4, 2]
