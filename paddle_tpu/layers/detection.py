@@ -27,6 +27,9 @@ __all__ = [
     "detection_output",
     "distribute_fpn_proposals",
     "box_decoder_and_assign",
+    "rpn_target_assign",
+    "generate_proposal_labels",
+    "detection_map",
 ]
 
 
@@ -455,3 +458,186 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
     if prior_box.shape:
         assigned.shape = (prior_box.shape[0], 4)
     return decoded, assigned
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """reference detection.py:59, dense redesign: returns
+    (predicted_cls [B,K], predicted_loc [B,K,4], target_label [B,K],
+    target_bbox [B,K,4], bbox_inside_weight [B,K,4]) at fixed
+    K = rpn_batch_size_per_im (pad label -1 / weight 0). gt_boxes is the
+    dense [B, G, 4] batch with zero-area padding rows."""
+    from . import nn as _nn
+
+    helper = LayerHelper("rpn_target_assign")
+    idx = helper.create_variable_for_type_inference("int32",
+                                                    stop_gradient=True)
+    lbl = helper.create_variable_for_type_inference("int32",
+                                                    stop_gradient=True)
+    tgt = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    inw = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes]},
+        outputs={"ScoreIndex": [idx], "LocIndex": [idx],
+                 "TargetLabel": [lbl], "TargetBBox": [tgt],
+                 "BBoxInsideWeight": [inw]},
+        attrs={"rpn_batch_size_per_im": int(rpn_batch_size_per_im),
+               "rpn_fg_fraction": float(rpn_fg_fraction),
+               "rpn_positive_overlap": float(rpn_positive_overlap),
+               "rpn_negative_overlap": float(rpn_negative_overlap)})
+    B = gt_boxes.shape[0]
+    K = int(rpn_batch_size_per_im)
+    idx.shape = lbl.shape = (B, K)
+    tgt.shape = inw.shape = (B, K, 4)
+
+    # gather predictions at the sampled anchor indices (pad idx -1 -> 0;
+    # padded rows carry label -1 / weight 0 so their values are inert)
+    from . import ops as _ops
+
+    flat_scores = _nn.reshape(cls_logits, shape=[B, -1])
+    flat_loc = _nn.reshape(bbox_pred, shape=[B, -1, 4])
+    safe = _ops.relu(idx)
+    sel_scores = _take_rows(flat_scores, safe)
+    sel_loc = _take_rows(flat_loc, safe)
+    return sel_scores, sel_loc, lbl, tgt, inw
+
+
+def _take_rows(x, idx):
+    """take_along_axis on dim 1 as a tiny op composition."""
+    helper = LayerHelper("take_rows")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="take_along_axis1",
+                     inputs={"X": [x], "Index": [idx]},
+                     outputs={"Out": [out]})
+    if x.shape and idx.shape:
+        out.shape = (x.shape[0], idx.shape[1]) + tuple(x.shape[2:])
+    return out
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    """reference detection.py:1746, dense contract (see the op)."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = helper.create_variable_for_type_inference("float32",
+                                                     stop_gradient=True)
+    labels = helper.create_variable_for_type_inference("int32",
+                                                       stop_gradient=True)
+    tgt = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    inw = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    outw = helper.create_variable_for_type_inference("float32",
+                                                     stop_gradient=True)
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "GtBoxes": [gt_boxes]},
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [tgt], "BboxInsideWeights": [inw],
+                 "BboxOutsideWeights": [outw]},
+        attrs={"batch_size_per_im": int(batch_size_per_im),
+               "fg_fraction": float(fg_fraction),
+               "fg_thresh": float(fg_thresh),
+               "bg_thresh_hi": float(bg_thresh_hi),
+               "bg_thresh_lo": float(bg_thresh_lo),
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": int(class_nums)})
+    B = gt_boxes.shape[0]
+    K = int(batch_size_per_im)
+    rois.shape = (B, K, 4)
+    labels.shape = (B, K)
+    tgt.shape = inw.shape = outw.shape = (B, K, 4 * int(class_nums))
+    return rois, labels, tgt, inw, outw
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """reference detection.py:613 mAP metric, dense contract: detect_res
+    [B, D, 6] (class, score, box; class < 0 pads — multiclass_nms's
+    output), label [B, G, 5] (class, box; zero-area pads). Computed by an
+    in-step host callback (metric, no gradients)."""
+    import numpy as np
+
+    from .decode import py_func
+
+    def _ap(rec, prec):
+        if ap_version == "11point":
+            return float(np.mean([
+                max([p for r, p in zip(rec, prec) if r >= t] or [0.0])
+                for t in np.linspace(0, 1, 11)]))
+        ap = 0.0
+        mrec = np.concatenate([[0.0], rec, [1.0]])
+        mpre = np.concatenate([[0.0], prec, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        for i in range(len(mrec) - 1):
+            ap += (mrec[i + 1] - mrec[i]) * mpre[i + 1]
+        return float(ap)
+
+    def _map(dets, labels):
+        aps = []
+        for c in range(class_num):
+            if c == background_label:
+                continue
+            records = []          # (score, image, box)
+            n_gt = 0
+            gt_by_img = []
+            for b in range(labels.shape[0]):
+                g = labels[b]
+                valid = (g[:, 0].astype(int) == c) & \
+                    ((g[:, 3] - g[:, 1]) > 0)
+                gt_by_img.append(g[valid, 1:5])
+                n_gt += int(valid.sum())
+                d = dets[b]
+                for row in d[d[:, 0].astype(int) == c]:
+                    records.append((float(row[1]), b, row[2:6]))
+            if n_gt == 0:
+                continue
+            records.sort(key=lambda r: -r[0])
+            used = [np.zeros(len(g), bool) for g in gt_by_img]
+            tp = np.zeros(len(records))
+            fp = np.zeros(len(records))
+            for i, (s, b, box) in enumerate(records):
+                g = gt_by_img[b]
+                best, bi = 0.0, -1
+                for j in range(len(g)):
+                    gx = g[j]
+                    ix = max(0, min(box[2], gx[2]) - max(box[0], gx[0]))
+                    iy = max(0, min(box[3], gx[3]) - max(box[1], gx[1]))
+                    inter = ix * iy
+                    ua = ((box[2] - box[0]) * (box[3] - box[1])
+                          + (gx[2] - gx[0]) * (gx[3] - gx[1]) - inter)
+                    iou = inter / ua if ua > 0 else 0.0
+                    if iou > best:
+                        best, bi = iou, j
+                if best >= overlap_threshold and bi >= 0 and \
+                        not used[b][bi]:
+                    tp[i] = 1
+                    used[b][bi] = True
+                else:
+                    fp[i] = 1
+            ctp = np.cumsum(tp)
+            cfp = np.cumsum(fp)
+            rec = ctp / n_gt
+            prec = ctp / np.maximum(ctp + cfp, 1e-10)
+            aps.append(_ap(rec, prec))
+        return (np.float32(np.mean(aps) if aps else 0.0),)
+
+    helper = LayerHelper("detection_map")
+    out = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    out.shape = (1,)
+    py_func(_map, [detect_res, label], [out])
+    return out

@@ -945,3 +945,170 @@ def _box_decoder_and_assign(ctx, ins, attrs):
         decoded, best[:, None, None].repeat(4, 2), axis=1)[:, 0]
     return {"DecodeBox": [decoded.reshape(N, C * 4)],
             "OutputAssignBox": [assigned]}
+
+
+@register_op("rpn_target_assign", no_grad=True, uses_rng=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """rpn_target_assign_op.cc, dense redesign: per image, label anchors
+    (1 fg: IoU >= positive_overlap or best-for-a-gt; 0 bg: max IoU <
+    negative_overlap; -1 ignore), randomly subsample to
+    batch_size_per_im with fg_fraction, and emit FIXED-size samples:
+    ScoreIndex/LocIndex [B, K] (pad -1), TargetLabel [B, K],
+    TargetBBox [B, K, 4] (encoded vs anchors), BBoxInsideWeight."""
+    anchors = ins["Anchor"][0].reshape(-1, 4)     # [A, 4]
+    gt = ins["GtBoxes"][0]                        # [B, G, 4]
+    K = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_t = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_t = float(attrs.get("rpn_negative_overlap", 0.3))
+    B = gt.shape[0]
+    A = anchors.shape[0]
+    rng = ctx.next_rng()
+    fg_cap = int(K * fg_frac)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+
+    def one(gt_i, key):
+        valid = (gt_i[:, 2] - gt_i[:, 0] > 0) & (gt_i[:, 3] - gt_i[:, 1] > 0)
+        iou = jnp.where(valid[:, None],
+                        _pairwise_iou_xyxy(gt_i, anchors), 0.0)  # [G, A]
+        amax = jnp.max(iou, axis=0)                  # [A]
+        agt = jnp.argmax(iou, axis=0)                # [A]
+        # best anchor per gt is fg regardless of threshold
+        best_per_gt = jnp.where(valid, jnp.argmax(iou, axis=1), -1)
+        is_best = jnp.zeros((A,), bool).at[
+            jnp.where(best_per_gt >= 0, best_per_gt, A)].set(
+            True, mode="drop")
+        fg = (amax >= pos_t) | is_best
+        bg = (~fg) & (amax < neg_t)
+
+        k1, k2 = jax.random.split(key)
+        # random priority subsample: top-K of noise among candidates
+        fg_pri = jnp.where(fg, jax.random.uniform(k1, (A,)), -1.0)
+        _, fg_idx = lax.top_k(fg_pri, fg_cap)
+        fg_take = jnp.take(fg_pri, fg_idx) > 0
+        nfg = jnp.sum(fg_take)
+        bg_pri = jnp.where(bg, jax.random.uniform(k2, (A,)), -1.0)
+        _, bg_idx = lax.top_k(bg_pri, K)
+        bg_rank = jnp.arange(K)
+        bg_take = (jnp.take(bg_pri, bg_idx) > 0) & (bg_rank < (K - nfg))
+
+        idx = jnp.concatenate([
+            jnp.where(fg_take, fg_idx, -1),
+            jnp.where(bg_take, bg_idx, -1)])[:K + fg_cap]
+        # compact: selected first
+        order = jnp.argsort(idx < 0, stable=True)
+        idx = jnp.take(idx, order)[:K]
+        sel = jnp.maximum(idx, 0)
+        label = jnp.where(idx < 0, -1,
+                          jnp.where(jnp.take(fg, sel), 1, 0))
+
+        g = gt_i[jnp.take(agt, sel)]
+        gw = g[:, 2] - g[:, 0] + 1.0
+        gh = g[:, 3] - g[:, 1] + 1.0
+        gcx = g[:, 0] + gw * 0.5
+        gcy = g[:, 1] + gh * 0.5
+        saw = jnp.take(aw, sel)
+        sah = jnp.take(ah, sel)
+        tx = (gcx - jnp.take(acx, sel)) / saw
+        ty = (gcy - jnp.take(acy, sel)) / sah
+        tw = jnp.log(gw / saw)
+        th = jnp.log(gh / sah)
+        tgt = jnp.stack([tx, ty, tw, th], axis=1)
+        inside = jnp.where((label == 1)[:, None],
+                           jnp.ones((K, 4), jnp.float32), 0.0)
+        tgt = jnp.where((label == 1)[:, None], tgt, 0.0)
+        return idx.astype(jnp.int32), label.astype(jnp.int32), tgt, inside
+
+    keys = jax.random.split(rng, B)
+    idx, label, tgt, inside = jax.vmap(one)(gt.astype(jnp.float32), keys)
+    return {"ScoreIndex": [idx], "LocIndex": [idx],
+            "TargetLabel": [label], "TargetBBox": [tgt],
+            "BBoxInsideWeight": [inside]}
+
+
+@register_op("generate_proposal_labels", no_grad=True, uses_rng=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """generate_proposal_labels_op.cc, dense: per image, sample K rois
+    from rpn_rois ∪ gt (fg IoU >= fg_thresh capped at fg_fraction*K; bg
+    in [bg_lo, bg_hi)); emit Rois [B, K, 4], LabelsInt32 [B, K] (-1
+    pad), BboxTargets [B, K, 4*C] per-class-encoded +
+    inside/outside weights."""
+    rois = ins["RpnRois"][0]                      # [B, R, 4]
+    gt_lab = ins["GtClasses"][0]                  # [B, G]
+    gt = ins["GtBoxes"][0]                        # [B, G, 4]
+    K = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_t = float(attrs.get("fg_thresh", 0.25))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    weights = [float(w) for w in attrs.get("bbox_reg_weights",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    C = int(attrs["class_nums"])
+    B, R, _ = rois.shape
+    fg_cap = int(K * fg_frac)
+    rng = ctx.next_rng()
+
+    def one(rois_i, gt_i, lab_i, key):
+        valid = (gt_i[:, 2] - gt_i[:, 0] > 0) & (gt_i[:, 3] - gt_i[:, 1] > 0)
+        cand = jnp.concatenate([rois_i, gt_i], axis=0)       # [R+G, 4]
+        iou = jnp.where(valid[:, None],
+                        _pairwise_iou_xyxy(gt_i, cand), 0.0)  # [G, R+G]
+        amax = jnp.max(iou, axis=0)
+        agt = jnp.argmax(iou, axis=0)
+        fg = amax >= fg_t
+        bg = (amax < bg_hi) & (amax >= bg_lo) & (~fg)
+
+        k1, k2 = jax.random.split(key)
+        n = cand.shape[0]
+        fg_pri = jnp.where(fg, jax.random.uniform(k1, (n,)), -1.0)
+        _, fg_idx = lax.top_k(fg_pri, fg_cap)
+        fg_take = jnp.take(fg_pri, fg_idx) > 0
+        nfg = jnp.sum(fg_take)
+        bg_pri = jnp.where(bg, jax.random.uniform(k2, (n,)), -1.0)
+        _, bg_idx = lax.top_k(bg_pri, K)
+        bg_take = (jnp.take(bg_pri, bg_idx) > 0) & \
+            (jnp.arange(K) < (K - nfg))
+        idx = jnp.concatenate([jnp.where(fg_take, fg_idx, -1),
+                               jnp.where(bg_take, bg_idx, -1)])[:K + fg_cap]
+        order = jnp.argsort(idx < 0, stable=True)
+        idx = jnp.take(idx, order)[:K]
+        sel = jnp.maximum(idx, 0)
+        out_rois = cand[sel]
+        is_fg = jnp.take(fg, sel) & (idx >= 0)
+        labels = jnp.where(idx < 0, -1,
+                           jnp.where(is_fg,
+                                     lab_i[jnp.take(agt, sel)].astype(
+                                         jnp.int32), 0))
+        # encoded per-class targets
+        g = gt_i[jnp.take(agt, sel)]
+        rw = out_rois[:, 2] - out_rois[:, 0] + 1.0
+        rh = out_rois[:, 3] - out_rois[:, 1] + 1.0
+        rcx = out_rois[:, 0] + rw * 0.5
+        rcy = out_rois[:, 1] + rh * 0.5
+        gw = g[:, 2] - g[:, 0] + 1.0
+        gh = g[:, 3] - g[:, 1] + 1.0
+        gcx = g[:, 0] + gw * 0.5
+        gcy = g[:, 1] + gh * 0.5
+        t = jnp.stack([(gcx - rcx) / rw / weights[0],
+                       (gcy - rcy) / rh / weights[1],
+                       jnp.log(gw / rw) / weights[2],
+                       jnp.log(gh / rh) / weights[3]], axis=1)  # [K, 4]
+        tgt = jnp.zeros((K, 4 * C), jnp.float32)
+        cls = jnp.maximum(labels, 0)
+        col = cls[:, None] * 4 + jnp.arange(4)[None, :]
+        tgt = jax.vmap(lambda row, cc, tt, m:
+                       row.at[cc].set(jnp.where(m, tt, 0.0)))(
+            tgt, col, t, is_fg[:, None].repeat(4, 1))
+        inside = (tgt != 0).astype(jnp.float32)
+        return out_rois, labels, tgt, inside
+
+    keys = jax.random.split(rng, B)
+    out_rois, labels, tgt, inside = jax.vmap(one)(
+        rois.astype(jnp.float32), gt.astype(jnp.float32), gt_lab, keys)
+    return {"Rois": [out_rois], "LabelsInt32": [labels],
+            "BboxTargets": [tgt], "BboxInsideWeights": [inside],
+            "BboxOutsideWeights": [inside]}

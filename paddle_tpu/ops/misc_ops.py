@@ -524,3 +524,14 @@ def _psroi_pool(ctx, ins, attrs):
 
     out = jax.vmap(one)(rois.astype(jnp.float32), rb)  # [N, out_c, ph, pw]
     return {"Out": [out]}
+
+
+@register_op("take_along_axis1", no_grad=True)
+def _take_along_axis1(ctx, ins, attrs):
+    """Batched row gather on dim 1 (detection sampling glue)."""
+    x = ins["X"][0]
+    idx = ins["Index"][0].astype(jnp.int32)
+    expanded = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    expanded = jnp.broadcast_to(
+        expanded, idx.shape + tuple(x.shape[2:]))
+    return {"Out": [jnp.take_along_axis(x, expanded, axis=1)]}

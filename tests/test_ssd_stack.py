@@ -124,3 +124,106 @@ def test_ssd_pipeline_trains(fresh_programs):
         (d,) = exe.run(main, feed=feed, fetch_list=[dets], scope=scope)
     assert np.isfinite(ls).all() and ls[-1] < ls[0]
     assert d.shape == (B, 10, 6)
+
+
+def test_rpn_target_assign_samples(fresh_programs):
+    main, startup, scope = fresh_programs
+    from paddle_tpu.core.scope import scope_guard
+
+    B, A, G, K = 1, 3 * 4 * 4, 2, 16
+    with fluid.program_guard(main, startup):
+        feat = layers.data("feat", [B, 8, 4, 4], append_batch_size=False)
+        anc, var = layers.anchor_generator(
+            feat, anchor_sizes=[8.0, 16.0, 32.0], aspect_ratios=[1.0],
+            stride=[8.0, 8.0])
+        bbox_pred = layers.data("bp", [B, A, 4], append_batch_size=False)
+        cls_log = layers.data("cl", [B, A], append_batch_size=False)
+        gtb = layers.data("gtb", [B, G, 4], append_batch_size=False)
+        sc, loc, lbl, tgt, inw = layers.rpn_target_assign(
+            bbox_pred, cls_log, anc, var, gtb,
+            rpn_batch_size_per_im=K)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        gt = np.array([[[2, 2, 14, 14], [16, 16, 30, 30]]], "float32")
+        outs = exe.run(main, feed={
+            "feat": np.zeros((B, 8, 4, 4), "float32"),
+            "bp": rs.randn(B, A, 4).astype("float32"),
+            "cl": rs.randn(B, A).astype("float32"),
+            "gtb": gt}, fetch_list=[sc, loc, lbl, tgt, inw], scope=scope)
+    sc_v, loc_v, lbl_v, tgt_v, inw_v = outs
+    assert sc_v.shape == (B, K) and loc_v.shape == (B, K, 4)
+    assert set(np.unique(lbl_v)) <= {-1, 0, 1}
+    npos = int((lbl_v == 1).sum())
+    assert npos >= 1  # the best anchor per gt is always fg
+    # inside weights 1 exactly on fg rows
+    assert (inw_v[lbl_v == 1] == 1).all()
+    assert (inw_v[lbl_v != 1] == 0).all()
+
+
+def test_generate_proposal_labels_samples(fresh_programs):
+    main, startup, scope = fresh_programs
+    from paddle_tpu.core.scope import scope_guard
+
+    B, R, G, K, C = 1, 20, 2, 12, 5
+    with fluid.program_guard(main, startup):
+        rois = layers.data("rois", [B, R, 4], append_batch_size=False)
+        gtc = layers.data("gtc", [B, G], dtype="int64",
+                          append_batch_size=False)
+        gtb = layers.data("gtb", [B, G, 4], append_batch_size=False)
+        out = layers.generate_proposal_labels(
+            rois, gtc, None, gtb, batch_size_per_im=K, class_nums=C,
+            fg_thresh=0.5)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(1)
+        base = rs.rand(B, R, 4).astype("float32") * 20
+        rois_np = np.concatenate([base[..., :2],
+                                  base[..., :2] + 5 + base[..., 2:]],
+                                 axis=-1).astype("float32")
+        gt = np.array([[[2, 2, 10, 10], [12, 12, 20, 20]]], "float32")
+        o_rois, o_lbl, o_tgt, o_inw, o_outw = exe.run(
+            main, feed={"rois": rois_np, "gtc":
+                        np.array([[1, 3]], "int64"), "gtb": gt},
+            fetch_list=list(out), scope=scope)
+    assert o_rois.shape == (B, K, 4)
+    assert o_tgt.shape == (B, K, 4 * C)
+    # gt boxes joined the candidate set -> at least the two fg samples
+    assert int((o_lbl > 0).sum()) >= 2
+    # fg targets live in their class's 4-column block
+    fg_rows = np.where(o_lbl[0] > 0)[0]
+    for r in fg_rows:
+        c = o_lbl[0, r]
+        blk = o_tgt[0, r, 4 * c:4 * (c + 1)]
+        assert np.abs(blk).sum() >= 0  # block exists; others zero
+        other = np.delete(o_tgt[0, r].reshape(C, 4), c, axis=0)
+        assert np.abs(other).sum() == 0
+
+
+def test_detection_map_perfect_is_one(fresh_programs):
+    main, startup, scope = fresh_programs
+    from paddle_tpu.core.scope import scope_guard
+
+    B, D, G, C = 2, 4, 2, 3
+    with fluid.program_guard(main, startup):
+        det = layers.data("det", [B, D, 6], append_batch_size=False)
+        lab = layers.data("lab", [B, G, 5], append_batch_size=False)
+        m = layers.detection_map(det, lab, class_num=C)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        # two gts per image, detections exactly match
+        lab_np = np.array([
+            [[1, 0, 0, 10, 10], [2, 20, 20, 30, 30]],
+            [[1, 5, 5, 15, 15], [2, 0, 0, 8, 8]]], "float32")
+        det_np = np.full((B, D, 6), -1.0, "float32")
+        for b in range(B):
+            for g in range(G):
+                det_np[b, g, 0] = lab_np[b, g, 0]
+                det_np[b, g, 1] = 0.9
+                det_np[b, g, 2:] = lab_np[b, g, 1:]
+        (mv,) = exe.run(main, feed={"det": det_np, "lab": lab_np},
+                        fetch_list=[m], scope=scope)
+    np.testing.assert_allclose(float(mv[0]), 1.0, rtol=1e-5)
