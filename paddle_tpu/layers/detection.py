@@ -30,6 +30,8 @@ __all__ = [
     "rpn_target_assign",
     "generate_proposal_labels",
     "detection_map",
+    "roi_perspective_transform",
+    "generate_mask_labels",
 ]
 
 
@@ -641,3 +643,57 @@ def detection_map(detect_res, label, class_num, background_label=0,
     out.shape = (1,)
     py_func(_map, [detect_res, label], [out])
     return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_batch=None, name=None):
+    """reference detection.py roi_perspective_transform: quadrilateral
+    ROIs ([N, 8]) warped to fixed patches via their homography."""
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    stop_gradient=True)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        ins["RoisBatch"] = [rois_batch]
+    helper.append_op(type="roi_perspective_transform", inputs=ins,
+                     outputs={"Out": [out]},
+                     attrs={"transformed_height": int(transformed_height),
+                            "transformed_width": int(transformed_width),
+                            "spatial_scale": float(spatial_scale)})
+    if rois.shape and input.shape:
+        out.shape = (rois.shape[0], input.shape[1],
+                     int(transformed_height), int(transformed_width))
+    return out
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes=None, resolution=14,
+                         gt_boxes=None):
+    """reference detection.py generate_mask_labels, dense bitmap
+    contract: gt_segms [B, G, Hm, Wm] bitmaps (polygon rasterization is
+    the data pipeline's job here); rois/labels from
+    generate_proposal_labels. Returns (mask_rois, roi_has_mask_int32,
+    mask_int32 [B, K, resolution^2], -1 rows for non-fg)."""
+    helper = LayerHelper("generate_mask_labels")
+    mrois = helper.create_variable_for_type_inference("float32",
+                                                      stop_gradient=True)
+    has = helper.create_variable_for_type_inference("int32",
+                                                    stop_gradient=True)
+    masks = helper.create_variable_for_type_inference("int32",
+                                                      stop_gradient=True)
+    helper.append_op(type="generate_mask_labels",
+                     inputs={"Rois": [rois],
+                             "LabelsInt32": [labels_int32],
+                             "GtBoxes": [gt_boxes],
+                             "GtSegms": [gt_segms]},
+                     outputs={"MaskRois": [mrois],
+                              "RoiHasMaskInt32": [has],
+                              "MaskInt32": [masks]},
+                     attrs={"resolution": int(resolution)})
+    if rois.shape:
+        B, K = rois.shape[0], rois.shape[1]
+        mrois.shape = rois.shape
+        has.shape = (B, K)
+        masks.shape = (B, K, int(resolution) ** 2)
+    return mrois, has, masks

@@ -26,6 +26,8 @@ __all__ = [
     "dynamic_lstm",
     "dynamic_gru",
     "gru_unit",
+    "similarity_focus",
+    "tree_conv",
     "dynamic_lstmp",
     "lstm",
     "chunk_eval",
@@ -2075,3 +2077,38 @@ def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
         out.shape = (rois.shape[0], int(output_channels),
                      int(pooled_height), int(pooled_width))
     return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference nn.py similarity_focus (axis=1 channel focus)."""
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    stop_gradient=True)
+    helper.append_op(type="similarity_focus", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": int(axis),
+                            "indexes": [int(i) for i in indexes]})
+    out.shape = input.shape
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """reference nn.py tree_conv (TBCNN; depth-2 windows — see the op)."""
+    helper = LayerHelper("tree_conv", name=name, bias_attr=bias_attr,
+                         act=act)
+    F = nodes_vector.shape[-1]
+    w = helper.create_parameter(param_attr,
+                                [F, 3, int(output_size), int(num_filters)],
+                                nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(nodes_vector.dtype)
+    helper.append_op(type="tree_conv",
+                     inputs={"NodesVector": [nodes_vector],
+                             "EdgeSet": [edge_set], "Filter": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"max_depth": int(max_depth)})
+    if nodes_vector.shape:
+        out.shape = (nodes_vector.shape[0], nodes_vector.shape[1],
+                     int(output_size), int(num_filters))
+    return helper.append_activation(out, act)

@@ -535,3 +535,199 @@ def _take_along_axis1(ctx, ins, attrs):
     expanded = jnp.broadcast_to(
         expanded, idx.shape + tuple(x.shape[2:]))
     return {"Out": [jnp.take_along_axis(x, expanded, axis=1)]}
+
+
+@register_op("similarity_focus", no_grad=True)
+def _similarity_focus(ctx, ins, attrs):
+    """similarity_focus_op.cc: per selected channel index, greedily pick
+    row/column-exclusive maxima of T = X[:, idx] (min(B, C) picks) and
+    mark them 1; OR over indexes; broadcast over the focused axis.
+    axis=1 (channel) supported — the reference's documented use."""
+    x = ins["X"][0]                          # [N, A, B, C]
+    axis = int(attrs.get("axis", 1))
+    if axis != 1:
+        raise NotImplementedError("similarity_focus supports axis=1")
+    indexes = [int(i) for i in attrs["indexes"]]
+    N, A, Bd, Cd = x.shape
+    picks = min(Bd, Cd)
+
+    def one_mask(t):
+        """t [B, C] -> exclusive-max mask."""
+        def body(_, state):
+            t_cur, mask = state
+            flat = jnp.argmax(t_cur)
+            i, j = flat // Cd, flat % Cd
+            mask = mask.at[i, j].set(1.0)
+            t_cur = t_cur.at[i, :].set(-jnp.inf).at[:, j].set(-jnp.inf)
+            return t_cur, mask
+
+        _, mask = lax.fori_loop(
+            0, picks, body, (t, jnp.zeros((Bd, Cd), jnp.float32)))
+        return mask
+
+    masks = []
+    for idx in indexes:
+        masks.append(jax.vmap(one_mask)(x[:, idx].astype(jnp.float32)))
+    m = masks[0]
+    for extra in masks[1:]:
+        m = jnp.maximum(m, extra)
+    out = jnp.broadcast_to(m[:, None], x.shape).astype(x.dtype)
+    return {"Out": [out]}
+
+
+def _quad_homography(quad):
+    """[8] quad (x1 y1 ... x4 y4, clockwise from top-left) -> 3x3 H
+    mapping unit square corners to the quad."""
+    src = jnp.asarray([[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]])
+    dst = quad.reshape(4, 2)
+    rows = []
+    for i in range(4):
+        sx, sy = src[i, 0], src[i, 1]
+        dx, dy = dst[i, 0], dst[i, 1]
+        rows.append(jnp.stack([sx, sy, jnp.float32(1.0), 0.0 * sx,
+                               0.0 * sx, 0.0 * sx, -dx * sx, -dx * sy]))
+        rows.append(jnp.stack([0.0 * sx, 0.0 * sx, 0.0 * sx, sx, sy,
+                               jnp.float32(1.0), -dy * sx, -dy * sy]))
+    A = jnp.stack(rows)                       # [8, 8]
+    b = dst.reshape(-1)
+    h = jnp.linalg.solve(A, b)
+    return jnp.concatenate([h, jnp.ones((1,))]).reshape(3, 3)
+
+
+@register_op("roi_perspective_transform", no_grad=True)
+def _roi_perspective_transform(ctx, ins, attrs):
+    """roi_perspective_transform_op.cc: bilinear-sample each quadrilateral
+    ROI ([N, 8] corner coords) through its unit-square homography into a
+    [transformed_height, transformed_width] patch."""
+    x = ins["X"][0]                           # [B, C, H, W]
+    rois = ins["ROIs"][0]                     # [N, 8]
+    roi_batch = (ins.get("RoisBatch") or [None])[0]
+    out_h = int(attrs["transformed_height"])
+    out_w = int(attrs["transformed_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    B, C, H, W = x.shape
+    N = rois.shape[0]
+    rb = (jnp.zeros((N,), jnp.int32) if roi_batch is None
+          else roi_batch.astype(jnp.int32))
+
+    ys = (jnp.arange(out_h, dtype=jnp.float32) + 0.5) / out_h
+    xs = (jnp.arange(out_w, dtype=jnp.float32) + 0.5) / out_w
+    gx, gy = jnp.meshgrid(xs, ys)             # [out_h, out_w]
+    ones = jnp.ones_like(gx)
+    unit = jnp.stack([gx, gy, ones], axis=-1)  # [oh, ow, 3]
+
+    def one(quad, b):
+        Hm = _quad_homography(quad.astype(jnp.float32) * scale)
+        mapped = unit @ Hm.T                  # [oh, ow, 3]
+        px = mapped[..., 0] / mapped[..., 2]
+        py = mapped[..., 1] / mapped[..., 2]
+        x0 = jnp.floor(px)
+        y0 = jnp.floor(py)
+
+        def gather(img, yy, xx):
+            inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            return jnp.where(inb[None], x[b][:, yc, xc], 0.0)
+
+        dy = (py - y0)[None]
+        dx = (px - x0)[None]
+        return (gather(x, y0, x0) * (1 - dy) * (1 - dx)
+                + gather(x, y0, x0 + 1) * (1 - dy) * dx
+                + gather(x, y0 + 1, x0) * dy * (1 - dx)
+                + gather(x, y0 + 1, x0 + 1) * dy * dx)
+
+    out = jax.vmap(one)(rois, rb)             # [N, C, oh, ow]
+    return {"Out": [out]}
+
+
+@register_op("generate_mask_labels", no_grad=True)
+def _generate_mask_labels(ctx, ins, attrs):
+    """generate_mask_labels_op.cc, dense bitmap redesign: gt segmentation
+    arrives as per-gt BITMAP masks [B, G, Hm, Wm] over the image extent
+    (the reference rasterizes COCO polygons host-side; polygon decoding
+    belongs to the data pipeline in this design). For each sampled fg
+    roi, the best-IoU gt's mask is crop-resized to resolution^2."""
+    rois = ins["Rois"][0]                     # [B, K, 4]
+    labels = ins["LabelsInt32"][0]            # [B, K]
+    gt = ins["GtBoxes"][0]                    # [B, G, 4]
+    segms = ins["GtSegms"][0]                 # [B, G, Hm, Wm]
+    res = int(attrs.get("resolution", 14))
+    im_h = segms.shape[2]
+    im_w = segms.shape[3]
+
+    ys = (jnp.arange(res, dtype=jnp.float32) + 0.5) / res
+    xs = (jnp.arange(res, dtype=jnp.float32) + 0.5) / res
+    gx, gy = jnp.meshgrid(xs, ys)
+
+    from .detection_ops import _pairwise_iou_xyxy
+
+    def one_img(rois_i, lab_i, gt_i, seg_i):
+        valid = (gt_i[:, 2] - gt_i[:, 0] > 0) & (gt_i[:, 3] - gt_i[:, 1] > 0)
+        iou = jnp.where(valid[:, None],
+                        _pairwise_iou_xyxy(gt_i, rois_i), 0.0)
+        agt = jnp.argmax(iou, axis=0)         # [K]
+
+        def one_roi(roi, g, is_fg):
+            px = roi[0] + gx * (roi[2] - roi[0])
+            py = roi[1] + gy * (roi[3] - roi[1])
+            xi = jnp.clip(px, 0, im_w - 1).astype(jnp.int32)
+            yi = jnp.clip(py, 0, im_h - 1).astype(jnp.int32)
+            m = seg_i[g][yi, xi]
+            return jnp.where(is_fg, m, -1.0)  # -1 marks non-fg rows
+
+        return jax.vmap(one_roi)(rois_i, agt, lab_i > 0)
+
+    masks = jax.vmap(one_img)(rois.astype(jnp.float32), labels,
+                              gt.astype(jnp.float32),
+                              segms.astype(jnp.float32))
+    B, K = labels.shape
+    return {"MaskRois": [rois], "RoiHasMaskInt32": [
+        (labels > 0).astype(jnp.int32)],
+        "MaskInt32": [masks.reshape(B, K, res * res)]}
+
+
+@register_op("tree_conv", diff_inputs=["NodesVector", "Filter"])
+def _tree_conv(ctx, ins, attrs):
+    """tree_conv_op.cc (TBCNN continuous binary tree conv), depth-2
+    patches: each node's window is itself + its direct children, with
+    the standard eta weights (top: 1 for the parent, 0 for children;
+    left/right: child position interpolation). max_depth > 2 windows are
+    not supported (documented subset)."""
+    nodes = ins["NodesVector"][0]             # [B, N, F]
+    edges = ins["EdgeSet"][0]                 # [B, E, 2] (parent, child)
+    w = ins["Filter"][0]                      # [F, 3, out, num_filters]
+    Bn, N, F = nodes.shape
+    E = edges.shape[1]
+    out_dim = w.shape[2]
+    num_filters = w.shape[3]
+    wt, wl, wr = w[:, 0], w[:, 1], w[:, 2]    # [F, out, nf]
+
+    def one(nv, es):
+        es = es.astype(jnp.int32)
+        parent = es[:, 0]
+        child = es[:, 1]
+        valid = (parent > 0) | (child > 0)    # 0,0 rows are padding
+        # children count + ordinal position per edge
+        ones = valid.astype(jnp.float32)
+        cnt = jnp.zeros((N,), jnp.float32).at[parent].add(ones,
+                                                          mode="drop")
+        order = (jnp.cumsum(
+            jax.nn.one_hot(parent, N, dtype=jnp.float32) * ones[:, None],
+            axis=0) * jax.nn.one_hot(parent, N, dtype=jnp.float32)
+        ).sum(axis=1)                          # 1-based position per edge
+        n_sib = jnp.maximum(cnt[parent], 1.0)
+        eta_r = jnp.where(n_sib > 1, (order - 1) / (n_sib - 1), 0.5)
+        eta_l = 1.0 - eta_r
+        cx = nv[child]                         # [E, F]
+        contrib = (jnp.einsum("ef,fok->eok", cx, wl) * eta_l[:, None, None]
+                   + jnp.einsum("ef,fok->eok", cx, wr)
+                   * eta_r[:, None, None])
+        contrib = jnp.where(valid[:, None, None], contrib, 0.0)
+        agg = jnp.zeros((N, out_dim, num_filters),
+                        jnp.float32).at[parent].add(contrib, mode="drop")
+        self_term = jnp.einsum("nf,fok->nok", nv, wt)
+        return agg + self_term                 # [N, out, nf]
+
+    out = jax.vmap(one)(nodes.astype(jnp.float32), edges)
+    return {"Out": [out]}

@@ -101,3 +101,59 @@ def test_io_shuffle_batch_wrappers():
     assert sorted(shuffled) == list(range(10))
     batched = list(io_batch(gen, 4)())
     assert [len(b) for b in batched] == [4, 4, 2]
+
+
+def test_final_four_layers(fresh_programs):
+    """similarity_focus exclusive-max mask, tree_conv shapes,
+    roi_perspective_transform axis-aligned crop, generate_mask_labels
+    bitmap crops."""
+    main, startup, scope = fresh_programs
+    from paddle_tpu.core.scope import scope_guard
+
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [1, 3, 4, 4], append_batch_size=False)
+        sf = layers.similarity_focus(x, axis=1, indexes=[0])
+        nv = layers.data("nv", [1, 5, 6], append_batch_size=False)
+        es = layers.data("es", [1, 4, 2], dtype="int64",
+                         append_batch_size=False)
+        tc = layers.tree_conv(nv, es, output_size=7, num_filters=2)
+        img = layers.data("im", [1, 2, 10, 10], append_batch_size=False)
+        quads = layers.data("qd", [2, 8], append_batch_size=False)
+        rp = layers.roi_perspective_transform(img, quads, 4, 4)
+        rois = layers.data("rois", [1, 3, 4], append_batch_size=False)
+        lbls = layers.data("lb", [1, 3], dtype="int32",
+                           append_batch_size=False)
+        gtb = layers.data("gtb", [1, 2, 4], append_batch_size=False)
+        segs = layers.data("sg", [1, 2, 10, 10], append_batch_size=False)
+        mr, hm, mk = layers.generate_mask_labels(
+            None, None, None, segs, rois, lbls, resolution=4,
+            gt_boxes=gtb)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        seg = np.zeros((1, 2, 10, 10), "float32")
+        seg[0, 0, :5, :5] = 1
+        seg[0, 1, 5:, 5:] = 1
+        quad = np.array([[2, 2, 8, 2, 8, 8, 2, 8],
+                         [0, 0, 4, 0, 4, 4, 0, 4]], "float32")
+        outs = exe.run(main, feed={
+            "x": rs.randn(1, 3, 4, 4).astype("float32"),
+            "nv": rs.randn(1, 5, 6).astype("float32"),
+            "es": np.array([[[0, 1], [0, 2], [1, 3], [0, 0]]], "int64"),
+            "im": rs.randn(1, 2, 10, 10).astype("float32"),
+            "qd": quad,
+            "rois": np.array([[[0, 0, 5, 5], [5, 5, 9, 9], [0, 0, 2, 2]]],
+                             "float32"),
+            "lb": np.array([[1, 2, 0]], "int32"),
+            "gtb": np.array([[[0, 0, 5, 5], [5, 5, 9, 9]]], "float32"),
+            "sg": seg,
+        }, fetch_list=[sf, tc, rp, mr, hm, mk], scope=scope)
+    m = outs[0][0, 0]
+    assert m.sum() == 4 and (m.sum(0) <= 1).all() and (m.sum(1) <= 1).all()
+    assert outs[1].shape == (1, 5, 7, 2) and np.isfinite(outs[1]).all()
+    assert outs[2].shape == (2, 2, 4, 4) and np.isfinite(outs[2]).all()
+    assert outs[4].tolist() == [[1, 1, 0]]
+    mk0 = outs[5].reshape(1, 3, 4, 4)
+    assert (mk0[0, 0] == 1).all()   # roi 0 fully inside gt0's mask
+    assert (mk0[0, 2] == -1).all()  # bg roi marked -1
