@@ -438,9 +438,22 @@ def main():
                     help="tiny batches (smoke test)")
     ap.add_argument("--worker", choices=sorted(WORKLOADS), default=None,
                     help=argparse.SUPPRESS)  # internal: in-process child
+    ap.add_argument("--probe", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: backend-init check
     ap.add_argument("--in-process", action="store_true",
                     help="no subprocess isolation (debugging)")
     args = ap.parse_args()
+
+    if args.probe:
+        if os.environ.get("JAX_PLATFORMS"):
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        _probe_backend()
+        import jax
+
+        _log("probe ok: %s" % jax.devices())
+        return 0
 
     if args.worker:
         return _run_worker(args.worker, not args.fp32, args.quick)
@@ -456,6 +469,34 @@ def main():
         "PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT", "900"))
     budget = int(os.environ.get("PADDLE_TPU_BENCH_TOTAL_BUDGET", "7200"))
     t_start = time.time()
+
+    # fail fast on a dead/wedged backend: one subprocess probe up front
+    # instead of 6 workers independently burning the init timeout each
+    init_timeout = int(os.environ.get("PADDLE_TPU_BENCH_INIT_TIMEOUT", "300"))
+    import signal as _signal
+
+    probe = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__), "--probe"],
+        stdout=subprocess.DEVNULL, stderr=sys.stderr,
+        start_new_session=True)
+    try:
+        probe_rc = probe.wait(timeout=init_timeout + 60)
+    except subprocess.TimeoutExpired:
+        probe_rc = -1
+        try:
+            os.killpg(probe.pid, _signal.SIGKILL)
+        except OSError:
+            pass
+        probe.wait()
+    if probe_rc != 0:
+        for name in names:
+            print(json.dumps({
+                "metric": name,
+                "error": "backend init probe failed (rc=%s): TPU tunnel "
+                         "unreachable or wedged; no workloads attempted"
+                         % probe_rc,
+            }), flush=True)
+        return 1
     ok_count = 0
     for name in names:
         left = budget - (time.time() - t_start)

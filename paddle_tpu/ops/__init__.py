@@ -19,6 +19,7 @@ from . import (  # noqa: F401
     misc_ops,
     nn,
     quant_ops,
+    recompute_ops,
     rnn,
     optimizer_ops,
     sequence,

@@ -1,0 +1,122 @@
+"""recompute_block: run a forward segment; rematerialize it in backward.
+
+See core/recompute.py for the design. Forward = plain emission of the
+sub-block. Grad = re-trace the same sub-block behind an
+``optimization_barrier`` (so XLA cannot CSE it with the forward emission
+and schedules it next to the gradient consumers — rematerialization),
+then jax.vjp through the re-trace. The segment's PRNG key is drawn once
+in the forward, exported through the ``RngKey`` output, and replayed in
+the grad, so dropout masks match bit-for-bit.
+
+Reference analog: the (later-era) fluid RecomputeOptimizer duplicates
+forward op descs into the backward program section; one sub-block op +
+a barrier is the whole-program-XLA equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autodiff import ATTR_DIFF
+from ..core.registry import register_grad_lowering, register_op
+
+__all__: List[str] = []
+
+
+def _sub_block(ctx, attrs):
+    return ctx.block.program.block(attrs["sub_block"])
+
+
+def _seg_key(ctx, attrs):
+    """One PRNG key per segment. Test mode draws nothing (dropout is
+    identity there), but still emits a constant so the declared RngKey
+    output always has a value."""
+    if not attrs.get("uses_rng"):
+        return None
+    if ctx.is_test or attrs.get("is_test", False) or ctx._rng is None:
+        return jax.random.PRNGKey(0)
+    return ctx.next_rng()
+
+
+def _run_segment(ctx, block, in_names, out_names, in_vals, key):
+    from ..core.lowering import LowerContext, lower_ops
+
+    env: Dict[str, Any] = dict(zip(in_names, in_vals))
+    sctx = LowerContext(block, key, ctx.is_test, ctx.amp)
+    lower_ops(sctx, block.ops, env)
+    missing = [n for n in out_names if n not in env]
+    if missing:
+        raise RuntimeError(
+            "recompute segment did not produce declared outputs %s" % missing)
+    return [env[n] for n in out_names]
+
+
+@register_op("recompute_block", diff_inputs=["X"], needs_env=False)
+def _recompute_block(ctx, ins, attrs):
+    block = _sub_block(ctx, attrs)
+    in_names = attrs["input_vars"]
+    out_names = attrs["output_vars"]
+    key = _seg_key(ctx, attrs)
+    outs = _run_segment(ctx, block, in_names, out_names, list(ins["X"]), key)
+    res = {"Out": outs}
+    if attrs.get("uses_rng"):
+        res["RngKey"] = [jax.random.key_data(key)]
+    return res
+
+
+@register_grad_lowering("recompute_block")
+def _recompute_block_grad(ctx, ins, attrs):
+    block = _sub_block(ctx, attrs)
+    in_names = attrs["input_vars"]
+    out_names = attrs["output_vars"]
+    xs = list(ins["X"])[:len(in_names)]
+    key = None
+    if attrs.get("uses_rng"):
+        key = jax.random.wrap_key_data(ins["RngKey"][0])
+
+    diff = [tuple(d) for d in attrs[ATTR_DIFF]]
+    diff_idx = [i for slot, i in diff if slot == "X"]
+
+    # the barrier makes this re-trace CSE-proof: XLA keeps it separate
+    # from the forward emission and schedules it where its consumers
+    # (the gradients) live — i.e. the segment is rematerialized, not
+    # kept alive across the forward->backward gap
+    xs_b = list(jax.lax.optimization_barrier(tuple(xs)))
+
+    # the forward's output values arrive as grad-op inputs (backward.py
+    # passes output slots through), which pins down the float outputs —
+    # the only ones vjp carries cotangents for
+    fwd_outs = list(ins.get("Out") or [])
+    float_pos = [i for i, v in enumerate(fwd_outs)
+                 if v is not None
+                 and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)]
+
+    def seg(dvals):
+        vals = list(xs_b)
+        for j, i in enumerate(diff_idx):
+            vals[i] = dvals[j]
+        outs = _run_segment(ctx, block, in_names, out_names, vals, key)
+        return [outs[i] for i in float_pos]
+
+    dvals0 = [xs_b[i] for i in diff_idx]
+    primals, vjp = jax.vjp(seg, dvals0)
+
+    gouts = ins.get("Out@GRAD") or []
+    cots = []
+    for k, pos in enumerate(float_pos):
+        g = gouts[pos] if pos < len(gouts) else None
+        pv = primals[k]
+        if g is None:
+            g = jnp.zeros_like(pv)
+        elif g.dtype != pv.dtype or g.shape != pv.shape:
+            g = jnp.broadcast_to(g.astype(pv.dtype), pv.shape)
+        cots.append(g)
+    (dins,) = vjp(cots)
+
+    grads: List[Any] = [None] * len(xs)
+    for j, i in enumerate(diff_idx):
+        grads[i] = dins[j]
+    return {"X@GRAD": grads}

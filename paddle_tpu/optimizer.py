@@ -49,6 +49,7 @@ __all__ = [
     "Lamb",
     "LambOptimizer",
     "ModelAverage",
+    "RecomputeOptimizer",
 ]
 
 
@@ -535,3 +536,56 @@ class ModelAverage:
                     self.restore(executor, scope)
 
         return _ctx()
+
+
+class RecomputeOptimizer(Optimizer):
+    """Gradient checkpointing wrapper (the later-era fluid
+    RecomputeOptimizer API shape: wrap an inner optimizer, name the
+    checkpoint vars, minimize). The reference implementation clones
+    forward op descs into the backward section; here minimize() runs
+    core/recompute.apply_recompute first — forward segments between
+    checkpoints move into recompute_block sub-blocks whose grad op
+    rematerializes them behind an optimization barrier (see
+    ops/recompute_ops.py) — then delegates to the inner optimizer.
+
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.Adam(1e-3))
+        opt._set_checkpoints([h1, h2])
+        opt.minimize(loss)
+    """
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+        self._applied_programs = set()  # program serials already rewritten
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = list(checkpoints)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if not self._checkpoints:
+            raise RuntimeError(
+                "RecomputeOptimizer: call _set_checkpoints([...]) before "
+                "minimize/backward")
+        program = loss.block.program
+        if program._serial not in self._applied_programs:
+            from .core.recompute import apply_recompute
+
+            apply_recompute(program, self._checkpoints)
+            self._applied_programs.add(program._serial)
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        main = loss.block.program
+        startup = startup_program or default_startup_program()
+        with program_guard(main, startup):
+            params_grads = self.backward(loss, startup, parameter_list,
+                                         no_grad_set)
+            opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
