@@ -1,0 +1,71 @@
+"""Estimate a Program's memory footprint before running it.
+
+Reference: python/paddle/fluid/contrib/memory_usage_calc.py:46
+(`memory_usage(program, batch_size)` — sums var sizes with -1 dims
+taken as the batch). The TPU build keeps that quick shape-based
+estimate and adds the authoritative number: XLA's own buffer-assignment
+stats for the compiled step (`Executor.cost_analysis`), which accounts
+for fusion, liveness-based reuse and donation — things a per-var sum
+structurally overestimates.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+__all__ = ["memory_usage", "compiled_memory_usage"]
+
+_DTYPE_SIZE = {
+    "float64": 8, "float32": 4, "float16": 2, "bfloat16": 2,
+    "int64": 8, "int32": 4, "int16": 2, "int8": 1, "uint8": 1,
+    "bool": 1,
+}
+
+
+def memory_usage(program, batch_size: int) -> Tuple[float, str]:
+    """Shape-based estimate: sum of all block-0 var sizes, with -1 dims
+    substituted by ``batch_size``. Returns (value, unit-string) like the
+    reference (unit auto-scales B/KB/MB/GB)."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive, got %s" % batch_size)
+    total = 0
+    for var in program.global_block().vars.values():
+        shape = list(var.shape or [])
+        count = 1
+        for d in shape:
+            count *= batch_size if d in (-1, None) else int(d)
+        total += count * _DTYPE_SIZE.get(str(var.dtype), 4)
+    for unit, scale in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if total >= scale:
+            return total / scale, unit
+    return float(total), "B"
+
+
+def compiled_memory_usage(executor, program, feed, fetch_list=None,
+                          scope=None) -> Optional[float]:
+    """Peak device bytes of the *compiled* step, from XLA's buffer
+    assignment (memory_analysis of the jitted whole-block function) —
+    the number that decides whether the step fits in HBM, accounting
+    for fusion, liveness reuse and donation. Returns None when the
+    backend exposes no memory analysis. TPU-only addition (no reference
+    analog: the reference could only estimate, executor.cc has no
+    compile step to ask)."""
+    from ..core.scope import global_scope
+
+    scope = scope or global_scope()
+    plan, feeds, const_state, mut_state, rng = executor._gather(
+        program, feed, fetch_list, scope)
+    try:
+        mem = plan.fn.lower(feeds, const_state, mut_state,
+                            rng).compile().memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    total = 0.0
+    for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                 "output_size_in_bytes"):
+        total += float(getattr(mem, attr, 0) or 0)
+    # donated inputs alias outputs; don't double count them
+    total -= float(getattr(mem, "alias_size_in_bytes", 0) or 0)
+    return total if total > 0 else None

@@ -1,0 +1,32 @@
+"""Op-frequency statistics over a Program.
+
+Reference: python/paddle/fluid/contrib/op_frequence.py:23
+(`op_freq_statistic(program)` — two OrderedDicts: per-op-type counts and
+counts of adjacent op pairs). Frequency tables guided the reference's
+hand-written fusion passes; on TPU they are diagnostics only (XLA fuses
+mechanically), but the introspection API keeps its users working.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_op_freq), both sorted most-frequent
+    first, counting every op in every block (sub-blocks included)."""
+    uni: "OrderedDict[str, int]" = OrderedDict()
+    adj: "OrderedDict[str, int]" = OrderedDict()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = "%s->%s" % (prev, op.type)
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+    uni = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni, adj

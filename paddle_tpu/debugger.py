@@ -1,0 +1,120 @@
+"""Program debugging helpers: pseudo-code printing + graphviz dumps.
+
+Reference: python/paddle/fluid/debugger.py (`pprint_program_codes`,
+`pprint_block_codes`, `draw_block_graphviz`) — the same introspection
+surface over the TPU build's Program. The DOT emitter here draws one
+*block* (any block, sub-blocks included); for a whole-program op/var
+graph use core/ir's graph_viz_pass, which this module intentionally
+does not depend on.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["pprint_program_codes", "pprint_block_codes",
+           "draw_block_graphviz"]
+
+_GRAD_SUFFIX = "@GRAD"
+
+
+def _repr_slot(slots) -> str:
+    parts = []
+    for slot, names in sorted(slots.items()):
+        real = [n for n in names if n]
+        if real:
+            parts.append("%s=[%s]" % (slot, ", ".join(real)))
+    return ", ".join(parts)
+
+
+def _repr_op(op) -> str:
+    outs = _repr_slot(op.outputs)
+    ins = _repr_slot(op.inputs)
+    attrs = {k: v for k, v in op.attrs.items()
+             if not k.startswith("__") and k != "sub_block"}
+    tail = ""
+    if attrs:
+        items = ", ".join("%s=%r" % (k, v) for k, v in sorted(attrs.items()))
+        if len(items) > 120:
+            items = items[:117] + "..."
+        tail = "  # " + items
+    if "sub_block" in op.attrs:
+        tail += "  [sub_block %s]" % op.attrs["sub_block"]
+    return "%s = %s(%s)%s" % (outs or "()", op.type, ins, tail)
+
+
+def pprint_block_codes(block, show_backward: bool = False,
+                       file=None) -> str:
+    """Pseudo-code for one block (reference debugger.py:114). Backward /
+    optimize-role ops — and the vars only they touch (@GRAD vars,
+    optimizer state) — are hidden unless show_backward."""
+    shown_ops = []
+    for op in block.ops:
+        role = op.attrs.get("__op_role__", "forward")
+        if not show_backward and role in ("backward", "optimize"):
+            continue
+        shown_ops.append(op)
+    if show_backward:
+        shown_vars = list(block.vars.values())
+    else:
+        used = {n for op in shown_ops
+                for n in op.input_names() + op.output_names()}
+        shown_vars = [v for v in block.vars.values()
+                      if v.name in used and _GRAD_SUFFIX not in v.name]
+    lines = ["block_%d {" % block.idx]
+    for var in shown_vars:
+        lines.append("  var %s : %s%s%s" % (
+            var.name, var.dtype, list(var.shape or []),
+            " persistable" if var.persistable else ""))
+    for op in shown_ops:
+        lines.append("  " + _repr_op(op))
+    lines.append("}")
+    text = "\n".join(lines)
+    if file is not None:
+        file.write(text + "\n")
+    else:
+        print(text)
+    return text
+
+
+def pprint_program_codes(program, show_backward: bool = False,
+                         file=None) -> str:
+    """Pseudo-code for every block (reference debugger.py:105)."""
+    return "\n".join(
+        pprint_block_codes(b, show_backward, file) for b in program.blocks)
+
+
+def draw_block_graphviz(block, highlights: Optional[list] = None,
+                        path: str = "./temp.dot") -> str:
+    """DOT dump of one block's op/var graph (reference debugger.py's
+    draw_block_graphviz; drawing via core/ir Graph.to_dot, the
+    graph_viz_pass substrate). Highlighted var names render filled."""
+    hi = set(highlights or [])
+    lines = ["digraph block_%d {" % block.idx,
+             '  node [fontsize=10];']
+    seen_vars = set()
+
+    def var_node(name):
+        if name not in seen_vars:
+            seen_vars.add(name)
+            style = (' style=filled fillcolor=yellow' if name in hi
+                     else ' style=filled fillcolor=lightgrey'
+                     if block.vars.get(name) is not None
+                     and block.vars[name].persistable else "")
+            lines.append('  "%s" [shape=box%s];' % (name, style))
+        return '"%s"' % name
+
+    for i, op in enumerate(block.ops):
+        op_id = "op_%d_%s" % (i, op.type)
+        lines.append('  "%s" [shape=ellipse label="%s"];' % (op_id, op.type))
+        for n in op.input_names():
+            if n:
+                lines.append("  %s -> \"%s\";" % (var_node(n), op_id))
+        for n in op.output_names():
+            if n:
+                lines.append("  \"%s\" -> %s;" % (op_id, var_node(n)))
+    lines.append("}")
+    dot = "\n".join(lines) + "\n"
+    with open(path, "w") as f:
+        f.write(dot)
+    return dot

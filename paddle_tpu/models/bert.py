@@ -40,7 +40,7 @@ def _bert_embed(src_ids, sent_ids, cfg, seq_len, is_test):
 
 
 def build(cfg=None, seq_len=128, max_mask=20, is_test=False,
-          use_fused_attention=None):
+          use_fused_attention=None, checkpoints=None):
     """MLM training graph. Feeds: src_ids/sent_ids [B,S] int64,
     input_mask [B,S] float (1=real token), mask_pos [B,max_mask] int64
     (flattened B*S positions), mask_label [B,max_mask] int64 (pad rows
@@ -65,7 +65,8 @@ def build(cfg=None, seq_len=128, max_mask=20, is_test=False,
     attn_bias = layers.unsqueeze(layers.unsqueeze(neg, [1]), [1])
 
     emb = _bert_embed(src_ids, sent_ids, cfg, seq_len, is_test)
-    enc = encoder(emb, attn_bias, cfg, is_test, use_fused_attention)
+    enc = encoder(emb, attn_bias, cfg, is_test, use_fused_attention,
+                  checkpoints=checkpoints)
 
     # MLM head: gather masked positions from the flattened sequence
     flat = layers.reshape(enc, [-1, cfg["d_model"]])          # [B*S, D]

@@ -109,7 +109,11 @@ def _prenorm(x, sub_fn, dropout, is_test, name):
     return layers.elementwise_add(x, h)
 
 
-def encoder(src_emb, self_bias, cfg, is_test=False, use_fused_attention=False):
+def encoder(src_emb, self_bias, cfg, is_test=False, use_fused_attention=False,
+            checkpoints=None):
+    """checkpoints: pass a list to collect per-layer outputs — the
+    recompute boundaries RecomputeOptimizer stores (everything between
+    two of them is rematerialized in the backward pass)."""
     x = src_emb
     for i in range(cfg["n_layer"]):
         nm = "enc_%d" % i
@@ -119,11 +123,13 @@ def encoder(src_emb, self_bias, cfg, is_test=False, use_fused_attention=False):
             cfg["dropout"], is_test, nm + "_pre1")
         x = _prenorm(x, lambda h, nm=nm: _ffn(h, cfg["d_model"], cfg["d_ff"], nm),
                      cfg["dropout"], is_test, nm + "_pre2")
+        if checkpoints is not None:
+            checkpoints.append(x)
     return layers.layer_norm(x, begin_norm_axis=2)
 
 
 def decoder(trg_emb, enc_out, self_bias, cross_bias, cfg, is_test=False,
-            use_fused_attention=False):
+            use_fused_attention=False, checkpoints=None):
     x = trg_emb
     for i in range(cfg["n_layer"]):
         nm = "dec_%d" % i
@@ -137,6 +143,8 @@ def decoder(trg_emb, enc_out, self_bias, cross_bias, cfg, is_test=False,
             cfg["dropout"], is_test, nm + "_pre2")
         x = _prenorm(x, lambda h, nm=nm: _ffn(h, cfg["d_model"], cfg["d_ff"], nm),
                      cfg["dropout"], is_test, nm + "_pre3")
+        if checkpoints is not None:
+            checkpoints.append(x)
     return layers.layer_norm(x, begin_norm_axis=2)
 
 
@@ -160,11 +168,13 @@ def _causal_bias(seq_len):
 
 
 def build(cfg=None, seq_len=64, is_test=False, label_smooth_eps=0.1,
-          use_fused_attention=None):
+          use_fused_attention=None, checkpoints=None):
     """Full training graph. Returns (avg_cost, feeds).
 
     use_fused_attention defaults to the PADDLE_TPU_FUSED_ATTENTION env
-    flag (default on) so hardware A/B runs need no code edit."""
+    flag (default on) so hardware A/B runs need no code edit.
+    checkpoints: pass a list to collect per-layer recompute boundaries
+    for RecomputeOptimizer (memory for FLOPs at long context)."""
     if use_fused_attention is None:
         import os
 
@@ -183,9 +193,10 @@ def build(cfg=None, seq_len=64, is_test=False, label_smooth_eps=0.1,
     trg_emb = _embed(trg, cfg["trg_vocab"], cfg["d_model"], cfg["max_length"],
                      cfg["dropout"], is_test, "trg")
 
-    enc_out = encoder(src_emb, src_bias, cfg, is_test, use_fused_attention)
+    enc_out = encoder(src_emb, src_bias, cfg, is_test, use_fused_attention,
+                      checkpoints=checkpoints)
     dec_out = decoder(trg_emb, enc_out, trg_bias, src_bias, cfg, is_test,
-                      use_fused_attention)
+                      use_fused_attention, checkpoints=checkpoints)
 
     logits = layers.fc(dec_out, cfg["trg_vocab"], num_flatten_dims=2,
                        bias_attr=False,

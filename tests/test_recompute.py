@@ -175,3 +175,44 @@ def test_recompute_unknown_checkpoint_raises():
 
         with pytest.raises(ValueError, match="not produced"):
             apply_recompute(main, ["no_such_var"])
+
+
+def test_transformer_model_recompute_builds_and_trains():
+    """The flagship model's checkpoints= hook: per-layer boundaries feed
+    RecomputeOptimizer; the wrapped program must still train (finite,
+    decreasing loss) with fused attention on its interpret path."""
+    from paddle_tpu.models import transformer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    startup.random_seed = 1
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    scope = Scope()
+    cfg = dict(d_model=32, d_ff=64, n_head=2, n_layer=2, src_vocab=64,
+               trg_vocab=64, max_length=16, dropout=0.1)
+    seq = 16
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        ckpts = []
+        loss, _ = transformer.build(cfg, seq_len=seq, checkpoints=ckpts)
+        assert len(ckpts) == 4  # 2 encoder + 2 decoder layers
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.Adam(learning_rate=1e-3))
+        opt._set_checkpoints(ckpts)
+        opt.minimize(loss)
+        kinds = [op.type for op in main.global_block().ops]
+        assert kinds.count("recompute_block") >= 3
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        feed = {
+            "src_ids": rs.randint(1, 64, (4, seq)).astype("int64"),
+            "trg_ids": rs.randint(1, 64, (4, seq)).astype("int64"),
+            "lbl_ids": rs.randint(1, 64, (4, seq)).astype("int64"),
+        }
+        losses = []
+        for _ in range(8):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

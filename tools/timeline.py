@@ -1,0 +1,75 @@
+"""Merge per-trainer profile dumps into one chrome://tracing timeline.
+
+Reference: tools/timeline.py (parses profiler.proto protobufs from
+several trainers and emits one chrome-trace JSON with a lane per
+device). Here the profiler already dumps chrome-trace JSON directly
+(paddle_tpu/profiler.py), so this tool's job is the distributed half:
+merge N dumps, one process-lane per trainer, preserving event times.
+
+    python tools/timeline.py \
+        --profile_path trainer0=prof0.json,trainer1=prof1.json \
+        --timeline_path merged.json
+
+Open the output in chrome://tracing or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def merge_traces(named_paths):
+    """named_paths: list of (label, path). Returns the merged trace dict.
+    Each input's events keep their tid but move to a dedicated pid, with
+    a process_name metadata event labelling the lane."""
+    merged = []
+    for pid, (label, path) in enumerate(named_paths):
+        with open(path) as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", trace if isinstance(trace, list)
+                           else [])
+        merged.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": label},
+        })
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # replaced by the labelled lane above
+            ev = dict(ev)
+            ev["pid"] = pid
+            merged.append(ev)
+    return {"traceEvents": merged}
+
+
+def _parse_profile_path(arg):
+    pairs = []
+    for item in arg.split(","):
+        if not item:
+            continue
+        if "=" in item:
+            label, path = item.split("=", 1)
+        else:
+            label, path = "trainer%d" % len(pairs), item
+        pairs.append((label, path))
+    if not pairs:
+        raise argparse.ArgumentTypeError("empty --profile_path")
+    return pairs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--profile_path", type=_parse_profile_path, required=True,
+                    help="comma-separated [name=]path chrome-trace dumps")
+    ap.add_argument("--timeline_path", required=True,
+                    help="output merged chrome-trace JSON")
+    args = ap.parse_args()
+    out = merge_traces(args.profile_path)
+    with open(args.timeline_path, "w") as f:
+        json.dump(out, f)
+    print("wrote %s (%d events from %d traces)" % (
+        args.timeline_path, len(out["traceEvents"]), len(args.profile_path)))
+
+
+if __name__ == "__main__":
+    main()
