@@ -76,7 +76,7 @@ def peak_flops():
 
 
 def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
-                  steps=10, warmup=3, quick=False):
+                  steps=10, warmup=3, quick=False, recompute=False):
     """Build, warm up, time, and report one workload in its own Scope."""
     if quick:
         steps, warmup = 2, 1
@@ -123,8 +123,7 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # recompute trades FLOPs for memory: mark the row so it is
             # never mistaken for (or regression-compared against) a
             # plain-activation baseline at the same batch size
-            **({"recompute": True} if os.environ.get(
-                "PADDLE_TPU_RECOMPUTE", "0") != "0" else {}),
+            **({"recompute": True} if recompute else {}),
             "value": round(throughput, 1),
             "unit": unit,
             "vs_baseline": round(throughput / BASELINES[name], 3)
@@ -136,11 +135,17 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
         return rec
 
 
+def _recompute_requested():
+    return os.environ.get("PADDLE_TPU_RECOMPUTE", "0") != "0"
+
+
 def _maybe_recompute(opt, checkpoints):
     """PADDLE_TPU_RECOMPUTE=1 trades FLOPs for activation memory via
     RecomputeOptimizer (per-layer boundaries) — the knob that buys batch
-    size (hence MFU) on memory-bound long-context runs."""
-    if os.environ.get("PADDLE_TPU_RECOMPUTE", "0") != "0" and checkpoints:
+    size (hence MFU) on memory-bound long-context runs. Only workloads
+    that thread checkpoints= through here are affected (and only their
+    rows carry the "recompute" marker)."""
+    if _recompute_requested() and checkpoints:
         import paddle_tpu as fluid
 
         opt = fluid.optimizer.RecomputeOptimizer(opt)
@@ -174,7 +179,8 @@ def bench_transformer(amp, quick):
         }
 
     return _run_workload("transformer_base_train_tokens_per_sec_per_chip",
-                         "tokens/sec", batch * seq, build, feed, amp, quick=quick)
+                         "tokens/sec", batch * seq, build, feed, amp,
+                         quick=quick, recompute=_recompute_requested())
 
 
 def bench_transformer_long(amp, quick):
@@ -205,7 +211,8 @@ def bench_transformer_long(amp, quick):
         }
 
     return _run_workload("transformer_base_s1024_train_tokens_per_sec_per_chip",
-                         "tokens/sec", batch * seq, build, feed, amp, quick=quick)
+                         "tokens/sec", batch * seq, build, feed, amp,
+                         quick=quick, recompute=_recompute_requested())
 
 
 def bench_resnet50(amp, quick):
@@ -284,7 +291,8 @@ def bench_bert(amp, quick):
         }
 
     return _run_workload("bert_base_mlm_train_tokens_per_sec_per_chip",
-                         "tokens/sec", batch * seq, build, feed, amp, quick=quick)
+                         "tokens/sec", batch * seq, build, feed, amp,
+                         quick=quick, recompute=_recompute_requested())
 
 
 def bench_deepfm(amp, quick):

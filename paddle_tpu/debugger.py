@@ -87,8 +87,9 @@ def pprint_program_codes(program, show_backward: bool = False,
 def draw_block_graphviz(block, highlights: Optional[list] = None,
                         path: str = "./temp.dot") -> str:
     """DOT dump of one block's op/var graph (reference debugger.py's
-    draw_block_graphviz; drawing via core/ir Graph.to_dot, the
-    graph_viz_pass substrate). Highlighted var names render filled."""
+    draw_block_graphviz). Emits DOT directly — works on any block,
+    sub-blocks included, which core/ir's program-level Graph.to_dot
+    (graph_viz_pass) does not. Highlighted var names render filled."""
     hi = set(highlights or [])
     lines = ["digraph block_%d {" % block.idx,
              '  node [fontsize=10];']

@@ -1,0 +1,95 @@
+"""Post-training int8 calibration (contrib.int8_inference.Calibrator).
+
+Reference contract (contrib/int8_inference/utility.py): sample fp32
+batches, compute per-activation thresholds (max or KL), emit a
+calibrated program whose predictions track fp32 closely.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.int8_inference import Calibrator
+
+
+def _build_and_train(scope):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    from paddle_tpu.core.scope import scope_guard
+
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data("x", [1, 8, 8])
+        conv = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                             act="relu")
+        flat = layers.reshape(conv, [-1, 4 * 8 * 8])
+        pred = layers.fc(flat, size=3, act="softmax")
+        infer = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        # spread the logits so softmax is confident: argmax must then be
+        # stable under int8 rounding (a fresh-init net outputs ~1/3 per
+        # class and its argmax is meaninglessly noise-sensitive)
+        wname = [v.name for v in main.global_block().all_parameters()
+                 if "fc" in v.name and v.name.endswith(".w_0")]
+        if wname:
+            w = np.asarray(scope.find_var(wname[0]))
+            scope.set_var(wname[0], w * 6.0)
+    return infer, pred, exe
+
+
+def _batches(n=4, bs=8):
+    rs = np.random.RandomState(0)
+    return [rs.rand(bs, 1, 8, 8).astype("float32") for _ in range(n)]
+
+
+@pytest.mark.parametrize("algo", ["max", "KL"])
+def test_calibrated_program_tracks_fp32(algo):
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    scope = Scope()
+    infer, pred, exe = _build_and_train(scope)
+    with scope_guard(scope):
+        calib = Calibrator(infer, scope=scope, algo=algo, bins=512)
+        assert calib.sampling_vars  # conv + fc activation inputs found
+        for xb in _batches():
+            calib.sample_data(exe, feed={"x": xb}, fetch_list=[pred])
+        scales = calib.scales()
+        assert all(s > 0 for s in scales.values())
+
+        qprog = calib.generate_calibrated_program()
+        kinds = [op.type for op in qprog.global_block().ops]
+        assert kinds.count("fake_quantize_abs_max") >= 3  # 2 acts + weights
+
+        xb = _batches(n=1)[0]
+        (fp32_out,) = exe.run(infer, feed={"x": xb}, fetch_list=[pred],
+                              scope=scope)
+        (q_out,) = exe.run(qprog, feed={"x": xb}, fetch_list=[pred],
+                           scope=scope)
+    fp32_out, q_out = np.asarray(fp32_out), np.asarray(q_out)
+    assert q_out.shape == fp32_out.shape
+    # int8 rounding error on a small net: predictions stay close and the
+    # argmax agrees on nearly all samples
+    np.testing.assert_allclose(q_out, fp32_out, atol=0.08)
+    agree = (q_out.argmax(1) == fp32_out.argmax(1)).mean()
+    assert agree >= 0.8
+
+
+def test_sample_before_scales_raises():
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    scope = Scope()
+    infer, _pred, _exe = _build_and_train(scope)
+    with scope_guard(scope):
+        calib = Calibrator(infer, scope=scope, algo="max")
+        with pytest.raises(RuntimeError, match="sample_data"):
+            calib.scales()
+
+
+def test_bad_algo_raises():
+    from paddle_tpu.core.scope import Scope
+
+    main = fluid.Program()
+    with pytest.raises(ValueError, match="algo"):
+        Calibrator(main, scope=Scope(), algo="entropy2")

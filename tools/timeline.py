@@ -27,8 +27,8 @@ def merge_traces(named_paths):
     for pid, (label, path) in enumerate(named_paths):
         with open(path) as f:
             trace = json.load(f)
-        events = trace.get("traceEvents", trace if isinstance(trace, list)
-                           else [])
+        events = (trace if isinstance(trace, list)
+                  else trace.get("traceEvents", []))
         merged.append({
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": label},
