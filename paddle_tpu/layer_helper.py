@@ -57,6 +57,17 @@ class LayerHelper:
             Constant(0.0) if is_bias else Xavier()
         )
         shape = [int(s) for s in shape]
+        # sharing-by-name (reference ParamAttr semantics): a second layer
+        # naming an existing parameter reuses it — same object, and no
+        # duplicate initializer op in the startup program (a statically
+        # unrolled decode loop re-creates its shared params every step)
+        existing = self.main_program.global_block().vars.get(name)
+        if isinstance(existing, Parameter):
+            if list(existing.shape) != shape:
+                raise ValueError(
+                    "parameter %r reused with shape %s, created with %s"
+                    % (name, shape, list(existing.shape)))
+            return existing
         # parameters always live in the global block (reference
         # framework.py create_parameter does the same): a parameter
         # created inside an RNN/conditional sub-block must be visible to

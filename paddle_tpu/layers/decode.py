@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from ..layer_helper import LayerHelper
 
-__all__ = ["beam_search", "beam_search_decode", "py_func"]
+__all__ = ["beam_search", "beam_search_decode", "beam_gather", "py_func"]
 
 
 def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None,
@@ -74,4 +74,19 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
         attrs={"forward_func": func,
                "out_shapes": [list(o.shape) for o in outs],
                "out_dtypes": [o.dtype for o in outs]})
+    return out
+
+
+def beam_gather(x, parent_idx, name=None):
+    """Reorder beam-grouped rows by parent index: x [B*beam, ...] with
+    rows grouped per source, parent_idx [B, beam] -> x[b*beam + parent].
+    The dense analog of the reference decoder's state reshuffle
+    (contrib/decoder/beam_search_decoder.py sequence_expand/lod_reset)."""
+    helper = LayerHelper("beam_gather", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype,
+                                                    stop_gradient=True)
+    out.shape = tuple(x.shape)
+    helper.append_op(type="beam_gather",
+                     inputs={"X": [x], "Index": [parent_idx]},
+                     outputs={"Out": [out]})
     return out

@@ -101,3 +101,20 @@ def _py_func(ctx, ins, attrs):
 
     outs = jax.experimental.io_callback(cb, result_spec, *xs, ordered=True)
     return {"Out": list(outs)}
+
+
+@register_op("beam_gather", no_grad=True)
+def _beam_gather(ctx, ins, attrs):
+    """Reorder per-row decoder state by parent beam index: X [B*K, ...]
+    (rows grouped by source), Index [B, K] -> X[b*K + Index[b,k]] laid
+    out as [B*K, ...]. The dense-beam analog of the reference decoder's
+    sequence_expand/lod_reset state reshuffle
+    (contrib/decoder/beam_search_decoder.py decode + beam_search_op.cc
+    parent_idx semantics)."""
+    x = ins["X"][0]
+    idx = ins["Index"][0].astype(jnp.int32)           # [B, K]
+    B, K = idx.shape
+    x3 = x.reshape((B, K) + x.shape[1:])
+    idx_full = idx.reshape((B, K) + (1,) * (x3.ndim - 2))
+    out = jnp.take_along_axis(x3, idx_full, axis=1)
+    return {"Out": [out.reshape(x.shape)]}

@@ -1,0 +1,186 @@
+"""contrib.decoder: StateCell / TrainingDecoder / BeamSearchDecoder.
+
+End-to-end contract (reference contrib/decoder/beam_search_decoder.py):
+train a seq2seq copy task through TrainingDecoder, then decode the same
+StateCell autoregressively with BeamSearchDecoder — the best beam must
+reproduce the source sequence.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.decoder import (BeamSearchDecoder, InitState,
+                                        StateCell, TrainingDecoder)
+
+V, T, D, H = 18, 5, 24, 48
+BOS, EOS = 1, 0
+
+
+def _make_cell(enc_last):
+    cell = StateCell(inputs={"x": None},
+                     states={"h": InitState(init=enc_last)},
+                     out_state="h")
+
+    @cell.state_updater
+    def _update(c):
+        x = c.get_input("x")
+        h = c.get_state("h")
+        xh = layers.concat([x, h], axis=1)
+        nh = layers.fc(xh, size=H, act="tanh",
+                       param_attr=fluid.ParamAttr(name="dec_step.w_0"),
+                       bias_attr=fluid.ParamAttr(name="dec_step.b_0"))
+        c.set_state("h", nh)
+
+    return cell
+
+
+def _encoder(src):
+    emb = layers.embedding(src, size=[V, D],
+                           param_attr=fluid.ParamAttr(name="word_emb"))
+    # order-preserving: flatten [B, T, D] -> [B, T*D] (a mean would make
+    # exact-order copying ambiguous and the decode test meaningless)
+    flat = layers.reshape(emb, [-1, T * D])
+    return layers.fc(flat, size=H, act="tanh",
+                     param_attr=fluid.ParamAttr(name="enc.w_0"),
+                     bias_attr=fluid.ParamAttr(name="enc.b_0"))
+
+
+def test_training_decoder_and_beam_decode_copy_task():
+    rng = np.random.RandomState(0)
+    n = 512
+    SRC = rng.randint(2, V, (n, T)).astype(np.int64)
+    TRG_IN = np.concatenate([np.full((n, 1), BOS), SRC], 1).astype(np.int64)
+    LBL = np.concatenate([SRC, np.full((n, 1), EOS)], 1).astype(np.int64)
+
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    startup.random_seed = 0
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        src = layers.data("src", [T], dtype="int64")
+        trg = layers.data("trg", [T + 1], dtype="int64")
+        lbl = layers.data("lbl", [T + 1], dtype="int64")
+        tlen = layers.data("tlen", [], dtype="int64")
+        enc_last = _encoder(src)
+        temb = layers.embedding(trg, size=[V, D],
+                                param_attr=fluid.ParamAttr(name="word_emb"))
+        cell = _make_cell(enc_last)
+        decoder = TrainingDecoder(cell)
+        with decoder.block():
+            w = decoder.step_input(temb, length=tlen)
+            cell.compute_state(inputs={"x": w})
+            score = layers.fc(cell.get_state("h"), size=V, act="softmax",
+                              param_attr=fluid.ParamAttr(name="score.w_0"),
+                              bias_attr=fluid.ParamAttr(name="score.b_0"))
+            cell.update_states()
+            decoder.output(score)
+        probs = decoder()                            # [B, T+1, V]
+        flat_p = layers.reshape(probs, [-1, V])
+        flat_l = layers.reshape(lbl, [-1, 1])
+        loss = layers.mean(layers.cross_entropy(flat_p, flat_l))
+        fluid.optimizer.Adam(0.02).minimize(loss)
+
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        B = 64
+        losses = []
+        for step in range(200):
+            i = (step * B) % n
+            (lv,) = exe.run(main, feed={
+                "src": SRC[i:i + B], "trg": TRG_IN[i:i + B],
+                "lbl": LBL[i:i + B],
+                "tlen": np.full((B,), T + 1, np.int64)}, fetch_list=[loss], scope=scope)
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+    # --------- inference program: same params, beam decode ----------
+    b_main, b_start = fluid.Program(), fluid.Program()
+    with scope_guard(scope), fluid.program_guard(b_main, b_start):
+        src = layers.data("src", [T], dtype="int64")
+        init_ids = layers.data("init_ids", [1], dtype="int64")
+        init_scores = layers.data("init_scores", [1], dtype="float32")
+        enc_last = _encoder(src)
+        cell = _make_cell(enc_last)
+        bsd = BeamSearchDecoder(
+            cell, init_ids, init_scores, target_dict_dim=V, word_dim=D,
+            max_len=T + 1, beam_size=3, end_id=EOS,
+            word_emb_param_name="word_emb",
+            score_fc_param_name="score")
+        bsd.decode()
+        trans_ids, trans_scores = bsd()
+
+        Bi = 32
+        feed = {"src": SRC[:Bi],
+                "init_ids": np.full((Bi, 1), BOS, np.int64),
+                "init_scores": np.zeros((Bi, 1), np.float32)}
+        ids_v, scores_v = exe.run(b_main, feed=feed,
+                                  fetch_list=[trans_ids, trans_scores],
+                                  scope=scope)
+    ids_v = np.asarray(ids_v)                       # [B, beam, T+1]
+    scores_v = np.asarray(scores_v)                 # [B, beam]
+    assert ids_v.shape == (Bi, 3, T + 1)
+    assert scores_v.shape == (Bi, 3)
+    best = ids_v[:, 0, :]                           # highest-scoring beam
+    # the copy task: first T tokens of the best beam reproduce the source
+    acc = (best[:, :T] == SRC[:Bi]).mean()
+    assert acc > 0.85, acc
+    # and the final token is EOS on most rows
+    assert (best[:, T] == EOS).mean() > 0.8
+
+
+def test_state_cell_errors():
+    cell = StateCell(inputs={"x": None},
+                     states={"h": InitState(shape=[H])}, out_state="h")
+    with pytest.raises(RuntimeError, match="state_updater"):
+        cell.compute_state(inputs={"x": None})
+    with pytest.raises(ValueError, match="out_state"):
+        StateCell(inputs={}, states={"h": InitState(shape=[4])},
+                  out_state="nope")
+    with pytest.raises(ValueError):
+        InitState()
+
+
+def test_beam_decoder_rejects_unnamed_updater_params():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src", [T], dtype="int64")
+        init_ids = layers.data("init_ids", [1], dtype="int64")
+        init_scores = layers.data("init_scores", [1], dtype="float32")
+        enc = _encoder(src)
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=enc)}, out_state="h")
+
+        @cell.state_updater
+        def _up(c):
+            # no ParamAttr name: each unrolled step would get fresh
+            # random weights — decode() must refuse, not emit garbage
+            c.set_state("h", layers.fc(
+                layers.concat([c.get_input("x"), c.get_state("h")], axis=1),
+                size=H, act="tanh"))
+
+        bsd = BeamSearchDecoder(cell, init_ids, init_scores,
+                                target_dict_dim=V, word_dim=D,
+                                max_len=3, beam_size=2, end_id=EOS)
+        with pytest.raises(RuntimeError, match="ParamAttr"):
+            bsd.decode()
+
+
+def test_param_sharing_by_name_no_duplicate_init():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8])
+        a = layers.fc(x, size=4, param_attr=fluid.ParamAttr(name="shared.w"),
+                      bias_attr=False)
+        b = layers.fc(x, size=4, param_attr=fluid.ParamAttr(name="shared.w"),
+                      bias_attr=False)
+        del a, b
+        inits = [op for op in startup.global_block().ops
+                 if "shared.w" in sum(op.outputs.values(), [])]
+        assert len(inits) == 1  # one initializer despite two fc calls
+        with pytest.raises(ValueError, match="shape"):
+            layers.fc(x, size=9, param_attr=fluid.ParamAttr(name="shared.w"),
+                      bias_attr=False)
