@@ -8,7 +8,8 @@ from .trainer import (BeginEpochEvent, BeginStepEvent,  # noqa: F401
                       EndEpochEvent, EndStepEvent, Trainer)
 from . import quantize  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401
-from . import decoder, int8_inference, memory_usage_calc, op_frequence  # noqa: F401
+from . import (decoder, int8_inference, memory_usage_calc,  # noqa: F401
+               op_frequence, utils)
 from .int8_inference import Calibrator  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401

@@ -1,0 +1,211 @@
+"""contrib.utils: HDFSClient (against a stub hadoop binary) and
+lookup_table_utils (against a real pserver-shard checkpoint layout)."""
+
+import os
+import stat
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.utils import (HDFSClient,
+                                      convert_dist_to_sparse_program,
+                                      load_persistables_for_inference,
+                                      multi_download)
+
+STUB = r"""#!/bin/bash
+# stub hadoop: 'fs' subcommand backed by a local directory $HDFS_ROOT
+shift  # drop 'fs'
+while [[ "$1" == -D* ]]; do shift; done
+cmd="$1"; shift
+root="${HDFS_ROOT:?}"
+case "$cmd" in
+  -test) flag="$1"; p="$root/$2"
+         [[ "$flag" == "-e" && -e "$p" ]] && exit 0
+         [[ "$flag" == "-d" && -d "$p" ]] && exit 0
+         exit 1 ;;
+  -mkdir) [[ "$1" == "-p" ]] && shift; mkdir -p "$root/$1" ;;
+  -put) cp -r "$1" "$root/$2" ;;
+  -get) cp -r "$root/$1" "$2" ;;
+  -rm|-rmr) rm -rf "$root/$1" ;;
+  -mv) mv "$root/$1" "$root/$2" ;;
+  -ls|-lsr)
+    p="$root/$1"
+    find "$p" -mindepth 1 | while read -r f; do
+      rel="${f#$root/}"
+      if [[ -d "$f" ]]; then mode="drwxr-xr-x"; else mode="-rw-r--r--"; fi
+      echo "$mode 1 u g 0 2026-01-01 00:00 $rel"
+    done ;;
+  *) echo "unknown $cmd" >&2; exit 1 ;;
+esac
+"""
+
+
+@pytest.fixture()
+def hdfs(tmp_path, monkeypatch):
+    home = tmp_path / "hadoop_home"
+    (home / "bin").mkdir(parents=True)
+    stub = home / "bin" / "hadoop"
+    stub.write_text(STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    hdfs_root = tmp_path / "hdfs_root"
+    hdfs_root.mkdir()
+    monkeypatch.setenv("HDFS_ROOT", str(hdfs_root))
+    return HDFSClient(str(home), {"fs.default.name": "hdfs://stub"})
+
+
+def test_hdfs_roundtrip(hdfs, tmp_path):
+    local = tmp_path / "data.txt"
+    local.write_text("hello")
+    assert hdfs.makedirs("models")
+    assert hdfs.upload("models/data.txt", str(local))
+    assert hdfs.is_exist("models/data.txt")
+    assert hdfs.is_dir("models")
+    assert not hdfs.is_dir("models/data.txt")
+    assert "models/data.txt" in hdfs.lsr("models")
+
+    dst = tmp_path / "back.txt"
+    assert hdfs.download("models/data.txt", str(dst))
+    assert dst.read_text() == "hello"
+
+    assert hdfs.rename("models/data.txt", "models/renamed.txt")
+    assert hdfs.is_exist("models/renamed.txt")
+    assert hdfs.delete("models/renamed.txt")
+    assert not hdfs.is_exist("models/renamed.txt")
+
+
+def test_hdfs_multi_download(hdfs, tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    for i in range(6):
+        (src / ("f%d.txt" % i)).write_text(str(i))
+    assert hdfs.makedirs("bulk")
+    for i in range(6):
+        hdfs.upload("bulk/f%d.txt" % i, str(src / ("f%d.txt" % i)))
+
+    out0 = tmp_path / "t0"
+    got0 = multi_download(hdfs, "bulk", str(out0), trainer_id=0, trainers=2)
+    out1 = tmp_path / "t1"
+    got1 = multi_download(hdfs, "bulk", str(out1), trainer_id=1, trainers=2)
+    assert len(got0) == 3 and len(got1) == 3  # round-robin split
+    names = {os.path.basename(p) for p in got0 + got1}
+    assert names == {"f%d.txt" % i for i in range(6)}
+
+
+def _fake_ps_checkpoint(tmp_path, table):
+    # two servers: w sliced into blocks, table whole on server 2
+    s1 = tmp_path / "127.0.0.1_7001"
+    s2 = tmp_path / "127.0.0.1_7002"
+    s1.mkdir()
+    s2.mkdir()
+    w0 = np.arange(12, dtype=np.float32).reshape(6, 2)
+    w1 = np.arange(12, 24, dtype=np.float32).reshape(6, 2)
+    np.savez(s1 / "shard.npz", **{"fc.w_0.block0": w0,
+                                  "fc.w_0.block0_moment_0": w0 * 0})
+    np.savez(s2 / "shard.npz", **{"fc.w_0.block1": w1, table[0]: table[1]})
+    return np.concatenate([w0, w1], axis=0)
+
+
+def test_load_persistables_for_inference(tmp_path):
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    emb_w = np.random.RandomState(0).rand(10, 4).astype(np.float32)
+    full_w = _fake_ps_checkpoint(tmp_path, ("emb.w_0", emb_w))
+
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        ids = layers.data("ids", [3], dtype="int64")
+        emb = layers.embedding(ids, size=[10, 4],
+                               param_attr=fluid.ParamAttr(name="emb.w_0"))
+        flat = layers.reshape(emb, [-1, 12])
+        pred = layers.fc(flat, size=2,
+                         param_attr=fluid.ParamAttr(name="fc.w_0"))
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        loaded = load_persistables_for_inference(
+            str(tmp_path), exe, main, lookup_table_var_name="emb.w_0",
+            scope=scope)
+        assert "emb.w_0" in loaded and "fc.w_0" in loaded
+        # moment (optimizer state) must NOT be loaded on the infer path
+        assert not any("moment" in n for n in loaded)
+        np.testing.assert_array_equal(np.asarray(scope.find_var("fc.w_0")),
+                                      full_w)
+        np.testing.assert_array_equal(np.asarray(scope.find_var("emb.w_0")),
+                                      emb_w)
+        # and the program still runs with the merged params
+        (out,) = exe.run(main, feed={"ids": np.zeros((2, 3), "int64")},
+                         fetch_list=[pred], scope=scope)
+        assert np.asarray(out).shape == (2, 2)
+
+    with pytest.raises(KeyError, match="no_such_table"):
+        load_persistables_for_inference(
+            str(tmp_path), exe, main, lookup_table_var_name="no_such_table",
+            scope=scope)
+    with pytest.raises(FileNotFoundError):
+        load_persistables_for_inference(str(tmp_path / "empty"), exe, main,
+                                        scope=scope)
+
+
+def test_convert_dist_to_sparse_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [3], dtype="int64")
+        out_v = main.global_block().create_var(name="emb_out",
+                                               dtype="float32")
+        dummy = main.global_block().create_var(name="sent", dtype="int32")
+        blk = main.global_block()
+        blk.append_op("prefetch", {"Ids": [ids.name]}, {"Out": [out_v.name]},
+                      {"endpoint": "127.0.0.1:7001", "table_name": "tbl.w",
+                       "width": 4, "dtype": "float32", "padding_idx": -1})
+        blk.append_op("send_sparse", {"Rows": [ids.name], "Values": [ids.name]},
+                      {"Out": [dummy.name]},
+                      {"endpoint": "127.0.0.1:7001", "var_name": "tbl.w@GRAD",
+                       "height": 10, "padding_idx": -1})
+    local = convert_dist_to_sparse_program(main)
+    kinds = [op.type for op in local.global_block().ops]
+    assert "lookup_table" in kinds
+    assert "prefetch" not in kinds and "send_sparse" not in kinds
+    assert "tbl.w" in local.global_block().vars
+    assert local.global_block().vars["tbl.w"].persistable
+
+
+def test_load_persistables_for_increment_table_path(tmp_path):
+    from paddle_tpu.contrib.utils import load_persistables_for_increment
+    from paddle_tpu.core.scope import Scope
+
+    _fake_ps_checkpoint(tmp_path, ("emb.w_0", np.zeros((2, 2), np.float32)))
+    table = np.random.RandomState(1).rand(7, 3).astype(np.float32)
+    tpath = tmp_path / "table.npy"
+    np.save(tpath, table)
+    scope = Scope()
+    loaded = load_persistables_for_increment(
+        str(tmp_path), None, fluid.Program(), lookup_table_var="big.w",
+        lookup_table_var_path=str(tpath), scope=scope)
+    assert "big.w" in loaded
+    np.testing.assert_array_equal(np.asarray(scope.find_var("big.w")), table)
+    # optimizer state DOES load on the increment path
+    assert any("moment" in n for n in loaded)
+    with pytest.raises(ValueError, match="together"):
+        load_persistables_for_increment(str(tmp_path), None, fluid.Program(),
+                                        lookup_table_var="x", scope=scope)
+
+
+def test_hdfs_download_unzip_and_no_overwrite(hdfs, tmp_path):
+    import zipfile
+
+    zsrc = tmp_path / "bundle.zip"
+    with zipfile.ZipFile(zsrc, "w") as z:
+        z.writestr("inner/a.txt", "A")
+    assert hdfs.makedirs("zips")
+    assert hdfs.upload("zips/bundle.zip", str(zsrc))
+    dstdir = tmp_path / "out"
+    dstdir.mkdir()
+    dst = dstdir / "bundle.zip"
+    assert hdfs.download("zips/bundle.zip", str(dst), unzip=True)
+    assert (dstdir / "inner" / "a.txt").read_text() == "A"
+    # existing destination without overwrite fails fast (no retries)
+    assert not hdfs.download("zips/bundle.zip", str(dst))
+    # upload to an existing remote path without overwrite fails fast too
+    assert not hdfs.upload("zips/bundle.zip", str(zsrc))
