@@ -10,6 +10,7 @@ from . import quantize  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401
 from . import (decoder, int8_inference, memory_usage_calc,  # noqa: F401
                op_frequence, utils)
+from .reader import ctr_reader  # noqa: F401  (module, per reference usage)
 from .int8_inference import Calibrator  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401

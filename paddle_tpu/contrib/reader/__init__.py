@@ -1,0 +1,1 @@
+from . import ctr_reader  # noqa: F401

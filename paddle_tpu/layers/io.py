@@ -83,6 +83,20 @@ class PyReader:
             raise RuntimeError("decorate a generator before iterating")
         q = _queue.Queue(maxsize=self.capacity)
         stop = object()
+        failure = []
+        cancelled = threading.Event()
+
+        def _put(item):
+            # bounded put that gives up when the consumer walked away
+            # (early break from the feed loop): otherwise the producer
+            # thread blocks forever pinning `capacity` device batches
+            while not cancelled.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
@@ -90,18 +104,28 @@ class PyReader:
                     if self.use_double_buffer:
                         # async device transfer overlaps the training step
                         batch = tuple(jax.device_put(b) for b in batch)
-                    q.put(batch)
+                    if not _put(batch):
+                        return
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                # surface producer errors to the consumer: a reader that
+                # dies mid-pass must not look like a clean end-of-data
+                failure.append(exc)
             finally:
-                q.put(stop)
+                _put(stop)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         names = [v.name for v in self.feed_list]
-        while True:
-            item = q.get()
-            if item is stop:
-                return
-            yield dict(zip(names, item))
+        try:
+            while True:
+                item = q.get()
+                if item is stop:
+                    if failure:
+                        raise failure[0]
+                    return
+                yield dict(zip(names, item))
+        finally:
+            cancelled.set()  # unblock + retire the producer on early exit
 
 
 def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,

@@ -1,0 +1,121 @@
+"""contrib.reader.ctr_reader: csv/svm click-log feeding via PyReader
+(reference contrib/reader/ctr_reader.py:53)."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_ctr_reader_csv(tmp_path):
+    path = tmp_path / "a.txt"
+    with open(path, "w") as f:
+        for i in range(10):
+            f.write("%d %0.1f,%0.1f %d,%d\n"
+                    % (i % 2, i, i + 0.5, i % 5, (i + 1) % 5))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        label = layers.data("label", [1], dtype="int64")
+        dense = layers.data("dense", [2])
+        sp = layers.data("sp", [2], dtype="int64")
+        r = fluid.contrib.ctr_reader.ctr_reader(
+            feed_dict=[label, dense, sp], file_type="plain",
+            file_format="csv", dense_slot_index=[1], sparse_slot_index=[2],
+            capacity=8, thread_num=2, batch_size=4, file_list=[str(path)],
+            slots=[])
+    batches = list(r())
+    assert len(batches) == 3  # 4 + 4 + 2
+    assert np.asarray(batches[0]["dense"]).shape == (4, 2)
+    np.testing.assert_allclose(np.asarray(batches[0]["dense"])[1],
+                               [1.0, 1.5])
+    assert np.asarray(batches[2]["label"]).shape == (2, 1)
+
+
+def test_ctr_reader_svm_gzip(tmp_path):
+    path = tmp_path / "b.txt.gz"
+    with gzip.open(path, "wt") as f:
+        for i in range(6):
+            f.write("1 3:%d 7:%d 7:%d\n" % (i, i * 2, i * 2 + 1))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        l2 = layers.data("l2", [1], dtype="int64")
+        s3 = layers.data("s3", [1], dtype="int64")
+        s7 = layers.data("s7", [2], dtype="int64")
+        r = fluid.contrib.ctr_reader.ctr_reader(
+            feed_dict=[l2, s3, s7], file_type="gzip", file_format="svm",
+            dense_slot_index=[], sparse_slot_index=[], capacity=8,
+            thread_num=2, batch_size=3, file_list=[str(path)], slots=[3, 7])
+    batches = list(r())
+    assert len(batches) == 2
+    s7b = np.asarray(batches[0]["s7"])
+    assert s7b.shape == (3, 2)  # two signs in slot 7 per line
+    np.testing.assert_array_equal(np.asarray(batches[0]["s3"]).ravel(),
+                                  [0, 1, 2])
+
+
+def test_ctr_reader_validation(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        label = layers.data("lab", [1], dtype="int64")
+        with pytest.raises(ValueError, match="file_type"):
+            fluid.contrib.ctr_reader.ctr_reader(
+                [label], "tar", "csv", [], [], 8, 1, 4, [], [])
+        with pytest.raises(ValueError, match="file_format"):
+            fluid.contrib.ctr_reader.ctr_reader(
+                [label], "plain", "json", [], [], 8, 1, 4, [], [])
+    # field-count mismatch surfaces from the producer thread
+    path = tmp_path / "c.txt"
+    path.write_text("1 2.0,3.0 4,5\n")
+    with fluid.program_guard(main, startup):
+        only_label = layers.data("only", [1], dtype="int64")
+        r = fluid.contrib.ctr_reader.ctr_reader(
+            [only_label], "plain", "csv", [1], [2], 8, 1, 1,
+            [str(path)], [])
+    with pytest.raises(ValueError, match="fields"):
+        for _ in r():
+            pass
+
+
+def test_ctr_reader_csv_interleaved_columns(tmp_path):
+    # sparse column BEFORE dense column: fields must bind in column order
+    path = tmp_path / "d.txt"
+    path.write_text("0 7,8 1.5,2.5\n1 9,1 3.5,4.5\n")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        label = layers.data("label", [1], dtype="int64")
+        sp = layers.data("sp", [2], dtype="int64")
+        dn = layers.data("dn", [2])
+        r = fluid.contrib.ctr_reader.ctr_reader(
+            feed_dict=[label, sp, dn], file_type="plain", file_format="csv",
+            dense_slot_index=[2], sparse_slot_index=[1], capacity=4,
+            thread_num=1, batch_size=2, file_list=[str(path)], slots=[])
+    (batch,) = list(r())
+    np.testing.assert_array_equal(np.asarray(batch["sp"]), [[7, 8], [9, 1]])
+    np.testing.assert_allclose(np.asarray(batch["dn"]),
+                               [[1.5, 2.5], [3.5, 4.5]])
+
+
+def test_pyreader_early_exit_retires_producer(tmp_path):
+    import threading
+    import time
+
+    from paddle_tpu.layers.io import PyReader
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [1])
+    before = threading.active_count()
+    for _ in range(3):
+        reader = PyReader(feed_list=[x], capacity=2)
+        reader.decorate_batch_generator(
+            lambda: ((np.zeros((1, 1), "float32"),) for _ in range(100)))
+        for _feed in reader():
+            break  # abandon with a full queue
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before + 1  # producers retired

@@ -46,6 +46,7 @@ MODULES = [
     "paddle_tpu.contrib.quantize",
     "paddle_tpu.contrib.decoder",
     "paddle_tpu.contrib.utils",
+    "paddle_tpu.contrib.reader.ctr_reader",
     "paddle_tpu.contrib.int8_inference",
     "paddle_tpu.contrib.memory_usage_calc",
     "paddle_tpu.contrib.op_frequence",
