@@ -1,0 +1,93 @@
+"""Minimal DOT-building helpers (reference python/paddle/fluid/graphviz.py).
+
+A tiny dependency-free Graph/Node/Edge builder that renders DOT text
+(and optionally pipes it through the `dot` binary when present). The
+program-aware drawing entries live in net_drawer.py / debugger.py; this
+module is the generic substrate, kept for reference API parity.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import Dict, List, Optional
+
+__all__ = ["Graph", "Node", "Edge"]
+
+
+def crepr(v) -> str:
+    """Quote a value for DOT (reference graphviz.py:25)."""
+    if isinstance(v, str):
+        return '"%s"' % v.replace("\\", "\\\\").replace('"', '\\"')
+    return str(v)
+
+
+def _attrs(attrs: Dict) -> str:
+    if not attrs:
+        return ""
+    return "[" + ", ".join("%s=%s" % (k, crepr(v))
+                           for k, v in sorted(attrs.items())) + "]"
+
+
+class Node:
+    _counter = 0
+
+    def __init__(self, label: str, prefix: str = "node", **attrs):
+        Node._counter += 1
+        self.name = "%s_%d" % (prefix, Node._counter)
+        self.attrs = dict(attrs)
+        self.attrs["label"] = label
+
+    def __str__(self):
+        return "%s %s;" % (self.name, _attrs(self.attrs))
+
+
+class Edge:
+    def __init__(self, source: Node, target: Node, **attrs):
+        self.source = source
+        self.target = target
+        self.attrs = dict(attrs)
+
+    def __str__(self):
+        return "%s -> %s %s;" % (self.source.name, self.target.name,
+                                 _attrs(self.attrs))
+
+
+class Graph:
+    def __init__(self, title: str = "G", **attrs):
+        self.title = title
+        self.attrs = dict(attrs)
+        self.nodes: List[Node] = []
+        self.edges: List[Edge] = []
+
+    def node(self, label: str, prefix: str = "node", **attrs) -> Node:
+        n = Node(label, prefix, **attrs)
+        self.nodes.append(n)
+        return n
+
+    def edge(self, source: Node, target: Node, **attrs) -> Edge:
+        e = Edge(source, target, **attrs)
+        self.edges.append(e)
+        return e
+
+    def code(self) -> str:
+        lines = ["digraph %s {" % crepr(self.title)]
+        lines += ["  %s=%s;" % (k, crepr(v))
+                  for k, v in sorted(self.attrs.items())]
+        lines += ["  " + str(n) for n in self.nodes]
+        lines += ["  " + str(e) for e in self.edges]
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+    def show(self, path: str, fmt: Optional[str] = None) -> str:
+        """Write DOT to path; if the `dot` binary exists and fmt is an
+        image format (png/svg/pdf), render next to it."""
+        with open(path, "w") as f:
+            f.write(self.code())
+        if fmt and shutil.which("dot"):
+            import os.path
+
+            out = "%s.%s" % (os.path.splitext(path)[0], fmt)
+            subprocess.run(["dot", "-T" + fmt, path, "-o", out], check=False)
+            return out
+        return path

@@ -1,0 +1,67 @@
+"""Draw a Program's op/var graph (reference python/paddle/fluid/net_drawer.py).
+
+`draw_graph(startup_program, main_program, path=..., fmt=None)` builds a
+graphviz.Graph over every block-0 op (ellipses) and the vars they touch
+(boxes), mirroring the reference's parse_graph/draw_graph entry points.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .graphviz import Graph
+
+__all__ = ["draw_graph", "parse_graph"]
+
+OP_STYLE = {"shape": "oval", "color": "#0F9D58", "style": "filled",
+            "fillcolor": "#DFF2E9"}
+VAR_STYLE = {"shape": "box"}
+PARAM_STYLE = {"shape": "box", "style": "filled", "fillcolor": "#FFF3CF"}
+
+
+def parse_graph(program, graph: Graph, var_dict: Optional[Dict] = None,
+                **kwargs) -> Graph:
+    """Append one program's block-0 ops/vars to `graph` (reference
+    net_drawer.py:77). var_dict shares var nodes across programs."""
+    from .core.program import Parameter
+
+    var_dict = var_dict if var_dict is not None else {}
+    block = program.global_block()
+
+    def var_node(name):
+        v = block.vars.get(name)
+        if name not in var_dict:
+            style = PARAM_STYLE if isinstance(v, Parameter) else VAR_STYLE
+            var_dict[name] = graph.node(name, prefix="var", **style)
+        elif isinstance(v, Parameter):
+            # upgrade: the startup program creates params as plain vars;
+            # the main program knows they are Parameters
+            var_dict[name].attrs.update(PARAM_STYLE)
+            var_dict[name].attrs["label"] = name
+        return var_dict[name]
+
+    for op in block.ops:
+        onode = graph.node(op.type, prefix="op", **OP_STYLE)
+        for name in op.input_names():
+            if name:
+                graph.edge(var_node(name), onode)
+        for name in op.output_names():
+            if name:
+                graph.edge(onode, var_node(name))
+    return graph
+
+
+def draw_graph(startup_program, main_program, path: Optional[str] = None,
+               graph_attrs: Optional[Dict] = None, fmt: Optional[str] = None,
+               **kwargs) -> Graph:
+    """Both programs into one drawing (reference net_drawer.py:103);
+    returns the Graph, optionally written/rendered to `path`."""
+    g = Graph(title="program", **(graph_attrs or {}))
+    shared: Dict = {}
+    if startup_program is not None:
+        parse_graph(startup_program, g, shared)
+    if main_program is not None:
+        parse_graph(main_program, g, shared)
+    if path:
+        g.show(path, fmt=fmt)
+    return g

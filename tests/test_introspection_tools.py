@@ -142,3 +142,30 @@ def test_timeline_profiler_roundtrip(tmp_path):
     merged = timeline.merge_traces([("t0", prof_path), ("t1", prof_path)])
     assert len([e for e in merged["traceEvents"]
                 if e.get("name") == "process_name"]) == 2
+
+
+def test_graphviz_and_net_drawer(tmp_path):
+    from paddle_tpu import net_drawer
+    from paddle_tpu.graphviz import Graph
+
+    g = Graph(title="t", rankdir="TB")
+    a = g.node("in put", prefix="var")   # label with a space quotes fine
+    b = g.node("op", shape="oval")
+    g.edge(a, b, label="x")
+    code = g.code()
+    assert code.startswith('digraph "t" {') and '"in put"' in code
+    assert "->" in code
+    # backslash-safe quoting: a trailing backslash must not eat the quote
+    from paddle_tpu.graphviz import crepr
+
+    assert crepr("a\\") == '"a\\\\"'
+
+    main, startup, _loss = _small_program()
+    out = tmp_path / "net.dot"
+    drawn = net_drawer.draw_graph(startup, main, path=str(out))
+    assert out.exists()
+    text = out.read_text()
+    # every main-block op drawn, params styled as filled boxes
+    n_ops = len(startup.global_block().ops) + len(main.global_block().ops)
+    assert sum(1 for n in drawn.nodes if n.name.startswith("op_")) >= n_ops
+    assert "#FFF3CF" in text  # at least one Parameter node

@@ -51,6 +51,8 @@ MODULES = [
     "paddle_tpu.contrib.memory_usage_calc",
     "paddle_tpu.contrib.op_frequence",
     "paddle_tpu.debugger",
+    "paddle_tpu.graphviz",
+    "paddle_tpu.net_drawer",
     "paddle_tpu.async_executor",
     "paddle_tpu.parallel",
 ]

@@ -1,0 +1,137 @@
+"""One-shot TPU readiness check: run this when the tunnel is healthy.
+
+Stages (each prints a PASS/FAIL line; exits nonzero on any FAIL):
+  1. probe      — backend init within a deadline
+  2. flash      — Pallas flash-attention fwd+bwd on REAL TPU vs the
+                  composed path (the round-2 regression class: kernels
+                  that only ever ran in interpret mode)
+  3. step       — one fused-attention transformer train step (tiny)
+  4. bench      — optional: full bench sweep (--bench)
+
+Usage:  python tools/tpu_validate.py [--bench] [--quick]
+Single TPU client rule: run alone, foreground (see .claude verify skill).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stage(name, fn):
+    t0 = time.time()
+    try:
+        fn()
+        print("[tpu_validate] PASS %-6s (%.1fs)" % (name, time.time() - t0),
+              flush=True)
+        return True
+    except Exception as exc:  # noqa: BLE001
+        print("[tpu_validate] FAIL %-6s (%.1fs): %s: %s"
+              % (name, time.time() - t0, type(exc).__name__,
+                 str(exc)[:300]), flush=True)
+        return False
+
+
+def probe():
+    import jax
+
+    devs = jax.devices()
+    assert devs, "no devices"
+    kind = devs[0].device_kind
+    assert "tpu" in str(devs[0].platform).lower() or "TPU" in kind, (
+        "not a TPU backend: %s (%s) — is JAX_PLATFORMS overridden?"
+        % (devs[0].platform, kind))
+    print("  device:", devs[0], flush=True)
+
+
+def flash():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.attention import flash_attention
+
+    B, H, S, D = 2, 4, 256, 64
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.rand(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rs.rand(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rs.rand(B, H, S, D).astype("float32"))
+
+    def composed(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, scale=D ** -0.5).sum()
+
+    def loss_comp(q, k, v):
+        return composed(q, k, v).sum()
+
+    o_f = jax.jit(flash_attention, static_argnames=("scale",))(
+        q, k, v, scale=D ** -0.5)
+    o_c = composed(q, k, v)
+    np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_c),
+                               rtol=2e-2, atol=2e-2)
+    g_f = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    g_c = jax.grad(loss_comp, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_c):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-2)
+    print("  flash fwd+bwd matches composed on hardware", flush=True)
+
+
+def step():
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.models import transformer
+
+    cfg = dict(d_model=128, d_ff=256, n_head=4, n_layer=2, src_vocab=512,
+               trg_vocab=512, max_length=128, dropout=0.1)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        loss, _ = transformer.build(cfg, seq_len=128,
+                                    use_fused_attention=True)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        main.set_amp(True)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        feed = {n: rs.randint(1, 512, (8, 128)).astype("int64")
+                for n in ("src_ids", "trg_ids", "lbl_ids")}
+        for _ in range(2):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        val = float(np.asarray(lv).reshape(-1)[0])
+        assert np.isfinite(val), "loss is not finite: %r" % val
+        print("  fused-attention AMP train step loss %.4f" % val, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", action="store_true",
+                    help="also run the full bench sweep")
+    ap.add_argument("--quick", action="store_true",
+                    help="bench in --quick mode")
+    args = ap.parse_args()
+
+    ok = _stage("probe", probe)
+    ok = ok and _stage("flash", flash)
+    ok = ok and _stage("step", step)
+    if ok and args.bench:
+        cmd = [sys.executable, os.path.join(REPO, "bench.py")]
+        if args.quick:
+            cmd.append("--quick")
+        ok = subprocess.run(cmd).returncode == 0
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
