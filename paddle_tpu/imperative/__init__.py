@@ -27,7 +27,8 @@ from ..core.autodiff import ATTR_DIFF, ATTR_FWD_IN, ATTR_FWD_OUT
 from ..core.lowering import LowerContext, as_jax_dtype
 from ..core.registry import get_op
 
-__all__ = ["guard", "enabled", "to_variable", "VarBase", "Tracer", "Layer"]
+__all__ = ["guard", "enabled", "to_variable", "VarBase", "Tracer", "Layer",
+           "PyLayer"]
 
 _tracer: Optional["Tracer"] = None
 
@@ -310,5 +311,72 @@ class Layer:
     def __call__(self, *a, **kw):
         return self.forward(*a, **kw)
 
+
+class PyLayer:
+    """User-defined forward/backward as numpy functions
+    (reference imperative/layers.py:216 PyLayer / pybind imperative.cc).
+    Subclass with two @staticmethods:
+
+        class Double(imperative.PyLayer):
+            @staticmethod
+            def forward(x):                 # numpy in
+                return 2 * x                # numpy out
+            @staticmethod
+            def backward(dout):
+                return 2 * dout
+
+        y = Double()(x_varbase)
+
+    Eager-mode only, like the reference: the callback runs on concrete
+    values. In graph mode use layers.py_func (ops/beam_search_ops.py),
+    which enters the lowered program as an ordered host callback.
+    """
+
+    @staticmethod
+    def forward(*inputs):
+        raise NotImplementedError("PyLayer subclasses define forward()")
+
+    @staticmethod
+    def backward(*douts):
+        raise NotImplementedError("PyLayer subclasses define backward()")
+
+    def __call__(self, *inputs):
+        vs = [to_variable(i) for i in inputs]
+        outs = trace_op("py_layer", {"X": vs},
+                        {"__forward__": type(self).forward,
+                         "__backward__": type(self).backward})
+        res = [o for o in outs["Out"] if o is not None]
+        return res[0] if len(res) == 1 else res
+
+
+def _as_seq(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+def _register_py_layer_op():
+    from ..core.registry import register_grad_lowering, register_op
+
+    @register_op("py_layer", diff_inputs=["X"])
+    def _py_layer(ctx, ins, attrs):
+        fn = attrs["__forward__"]
+        outs = _as_seq(fn(*[np.asarray(v) for v in ins["X"]]))
+        return {"Out": [jnp.asarray(o) for o in outs]}
+
+    @register_grad_lowering("py_layer")
+    def _py_layer_grad(ctx, ins, attrs):
+        bwd = attrs["__backward__"]
+        douts = [np.asarray(g) if g is not None else None
+                 for g in ins.get("Out@GRAD", [])]
+        dins = _as_seq(bwd(*douts))
+        n_in = len(ins["X"])
+        if len(dins) != n_in:
+            raise ValueError(
+                "PyLayer.backward returned %d grads for %d inputs"
+                % (len(dins), n_in))
+        return {"X@GRAD": [None if d is None else jnp.asarray(d)
+                           for d in dins]}
+
+
+_register_py_layer_op()
 
 from . import nn  # noqa: E402,F401  (FC/Conv2D/BatchNorm/Embedding/Pool2D)

@@ -118,3 +118,51 @@ def test_embedding_layer():
         g = emb._w.gradient()
         assert g is not None and np.abs(g[[1, 3]]).sum() > 0
         assert np.abs(g[0]).sum() == 0
+
+
+def test_py_layer_custom_forward_backward():
+    """PyLayer (reference imperative/layers.py:216): numpy forward and a
+    CUSTOM backward — the tape must apply the user's backward, not a
+    vjp of the forward."""
+    from paddle_tpu import imperative
+
+    class TripleButGradIsTen(imperative.PyLayer):
+        @staticmethod
+        def forward(x):
+            return 3.0 * x
+
+        @staticmethod
+        def backward(dout):
+            return 10.0 * dout  # deliberately NOT the true derivative
+
+    with imperative.guard():
+        x = imperative.to_variable(np.array([[1.0, 2.0]], dtype=np.float32))
+        y = TripleButGradIsTen()(x)
+        np.testing.assert_allclose(y.numpy(), [[3.0, 6.0]])
+        z = y * 2.0
+        loss_entry = z
+        loss_entry.backward()
+        # dz/dy = 2, user backward multiplies by 10 -> dx = 20
+        np.testing.assert_allclose(x.gradient(), [[20.0, 20.0]])
+
+
+def test_py_layer_multi_input():
+    from paddle_tpu import imperative
+
+    class WeightedSum(imperative.PyLayer):
+        @staticmethod
+        def forward(a, b):
+            return 2.0 * a + 3.0 * b
+
+        @staticmethod
+        def backward(dout):
+            return 2.0 * dout, 3.0 * dout
+
+    with imperative.guard():
+        a = imperative.to_variable(np.ones((2, 2), np.float32))
+        b = imperative.to_variable(np.ones((2, 2), np.float32))
+        out = WeightedSum()(a, b)
+        np.testing.assert_allclose(out.numpy(), 5.0 * np.ones((2, 2)))
+        out.backward()
+        np.testing.assert_allclose(a.gradient(), 2.0 * np.ones((2, 2)))
+        np.testing.assert_allclose(b.gradient(), 3.0 * np.ones((2, 2)))
