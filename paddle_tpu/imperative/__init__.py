@@ -25,6 +25,7 @@ import numpy as np
 
 from ..core.autodiff import ATTR_DIFF, ATTR_FWD_IN, ATTR_FWD_OUT
 from ..core.lowering import LowerContext, as_jax_dtype
+from ..core import registry as _registry
 from ..core.registry import get_op
 
 __all__ = ["guard", "enabled", "to_variable", "VarBase", "Tracer", "Layer",
@@ -344,39 +345,38 @@ class PyLayer:
         vs = [to_variable(i) for i in inputs]
         outs = trace_op("py_layer", {"X": vs},
                         {"__forward__": type(self).forward,
-                         "__backward__": type(self).backward})
-        res = [o for o in outs["Out"] if o is not None]
-        return res[0] if len(res) == 1 else res
+                         "__backward__": type(self).backward})["Out"]
+        return outs[0] if len(outs) == 1 else outs
 
 
 def _as_seq(v):
     return list(v) if isinstance(v, (list, tuple)) else [v]
 
 
-def _register_py_layer_op():
-    from ..core.registry import register_grad_lowering, register_op
-
-    @register_op("py_layer", diff_inputs=["X"])
-    def _py_layer(ctx, ins, attrs):
-        fn = attrs["__forward__"]
-        outs = _as_seq(fn(*[np.asarray(v) for v in ins["X"]]))
-        return {"Out": [jnp.asarray(o) for o in outs]}
-
-    @register_grad_lowering("py_layer")
-    def _py_layer_grad(ctx, ins, attrs):
-        bwd = attrs["__backward__"]
-        douts = [np.asarray(g) if g is not None else None
-                 for g in ins.get("Out@GRAD", [])]
-        dins = _as_seq(bwd(*douts))
-        n_in = len(ins["X"])
-        if len(dins) != n_in:
-            raise ValueError(
-                "PyLayer.backward returned %d grads for %d inputs"
-                % (len(dins), n_in))
-        return {"X@GRAD": [None if d is None else jnp.asarray(d)
-                           for d in dins]}
+@_registry.register_op("py_layer", diff_inputs=["X"])
+def _py_layer(ctx, ins, attrs):
+    fn = attrs["__forward__"]
+    outs = _as_seq(fn(*[np.asarray(v) for v in ins["X"]]))
+    return {"Out": [jnp.asarray(o) for o in outs]}
 
 
-_register_py_layer_op()
+@_registry.register_grad_lowering("py_layer")
+def _py_layer_grad(ctx, ins, attrs):
+    bwd = attrs["__backward__"]
+    # an output unused by the loss carries no gradient; the user's
+    # backward is promised numpy arrays, so fill zeros shaped like the
+    # forward output (available as a grad-op input)
+    fwd_outs = ins.get("Out", [])
+    douts = [np.asarray(g) if g is not None
+             else np.zeros_like(np.asarray(fwd_outs[i]))
+             for i, g in enumerate(ins.get("Out@GRAD", []))]
+    dins = _as_seq(bwd(*douts))
+    n_in = len(ins["X"])
+    if len(dins) != n_in:
+        raise ValueError(
+            "PyLayer.backward returned %d grads for %d inputs"
+            % (len(dins), n_in))
+    return {"X@GRAD": [None if d is None else jnp.asarray(d)
+                       for d in dins]}
 
 from . import nn  # noqa: E402,F401  (FC/Conv2D/BatchNorm/Embedding/Pool2D)

@@ -166,3 +166,25 @@ def test_py_layer_multi_input():
         out.backward()
         np.testing.assert_allclose(a.gradient(), 2.0 * np.ones((2, 2)))
         np.testing.assert_allclose(b.gradient(), 3.0 * np.ones((2, 2)))
+
+
+def test_py_layer_unused_output_gets_zero_grad():
+    from paddle_tpu import imperative
+
+    class TwoOut(imperative.PyLayer):
+        @staticmethod
+        def forward(x):
+            return 2.0 * x, 3.0 * x
+
+        @staticmethod
+        def backward(d0, d1):
+            # both douts must be real arrays (zeros for the unused one)
+            assert d0 is not None and d1 is not None
+            return 2.0 * d0 + 3.0 * d1
+
+    with imperative.guard():
+        x = imperative.to_variable(np.ones((2,), np.float32))
+        a, b = TwoOut()(x)
+        del b  # second output never used by the loss
+        a.backward()
+        np.testing.assert_allclose(x.gradient(), 2.0 * np.ones((2,)))
