@@ -27,8 +27,9 @@ from __future__ import annotations
 
 from typing import List, Sequence
 
-from .program import Program, Variable
+from .program import Operator, Program, Variable
 from .registry import get_op
+from .. import unique_name
 
 __all__ = ["apply_recompute"]
 
@@ -144,8 +145,6 @@ def apply_recompute(program: Program, checkpoints: Sequence) -> int:
             op.block = sub
             sub.ops.append(op)
 
-        from .. import unique_name
-
         out_slots = {"Out": outputs}
         attrs = {
             "sub_block": sub.idx,
@@ -159,8 +158,6 @@ def apply_recompute(program: Program, checkpoints: Sequence) -> int:
                              persistable=False)
             out_slots = {"Out": outputs, "RngKey": [rng_name]}
             attrs["uses_rng"] = True
-        from .program import Operator
-
         new_ops.append(Operator(block, "recompute_block",
                                 {"X": inputs}, out_slots, attrs))
         wrapped += 1

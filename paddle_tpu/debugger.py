@@ -87,35 +87,35 @@ def pprint_program_codes(program, show_backward: bool = False,
 def draw_block_graphviz(block, highlights: Optional[list] = None,
                         path: str = "./temp.dot") -> str:
     """DOT dump of one block's op/var graph (reference debugger.py's
-    draw_block_graphviz). Emits DOT directly — works on any block,
-    sub-blocks included, which core/ir's program-level Graph.to_dot
-    (graph_viz_pass) does not. Highlighted var names render filled."""
+    draw_block_graphviz), built on paddle_tpu.graphviz — works on any
+    block, sub-blocks included, which core/ir's program-level
+    graph_viz_pass does not. Highlighted var names render filled."""
+    from .graphviz import Graph
+
     hi = set(highlights or [])
-    lines = ["digraph block_%d {" % block.idx,
-             '  node [fontsize=10];']
-    seen_vars = set()
+    g = Graph(title="block_%d" % block.idx)
+    var_nodes = {}
 
     def var_node(name):
-        if name not in seen_vars:
-            seen_vars.add(name)
-            style = (' style=filled fillcolor=yellow' if name in hi
-                     else ' style=filled fillcolor=lightgrey'
-                     if block.vars.get(name) is not None
-                     and block.vars[name].persistable else "")
-            lines.append('  "%s" [shape=box%s];' % (name, style))
-        return '"%s"' % name
+        if name not in var_nodes:
+            attrs = {"shape": "box"}
+            if name in hi:
+                attrs.update(style="filled", fillcolor="yellow")
+            elif block.vars.get(name) is not None \
+                    and block.vars[name].persistable:
+                attrs.update(style="filled", fillcolor="lightgrey")
+            var_nodes[name] = g.node(name, prefix="var", **attrs)
+        return var_nodes[name]
 
-    for i, op in enumerate(block.ops):
-        op_id = "op_%d_%s" % (i, op.type)
-        lines.append('  "%s" [shape=ellipse label="%s"];' % (op_id, op.type))
+    for op in block.ops:
+        onode = g.node(op.type, prefix="op", shape="ellipse")
         for n in op.input_names():
             if n:
-                lines.append("  %s -> \"%s\";" % (var_node(n), op_id))
+                g.edge(var_node(n), onode)
         for n in op.output_names():
             if n:
-                lines.append("  \"%s\" -> %s;" % (op_id, var_node(n)))
-    lines.append("}")
-    dot = "\n".join(lines) + "\n"
+                g.edge(onode, var_node(n))
+    dot = g.code()
     with open(path, "w") as f:
         f.write(dot)
     return dot

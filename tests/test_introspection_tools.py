@@ -96,8 +96,8 @@ def test_debugger_pprint_and_dot(tmp_path):
     dot = debugger.draw_block_graphviz(main.global_block(),
                                        highlights=[loss.name], path=dot_path)
     assert os.path.exists(dot_path)
-    assert "digraph" in dot and "fillcolor=yellow" in dot
-    assert dot.count("shape=ellipse") == len(main.global_block().ops)
+    assert "digraph" in dot and 'fillcolor="yellow"' in dot
+    assert dot.count('shape="ellipse"') == len(main.global_block().ops)
 
 
 def test_timeline_merge(tmp_path):
