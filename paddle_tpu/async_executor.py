@@ -23,20 +23,15 @@ import numpy as np
 from .core.executor import Executor
 from .core.program import Program, default_main_program
 from .core.scope import Scope, global_scope
-from .native.data_feed import MultiSlotDataFeed, SlotDesc
+from .native.data_feed import MultiSlotDataFeed
 
 __all__ = ["AsyncExecutor", "DataFeedDesc"]
 
 
-class DataFeedDesc:
-    """Slot schema for MultiSlotDataFeed (data_feed.proto analog)."""
-
-    def __init__(self, slots: Sequence[SlotDesc], batch_size: int = 32):
-        self.slots = list(slots)
-        self.batch_size = batch_size
-
-    def set_batch_size(self, bs: int):
-        self.batch_size = bs
+# The canonical DataFeedDesc (proto-text OR programmatic slots) lives in
+# data_feed_desc.py (reference python/paddle/fluid/data_feed_desc.py);
+# re-exported here because AsyncExecutor.run consumes it.
+from .data_feed_desc import DataFeedDesc  # noqa: E402
 
 
 class AsyncExecutor:
@@ -55,7 +50,7 @@ class AsyncExecutor:
         scope = scope or global_scope()
         fetch_names = [getattr(v, "name", v) for v in (fetch or [])]
         feed = MultiSlotDataFeed(
-            files=filelist, slots=data_feed.slots,
+            files=filelist, slots=data_feed.slot_descs,
             batch_size=data_feed.batch_size, n_threads=thread_num,
             epochs=epochs)
         last = None

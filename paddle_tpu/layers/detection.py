@@ -565,11 +565,14 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
 def detection_map(detect_res, label, class_num, background_label=0,
                   overlap_threshold=0.5, evaluate_difficult=True,
                   has_state=None, input_states=None, out_states=None,
-                  ap_version="integral"):
+                  ap_version="integral", difficult=None):
     """reference detection.py:613 mAP metric, dense contract: detect_res
     [B, D, 6] (class, score, box; class < 0 pads — multiclass_nms's
-    output), label [B, G, 5] (class, box; zero-area pads). Computed by an
-    in-step host callback (metric, no gradients)."""
+    output), label [B, G, 5] (class, box; zero-area pads), optional
+    difficult [B, G] 0/1. With evaluate_difficult=False, difficult GT
+    boxes are excluded from the recall denominator and detections
+    matching them count as neither TP nor FP (VOC semantics). Computed
+    by an in-step host callback (metric, no gradients)."""
     import numpy as np
 
     from .decode import py_func
@@ -588,7 +591,7 @@ def detection_map(detect_res, label, class_num, background_label=0,
             ap += (mrec[i + 1] - mrec[i]) * mpre[i + 1]
         return float(ap)
 
-    def _map(dets, labels):
+    def _map(dets, labels, diff=None):
         aps = []
         for c in range(class_num):
             if c == background_label:
@@ -596,12 +599,20 @@ def detection_map(detect_res, label, class_num, background_label=0,
             records = []          # (score, image, box)
             n_gt = 0
             gt_by_img = []
+            diff_by_img = []
             for b in range(labels.shape[0]):
                 g = labels[b]
                 valid = (g[:, 0].astype(int) == c) & \
                     ((g[:, 3] - g[:, 1]) > 0)
                 gt_by_img.append(g[valid, 1:5])
-                n_gt += int(valid.sum())
+                d_mask = (diff[b][valid].astype(bool)
+                          if diff is not None
+                          else np.zeros(int(valid.sum()), bool))
+                diff_by_img.append(d_mask)
+                # difficult GT leaves the recall denominator under VOC
+                # semantics (evaluate_difficult=False)
+                n_gt += int(valid.sum()) if evaluate_difficult \
+                    else int((valid.sum() - d_mask.sum()))
                 d = dets[b]
                 for row in d[d[:, 0].astype(int) == c]:
                     records.append((float(row[1]), b, row[2:6]))
@@ -624,10 +635,14 @@ def detection_map(detect_res, label, class_num, background_label=0,
                     iou = inter / ua if ua > 0 else 0.0
                     if iou > best:
                         best, bi = iou, j
-                if best >= overlap_threshold and bi >= 0 and \
-                        not used[b][bi]:
-                    tp[i] = 1
-                    used[b][bi] = True
+                if best >= overlap_threshold and bi >= 0:
+                    if not evaluate_difficult and diff_by_img[b][bi]:
+                        continue  # matched a difficult GT: ignored
+                    if not used[b][bi]:
+                        tp[i] = 1
+                        used[b][bi] = True
+                    else:
+                        fp[i] = 1
                 else:
                     fp[i] = 1
             ctp = np.cumsum(tp)
@@ -641,7 +656,8 @@ def detection_map(detect_res, label, class_num, background_label=0,
     out = helper.create_variable_for_type_inference("float32",
                                                     stop_gradient=True)
     out.shape = (1,)
-    py_func(_map, [detect_res, label], [out])
+    xs = [detect_res, label] + ([difficult] if difficult is not None else [])
+    py_func(_map, xs, [out])
     return out
 
 

@@ -1,0 +1,196 @@
+"""average / evaluator / data_feed_desc / distribute_lookup_table —
+legacy top-level module parity (reference python/paddle/fluid/*.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_weighted_average():
+    from paddle_tpu.average import WeightedAverage
+
+    avg = WeightedAverage()
+    with pytest.raises(ValueError):
+        avg.eval()
+    avg.add(value=2.0, weight=1)
+    avg.add(value=4.0, weight=3)
+    assert avg.eval() == pytest.approx((2 + 12) / 4)
+    avg.reset()
+    avg.add(value=np.array([[1.0], [3.0]]))  # matrix: mean, weight=rows
+    assert avg.eval() == pytest.approx(2.0)
+
+
+def test_chunk_evaluator_accumulates():
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.evaluator import ChunkEvaluator
+
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        # IOB tags over 2 chunk types: tags = {I-0,B-0,I-1,B-1,O...}
+        inp = layers.data("inp", [6], dtype="int64")
+        lab = layers.data("lab", [6], dtype="int64")
+        ev = ChunkEvaluator(inp, lab, chunk_scheme="IOB", num_chunk_types=2)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        ev.reset(exe, scope=scope)
+        perfect = np.array([[1, 0, 0, 3, 2, 2]], dtype=np.int64)
+        for _ in range(2):  # two identical batches, perfect predictions
+            exe.run(main, feed={"inp": perfect, "lab": perfect},
+                    fetch_list=ev.metrics, scope=scope)
+        p, r, f1 = ev.eval(exe, scope=scope)
+    assert float(p) == pytest.approx(1.0)
+    assert float(r) == pytest.approx(1.0)
+    assert float(f1) == pytest.approx(1.0)
+
+
+def test_edit_distance_evaluator():
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.evaluator import EditDistance
+
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        hyp = layers.data("hyp", [4], dtype="int64")
+        ref = layers.data("ref", [4], dtype="int64")
+        hl = layers.data("hl", [], dtype="int64")
+        rl = layers.data("rl", [], dtype="int64")
+        ev = EditDistance(hyp, ref, hl, rl)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        ev.reset(exe, scope=scope)
+        feed = {
+            "hyp": np.array([[1, 2, 3, 4], [1, 1, 1, 1]], np.int64),
+            "ref": np.array([[1, 2, 3, 4], [2, 2, 2, 2]], np.int64),
+            "hl": np.array([4, 4], np.int64),
+            "rl": np.array([4, 4], np.int64),
+        }
+        exe.run(main, feed=feed, fetch_list=ev.metrics, scope=scope)
+        avg, err_rate = ev.eval(exe, scope=scope)
+    # row 0: identical (distance 0); row 1: all 4 substitutions -> 1.0
+    # normalized; instance error rate = 1/2
+    assert float(avg) == pytest.approx(0.5)
+    assert float(err_rate) == pytest.approx(0.5)
+
+
+def test_data_feed_desc_roundtrip(tmp_path):
+    from paddle_tpu.data_feed_desc import DataFeedDesc
+
+    proto = tmp_path / "feed.proto"
+    proto.write_text("""
+name: "MultiSlotDataFeed"
+batch_size: 2
+slots {
+  name: "words"
+  type: "uint64"
+  is_dense: false
+  is_used: false
+}
+slots {
+  name: "score"
+  type: "float"
+  is_dense: true
+  is_used: false
+  dim: 3
+}
+""")
+    desc = DataFeedDesc(str(proto))
+    assert desc.batch_size == 2
+    assert [s.name for s in desc.slots] == ["words", "score"]
+    desc.set_batch_size(128)
+    desc.set_use_slots(["words", "score"])
+    desc.set_dense_slots(["score"])
+    assert desc.batch_size == 128
+    assert all(s.is_used for s in desc.slots)
+    text = desc.desc()
+    assert 'name: "words"' in text and "batch_size: 128" in text
+    with pytest.raises(ValueError, match="unknown"):
+        desc.set_use_slots(["nope"])
+
+    # native bridge: parse a real multi-slot file through the C++ reader
+    data = tmp_path / "part-0.txt"
+    # multi-slot line format per slot: <count> values...
+    data.write_text("2 11 12 3 0.5 0.25 0.125\n1 7 3 1.0 2.0 3.0\n")
+    feed = desc.create_feed([str(data)])
+    batches = list(feed)
+    feed.close()
+    assert len(batches) == 1  # batch_size 128 swallows both rows
+    words, score = batches[0]
+    assert words.shape == (2, 1) and words.dtype == np.int64
+    assert score.shape == (2, 3) and score.dtype == np.float32
+    np.testing.assert_allclose(score[1], [1.0, 2.0, 3.0])
+
+
+def test_find_distributed_lookup_table():
+    from paddle_tpu.distribute_lookup_table import (
+        find_distributed_lookup_table,
+        find_distributed_lookup_table_inputs,
+        find_distributed_lookup_table_outputs)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [1], dtype="int64")
+        emb = layers.embedding(ids, size=[100, 8], is_distributed=True,
+                               param_attr=fluid.ParamAttr(name="dist.w"))
+        layers.fc(emb, size=4)
+    assert find_distributed_lookup_table(main) == "dist.w"
+    ins = find_distributed_lookup_table_inputs(main, "dist.w")
+    outs = find_distributed_lookup_table_outputs(main, "dist.w")
+    assert [v.name for v in ins] == ["ids"]
+    assert len(outs) == 1
+
+    plain, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(plain, s2):
+        ids = layers.data("ids", [1], dtype="int64")
+        layers.embedding(ids, size=[10, 4])
+    assert find_distributed_lookup_table(plain) is None
+
+
+def test_detection_map_difficult_voc_semantics():
+    """evaluate_difficult=False: difficult GT leaves the recall
+    denominator and detections matching it are ignored."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        det = layers.data("det", [2, 6])
+        lab = layers.data("lab", [2, 5])
+        dif = layers.data("dif", [2])
+        m_all = layers.detection_map(det, lab, class_num=2,
+                                     background_label=-1,
+                                     evaluate_difficult=True)
+        m_voc = layers.detection_map(det, lab, class_num=2,
+                                     background_label=-1,
+                                     evaluate_difficult=False,
+                                     difficult=dif)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        # one image: GT0 easy matched perfectly; GT1 difficult, matched
+        # by a second (lower-scored) detection
+        feed = {
+            "det": np.array([[[0, 0.9, 0, 0, 1, 1],
+                              [0, 0.8, 2, 2, 3, 3]]], np.float32),
+            "lab": np.array([[[0, 0, 0, 1, 1],
+                              [0, 2, 2, 3, 3]]], np.float32),
+            "dif": np.array([[0.0, 1.0]], np.float32),
+        }
+        a, v = exe.run(main, feed=feed, fetch_list=[m_all, m_voc],
+                       scope=scope)
+    # evaluate_difficult=True: both GT count, both dets TP -> mAP 1.0
+    assert float(np.asarray(a)[0]) == pytest.approx(1.0)
+    # VOC: difficult GT excluded (n_gt=1), its detection ignored -> 1.0
+    assert float(np.asarray(v)[0]) == pytest.approx(1.0)
+
+
+def test_data_feed_desc_pathlib(tmp_path):
+    import pathlib
+
+    from paddle_tpu.data_feed_desc import DataFeedDesc
+
+    p = tmp_path / "f.proto"
+    p.write_text('batch_size: 7\nslots {\n  name: "a"\n  type: "uint64"\n}\n')
+    desc = DataFeedDesc(pathlib.Path(p))
+    assert desc.batch_size == 7 and desc.slots[0].name == "a"
