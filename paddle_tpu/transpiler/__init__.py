@@ -24,3 +24,21 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
 
 def release_memory(input_program=None, skip_opt_set=None):
     return input_program
+
+
+class InferenceTranspiler:
+    """Inference program rewrite (reference transpiler/
+    inference_transpiler.py): the reference folds conv+bn / conv+eltwise
+    and relu-fuses for cuDNN/MKL-DNN; under XLA those fusions happen in
+    the compiler, so the surviving job is the train->test rewrite —
+    flip every train-mode op (dropout, batch_norm, quant ops) to
+    is_test via the ir is_test_pass."""
+
+    def transpile(self, program, place=None, scope=None):
+        from ..core.ir import Graph, get_pass
+
+        graph = Graph(program)
+        get_pass("is_test_pass").apply(graph)
+        graph.materialize()
+        program._bump()
+        return program

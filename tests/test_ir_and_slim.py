@@ -280,3 +280,21 @@ def test_quantize_after_minimize_preserves_order(fresh_programs):
         (lv,) = exe.run(main, feed={"x": X, "y": X[:, :1]},
                         fetch_list=[loss.name], scope=scope)
         assert np.isfinite(float(lv))
+
+
+def test_inference_transpiler_flips_is_test():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.transpiler import InferenceTranspiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [1, 8, 8])
+        c = layers.conv2d(x, num_filters=2, filter_size=3, padding=1)
+        b = layers.batch_norm(c)
+        d = layers.dropout(b, dropout_prob=0.5)
+        layers.reduce_mean(d)
+    InferenceTranspiler().transpile(main)
+    kinds = {op.type: op for op in main.global_block().ops}
+    assert kinds["batch_norm"].attrs.get("is_test") is True
+    assert kinds["dropout"].attrs.get("is_test") is True
