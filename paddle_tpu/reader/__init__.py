@@ -9,7 +9,8 @@ import queue
 import random as _random
 import threading
 
-__all__ = ["batch", "shuffle", "buffered", "cache", "map_readers",
+__all__ = ["Fake", "PipeReader",
+           "batch", "shuffle", "buffered", "cache", "map_readers",
            "xmap_readers", "chain", "compose", "firstn",
            "multiprocess_reader"]
 
@@ -186,3 +187,68 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 yield item
 
     return reader
+
+
+class Fake:
+    """Cache the first sample and replay it data_num times (reference
+    reader/decorator.py:509) — input-pipeline-free speed testing."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            while self.yield_num < data_num:
+                self.yield_num += 1
+                yield self.data
+            self.yield_num = 0
+
+        return fake_reader
+
+
+class PipeReader:
+    """Stream samples out of a shell command's stdout (reference
+    reader/decorator.py:438): `hadoop fs -cat ...`, `curl ...`,
+    `cat f.gz`. get_line() decodes buffered chunks into text lines."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("a command string is required")
+        if file_type not in ("gzip", "plain"):
+            raise TypeError("file_type %s is not allowed" % file_type)
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+        self.process = None
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import subprocess
+        import zlib
+
+        self.process = subprocess.Popen(
+            self.command.split(" "), bufsize=self.bufsize,
+            stdout=subprocess.PIPE)
+        decomp = zlib.decompressobj(32 + zlib.MAX_WBITS) \
+            if self.file_type == "gzip" else None
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if decomp is not None:
+                buff = decomp.decompress(buff)
+            text = remained + buff.decode("utf8", errors="replace")
+            if not cut_lines:
+                remained = ""
+                yield text
+                continue
+            lines = text.split(line_break)
+            remained = lines.pop()
+            for line in lines:
+                yield line
+        if remained:
+            yield remained
+        self.process.wait()

@@ -221,3 +221,37 @@ def test_wmt14_seq2seq_book_trains(fresh_programs):
     first = np.mean(losses[:5])
     last = np.mean(losses[-5:])
     assert last < 0.8 * first, (first, last)
+
+
+def test_reader_fake_and_pipereader(tmp_path):
+    import gzip
+
+    from paddle_tpu import reader
+
+    calls = [0]
+
+    def r():
+        calls[0] += 1
+        yield ("a", calls[0])
+
+    fake = reader.Fake()(r, 3)
+    assert list(fake()) == [("a", 1)] * 3
+    assert list(fake()) == [("a", 1)] * 3  # replays the cached sample
+    assert calls[0] == 1                   # source read exactly once
+
+    p = tmp_path / "x.txt"
+    p.write_text("l1\nl2\nl3")
+    assert list(reader.PipeReader("cat %s" % p).get_line()) == \
+        ["l1", "l2", "l3"]
+    pg = tmp_path / "x.gz"
+    with gzip.open(pg, "wb") as f:
+        f.write(b"g1\ng2\n")
+    assert list(reader.PipeReader("cat %s" % pg,
+                                  file_type="gzip").get_line()) == \
+        ["g1", "g2"]
+    import pytest
+
+    with pytest.raises(TypeError):
+        reader.PipeReader(["ls"])
+    with pytest.raises(TypeError):
+        reader.PipeReader("cat x", file_type="tar")
