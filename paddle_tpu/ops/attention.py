@@ -51,8 +51,19 @@ from ..core.registry import register_grad_lowering, register_op
 
 __all__ = ["flash_attention", "flash_attention_with_lse"]
 
-_BQ = 128  # query rows per block
-_BK = 128  # key rows per block
+# Block sizes: env-tunable so hardware sweeps (VMEM vs occupancy per
+# chip generation) need no code edit. Defaults fit v5e comfortably.
+# Constraints (Mosaic tiling + the validator below): BQ % 8 == 0,
+# BK % 128 == 0.
+import os as _os
+
+_BQ = int(_os.environ.get("PADDLE_TPU_FLASH_BQ", "128"))
+_BK = int(_os.environ.get("PADDLE_TPU_FLASH_BK", "128"))
+if _BQ % 8 or _BK % 128 or _BQ <= 0 or _BK <= 0:
+    raise ValueError(
+        "PADDLE_TPU_FLASH_BQ must be a positive multiple of 8 and "
+        "PADDLE_TPU_FLASH_BK a positive multiple of 128; got %d/%d"
+        % (_BQ, _BK))
 _MASK = -1e9  # additive mask for padded key columns
 
 
