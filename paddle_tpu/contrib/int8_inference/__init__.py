@@ -189,6 +189,22 @@ class Calibrator:
         p._bump()
         return p
 
+    def save_int8_model(self, dirname, executor, feeded_var_names,
+                        target_vars, model_filename=None,
+                        params_filename=None):
+        """Calibrate and export in one call (reference utility.py:69):
+        generate the fixed-scale program and write it through
+        io.save_inference_model, scale vars included."""
+        from ... import io
+
+        qprog = self.generate_calibrated_program()
+        targets = [qprog.global_block().var(getattr(v, "name", v))
+                   for v in target_vars]
+        return io.save_inference_model(
+            dirname, list(feeded_var_names), targets, executor,
+            main_program=qprog, model_filename=model_filename,
+            params_filename=params_filename)
+
     def _quantize_edge(self, graph, xnode, opnode, slot, scales, quantized):
         name = xnode.name
         if name.endswith(".calib_q"):

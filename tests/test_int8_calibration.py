@@ -93,3 +93,26 @@ def test_bad_algo_raises():
     main = fluid.Program()
     with pytest.raises(ValueError, match="algo"):
         Calibrator(main, scope=Scope(), algo="entropy2")
+
+
+def test_save_int8_model_roundtrip(tmp_path):
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    scope = Scope()
+    infer, pred, exe = _build_and_train(scope)
+    with scope_guard(scope):
+        calib = Calibrator(infer, scope=scope, algo="max")
+        for xb in _batches(n=2):
+            calib.sample_data(exe, feed={"x": xb}, fetch_list=[pred])
+        out = str(tmp_path / "int8_model")
+        calib.save_int8_model(out, exe, ["x"], [pred])
+        prog2, feeds, fetches = fluid.io.load_inference_model(out, exe)
+        xb = _batches(n=1)[0]
+        (q_out,) = exe.run(prog2, feed={feeds[0]: xb},
+                           fetch_list=fetches, scope=scope)
+        (fp_out,) = exe.run(infer, feed={"x": xb}, fetch_list=[pred],
+                            scope=scope)
+    kinds = [op.type for op in prog2.global_block().ops]
+    assert "fake_quantize_abs_max" in kinds  # quant ops survived export
+    np.testing.assert_allclose(np.asarray(q_out), np.asarray(fp_out),
+                               atol=0.05)
