@@ -277,7 +277,15 @@ class Operator:
 
 
 def _jsonable(v):
-    return isinstance(v, (int, float, str, bool, list, tuple, type(None)))
+    """True iff json.dump can round-trip v: scalars, and containers of
+    jsonable values (grad ops carry dict attrs like __fwd_in_slots__;
+    py_func-style ops carry callables that must be dropped even when
+    nested in a list)."""
+    if isinstance(v, dict):
+        return all(isinstance(k, str) and _jsonable(x) for k, x in v.items())
+    if isinstance(v, (list, tuple)):
+        return all(_jsonable(x) for x in v)
+    return isinstance(v, (int, float, str, bool, type(None)))
 
 
 def _slot_names(slots) -> Dict[str, List[str]]:

@@ -36,7 +36,8 @@ def _python_embed_flags():
     return flags
 
 
-_EXTRA_FLAGS = {"serving": _python_embed_flags}
+_EXTRA_FLAGS = {"serving": _python_embed_flags,
+                "train": _python_embed_flags}
 
 
 def _build(name: str) -> str:

@@ -1,0 +1,159 @@
+// Shared CPython-embedding plumbing for the native entry shims
+// (serving.cc, train.cc): error marshaling, interpreter bring-up, dtype
+// table, and the C-buffer -> numpy feed-dict builder. Header-only so
+// each .so carries its own copy of the *state* (thread_local error
+// string) while the *logic* has exactly one source.
+#pragma once
+
+#include <Python.h>
+#include <fcntl.h>
+#include <sys/file.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace pd_embed {
+
+inline thread_local std::string g_error;
+
+inline void set_error(const std::string& msg) { g_error = msg; }
+
+inline void set_py_error(const std::string& prefix) {
+  std::string msg = prefix;
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg += std::string(": ") + c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  PyErr_Clear();  // str()/encode failures must not leak into the caller
+  set_error(msg);
+}
+
+// Bring up the embedded interpreter once per process. `pyinit_env` names
+// an env var holding a statement to run before framework imports (e.g.
+// pinning the jax backend). Returns false — and KEEPS failing — if that
+// hook failed, so a bad deployment never half-runs.
+//
+// Two different embedding .so's (serving + train) in one process each
+// carry this function, so the per-library mutex is not enough:
+// Py_InitializeEx itself is serialized through a process-wide file lock.
+inline bool ensure_python(const char* pyinit_env) {
+  static std::mutex local_mutex;
+  static bool hook_failed = false;
+  std::lock_guard<std::mutex> lock(local_mutex);
+  if (hook_failed) {
+    set_error(std::string(pyinit_env) + " failed earlier in this process");
+    return false;
+  }
+  if (Py_IsInitialized()) return true;
+
+  int fd = ::open("/tmp/.pd_embed_init.lock", O_CREAT | O_RDWR, 0600);
+  if (fd >= 0) ::flock(fd, LOCK_EX);
+  bool ok = true;
+  if (!Py_IsInitialized()) {  // re-check under the cross-library lock
+    Py_InitializeEx(0);
+    if (!Py_IsInitialized()) {
+      set_error("CPython failed to initialize");
+      ok = false;
+    } else {
+      const char* init = std::getenv(pyinit_env);
+      if (init != nullptr && PyRun_SimpleString(init) != 0) {
+        set_error(std::string(pyinit_env) + " failed: " + init);
+        hook_failed = true;
+        ok = false;
+      }
+      // Release the GIL the initializing thread holds, so other
+      // threads' PyGILState_Ensure can acquire it.
+      PyEval_SaveThread();
+    }
+  }
+  if (fd >= 0) {
+    ::flock(fd, LOCK_UN);
+    ::close(fd);
+  }
+  return ok;
+}
+
+// dtype codes follow native/dtypes.py: 0=float32, 1=int64, 3=int32.
+inline const char* dtype_name(int code) {
+  switch (code) {
+    case 0: return "float32";
+    case 1: return "int64";
+    case 3: return "int32";
+    default: return nullptr;
+  }
+}
+
+inline int dtype_size(int code) {
+  switch (code) {
+    case 0: return 4;
+    case 1: return 8;
+    case 3: return 4;
+    default: return 0;
+  }
+}
+
+// Build {name: np.ndarray} from typed C buffers. Returns a new reference
+// or nullptr with the error set. GIL must be held.
+inline PyObject* build_feed_dict(PyObject* np, const char** names,
+                                 const void** data, const int* dtypes,
+                                 const long long** shapes, const int* ndims,
+                                 int n_inputs) {
+  PyObject* feed = PyDict_New();
+  if (feed == nullptr) {
+    set_py_error("allocating feed dict failed");
+    return nullptr;
+  }
+  for (int i = 0; i < n_inputs; ++i) {
+    const char* dt = dtype_name(dtypes[i]);
+    if (dt == nullptr) {
+      set_error("unsupported input dtype code");
+      Py_DECREF(feed);
+      return nullptr;
+    }
+    long long numel = 1;
+    PyObject* shape = PyTuple_New(ndims[i]);
+    if (shape == nullptr) {
+      set_py_error("allocating shape tuple failed");
+      Py_DECREF(feed);
+      return nullptr;
+    }
+    for (int d = 0; d < ndims[i]; ++d) {
+      numel *= shapes[i][d];
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(shapes[i][d]));
+    }
+    PyObject* mv = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<void*>(data[i])),
+        numel * static_cast<long long>(dtype_size(dtypes[i])), PyBUF_READ);
+    PyObject* flat = mv == nullptr
+        ? nullptr
+        : PyObject_CallMethod(np, "frombuffer", "Os", mv, dt);
+    PyObject* arr = flat == nullptr
+        ? nullptr
+        : PyObject_CallMethod(flat, "reshape", "O", shape);
+    bool ok = arr != nullptr &&
+        PyDict_SetItemString(feed, names[i], arr) == 0;
+    if (!ok) set_py_error("building input array failed");
+    Py_XDECREF(arr);
+    Py_XDECREF(flat);
+    Py_XDECREF(mv);
+    Py_DECREF(shape);
+    if (!ok) {
+      Py_DECREF(feed);
+      return nullptr;
+    }
+  }
+  return feed;
+}
+
+}  // namespace pd_embed

@@ -23,70 +23,24 @@
 // Build: g++ -shared -fPIC serving.cc $(python3-config --includes
 //        --ldflags --embed)  (native/__init__.py does this on first use.)
 
-#include <Python.h>
+#include "embed_common.h"
 
 #include <cstdlib>
 #include <cstring>
-#include <mutex>
-#include <string>
 #include <vector>
 
 namespace {
 
-thread_local std::string g_error;
-
-void set_error(const std::string& msg) { g_error = msg; }
-
-void set_py_error(const char* where) {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  std::string msg = where;
-  if (value != nullptr) {
-    PyObject* s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char* text = PyUnicode_AsUTF8(s);
-      if (text != nullptr) {
-        msg += ": ";
-        msg += text;
-      }
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  PyErr_Clear();  // str()/encode failures must not leak into the caller
-  set_error(msg);
-}
+using pd_embed::build_feed_dict;
+using pd_embed::g_error;
+using pd_embed::set_error;
+using pd_embed::set_py_error;
 
 struct Predictor {
   PyObject* predictor;                  // paddle_tpu.inference.Predictor
   std::vector<std::vector<float>> out_bufs;
   std::vector<std::vector<long long>> out_shapes;
 };
-
-std::mutex g_init_mutex;
-
-bool ensure_python() {
-  std::lock_guard<std::mutex> lock(g_init_mutex);
-  if (Py_IsInitialized()) return true;
-  Py_InitializeEx(0);
-  if (!Py_IsInitialized()) return false;
-  // Deployment hook: PD_SERVING_PYINIT holds a statement to run before
-  // the framework imports (e.g. pinning the jax backend:
-  //   import jax; jax.config.update("jax_platforms", "cpu")
-  // — env vars alone can be too late once plugins self-register).
-  const char* init = std::getenv("PD_SERVING_PYINIT");
-  bool ok = true;
-  if (init != nullptr && PyRun_SimpleString(init) != 0) {
-    set_error(std::string("PD_SERVING_PYINIT failed: ") + init);
-    ok = false;
-  }
-  // Release the GIL the initializing thread holds, so other threads'
-  // PyGILState_Ensure can acquire it (multithreaded C servers).
-  PyEval_SaveThread();
-  return ok;
-}
 
 }  // namespace
 
@@ -95,10 +49,7 @@ extern "C" {
 const char* pd_last_error(void) { return g_error.c_str(); }
 
 void* pd_predictor_create(const char* model_dir) {
-  if (!ensure_python()) {
-    set_error("CPython failed to initialize");
-    return nullptr;
-  }
+  if (!pd_embed::ensure_python("PD_SERVING_PYINIT")) return nullptr;
   PyGILState_STATE gil = PyGILState_Ensure();
   void* result = nullptr;
   PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
@@ -118,25 +69,6 @@ void* pd_predictor_create(const char* model_dir) {
   }
   PyGILState_Release(gil);
   return result;
-}
-
-// dtype codes follow native/dtypes.py: 0=float32, 1=int64, 3=int32.
-static const char* dtype_name(int code) {
-  switch (code) {
-    case 0: return "float32";
-    case 1: return "int64";
-    case 3: return "int32";
-    default: return nullptr;
-  }
-}
-
-static int dtype_size(int code) {
-  switch (code) {
-    case 0: return 4;
-    case 1: return 8;
-    case 3: return 4;
-    default: return 0;
-  }
 }
 
 int pd_predictor_run_ex(void* handle, const char** names,
@@ -161,41 +93,9 @@ int pd_predictor_run_ex(void* handle, const char** names,
       set_py_error("import numpy failed");
       break;
     }
-    feed = PyDict_New();
-    bool ok = true;
-    for (int i = 0; i < n_inputs && ok; ++i) {
-      const char* dt = dtype_name(dtypes[i]);
-      if (dt == nullptr) {
-        set_error("unsupported input dtype code");
-        ok = false;
-        break;
-      }
-      long long numel = 1;
-      PyObject* shape = PyTuple_New(ndims[i]);
-      for (int d = 0; d < ndims[i]; ++d) {
-        numel *= shapes[i][d];
-        PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(shapes[i][d]));
-      }
-      PyObject* mv = PyMemoryView_FromMemory(
-          reinterpret_cast<char*>(const_cast<void*>(data[i])),
-          numel * static_cast<long long>(dtype_size(dtypes[i])),
-          PyBUF_READ);
-      PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", mv, dt);
-      PyObject* arr = flat == nullptr
-          ? nullptr
-          : PyObject_CallMethod(flat, "reshape", "O", shape);
-      if (arr == nullptr) {
-        set_py_error("building input array failed");
-        ok = false;
-      } else {
-        PyDict_SetItemString(feed, names[i], arr);
-      }
-      Py_XDECREF(arr);
-      Py_XDECREF(flat);
-      Py_XDECREF(mv);
-      Py_DECREF(shape);
-    }
-    if (!ok) break;
+    feed = build_feed_dict(np, names, data, dtypes, shapes, ndims,
+                           n_inputs);
+    if (feed == nullptr) break;
 
     outs = PyObject_CallMethod(p->predictor, "run", "(O)", feed);
     if (outs == nullptr) {

@@ -1,0 +1,136 @@
+// C training entry: drive train steps of a saved program from a C/C++
+// process, no Python in user code.
+//
+// Reference analog: paddle/fluid/train/ (demo_trainer.cc loads a saved
+// ProgramDesc + params and runs the executor;
+// test_train_recognize_digits.cc is the e2e test). Same embedding
+// strategy as serving.cc — shared plumbing in embed_common.h; this shim
+// is the stable C ABI around paddle_tpu.native.train_entry.
+//
+//   const char* pd_train_last_error(void);
+//   void*  pd_trainer_create(const char* model_dir);
+//   int    pd_trainer_step(h, names, data, dtypes, shapes, ndims,
+//                          n_inputs, double* loss_out);
+//   int    pd_trainer_save(void* h, const char* dirname);
+//   void   pd_trainer_destroy(void* h);
+//
+// dtype codes follow native/dtypes.py: 0=float32, 1=int64, 3=int32.
+// PD_TRAIN_PYINIT: statement run before framework imports (pin the jax
+// backend, etc.).
+
+#include "embed_common.h"
+
+namespace {
+
+using pd_embed::build_feed_dict;
+using pd_embed::ensure_python;
+using pd_embed::g_error;
+using pd_embed::set_error;
+using pd_embed::set_py_error;
+
+struct Trainer {
+  PyObject* trainer;  // paddle_tpu.native.train_entry.NativeTrainer
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* pd_train_last_error(void) { return g_error.c_str(); }
+
+void* pd_trainer_create(const char* model_dir) {
+  if (!ensure_python("PD_TRAIN_PYINIT")) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  void* result = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.native.train_entry");
+  if (mod == nullptr) {
+    set_py_error("import paddle_tpu.native.train_entry failed");
+  } else {
+    PyObject* out = PyObject_CallMethod(
+        mod, "create_trainer_from_dir", "s", model_dir);
+    if (out == nullptr) {
+      set_py_error("create_trainer_from_dir failed");
+    } else {
+      Trainer* t = new Trainer();
+      t->trainer = out;  // owned
+      result = t;
+    }
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gil);
+  return result;
+}
+
+int pd_trainer_step(void* handle, const char** names, const void** data,
+                    const int* dtypes, const long long** shapes,
+                    const int* ndims, int n_inputs, double* loss_out) {
+  Trainer* t = static_cast<Trainer*>(handle);
+  if (t == nullptr) {
+    set_error("null trainer");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* np = nullptr;
+  PyObject* feed = nullptr;
+  PyObject* loss = nullptr;
+  do {
+    np = PyImport_ImportModule("numpy");
+    if (np == nullptr) {
+      set_py_error("import numpy failed");
+      break;
+    }
+    feed = build_feed_dict(np, names, data, dtypes, shapes, ndims, n_inputs);
+    if (feed == nullptr) break;
+
+    loss = PyObject_CallMethod(t->trainer, "step_typed", "(O)", feed);
+    if (loss == nullptr) {
+      set_py_error("trainer.step failed");
+      break;
+    }
+    double v = PyFloat_AsDouble(loss);
+    if (PyErr_Occurred()) {
+      set_py_error("loss is not a float");
+      break;
+    }
+    if (loss_out != nullptr) *loss_out = v;
+    rc = 0;
+  } while (false);
+  Py_XDECREF(loss);
+  Py_XDECREF(feed);
+  Py_XDECREF(np);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int pd_trainer_save(void* handle, const char* dirname) {
+  Trainer* t = static_cast<Trainer*>(handle);
+  if (t == nullptr) {
+    set_error("null trainer");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* out = PyObject_CallMethod(t->trainer, "save", "s", dirname);
+  if (out == nullptr) {
+    set_py_error("trainer.save failed");
+  } else {
+    rc = 0;
+    Py_DECREF(out);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void pd_trainer_destroy(void* handle) {
+  Trainer* t = static_cast<Trainer*>(handle);
+  if (t == nullptr) return;
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_XDECREF(t->trainer);
+    PyGILState_Release(gil);
+  }
+  delete t;
+}
+
+}  // extern "C"

@@ -1,0 +1,99 @@
+"""Python half of the native C trainer (train.cc).
+
+Reference analog: paddle/fluid/train/ (demo_trainer.cc +
+test_train_recognize_digits.cc) — a C++ process loads a saved *training*
+program and drives train steps without any Python in user code. Here the
+C side embeds CPython (same pattern as native/serving.cc) and calls:
+
+    save_trainable_model(dirname, feed_names, loss, exe)   # python side
+    t = create_trainer_from_dir(dirname)                   # embedded side
+    t.step_typed(feed_dict) -> float loss
+    t.save(dirname)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+__all__ = ["save_trainable_model", "create_trainer_from_dir",
+           "NativeTrainer"]
+
+_META = "__train_meta__.json"
+
+
+def _write_meta(dirname: str, feed_names: List[str], loss_name: str,
+                main, startup) -> None:
+    """The one place the checkpoint contract is written (both the
+    initial export and NativeTrainer.save use it)."""
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "feed": list(feed_names),
+        "loss": loss_name,
+        "main": main.to_dict(),
+        "startup": startup.to_dict(),
+    }
+    with open(os.path.join(dirname, _META), "w") as f:
+        json.dump(meta, f)
+
+
+def save_trainable_model(dirname: str, feed_names: List[str], loss,
+                         executor, main_program=None, startup_program=None,
+                         scope=None) -> None:
+    """Serialize the FULL training program (forward+backward+optimizer),
+    its startup program, current persistables, and the feed/loss
+    contract."""
+    from .. import io
+    from ..core.program import default_main_program, default_startup_program
+    from ..core.scope import global_scope
+
+    main = main_program or default_main_program()
+    startup = startup_program or default_startup_program()
+    scope = scope or global_scope()
+    _write_meta(dirname, feed_names, getattr(loss, "name", str(loss)),
+                main, startup)
+    io.save_persistables(executor, dirname, main_program=main, scope=scope)
+
+
+class NativeTrainer:
+    def __init__(self, dirname: str):
+        import numpy as np
+
+        from .. import io
+        from ..core.executor import Executor
+        from ..core.place import TPUPlace
+        from ..core.scope import Scope
+        from ..io import _program_from_dict
+
+        with open(os.path.join(dirname, _META)) as f:
+            meta = json.load(f)
+        self.feed_names = list(meta["feed"])
+        self.loss_name = meta["loss"]
+        self.main = _program_from_dict(meta["main"])
+        self.startup = _program_from_dict(meta["startup"])
+        self.scope = Scope()
+        self.exe = Executor(TPUPlace())
+        self.exe.run(self.startup, scope=self.scope)
+        io.load_persistables(self.exe, dirname, main_program=self.main,
+                             scope=self.scope)
+        self._np = np
+
+    def step_typed(self, feed: Dict[str, object]) -> float:
+        (loss,) = self.exe.run(self.main, feed=feed,
+                               fetch_list=[self.loss_name],
+                               scope=self.scope)
+        return float(self._np.asarray(loss).reshape(-1)[0])
+
+    def save(self, dirname: str) -> None:
+        from .. import io
+
+        # the program contract travels alongside the refreshed params
+        _write_meta(dirname, self.feed_names, self.loss_name, self.main,
+                    self.startup)
+        io.save_persistables(self.exe, dirname, main_program=self.main,
+                             scope=self.scope)
+
+
+def create_trainer_from_dir(dirname: str) -> NativeTrainer:
+    return NativeTrainer(dirname)
