@@ -1,0 +1,53 @@
+"""API-stability diff CLI (reference tools/diff_api.py).
+
+Compares the committed API.spec against the live surface (the same
+check tests/test_api_spec.py runs in CI) and prints a reviewable diff.
+
+    python tools/diff_api.py            # diff against API.spec
+    python tools/diff_api.py --update   # regenerate API.spec in place
+"""
+
+from __future__ import annotations
+
+import argparse
+import difflib
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spec", default=os.path.join(ROOT, "API.spec"))
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the spec instead of diffing")
+    args = ap.parse_args()
+
+    sys.path.insert(0, ROOT)
+    sys.path.insert(0, HERE)
+    import print_signatures
+
+    got = print_signatures.collect()
+    if args.update:
+        with open(args.spec, "w") as f:
+            f.write("\n".join(got) + "\n")
+        print("wrote %s (%d symbols)" % (args.spec, len(got)))
+        return 0
+
+    with open(args.spec) as f:
+        want = [line.rstrip("\n") for line in f if line.strip()]
+    diff = list(difflib.unified_diff(want, got, fromfile="API.spec",
+                                     tofile="live", lineterm=""))
+    if not diff:
+        print("API surface matches API.spec (%d symbols)" % len(got))
+        return 0
+    print("\n".join(diff))
+    print("\nAPI drifted. If intentional: python tools/diff_api.py --update",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
