@@ -55,8 +55,9 @@ from .core.program import (  # noqa: F401
 )
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
-from . import (average, data_feed_desc, debugger,  # noqa: F401
-               distribute_lookup_table, evaluator, graphviz, net_drawer)
+from . import (average, compat, data_feed_desc, debugger,  # noqa: F401
+               distribute_lookup_table, evaluator, graphviz, net_drawer,
+               utils)
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from . import plot  # noqa: F401
+from .plot import PlotData, Ploter  # noqa: F401

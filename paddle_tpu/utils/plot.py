@@ -1,0 +1,75 @@
+"""Training-curve plotting (reference python/paddle/utils/plot.py —
+the Ploter the book tutorials drive). Works headless: without a
+display (or with PADDLE_TPU_NO_PLOT=1) data still accumulates and
+plot() is a no-op, so training scripts run unchanged on servers."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["PlotData", "Ploter"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    """Ploter("train_cost", "test_cost"); append(title, step, value);
+    plot() redraws all titles on one figure."""
+
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {title: PlotData() for title in args}
+        self.__disable_plot__ = os.environ.get("PADDLE_TPU_NO_PLOT",
+                                               os.environ.get("DISABLE_PLOT",
+                                                              "0")) == "1"
+        self.__plt__ = None
+        if not self.__disable_plot__:
+            try:
+                import matplotlib
+
+                if not os.environ.get("DISPLAY"):
+                    matplotlib.use("Agg")
+                import matplotlib.pyplot as plt
+
+                self.__plt__ = plt
+            except Exception:  # headless/broken backend: accumulate only
+                self.__plt__ = None
+
+    def append(self, title, step, value):
+        if title not in self.__plot_data__:
+            raise ValueError("no such title %r (have %s)"
+                             % (title, list(self.__args__)))
+        self.__plot_data__[title].append(step, value)
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()
+        if self.__plt__ is not None:
+            self.__plt__.close("all")
+
+    def plot(self, path=None):
+        if self.__plt__ is None:
+            return
+        plt = self.__plt__
+        plt.clf()
+        titles = []
+        for title in self.__args__:
+            data = self.__plot_data__[title]
+            if len(data.step) > 0:
+                plt.plot(data.step, data.value)
+                titles.append(title)
+        plt.legend(titles, loc="upper left")
+        if path:
+            plt.savefig(path)

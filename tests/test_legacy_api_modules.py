@@ -194,3 +194,43 @@ def test_data_feed_desc_pathlib(tmp_path):
     p.write_text('batch_size: 7\nslots {\n  name: "a"\n  type: "uint64"\n}\n')
     desc = DataFeedDesc(pathlib.Path(p))
     assert desc.batch_size == 7 and desc.slots[0].name == "a"
+
+
+def test_compat_helpers():
+    from paddle_tpu import compat
+
+    assert compat.to_text(b"abc") == "abc"
+    assert compat.to_bytes("abc") == b"abc"
+    mixed = [b"a", {"k": b"v"}, {b"s"}]
+    out = compat.to_text(mixed)
+    assert out == ["a", {"k": "v"}, {"s"}]
+    lst = [b"x"]
+    compat.to_text(lst, inplace=True)
+    assert lst == ["x"]
+    # half-away-from-zero (python3's builtin would give 0 for 0.5)
+    assert compat.round(0.5) == 1.0
+    assert compat.round(-0.5) == -1.0
+    assert compat.round(2.675, 2) == 2.68
+    assert compat.floor_division(7, 2) == 3
+    assert compat.get_exception_message(ValueError("boom")) == "boom"
+
+
+def test_ploter_headless(tmp_path, monkeypatch):
+    import os
+
+    monkeypatch.delenv("DISPLAY", raising=False)
+    from paddle_tpu.utils import Ploter
+
+    p = Ploter("train", "test")
+    for i in range(5):
+        p.append("train", i, 1.0 / (i + 1))
+        p.append("test", i, 1.2 / (i + 1))
+    out = str(tmp_path / "curve.png")
+    p.plot(out)
+    if p.__plt__ is not None:  # Agg backend present
+        assert os.path.exists(out)
+    assert len(p.__plot_data__["train"].step) == 5
+    p.reset()
+    assert len(p.__plot_data__["train"].step) == 0
+    with pytest.raises(ValueError, match="no such title"):
+        p.append("valid", 0, 1.0)

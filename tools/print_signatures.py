@@ -51,10 +51,13 @@ MODULES = [
     "paddle_tpu.contrib.memory_usage_calc",
     "paddle_tpu.contrib.op_frequence",
     "paddle_tpu.average",
+    "paddle_tpu.compat",
     "paddle_tpu.data_feed_desc",
     "paddle_tpu.debugger",
     "paddle_tpu.distribute_lookup_table",
     "paddle_tpu.evaluator",
+    "paddle_tpu.utils",
+    "paddle_tpu.utils.plot",
     "paddle_tpu.graphviz",
     "paddle_tpu.net_drawer",
     "paddle_tpu.async_executor",
