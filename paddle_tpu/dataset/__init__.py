@@ -4,6 +4,6 @@ this sandbox has no egress, so each module synthesizes a deterministic,
 *learnable* surrogate with the same sample schema and reader API. Point
 PADDLE_TPU_DATA_HOME at real data to swap in actual corpora."""
 
-from . import (cifar, conll05, flowers, image, imdb, imikolov, mnist,  # noqa: F401
+from . import (cifar, common, conll05, flowers, image, imdb, imikolov, mnist,  # noqa: F401
                movielens, mq2007, sentiment, uci_housing, voc2012, wmt14,
                wmt16)

@@ -255,3 +255,38 @@ def test_reader_fake_and_pipereader(tmp_path):
         reader.PipeReader(["ls"])
     with pytest.raises(TypeError):
         reader.PipeReader("cat x", file_type="tar")
+
+
+def test_dataset_common_split_and_cluster_reader(tmp_path, monkeypatch):
+    import os
+
+    from paddle_tpu.dataset import common
+
+    monkeypatch.chdir(tmp_path)
+
+    def reader():
+        for i in range(10):
+            yield (i, i * i)
+
+    files = common.split(reader, 3, suffix=str(tmp_path / "part-%05d.pkl"))
+    assert len(files) == 4  # 3+3+3+1
+    # trainer 0 of 2 reads files 0 and 2
+    r0 = common.cluster_files_reader(str(tmp_path / "part-*.pkl"), 2, 0)
+    r1 = common.cluster_files_reader(str(tmp_path / "part-*.pkl"), 2, 1)
+    got = sorted(list(r0()) + list(r1()))
+    assert got == [(i, i * i) for i in range(10)]
+    assert len(list(r0())) == 6  # files 0 (3 samples) + 2 (3)
+    # md5 + file:// download into the cache
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"hello world")
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "home"))
+    md5 = common.md5file(str(src))
+    path = common.download("file://%s" % src, "unit", md5)
+    assert os.path.exists(path) and common.md5file(path) == md5
+    # cache hit: served without copying again
+    os.remove(str(src))
+    assert common.download("file://%s" % src, "unit", md5) == path
+    import pytest
+
+    with pytest.raises(RuntimeError, match="no network egress"):
+        common.download("https://example.com/x.tgz", "unit", "0" * 32)
