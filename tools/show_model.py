@@ -1,0 +1,57 @@
+"""Inspect a saved model directory (reference tools/show_pb.py, which
+pretty-prints a ProgramDesc protobuf; here models serialize as
+__model__.json / __train_meta__.json and params in the native PTCK
+store).
+
+    python tools/show_model.py <model_dir> [--show-backward]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_dir")
+    ap.add_argument("--show-backward", action="store_true")
+    args = ap.parse_args()
+
+    sys.path.insert(0, ROOT)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu import debugger
+    from paddle_tpu.io import _program_from_dict
+
+    meta_path = None
+    for name in ("__model__.json", "__train_meta__.json"):
+        p = os.path.join(args.model_dir, name)
+        if os.path.exists(p):
+            meta_path = p
+            break
+    if meta_path is None:
+        sys.exit("no __model__.json / __train_meta__.json in %s"
+                 % args.model_dir)
+    with open(meta_path) as f:
+        meta = json.load(f)
+
+    print("# %s" % meta_path)
+    print("feeds: %s" % meta.get("feed"))
+    if "fetch" in meta:
+        print("fetches: %s" % meta["fetch"])
+    if "loss" in meta:
+        print("loss: %s" % meta["loss"])
+    prog = _program_from_dict(meta.get("program") or meta["main"])
+    for block in prog.blocks:
+        debugger.pprint_block_codes(block, show_backward=args.show_backward)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
