@@ -316,6 +316,13 @@ def analyze_block(program: Program, feed_names, fetch_names, scope):
             env.update(zip(feed_names, feeds))
             ctx = LowerContext(block, rng, amp=amp)
             lower_block(ctx, block, env)
+            missing_f = [n for n in fetch_names if n not in env]
+            if missing_f:
+                raise KeyError(
+                    "fetch vars %s were not produced at the top level — a "
+                    "var internal to a recompute/control-flow sub-block "
+                    "cannot be fetched; fetch a segment output or disable "
+                    "recompute for this run" % missing_f)
             fetches = [env[n] for n in fetch_names]
             new_mut = [env[n] for n in mut_state]
             new_pure = [env[n] for n in pure_written]

@@ -216,3 +216,24 @@ def test_transformer_model_recompute_builds_and_trains():
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_fetching_segment_internal_var_errors_clearly():
+    main, startup = fluid.Program(), fluid.Program()
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.core.recompute import apply_recompute
+
+    scope = Scope()
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data("x", [8])
+        h1 = layers.fc(x, size=8, act="relu")    # internal to segment
+        h2 = layers.scale(h1, scale=2.0)         # checkpoint boundary
+        loss = layers.mean(h2)
+        apply_recompute(main, [h2])
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        feed = {"x": np.zeros((2, 8), "float32")}
+        # boundary + downstream fetches work
+        exe.run(main, feed=feed, fetch_list=[loss, h2], scope=scope)
+        with pytest.raises(Exception, match="recompute"):
+            exe.run(main, feed=feed, fetch_list=[h1], scope=scope)
