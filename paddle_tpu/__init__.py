@@ -60,7 +60,8 @@ from . import (average, compat, data_feed_desc, debugger,  # noqa: F401
                utils)
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 
-__version__ = "0.1.0"
+from . import version  # noqa: F401
+__version__ = version.full_version
 
 # reference-parity alias: user code does `fluid.io.save_params(...)` etc.
 name = "paddle_tpu"
