@@ -234,3 +234,44 @@ def test_ploter_headless(tmp_path, monkeypatch):
     assert len(p.__plot_data__["train"].step) == 0
     with pytest.raises(ValueError, match="no such title"):
         p.append("valid", 0, 1.0)
+
+
+def test_async_executor_with_proto_data_feed_desc(tmp_path):
+    """The unified DataFeedDesc: proto-text construction feeding
+    AsyncExecutor.run end-to-end (regression for the slot_descs
+    bridge)."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.data_feed_desc import DataFeedDesc
+
+    proto = tmp_path / "feed.proto"
+    proto.write_text('''
+batch_size: 8
+slots { name: "x" type: "float" is_dense: true is_used: true dim: 4 }
+slots { name: "y" type: "float" is_dense: true is_used: true dim: 1 }
+''')
+    data = tmp_path / "part-0.txt"
+    rows = []
+    rs = np.random.RandomState(0)
+    for _ in range(64):
+        xv = rs.rand(4)
+        yv = 2.0 * xv[0] + 1.0
+        rows.append("4 %s 1 %f" % (" ".join("%f" % v for v in xv), yv))
+    data.write_text("\n".join(rows) + "\n")
+
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(
+            layers.square(layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(0.2).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        desc = DataFeedDesc(str(proto))
+        ae = fluid.AsyncExecutor()
+        last = ae.run(main, desc, [str(data)], thread_num=2,
+                      fetch=[loss], scope=scope, epochs=6)
+    assert last is not None
+    assert float(np.asarray(last[0]).reshape(-1)[0]) < 0.5
