@@ -237,3 +237,29 @@ def test_fetching_segment_internal_var_errors_clearly():
         exe.run(main, feed=feed, fetch_list=[loss, h2], scope=scope)
         with pytest.raises(Exception, match="recompute"):
             exe.run(main, feed=feed, fetch_list=[h1], scope=scope)
+
+
+def test_recompute_program_infer_clone_runs():
+    """clone(for_test=True) of a recompute-surgered program: the
+    recompute_block lowers in test mode (constant RngKey, no dropout)
+    and predictions are deterministic."""
+    main, startup = fluid.Program(), fluid.Program()
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.core.recompute import apply_recompute
+
+    scope = Scope()
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data("x", [16])
+        h1 = layers.fc(x, size=32, act="relu")
+        h1 = layers.dropout(h1, dropout_prob=0.4)
+        h2 = layers.fc(h1, size=16, act="tanh")
+        pred = layers.fc(h2, size=4, act="softmax")
+        apply_recompute(main, [h2])
+        infer = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        feed = {"x": np.random.RandomState(0).rand(8, 16).astype("float32")}
+        (a,) = exe.run(infer, feed=feed, fetch_list=[pred], scope=scope)
+        (b,) = exe.run(infer, feed=feed, fetch_list=[pred], scope=scope)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.allclose(np.asarray(a).sum(1), 1.0, atol=1e-5)
