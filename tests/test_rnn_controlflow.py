@@ -133,10 +133,10 @@ def test_conditional_block(fresh_programs):
     exe.run(startup, scope=scope)
     (v,) = exe.run(main, feed={"x": np.array([0.9], "float32")},
                    fetch_list=[out], scope=scope)
-    assert float(v) == 1.0
+    assert float(np.asarray(v).reshape(-1)[0]) == 1.0
     (v,) = exe.run(main, feed={"x": np.array([0.1], "float32")},
                    fetch_list=[out], scope=scope)
-    assert float(v) == -1.0
+    assert float(np.asarray(v).reshape(-1)[0]) == -1.0
 
 
 def test_gru_unit_matches_numpy(fresh_programs):
