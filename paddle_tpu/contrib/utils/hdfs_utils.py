@@ -61,9 +61,12 @@ class HDFSClient:
         return ret == 0
 
     def ls(self, hdfs_path: str) -> List[str]:
-        ret, out, _ = self.__run_hdfs_cmd(["-ls", hdfs_path], retry_times=1)
+        ret, out, err = self.__run_hdfs_cmd(["-ls", hdfs_path], retry_times=1)
         if ret != 0:
-            return []
+            # an unreachable cluster / bad path must not look like an
+            # empty directory (silent zero-file multi_download)
+            raise IOError("hdfs ls %s failed (rc=%d): %s"
+                          % (hdfs_path, ret, err.strip()[:200]))
         files = []
         for line in out.splitlines():
             parts = line.split(None, 7)  # 8th field keeps spaces in names
@@ -73,9 +76,11 @@ class HDFSClient:
 
     def lsr(self, hdfs_path: str, only_file: bool = True,
             sort: bool = True) -> List[str]:
-        ret, out, _ = self.__run_hdfs_cmd(["-lsr", hdfs_path], retry_times=1)
+        ret, out, err = self.__run_hdfs_cmd(["-lsr", hdfs_path],
+                                            retry_times=1)
         if ret != 0:
-            return []
+            raise IOError("hdfs lsr %s failed (rc=%d): %s"
+                          % (hdfs_path, ret, err.strip()[:200]))
         files = []
         for line in out.splitlines():
             parts = line.split(None, 7)

@@ -57,13 +57,25 @@ __all__ = ["flash_attention", "flash_attention_with_lse"]
 # BK % 128 == 0.
 import os as _os
 
-_BQ = int(_os.environ.get("PADDLE_TPU_FLASH_BQ", "128"))
-_BK = int(_os.environ.get("PADDLE_TPU_FLASH_BK", "128"))
-if _BQ % 8 or _BK % 128 or _BQ <= 0 or _BK <= 0:
-    raise ValueError(
-        "PADDLE_TPU_FLASH_BQ must be a positive multiple of 8 and "
-        "PADDLE_TPU_FLASH_BK a positive multiple of 128; got %d/%d"
-        % (_BQ, _BK))
+def _block_sizes():
+    """Parse and validate block sizes at first kernel use, not import:
+    a malformed PADDLE_TPU_FLASH_BQ must not make `import paddle_tpu`
+    fail for workflows that never touch attention."""
+    raw_bq = _os.environ.get("PADDLE_TPU_FLASH_BQ", "128")
+    raw_bk = _os.environ.get("PADDLE_TPU_FLASH_BK", "128")
+    try:
+        bq, bk = int(raw_bq), int(raw_bk)
+    except ValueError:
+        raise ValueError(
+            "PADDLE_TPU_FLASH_BQ/BK must be decimal integers "
+            "(multiple of 8 / multiple of 128); got %r/%r"
+            % (raw_bq, raw_bk)) from None
+    if bq % 8 or bk % 128 or bq <= 0 or bk <= 0:
+        raise ValueError(
+            "PADDLE_TPU_FLASH_BQ must be a positive multiple of 8 and "
+            "PADDLE_TPU_FLASH_BK a positive multiple of 128; got %d/%d"
+            % (bq, bk))
+    return bq, bk
 _MASK = -1e9  # additive mask for padded key columns
 
 
@@ -227,6 +239,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
 def _forward_pallas(q, k, v, bias, scale):
     B, H, S, D = q.shape
     Sk = k.shape[2]
+    _BQ, _BK = _block_sizes()
     Sp, Skp = _pad_len(S, _BQ), _pad_len(Sk, _BK)
     bias = _pad_bias(bias, S, Sp, Sk, Skp)
     q = _pad_axis(q, 2, Sp)
@@ -355,6 +368,7 @@ def _backward_pallas(q, k, v, bias, o, lse, g, scale, want_db=False,
                      g_lse=None):
     B, H, S, D = q.shape
     Sk = k.shape[2]
+    _BQ, _BK = _block_sizes()
     Sp, Skp = _pad_len(S, _BQ), _pad_len(Sk, _BK)
     bias = _pad_bias(bias, S, Sp, Sk, Skp)
     q = _pad_axis(q, 2, Sp)

@@ -249,6 +249,17 @@ class PipeReader:
             remained = lines.pop()
             for line in lines:
                 yield line
+        if decomp is not None:
+            # emit any tail still buffered in the decompressor
+            tail = decomp.flush()
+            if tail:
+                remained += tail.decode("utf8", errors="replace")
         if remained:
             yield remained
-        self.process.wait()
+        rc = self.process.wait()
+        if rc != 0:
+            # a failing command (bad path, auth error, killed pipe) must
+            # not look like a clean end-of-stream with truncated data
+            raise RuntimeError(
+                "PipeReader command %r exited with status %d"
+                % (self.command, rc))
