@@ -75,11 +75,43 @@ def peak_flops():
     return None
 
 
+def _fused_attention_on():
+    from paddle_tpu.ops.attention import fused_attention_enabled
+
+    return fused_attention_enabled()
+
+
+def _check_pallas_mode(uses_flash):
+    """Returns the pallas mode for the row, or raises when a 'fused' row
+    would actually run interpret mode on a non-CPU backend — an
+    interpret fallback on hardware is catastrophically slow and must
+    surface as a row failure, not a kernel-regression-shaped number
+    (set PADDLE_TPU_BENCH_ALLOW_INTERPRET=1 to record it anyway)."""
+    if not uses_flash:
+        return None
+    import jax
+    from paddle_tpu.ops.attention import pallas_mode
+
+    mode = pallas_mode()
+    platform = jax.devices()[0].platform.lower()
+    if (mode == "interpret" and platform != "cpu"
+            and os.environ.get("PADDLE_TPU_BENCH_ALLOW_INTERPRET") != "1"):
+        raise RuntimeError(
+            "fused-attention workload would run Pallas INTERPRET mode on "
+            "platform %r — not a fused measurement. Set "
+            "PADDLE_TPU_FLASH_INTERPRET=0 to force the compiled path or "
+            "PADDLE_TPU_BENCH_ALLOW_INTERPRET=1 to record it anyway."
+            % platform)
+    return mode
+
+
 def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
-                  steps=10, warmup=3, quick=False, recompute=False):
+                  steps=10, warmup=3, quick=False, recompute=False,
+                  uses_flash=False):
     """Build, warm up, time, and report one workload in its own Scope."""
     if quick:
         steps, warmup = 2, 1
+    pallas = _check_pallas_mode(uses_flash)
     import paddle_tpu as fluid
     from paddle_tpu.core.scope import Scope, scope_guard
 
@@ -124,6 +156,10 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # never mistaken for (or regression-compared against) a
             # plain-activation baseline at the same batch size
             **({"recompute": True} if recompute else {}),
+            # which flash-kernel path the row actually exercised:
+            # "compiled" (Mosaic) / "interpret"; absent on non-attention
+            # workloads and on composed-path (unfused) runs
+            **({"pallas_mode": pallas} if pallas else {}),
             "value": round(throughput, 1),
             "unit": unit,
             "vs_baseline": round(throughput / BASELINES[name], 3)
@@ -153,7 +189,7 @@ def _maybe_recompute(opt, checkpoints):
     return opt
 
 
-def bench_transformer(amp, quick):
+def bench_transformer(amp, quick, uses_flash=False):
     import paddle_tpu.models.transformer as transformer
 
     seq, batch = 128, (8 if quick else 256)
@@ -180,10 +216,11 @@ def bench_transformer(amp, quick):
 
     return _run_workload("transformer_base_train_tokens_per_sec_per_chip",
                          "tokens/sec", batch * seq, build, feed, amp,
-                         quick=quick, recompute=_recompute_requested())
+                         quick=quick, recompute=_recompute_requested(),
+                         uses_flash=uses_flash)
 
 
-def bench_transformer_long(amp, quick):
+def bench_transformer_long(amp, quick, uses_flash=False):
     """Long-context variant (S=1024): the fused flash-attention path's
     showcase — the composed path materializes [S, S] scores per head."""
     import paddle_tpu.models.transformer as transformer
@@ -212,10 +249,11 @@ def bench_transformer_long(amp, quick):
 
     return _run_workload("transformer_base_s1024_train_tokens_per_sec_per_chip",
                          "tokens/sec", batch * seq, build, feed, amp,
-                         quick=quick, recompute=_recompute_requested())
+                         quick=quick, recompute=_recompute_requested(),
+                         uses_flash=uses_flash)
 
 
-def bench_resnet50(amp, quick):
+def bench_resnet50(amp, quick, uses_flash=False):
     import paddle_tpu.models.resnet as resnet
 
     batch = 4 if quick else 128
@@ -238,7 +276,7 @@ def bench_resnet50(amp, quick):
                          "images/sec", batch, build, feed, amp, quick=quick)
 
 
-def bench_vgg16(amp, quick):
+def bench_vgg16(amp, quick, uses_flash=False):
     import paddle_tpu.models.vgg as vgg
 
     batch = 4 if quick else 128
@@ -261,7 +299,7 @@ def bench_vgg16(amp, quick):
                          "images/sec", batch, build, feed, amp, quick=quick)
 
 
-def bench_bert(amp, quick):
+def bench_bert(amp, quick, uses_flash=False):
     import paddle_tpu.models.bert as bert
 
     seq, max_mask = 128, 20
@@ -292,10 +330,11 @@ def bench_bert(amp, quick):
 
     return _run_workload("bert_base_mlm_train_tokens_per_sec_per_chip",
                          "tokens/sec", batch * seq, build, feed, amp,
-                         quick=quick, recompute=_recompute_requested())
+                         quick=quick, recompute=_recompute_requested(),
+                         uses_flash=uses_flash)
 
 
-def bench_deepfm(amp, quick):
+def bench_deepfm(amp, quick, uses_flash=False):
     import paddle_tpu.models.ctr as ctr
 
     batch = 256 if quick else 8192
@@ -380,7 +419,16 @@ def _run_worker(name, amp, quick):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     _probe_backend()
     try:
-        WORKLOADS[name](amp, quick)
+        # single source of truth for "this row exercises the flash
+        # kernel": the ATTENTION_WORKLOADS set + the fused-attention
+        # env knob — per-call-site kwargs would drift (and default off)
+        uses_flash = name in ATTENTION_WORKLOADS and _fused_attention_on()
+        if uses_flash:
+            from paddle_tpu.ops.attention import pallas_mode
+
+            _log("%s: flash-attention pallas mode = %s"
+                 % (name, pallas_mode()))
+        WORKLOADS[name](amp, quick, uses_flash=uses_flash)
         return 0
     except Exception as exc:  # noqa: BLE001
         import traceback

@@ -48,10 +48,9 @@ def build(cfg=None, seq_len=128, max_mask=20, is_test=False,
     use_fused_attention defaults to the PADDLE_TPU_FUSED_ATTENTION env
     flag (default on) so hardware A/B runs need no code edit."""
     if use_fused_attention is None:
-        import os
+        from ..ops.attention import fused_attention_enabled
 
-        use_fused_attention = os.environ.get(
-            "PADDLE_TPU_FUSED_ATTENTION", "1") != "0"
+        use_fused_attention = fused_attention_enabled()
     cfg = cfg or base_config()
     src_ids = layers.data("src_ids", [seq_len], dtype="int64")
     sent_ids = layers.data("sent_ids", [seq_len], dtype="int64")

@@ -176,10 +176,9 @@ def build(cfg=None, seq_len=64, is_test=False, label_smooth_eps=0.1,
     checkpoints: pass a list to collect per-layer recompute boundaries
     for RecomputeOptimizer (memory for FLOPs at long context)."""
     if use_fused_attention is None:
-        import os
+        from ..ops.attention import fused_attention_enabled
 
-        use_fused_attention = os.environ.get(
-            "PADDLE_TPU_FUSED_ATTENTION", "1") != "0"
+        use_fused_attention = fused_attention_enabled()
     cfg = cfg or base_config()
     src = layers.data("src_ids", [seq_len], dtype="int64")
     trg = layers.data("trg_ids", [seq_len], dtype="int64")

@@ -49,7 +49,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..core.registry import register_grad_lowering, register_op
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "pallas_mode",
+           "fused_attention_enabled"]
 
 # Block sizes: env-tunable so hardware sweeps (VMEM vs occupancy per
 # chip generation) need no code edit. Defaults fit v5e comfortably.
@@ -81,13 +82,37 @@ _MASK = -1e9  # additive mask for padded key columns
 
 def _use_interpret() -> bool:
     """Pallas interpret mode off only on real TPU backends (including the
-    'axon' PJRT tunnel, whose platform name is not 'tpu')."""
+    'axon' PJRT tunnel, whose platform name is not 'tpu').
+
+    PADDLE_TPU_FLASH_INTERPRET overrides the autodetect: "1" forces
+    interpret mode (debugging numerics on any backend), "0" forces the
+    compiled Mosaic path (the operator's escape hatch when a renamed
+    tunnel platform defeats the autodetect; bench.py refuses to record a
+    fused row that would run interpret on non-CPU hardware)."""
+    env = _os.environ.get("PADDLE_TPU_FLASH_INTERPRET", "")
+    if env != "":
+        return env != "0"
     try:
         dev = jax.devices()[0]
     except Exception:
         return True
     plat = dev.platform.lower()
     return not (plat in ("tpu", "axon") or "tpu" in dev.device_kind.lower())
+
+
+def fused_attention_enabled() -> bool:
+    """Single source of truth for the PADDLE_TPU_FUSED_ATTENTION knob
+    (default on): models and bench must agree on which path a run
+    exercises, or rows get mislabeled."""
+    return _os.environ.get("PADDLE_TPU_FUSED_ATTENTION", "1") != "0"
+
+
+def pallas_mode() -> str:
+    """'compiled' (real Mosaic lowering) or 'interpret' — what the flash
+    kernels would run as right now. Bench rows record this so an
+    accidental interpret fallback on hardware can never masquerade as a
+    fused-kernel measurement."""
+    return "interpret" if _use_interpret() else "compiled"
 
 
 _NEG = -1e30

@@ -151,3 +151,32 @@ def test_flash_attention_trainable_bias_cotangent():
     assert float(jnp.abs(got).max()) > 0  # not the zero-cotangent bug
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_pallas_mode_env_override(monkeypatch):
+    from paddle_tpu.ops.attention import pallas_mode
+
+    monkeypatch.delenv("PADDLE_TPU_FLASH_INTERPRET", raising=False)
+    assert pallas_mode() == "interpret"  # CPU backend autodetect
+    monkeypatch.setenv("PADDLE_TPU_FLASH_INTERPRET", "0")
+    assert pallas_mode() == "compiled"
+    monkeypatch.setenv("PADDLE_TPU_FLASH_INTERPRET", "1")
+    assert pallas_mode() == "interpret"
+
+
+def test_flash_block_size_env_validated_at_use(monkeypatch):
+    # a malformed env var must not make `import paddle_tpu` fail; it
+    # fails (with the curated message) at first kernel use instead
+    import pytest
+
+    from paddle_tpu.ops import attention
+
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BQ", "128k")
+    with pytest.raises(ValueError, match="decimal integers"):
+        attention._block_sizes()
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BQ", "96")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BK", "256")
+    assert attention._block_sizes() == (96, 256)
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BQ", "7")
+    with pytest.raises(ValueError, match="multiple of 8"):
+        attention._block_sizes()

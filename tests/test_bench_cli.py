@@ -19,6 +19,12 @@ def _run(args, env_extra, timeout):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # 1-device CPU is fine and compiles faster
+    # a developer shell's flash/bench knobs must not leak into the
+    # subprocess and flip the pallas_mode/fused-path assertions
+    for knob in ("PADDLE_TPU_FLASH_INTERPRET", "PADDLE_TPU_FUSED_ATTENTION",
+                 "PADDLE_TPU_BENCH_ALLOW_INTERPRET", "PADDLE_TPU_FLASH_BQ",
+                 "PADDLE_TPU_FLASH_BK", "PADDLE_TPU_RECOMPUTE"):
+        env.pop(knob, None)
     env.update(env_extra)
     proc = subprocess.run(
         [sys.executable, BENCH] + args, env=env, timeout=timeout,
@@ -37,6 +43,44 @@ def test_bench_orchestrator_happy_path():
     assert row["value"] > 0
     assert row["unit"] == "examples/sec"
     assert "vs_baseline" in row and "tflops_per_sec" in row
+
+
+def test_bench_fused_row_records_pallas_mode():
+    # On the CPU backend interpret mode is expected and legal; the row
+    # must say so (hardware rows carry "compiled" or fail — below).
+    rc, rows = _run(["--only", "transformer", "--quick"],
+                    {"PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT": "420"}, 450)
+    assert rc == 0
+    result = [r for r in rows if "error" not in r]
+    assert result and result[0]["pallas_mode"] == "interpret"
+
+
+def test_check_pallas_mode_failure_path(monkeypatch):
+    # The weak-#1 scenario: a fused workload about to run interpret mode
+    # on a non-CPU backend must raise, not produce a misleading number.
+    sys.path.insert(0, os.path.dirname(BENCH))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    class _Dev:
+        platform = "axon"
+
+    monkeypatch.setattr("jax.devices", lambda *a: [_Dev()])
+    # force interpret despite the "hardware" platform: the exact silent-
+    # fallback condition the row must refuse to measure
+    monkeypatch.setenv("PADDLE_TPU_FLASH_INTERPRET", "1")
+    monkeypatch.delenv("PADDLE_TPU_BENCH_ALLOW_INTERPRET", raising=False)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="INTERPRET"):
+        bench._check_pallas_mode(True)
+    # the escape hatch records the row instead
+    monkeypatch.setenv("PADDLE_TPU_BENCH_ALLOW_INTERPRET", "1")
+    assert bench._check_pallas_mode(True) == "interpret"
+    # non-attention workloads are unaffected
+    assert bench._check_pallas_mode(False) is None
 
 
 def test_bench_orchestrator_kills_hung_workload():
