@@ -47,6 +47,8 @@ class _Plan:
         self.needs_rng = needs_rng
         self.fn = fn
         self.cost = None  # cost_analysis() result, filled on first request
+        self.hlo_text = {}  # stage -> lowered_hlo() text (AOT compiles
+        #                     can't reuse the jit cache; amortize them)
 
 
 class Executor:
@@ -156,6 +158,41 @@ class Executor:
                 cost = cost[0] if cost else {}
             plan.cost = dict(cost or {})
         return dict(plan.cost)
+
+    def lowered_hlo(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        stage: str = "optimized",
+    ) -> str:
+        """Text of the compiled step for this (program, feed-signature):
+        ``stage="stablehlo"`` is the pre-XLA lowering, ``"optimized"`` the
+        post-pass HLO module (fusions, buffer donation aliasing, SPMD
+        collectives). This is the self-measurement surface SURVEY §6
+        prescribes — golden-structure tests pin invariants on it (no host
+        callbacks in a train step, donation aliasing present, one scan for
+        grad accumulation) so perf regressions surface without TPU
+        hardware, the way the reference pins transpiled program structure
+        in test_dist_transpiler.py."""
+        if stage not in ("stablehlo", "optimized"):
+            raise ValueError("stage must be 'stablehlo' or 'optimized', "
+                             "got %r" % (stage,))
+        from ..compiler import CompiledProgram
+
+        if isinstance(program, CompiledProgram):
+            program = program._program
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        plan, feeds, const_state, mut_state, rng = self._gather(
+            program, feed, fetch_list, scope)
+        if stage not in plan.hlo_text:
+            lowered = plan.fn.lower(feeds, const_state, mut_state, rng)
+            plan.hlo_text[stage] = (
+                lowered.as_text() if stage == "stablehlo"
+                else lowered.compile().as_text())
+        return plan.hlo_text[stage]
 
     def _gather(self, program, feed, fetch_list, scope):
         """Shared run()/cost_analysis() plumbing: feed conversion, plan

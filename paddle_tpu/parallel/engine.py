@@ -54,6 +54,7 @@ class _ParallelPlan:
         self.fn = fn
         self.feed_shardings = feed_shardings      # name -> NamedSharding
         self.state_shardings = state_shardings    # name -> NamedSharding
+        self.hlo_text = {}  # stage -> lowered_hlo() text cache
 
 
 class ParallelEngine:
@@ -80,39 +81,22 @@ class ParallelEngine:
     def run(self, feed, fetch_list, scope: Optional[Scope] = None,
             return_numpy: bool = True):
         scope = scope if scope is not None else global_scope()
-        feed = feed or {}
-        fetch_names = [
-            v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
-        ]
-        block = self.program.global_block()
-        feed_vals = {}
-        for name, val in feed.items():
-            var = block.vars.get(name)
-            feed_vals[name] = _feed_to_device(name, val, var)
-
-        key = self._cache_key(feed_vals, fetch_names)
-        plan = self._cache.get(key)
-        if plan is None:
-            plan = self._prepare(feed_vals, fetch_names, scope)
-            self._cache[key] = plan
+        plan, feeds, const_state, mut_state, rng = self._gather(
+            feed, fetch_list, scope)
 
         # Place inputs: feeds split over the data axis, state per its spec.
         feeds = [
-            jax.device_put(feed_vals[n], plan.feed_shardings[n])
-            for n in plan.feed_names
+            jax.device_put(v, plan.feed_shardings[n])
+            for n, v in zip(plan.feed_names, feeds)
         ]
         const_state = [
-            jax.device_put(_require(scope, n), plan.state_shardings[n])
-            for n in plan.const_state
+            jax.device_put(v, plan.state_shardings[n])
+            for n, v in zip(plan.const_state, const_state)
         ]
         mut_state = [
-            jax.device_put(_require(scope, n), plan.state_shardings[n])
-            for n in plan.mut_state
+            jax.device_put(v, plan.state_shardings[n])
+            for n, v in zip(plan.mut_state, mut_state)
         ]
-        rng = scope.find_var(RNG_VAR)
-        if rng is None:
-            seed = self.program.random_seed if self.program.random_seed is not None else 0
-            rng = jax.random.PRNGKey(seed)
         rng = jax.device_put(rng, NamedSharding(self.mesh, P()))
 
         fetches, new_mut, new_pure, new_rng = plan.fn(feeds, const_state, mut_state, rng)
@@ -127,6 +111,55 @@ class ParallelEngine:
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    def lowered_hlo(self, feed, fetch_list, scope: Optional[Scope] = None,
+                    stage: str = "optimized") -> str:
+        """Post-SPMD-partitioner HLO text of the sharded step (or the
+        pre-XLA ``"stablehlo"``). Golden-structure tests assert the
+        data-parallel gradient all-reduces are present — the CPU-side
+        tripwire for a dropped sharding rule (see Executor.lowered_hlo)."""
+        if stage not in ("stablehlo", "optimized"):
+            raise ValueError("stage must be 'stablehlo' or 'optimized', "
+                             "got %r" % (stage,))
+        scope = scope if scope is not None else global_scope()
+        plan, feeds, const_state, mut_state, rng = self._gather(
+            feed, fetch_list, scope)
+        if stage not in plan.hlo_text:
+            with self.mesh:
+                lowered = plan.fn.lower(feeds, const_state, mut_state, rng)
+            plan.hlo_text[stage] = (
+                lowered.as_text() if stage == "stablehlo"
+                else lowered.compile().as_text())
+        return plan.hlo_text[stage]
+
+    def _gather(self, feed, fetch_list, scope):
+        """Shared run()/lowered_hlo() plumbing: feed conversion, plan
+        cache lookup, state/RNG gathering (host-side values; run() then
+        device_puts them per the plan's shardings)."""
+        feed = feed or {}
+        fetch_names = [
+            v.name if isinstance(v, Variable) else str(v)
+            for v in (fetch_list or [])
+        ]
+        block = self.program.global_block()
+        feed_vals = {
+            n: _feed_to_device(n, v, block.vars.get(n))
+            for n, v in feed.items()
+        }
+        key = self._cache_key(feed_vals, fetch_names)
+        plan = self._cache.get(key)
+        if plan is None:
+            plan = self._prepare(feed_vals, fetch_names, scope)
+            self._cache[key] = plan
+        feeds = [feed_vals[n] for n in plan.feed_names]
+        const_state = [_require(scope, n) for n in plan.const_state]
+        mut_state = [_require(scope, n) for n in plan.mut_state]
+        rng = scope.find_var(RNG_VAR)
+        if rng is None:
+            seed = (self.program.random_seed
+                    if self.program.random_seed is not None else 0)
+            rng = jax.random.PRNGKey(seed)
+        return plan, feeds, const_state, mut_state, rng
 
     # -------------------------------------------------------------- prepare
     def _cache_key(self, feed_vals, fetch_names):

@@ -1,0 +1,227 @@
+"""Golden HLO-structure tests: the CPU-side perf-regression net.
+
+The compiled step's *structure* is the thing the rare TPU windows can't
+be the first to check: a dropped sharding rule, a de-donated buffer, or
+a host round-trip sneaking into the train step would silently cost the
+next hardware session. These tests pin those properties on the lowered/
+optimized HLO text (Executor.lowered_hlo / ParallelEngine.lowered_hlo),
+the way the reference pins transpiled program structure in
+/root/reference/python/paddle/fluid/tests/unittests/test_dist_transpiler.py
+(golden op-list assertions on the rewritten program).
+
+Each invariant test carries its own sensitivity control — a variant that
+violates the property — so the assertions are known to actually detect
+the regression class, not just pass vacuously.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.scope import Scope, scope_guard
+from paddle_tpu.parallel.engine import ParallelEngine
+from paddle_tpu.parallel.sharding import P, ShardingRules
+
+BATCH = 16
+
+
+def _build_mlp():
+    x = fluid.layers.data(name="x", shape=[32], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=64, act="relu")
+    pred = fluid.layers.fc(h, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+    return loss
+
+
+def _feed(batch=BATCH):
+    rs = np.random.RandomState(0)
+    return {"x": rs.rand(batch, 32).astype("float32"),
+            "y": rs.randint(0, 10, (batch, 1)).astype("int64")}
+
+
+def _train_step_hlo(scope, stage="optimized", accum=None, optimizer=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss = _build_mlp()
+        (optimizer or fluid.optimizer.SGD(learning_rate=0.1)).minimize(loss)
+    if accum:
+        main.set_gradient_accumulation(accum)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup, scope=scope)
+    return exe.lowered_hlo(main, feed=_feed(), fetch_list=[loss],
+                           scope=scope, stage=stage)
+
+
+def _hlo_ops(txt, opname):
+    """HLO-op definition lines '%x = <type> op(...)' — result types may be
+    tuples with spaces, and metadata={op_name=...} trailers may mention op
+    names, so match only between '=' and the first 'metadata='."""
+    out = []
+    for line in txt.splitlines():
+        if "=" not in line:
+            continue
+        body = line.split("metadata=")[0]
+        if re.search(r"=\s.*\s%s\(" % re.escape(opname), body):
+            out.append(line)
+    return out
+
+
+def _alias_entries(txt):
+    """Parse the module's input_output_alias entries (balanced-brace scan:
+    each entry is '{out_idx}: (param_idx, {...}, kind)', so the attribute
+    contains nested braces a non-greedy regex would stop at)."""
+    start = txt.find("input_output_alias={")
+    if start < 0:
+        return []
+    i = txt.index("{", start)
+    depth, j = 0, i
+    while j < len(txt):
+        if txt[j] == "{":
+            depth += 1
+        elif txt[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    body = txt[i:j + 1]
+    return re.findall(r"\{[\d,\s]*\}:\s*\(\d+", body)
+
+
+# ---------------------------------------------------------------- host I/O
+
+def test_train_step_has_no_host_callbacks():
+    """The single-chip train step must be one self-contained executable:
+    no infeed/outfeed, no Python-callback custom-calls (a host round-trip
+    inside the hot loop is the canonical silent 10x regression)."""
+    scope = Scope()
+    with scope_guard(scope):
+        txt = _train_step_hlo(scope)
+    assert not _hlo_ops(txt, "infeed")
+    assert not _hlo_ops(txt, "outfeed")
+    callback_targets = [t for t in
+                        re.findall(r'custom_call_target="([^"]+)"', txt)
+                        if "callback" in t or "python" in t]
+    assert not callback_targets, callback_targets
+
+
+def test_host_callback_scan_detects_py_func():
+    """Sensitivity control: a program that genuinely round-trips to the
+    host (py_func) must trip the same scan, or the test above proves
+    nothing."""
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+            out = main.global_block().create_var(
+                name="pyout", shape=(2, 3), dtype="float32")
+            fluid.layers.py_func(lambda a: a * 2, x, out)
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        txt = exe.lowered_hlo(main, feed={"x": np.zeros((2, 3), "float32")},
+                              fetch_list=["pyout"], scope=scope)
+    assert any("callback" in t for t in
+               re.findall(r'custom_call_target="([^"]+)"', txt))
+
+
+# ---------------------------------------------------------------- donation
+
+def test_donated_state_appears_in_input_output_aliasing():
+    """The executor donates mutable state (params + optimizer slots); XLA
+    must turn that into input->output buffer aliasing or every step pays
+    a full parameter copy. SGD on the 2-layer MLP donates exactly the 4
+    param buffers (w0, b0, w1, b1); the learning-rate var is read-only
+    const state and must NOT be aliased."""
+    scope = Scope()
+    with scope_guard(scope):
+        txt = _train_step_hlo(scope)
+    assert len(_alias_entries(txt)) == 4, txt[:400]
+
+
+def test_adam_aliases_params_and_moment_slots():
+    """Adam keeps per-param accumulators (moment1, moment2, beta1_pow,
+    beta2_pow — matching the reference's per-param accumulator table,
+    adam_op.h) — all donated alongside the param itself: 4 params x
+    (1 + 4 slots) = 20 aliased buffers."""
+    scope = Scope()
+    with scope_guard(scope):
+        txt = _train_step_hlo(
+            scope, optimizer=fluid.optimizer.Adam(learning_rate=1e-3))
+    assert len(_alias_entries(txt)) == 20, _alias_entries(txt)
+
+
+def test_inference_clone_has_no_aliasing():
+    """Sensitivity control for the aliasing parser: a forward-only program
+    mutates no state, so the module must carry no alias entries (if the
+    parser returned phantom entries, the donation tests above could pass
+    against broken donation)."""
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss = _build_mlp()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        txt = exe.lowered_hlo(main, feed=_feed(), fetch_list=[loss],
+                              scope=scope)
+    assert len(_alias_entries(txt)) == 0
+
+
+# ------------------------------------------------------------- collectives
+
+def test_dp_step_contains_gradient_all_reduce():
+    """Data-parallel engine over the 8-device mesh: batch-sharded feeds
+    force the SPMD partitioner to insert gradient all-reduces. If a
+    sharding rule is dropped (feeds silently replicated), the all-reduces
+    vanish — and with them, the parallelism. Both directions pinned."""
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss = _build_mlp()
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+
+        engine = ParallelEngine(main, loss_name=loss.name)
+        txt = engine.lowered_hlo(feed=_feed(), fetch_list=[loss],
+                                 scope=scope)
+        n_ar = len(_hlo_ops(txt, "all-reduce")) + \
+            len(_hlo_ops(txt, "all-reduce-start"))
+        assert n_ar >= 1, "no all-reduce in the DP step HLO"
+        # donation must survive the mesh path too
+        assert len(_alias_entries(txt)) == 4
+
+        # sensitivity control: replicate the feeds -> no data axis ->
+        # the gradient all-reduces must disappear
+        broken = ParallelEngine(
+            main, loss_name=loss.name,
+            rules=ShardingRules(feed_rules=[(".*", P())]))
+        txt2 = broken.lowered_hlo(feed=_feed(), fetch_list=[loss],
+                                  scope=scope)
+        n_ar2 = len(_hlo_ops(txt2, "all-reduce")) + \
+            len(_hlo_ops(txt2, "all-reduce-start"))
+        assert n_ar2 == 0, "replicated feeds still emitted all-reduce"
+
+
+# --------------------------------------------------------------- grad accum
+
+def test_grad_accum_lowers_to_exactly_one_scan():
+    """set_gradient_accumulation(k) must emit ONE lax.scan over the
+    microbatch axis (one stablehlo.while), not k unrolled copies of the
+    forward/backward (code-size blowup) and not zero (silent full-batch
+    step). Checked pre-optimization: XLA may legitimately unroll the
+    small-trip-count loop afterwards."""
+    scope = Scope()
+    with scope_guard(scope):
+        txt = _train_step_hlo(scope, stage="stablehlo", accum=4)
+    assert len(re.findall(r"stablehlo\.while", txt)) == 1
+
+    # sensitivity control: without accumulation there is no loop at all
+    scope2 = Scope()
+    with scope_guard(scope2):
+        txt2 = _train_step_hlo(scope2, stage="stablehlo")
+    assert len(re.findall(r"stablehlo\.while", txt2)) == 0
