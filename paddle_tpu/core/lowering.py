@@ -26,11 +26,16 @@ class LowerContext:
     the owning block (for sub-block control flow), and mode flags."""
 
     def __init__(self, block: Optional[Block] = None, rng: Optional[jax.Array] = None,
-                 is_test: bool = False, amp: bool = False):
+                 is_test: bool = False, amp: bool = False, mesh=None,
+                 data_axis: str = "data"):
         self.block = block
         self._rng = rng
         self.is_test = is_test
         self.amp = amp
+        self.mesh = mesh  # jax Mesh when lowering under ParallelEngine:
+        #                   ops with explicit-collective paths (pipeline,
+        #                   moe) pick their shard_map axis from it
+        self.data_axis = data_axis  # the engine's batch axis name
         self.rng_used = False
 
     def next_rng(self) -> jax.Array:
@@ -48,12 +53,16 @@ class LowerContext:
         return self._rng
 
     def sub(self, block: Block) -> "LowerContext":
-        c = LowerContext(block, self._rng, self.is_test, self.amp)
+        c = LowerContext(block, self._rng, self.is_test, self.amp, self.mesh,
+                         self.data_axis)
         return c
 
     def pure(self) -> "LowerContext":
-        """Context for re-tracing a forward lowering inside a vjp: no RNG."""
-        return LowerContext(self.block, None, self.is_test, self.amp)
+        """Context for re-tracing a forward lowering inside a vjp: no RNG.
+        Keeps the mesh: the re-trace must pick the same (shard_map vs
+        sequential) path as the forward emission or XLA cannot CSE them."""
+        return LowerContext(self.block, None, self.is_test, self.amp,
+                            self.mesh, self.data_axis)
 
 
 def lower_op(ctx: LowerContext, op, env: Dict[str, Any]) -> None:

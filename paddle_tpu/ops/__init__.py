@@ -17,11 +17,13 @@ from . import (  # noqa: F401
     math,
     metrics,
     misc_ops,
+    moe_ops,
     nn,
     quant_ops,
     recompute_ops,
     rnn,
     optimizer_ops,
+    pipeline_ops,
     sequence,
     tensor_ops,
 )

@@ -1,0 +1,110 @@
+"""moe_ffn op: top-1 switch-routed expert FFN as one graph op.
+
+The reference (Fluid v1.3) has no mixture-of-experts; this op promotes
+`parallel/moe.py` into the Program/layers API (the 'ep' axis). Expert
+weights arrive stacked [E, ...]; under a ParallelEngine mesh with an
+'expert' axis of size E each device computes ITS expert on the tokens
+routed to it and the [capacity, D] results all_gather back — with the
+engine's replicated activations every device already holds the full
+token set, so this costs ONE collective and capacity rows per expert
+(the general token-sharded case, where tokens must first travel to
+their expert's device via all_to_all, lives in `parallel/moe.py`'s
+``moe_apply`` for shard_map users). Without the axis, every expert
+computes locally. All paths share ``route_tokens``, so single-device
+and expert-parallel runs agree exactly (the parity contract the tests
+pin): Switch Transformer discipline — static capacity, overflow tokens
+contribute zero, aux load-balancing loss.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.registry import register_op
+
+__all__: List[str] = []
+
+
+def _moe_local(x, w1, b1, w2, b2, gate_w, E, capacity):
+    """Single-device path: every expert computes on the full token set,
+    outputs select by routing — matching the parallel path's keep/drop
+    discipline through the shared route_tokens."""
+    from ..parallel.moe import route_tokens
+
+    expert_idx, gate, _pos, keep, aux = route_tokens(x, gate_w, E, capacity)
+    out = jnp.zeros_like(x)
+    for e in range(E):
+        h = jax.nn.relu(x @ w1[e] + b1[e])
+        y = h @ w2[e] + b2[e]
+        sel = ((expert_idx == e) & keep)[:, None]
+        out = out + jnp.where(sel, y, 0.0)
+    return out * gate[:, None], aux
+
+
+@register_op("moe_ffn",
+             diff_inputs=["X", "W1", "B1", "W2", "B2", "Gate"],
+             needs_env=False)
+def _moe_ffn(ctx, ins, attrs):
+    from ..parallel.moe import route_tokens
+
+    x = ins["X"][0]
+    w1, b1, w2, b2 = ins["W1"][0], ins["B1"][0], ins["W2"][0], ins["B2"][0]
+    gate_w = ins["Gate"][0]
+    E = int(attrs["n_experts"])
+    axis = attrs.get("axis", "expert")
+
+    D = x.shape[-1]
+    xf = x.reshape(-1, D)
+    T = xf.shape[0]
+    capacity = int(attrs.get("capacity") or -(-2 * T // E))
+
+    mesh = ctx.mesh
+    use_ep = mesh is not None and axis in mesh.axis_names \
+        and mesh.shape[axis] > 1
+    if use_ep and mesh.shape[axis] != E:
+        raise ValueError(
+            "moe_ffn with n_experts=%d under a mesh whose %r axis has %d "
+            "devices — experts map one-per-device" % (E, axis,
+                                                      mesh.shape[axis]))
+
+    if not use_ep:
+        out, aux = _moe_local(xf, w1, b1, w2, b2, gate_w, E, capacity)
+        return {"Out": out.reshape(x.shape), "AuxLoss": aux}
+
+    def shard_body(xl, w1l, b1l, w2l, b2l, gl):
+        # xl replicated on the axis -> routing is identical everywhere;
+        # each device fills the send buffer, runs ITS expert on its
+        # [capacity, D] slice, and one all_gather rebuilds [E, capacity,
+        # D] results for the (replicated) token-side gather.
+        expert_idx, gate, pos, keep, aux = route_tokens(xl, gl, E, capacity)
+        safe_e = jnp.where(keep, expert_idx, 0)
+        safe_p = jnp.where(keep, pos, 0)
+        buf = jnp.zeros((E, capacity, D), xl.dtype)
+        buf = buf.at[safe_e, safe_p].add(jnp.where(keep[:, None], xl, 0.0))
+
+        d = lax.axis_index(axis)
+        mine = lax.dynamic_index_in_dim(buf, d, axis=0, keepdims=False)
+        h = jax.nn.relu(mine @ w1l[0] + b1l[0])
+        y = h @ w2l[0] + b2l[0]                       # [capacity, D]
+        ys = lax.all_gather(y, axis)                  # [E, capacity, D]
+
+        out = ys[safe_e, safe_p]
+        out = jnp.where(keep[:, None], out, 0.0) * gate[:, None]
+        return out, aux
+
+    # check_vma off: ys is the same on every device after the
+    # all_gather, but the varying-manner analysis cannot prove the
+    # gathered values replicated (the parity tests pin it numerically)
+    fn = jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P(),) + (P(axis),) * 4 + (P(),),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    out, aux = fn(xf, w1, b1, w2, b2, gate_w)
+    return {"Out": out.reshape(x.shape), "AuxLoss": aux}

@@ -1,0 +1,105 @@
+"""pipeline op: GPipe-schedule stage stack as one graph op.
+
+The reference (Fluid v1.3) has no pipeline parallelism; this op promotes
+the `parallel/pipeline.py` collective-permute schedule into the
+Program/layers API (the 'pp' axis of the dp/tp/sp/pp/ep set). The layer
+(`layers.pipeline`) builds the per-stage computation into a sub-block
+whose parameters are created STACKED with a leading [n_stages] dim; this
+lowering then either
+
+  - runs the stages under ``shard_map`` over the mesh's 'pipe' axis with
+    ``pipeline_apply`` (stage params sharded one-per-device, activations
+    hopping stage-to-stage over ICI via lax.ppermute) when the engine's
+    mesh has one, or
+  - applies the stages sequentially (identical math: stages are
+    per-sample maps, so microbatch boundaries don't change results) on a
+    single device / mesh without a pipe axis.
+
+Gradients come from the generic vjp synthesis (core/autodiff.py): jax
+transposes ppermute into the reverse hop, so the backward pass is
+automatically the reverse-order pipeline — no hand-built 1F1B schedule.
+Stage bodies must be deterministic (no dropout): the op lowers through a
+pure (RNG-free) context so the vjp re-trace CSEs against the forward.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..core.registry import register_op
+
+__all__: List[str] = []
+
+
+def _stage_fn(ctx, sub, slice_names, in_name, out_name):
+    from ..core.lowering import LowerContext, lower_ops
+
+    def stage(param_slices, x):
+        env: Dict[str, Any] = dict(zip(slice_names, param_slices))
+        env[in_name] = x
+        sctx = LowerContext(sub, None, ctx.is_test, ctx.amp, ctx.mesh,
+                            ctx.data_axis)
+        lower_ops(sctx, sub.ops, env)
+        return env[out_name]
+
+    return stage
+
+
+@register_op("pipeline", diff_inputs=["X", "StackedParams"], needs_env=False)
+def _pipeline(ctx, ins, attrs):
+    from ..parallel.pipeline import pipeline_apply
+
+    x = ins["X"][0]
+    stacked = list(ins["StackedParams"])
+    n_stages = int(attrs["n_stages"])
+    n_mb = int(attrs["n_microbatches"])
+    axis = attrs.get("axis", "pipe")
+    sub = ctx.block.program.block(attrs["sub_block"])
+    stage = _stage_fn(ctx, sub, attrs["slice_names"], attrs["in_name"],
+                      attrs["out_name"])
+
+    mesh = ctx.mesh
+    use_pipe = mesh is not None and axis in mesh.axis_names \
+        and mesh.shape[axis] > 1
+    if use_pipe and mesh.shape[axis] != n_stages:
+        raise ValueError(
+            "pipeline op with n_stages=%d under a mesh whose %r axis has "
+            "%d devices — stages map one-per-device; reshape the mesh or "
+            "the stage count" % (n_stages, axis, mesh.shape[axis]))
+
+    if not use_pipe:
+        # sequential fallback: same per-sample math, no microbatching
+        out = x
+        for s in range(n_stages):
+            out = stage([p[s] for p in stacked], out)
+        return {"Out": out}
+
+    B = x.shape[0]
+    if B % n_mb:
+        raise ValueError(
+            "pipeline batch %d is not divisible by n_microbatches=%d"
+            % (B, n_mb))
+    x_mb = x.reshape((n_mb, B // n_mb) + x.shape[1:])
+
+    # shard the microbatch dim over the engine's data axis when the mesh
+    # has it (dp x pp); axes not named in a spec are replicated
+    data_axis = ctx.data_axis
+    has_data = data_axis in mesh.axis_names and mesh.shape[data_axis] > 1 \
+        and (B // n_mb) % mesh.shape[data_axis] == 0
+    x_spec = P(None, data_axis) if has_data else P()
+
+    def shard_body(x_mb_l, *stacked_l):
+        return pipeline_apply(
+            lambda ps, xi: stage(list(ps), xi), list(stacked_l), x_mb_l, axis)
+
+    fn = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(x_spec,) + (P(axis),) * len(stacked),
+        out_specs=x_spec,
+    )
+    out_mb = fn(x_mb, *stacked)
+    return {"Out": out_mb.reshape((B,) + x.shape[1:])}

@@ -45,7 +45,8 @@ def _run_segment(ctx, block, in_names, out_names, in_vals, key):
     from ..core.lowering import LowerContext, lower_ops
 
     env: Dict[str, Any] = dict(zip(in_names, in_vals))
-    sctx = LowerContext(block, key, ctx.is_test, ctx.amp)
+    sctx = LowerContext(block, key, ctx.is_test, ctx.amp, ctx.mesh,
+                        ctx.data_axis)
     lower_ops(sctx, block.ops, env)
     missing = [n for n in out_names if n not in env]
     if missing:

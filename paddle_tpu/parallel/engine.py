@@ -132,6 +132,32 @@ class ParallelEngine:
                 else lowered.compile().as_text())
         return plan.hlo_text[stage]
 
+    def _with_ext_rules(self) -> ShardingRules:
+        """User rules + automatic stage/expert sharding: parameters the
+        `layers.pipeline` / `layers.moe_ffn` layers created stacked are
+        sharded over the 'pipe' / 'expert' mesh axis (leading dim), and —
+        via prefix match — so are their optimizer accumulator slots
+        (named '<param>_<slot>'; slots whose shape the axis doesn't
+        divide, like beta-pow scalars, fall back to replicated inside
+        spec_for). User rules are matched first, so an explicit rule for
+        a stacked param wins."""
+        import re as _re
+
+        ext = []
+        for attr, axis in (("_pipeline_params", "pipe"),
+                           ("_expert_params", "expert")):
+            if axis not in self.mesh.axis_names:
+                continue
+            for pname in getattr(self.program, attr, ()):
+                ext.append(("^" + _re.escape(pname), P(axis)))
+        if not ext:
+            return self.rules
+        merged = ShardingRules(data_axis=self.rules.data_axis)
+        merged.rules = list(self.rules.rules) + [
+            (_re.compile(pat), spec) for pat, spec in ext]
+        merged.feed_rules = list(self.rules.feed_rules)
+        return merged
+
     def _gather(self, feed, fetch_list, scope):
         """Shared run()/lowered_hlo() plumbing: feed conversion, plan
         cache lookup, state/RNG gathering (host-side values; run() then
@@ -170,7 +196,8 @@ class ParallelEngine:
     def _prepare(self, feed_vals, fetch_names, scope) -> _ParallelPlan:
         (feed_names, fetch_names, const_state, mut_state, pure_written,
          needs_rng, step) = analyze_block(
-            self.program, sorted(feed_vals), fetch_names, scope)
+            self.program, sorted(feed_vals), fetch_names, scope,
+            mesh=self.mesh, data_axis=self.rules.data_axis)
 
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
@@ -179,11 +206,12 @@ class ParallelEngine:
                 feed_vals[n].shape, mesh, name=n))
             for n in feed_names
         }
+        rules = self._with_ext_rules()
         state_shardings = {}
         for n in const_state + mut_state:
             v = scope.find_var(n)
             shape = getattr(v, "shape", None)
-            state_shardings[n] = NamedSharding(mesh, self.rules.spec_for(n, shape, mesh))
+            state_shardings[n] = NamedSharding(mesh, rules.spec_for(n, shape, mesh))
 
         in_shardings = (
             [feed_shardings[n] for n in feed_names],

@@ -24,7 +24,27 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["moe_apply"]
+__all__ = ["moe_apply", "route_tokens"]
+
+
+def route_tokens(x, gate_w, E, capacity):
+    """Shared top-1 routing/capacity math — the ONE derivation both the
+    distributed path below and the single-device dense fallback
+    (ops/moe_ops.py) use, so their exact-parity contract can't drift.
+
+    Returns (expert_idx [T], gate [T], pos [T], keep [T], aux scalar).
+    """
+    probs = jax.nn.softmax(x @ gate_w, axis=-1)          # [T, E]
+    expert_idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert_idx, E)
+    # Switch aux loss: E * mean(fraction_per_expert * prob_per_expert)
+    aux = E * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
+    # position of each token within its expert's send buffer
+    pos = (jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
+           ).astype(jnp.int32)
+    keep = pos < capacity
+    return expert_idx, gate, pos, keep, aux
 
 
 def moe_apply(expert_params, gate_w, x, axis_name, capacity=None):
@@ -44,21 +64,7 @@ def moe_apply(expert_params, gate_w, x, axis_name, capacity=None):
     T, D = x.shape
     capacity = int(capacity or -(-2 * T // E))
 
-    logits = x @ gate_w                      # [T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [T] top-1 routing
-    gate = jnp.max(probs, axis=-1)           # [T] the chosen prob
-
-    # Switch aux loss: E * mean(fraction_per_expert * prob_per_expert)
-    onehot = jax.nn.one_hot(expert_idx, E)
-    frac = jnp.mean(onehot, axis=0)
-    mean_p = jnp.mean(probs, axis=0)
-    aux = E * jnp.sum(frac * mean_p)
-
-    # position of each token within its expert's send buffer
-    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # [T, E]
-    pos = (jnp.sum(pos_in_expert, axis=-1) - 1).astype(jnp.int32)
-    keep = pos < capacity
+    expert_idx, gate, pos, keep, aux = route_tokens(x, gate_w, E, capacity)
 
     # scatter tokens into the [E, capacity, D] send buffer
     buf = jnp.zeros((E, capacity, D), x.dtype)

@@ -1,0 +1,158 @@
+"""layers.moe_ffn: the ep axis as a framework feature.
+
+Contract (VERDICT r3 task 6): a Program-built MoE model trains through
+ParallelEngine over an 'expert' mesh axis (tokens all_to_all to their
+expert's device); the expert-parallel run matches the single-device
+dense-fallback run exactly; the Switch aux loss actually changes
+routing; and the static-capacity overflow discipline drops tokens.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.core.scope import Scope, scope_guard
+from paddle_tpu.parallel.engine import ParallelEngine, make_mesh
+
+D, E, H = 16, 8, 32
+
+
+def _build(aux_weight=0.01, capacity=None):
+    x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h, aux = fluid.layers.moe_ffn(x, n_experts=E, d_hidden=H,
+                                  capacity=capacity)
+    pred = fluid.layers.fc(h, size=1)
+    mse = fluid.layers.mean(fluid.layers.square(pred - y))
+    loss = fluid.layers.elementwise_add(
+        mse, fluid.layers.scale(aux, scale=aux_weight))
+    return loss, aux, h
+
+
+def _feed(batch=32, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"x": rs.rand(batch, D).astype("float32"),
+            "y": rs.rand(batch, 1).astype("float32")}
+
+
+def test_moe_expert_parallel_matches_dense_fallback():
+    feed = _feed()
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss, aux, _ = _build()
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        seq = []
+        for _ in range(8):
+            v, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            seq.append(float(v.reshape(-1)[0]))
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    scope2 = Scope()
+    with scope_guard(scope2):
+        with fluid.program_guard(main2, startup2):
+            loss2, aux2, _ = _build()
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss2)
+        exe2 = fluid.Executor(fluid.TPUPlace())
+        exe2.run(startup2, scope=scope2)  # same seed -> identical init
+        mesh = make_mesh(jax.devices(), ("expert",), (E,))
+        eng = ParallelEngine(main2, loss_name=loss2.name, mesh=mesh)
+        ep = []
+        for _ in range(8):
+            v, = eng.run(feed, [loss2], scope2)
+            ep.append(float(np.asarray(v).reshape(-1)[0]))
+
+        # expert weights sharded one-per-device on the expert axis
+        plan = next(iter(eng._cache.values()))
+        for n in main2._expert_params:
+            spec = plan.state_shardings[n].spec
+            assert spec and spec[0] == "expert", (n, spec)
+
+    assert seq[0] > seq[-1], "did not train"
+    np.testing.assert_allclose(ep, seq, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_step_hlo_contains_expert_collective():
+    """The expert-parallel step must carry the result all-gather (each
+    device computes only ITS expert's [capacity, D] slice — see
+    ops/moe_ops.py); the single-device lowering must not reach for any
+    collective."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss, _, _ = _build()
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        mesh = make_mesh(jax.devices(), ("expert",), (E,))
+        eng = ParallelEngine(main, loss_name=loss.name, mesh=mesh)
+        txt = eng.lowered_hlo(feed=_feed(), fetch_list=[loss], scope=scope)
+        assert "all-gather" in txt
+        with scope_guard(scope):
+            txt1 = exe.lowered_hlo(main, feed=_feed(), fetch_list=[loss],
+                                   scope=scope)
+        assert "all-gather" not in txt1 and "all-to-all" not in txt1
+
+
+def test_moe_aux_loss_changes_routing():
+    """Training WITH the load-balancing penalty must end with more
+    balanced routing (lower aux value) than training without it —
+    otherwise the aux plumbing through the optimizer path is dead."""
+
+    def run(aux_weight):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = Scope()
+        with scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                loss, aux, _ = _build(aux_weight=aux_weight)
+                fluid.optimizer.Adam(learning_rate=5e-2).minimize(loss)
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup, scope=scope)
+            feed = _feed(batch=64)
+            a = None
+            for _ in range(30):
+                _, a = exe.run(main, feed=feed, fetch_list=[loss, aux],
+                               scope=scope)
+            return float(np.asarray(a).reshape(-1)[0])
+
+    assert run(aux_weight=1.0) < run(aux_weight=0.0) - 0.05
+
+
+def test_moe_capacity_overflow_drops_tokens():
+    """Identical tokens all route to one expert; with capacity=1 only the
+    first survives — the rest contribute exactly zero (Switch overflow
+    discipline), unlike the uncapped run."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            _, _, h = _build(capacity=1)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        x = np.tile(np.linspace(0.1, 0.9, D).astype("float32"), (6, 1))
+        out, = exe.run(main, feed={"x": x, "y": np.zeros((6, 1), "float32")},
+                       fetch_list=[h], scope=scope)
+    # all 6 tokens identical -> same expert; one survives capacity=1
+    nonzero = np.abs(out).sum(axis=1) > 1e-9
+    assert nonzero.sum() == 1, nonzero
+
+
+def test_moe_expert_count_must_match_axis():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss, _, _ = _build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        mesh = make_mesh(jax.devices(), ("expert", "data"), (4, 2))
+        eng = ParallelEngine(main, loss_name=loss.name, mesh=mesh)
+        with pytest.raises(Exception, match="one-per-device"):
+            eng.run(_feed(), [loss], scope)

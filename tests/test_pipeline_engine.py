@@ -1,0 +1,169 @@
+"""layers.pipeline: the pp axis as a framework feature.
+
+Contract (VERDICT r3 task 5): a Program-built model reaches the
+collective-permute GPipe schedule (parallel/pipeline.py) through an
+ordinary layer call; ParallelEngine shards the stacked stage params over
+a 'pipe' mesh axis automatically; and the pipelined run matches the
+single-device sequential run within fp tolerance — forward AND through
+optimizer steps (gradients cross the ppermute hops).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.core.scope import Scope, scope_guard
+from paddle_tpu.parallel.engine import ParallelEngine, make_mesh
+
+D = 16
+
+
+def _stage(pb, xin):
+    w = pb.param([D, D])
+    b = pb.param([D], is_bias=True)
+    h = fluid.layers.elementwise_add(fluid.layers.matmul(xin, w), b)
+    return fluid.layers.relu(h)
+
+
+def _build(n_stages=4, n_microbatches=None):
+    x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.pipeline(x, n_stages=n_stages, stage_fn=_stage,
+                              n_microbatches=n_microbatches)
+    pred = fluid.layers.fc(h, size=1)
+    loss = fluid.layers.mean(fluid.layers.square(pred - y))
+    return loss
+
+
+def _feed(batch=16):
+    rs = np.random.RandomState(0)
+    return {"x": rs.rand(batch, D).astype("float32"),
+            "y": rs.rand(batch, 1).astype("float32")}
+
+
+def _train(run_fn, steps=8):
+    losses = [float(np.asarray(run_fn()).reshape(-1)[0])
+              for _ in range(steps)]
+    return losses
+
+
+def test_pipeline_matches_sequential_through_training():
+    feed = _feed()
+
+    # single device: sequential stage application
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss = _build()
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        seq = _train(lambda: exe.run(main, feed=feed, fetch_list=[loss],
+                                     scope=scope)[0])
+
+    # dp x pp mesh: ppermute schedule, stacked params sharded on 'pipe'
+    main2, startup2 = fluid.Program(), fluid.Program()
+    scope2 = Scope()
+    with scope_guard(scope2):
+        with fluid.program_guard(main2, startup2):
+            loss2 = _build()
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss2)
+        exe2 = fluid.Executor(fluid.TPUPlace())
+        exe2.run(startup2, scope=scope2)  # same seed -> identical init
+        mesh = make_mesh(jax.devices(), ("data", "pipe"), (2, 4))
+        eng = ParallelEngine(main2, loss_name=loss2.name, mesh=mesh)
+        pipe = _train(lambda: eng.run(feed, [loss2], scope2)[0])
+
+        # the stacked stage params actually live sharded on the pipe axis
+        plan = next(iter(eng._cache.values()))
+        stacked = [n for n in main2._pipeline_params]
+        assert stacked
+        for n in stacked:
+            spec = plan.state_shardings[n].spec
+            assert spec and spec[0] == "pipe", (n, spec)
+
+    assert seq[0] > seq[-1], "did not train"
+    np.testing.assert_allclose(pipe, seq, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_step_hlo_contains_collective_permute():
+    """The pipelined step's optimized HLO must carry the stage-hop
+    collective — if the shard_map path silently degrades to the
+    sequential fallback, the schedule (and its overlap) is gone."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss = _build()
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        mesh = make_mesh(jax.devices(), ("data", "pipe"), (2, 4))
+        eng = ParallelEngine(main, loss_name=loss.name, mesh=mesh)
+        txt = eng.lowered_hlo(feed=_feed(), fetch_list=[loss], scope=scope)
+    assert "collective-permute" in txt
+    # and the single-device lowering must NOT reach for collectives
+    with scope_guard(scope):
+        txt1 = exe.lowered_hlo(main, feed=_feed(), fetch_list=[loss],
+                               scope=scope)
+    assert "collective-permute" not in txt1
+
+
+def test_pipeline_shape_contract_rejected(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+
+        def bad_stage(pb, xin):
+            w = pb.param([D, D * 2])
+            return fluid.layers.matmul(xin, w)  # D -> 2D: not allowed
+
+        with pytest.raises(ValueError, match="GPipe"):
+            fluid.layers.pipeline(x, n_stages=2, stage_fn=bad_stage)
+
+
+def test_pipeline_rejects_rng_stage_body(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+
+        def dropout_stage(pb, xin):
+            w = pb.param([D, D])
+            h = fluid.layers.matmul(xin, w)
+            return fluid.layers.dropout(h, dropout_prob=0.5)
+
+        with pytest.raises(ValueError, match="deterministic"):
+            fluid.layers.pipeline(x, n_stages=2, stage_fn=dropout_stage)
+
+
+def test_pipeline_stage_count_must_match_pipe_axis():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss = _build(n_stages=2)  # mesh pipe axis will be 4
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        mesh = make_mesh(jax.devices(), ("data", "pipe"), (2, 4))
+        eng = ParallelEngine(main, loss_name=loss.name, mesh=mesh)
+        with pytest.raises(Exception, match="one-per-device"):
+            eng.run(_feed(), [loss], scope)
+
+
+def test_pipeline_batch_divisibility():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss = _build(n_stages=4, n_microbatches=3)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        mesh = make_mesh(jax.devices(), ("data", "pipe"), (2, 4))
+        eng = ParallelEngine(main, loss_name=loss.name, mesh=mesh)
+        with pytest.raises(Exception, match="divisible"):
+            eng.run(_feed(batch=16), [loss], scope)  # 16 % 3 != 0
