@@ -36,19 +36,55 @@ def _python_embed_flags():
     return flags
 
 
+def pjrt_include_dir():
+    """Directory holding xla/pjrt/c/pjrt_c_api.h, or None. Checked in
+    order: PD_PJRT_INCLUDE env override, then the tensorflow wheel's
+    include tree (resolved by path, never imported)."""
+    import sysconfig
+
+    candidates = []
+    env = os.environ.get("PD_PJRT_INCLUDE")
+    if env:
+        candidates.append(env)
+    candidates.append(os.path.join(sysconfig.get_paths()["purelib"],
+                                   "tensorflow", "include"))
+    for inc in candidates:
+        if os.path.exists(os.path.join(inc, "xla", "pjrt", "c",
+                                       "pjrt_c_api.h")):
+            return inc
+    return None
+
+
+def _pjrt_flags():
+    """PJRT C API include + dl. No python flags: the whole point of
+    pjrt_serving is a libpython-free dependency closure."""
+    inc = pjrt_include_dir()
+    if inc is None:
+        raise RuntimeError(
+            "pjrt_c_api.h not found; install a tensorflow wheel or set "
+            "PD_PJRT_INCLUDE to an XLA include tree")
+    return ["-I" + inc, "-ldl"]
+
+
 _EXTRA_FLAGS = {"serving": _python_embed_flags,
-                "train": _python_embed_flags}
+                "train": _python_embed_flags,
+                "pjrt_serving": _pjrt_flags}
+
+# additional .cc files compiled into the named library
+_EXTRA_SOURCES = {"pjrt_serving": ["tensor_store.cc"]}
 
 
 def _build(name: str) -> str:
-    src = os.path.join(_DIR, name + ".cc")
+    srcs = [os.path.join(_DIR, name + ".cc")] + [
+        os.path.join(_DIR, s) for s in _EXTRA_SOURCES.get(name, ())]
     so = os.path.join(_DIR, "lib" + name + ".so")
     with _BUILD_LOCK:
         if (not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src)):
+                or os.path.getmtime(so) < max(os.path.getmtime(s)
+                                              for s in srcs)):
             extra = _EXTRA_FLAGS.get(name)
             cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                    "-pthread", src] + (extra() if extra else [])
+                    "-pthread"] + srcs + (extra() if extra else [])
                    + ["-o", so])
             subprocess.run(cmd, check=True, capture_output=True)
     return so

@@ -1,0 +1,182 @@
+"""Python-free serving: the AOT artifact + PJRT C-API loader.
+
+Closes VERDICT r3 task 8 (reference: the genuinely Python-free engine at
+paddle/fluid/inference/api/paddle_api.h:199). Three layers of proof:
+
+1. The artifact round-trips in Python: jax.export deserialization of the
+   saved buckets reproduces the live Predictor bit-for-bit.
+2. libpjrt_serving.so's dependency closure contains NO libpython, and a
+   gcc-compiled C driver (also libpython-free) completes the
+   GetPjrtApi version handshake against a stub PJRT plugin.
+3. The full pds_load/pds_run execute path needs a real PJRT plugin
+   backed by hardware — staged in tools/tpu_validate.py for the first
+   healthy TPU window (no CPU PJRT C-API plugin ships in this image).
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.scope import Scope, scope_guard
+
+from paddle_tpu.native import pjrt_include_dir
+
+TF_INC = pjrt_include_dir()  # same discovery the build itself uses
+
+
+def _save_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            pred = fluid.layers.fc(h, size=4, act="softmax")
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        mdl = str(tmp_path / "model")
+        fluid.io.save_inference_model(mdl, ["x"], [pred], exe,
+                                      main_program=main)
+    return mdl
+
+
+def test_artifact_roundtrip_matches_predictor(tmp_path):
+    from paddle_tpu.inference import AnalysisConfig, Predictor
+    from paddle_tpu.inference.export_serving import (
+        load_serving_artifact, save_serving_artifact)
+
+    mdl = _save_model(tmp_path)
+    art = str(tmp_path / "artifact")
+    save_serving_artifact(mdl, art, batch_sizes=(1, 4))
+
+    files = set(os.listdir(art))
+    assert {"manifest.json", "manifest.txt", "params.ptck",
+            "compile_options.pb", "bucket_1.shlo",
+            "bucket_4.shlo"} <= files
+
+    manifest, runners = load_serving_artifact(art)
+    assert manifest["platforms"] == ["cpu", "tpu"]
+    X = np.random.RandomState(0).rand(4, 8).astype("float32")
+    got = runners[4]({"x": X})[0]
+    ref = Predictor(AnalysisConfig(model_dir=mdl)).run({"x": X})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_c_manifest_is_fscanf_parseable(tmp_path):
+    from paddle_tpu.inference.export_serving import save_serving_artifact
+
+    mdl = _save_model(tmp_path)
+    art = str(tmp_path / "artifact")
+    save_serving_artifact(mdl, art, batch_sizes=(2,))
+    toks = open(os.path.join(art, "manifest.txt")).read().split()
+    assert toks[0] == "pds-manifest" and toks[1] == "1"
+    i = toks.index("platforms")
+    assert toks[i + 1] == "2" and toks[i + 2:i + 4] == ["cpu", "tpu"]
+    assert "bucket" in toks and "feeds" in toks and "outs" in toks
+
+
+STUB_PLUGIN = r"""
+// Minimal PJRT plugin: version handshake only (the ABI surface
+// pds_probe exercises). Execution needs a real backend.
+#include "xla/pjrt/c/pjrt_c_api.h"
+#include <cstring>
+static PJRT_Api api;
+extern "C" const PJRT_Api* GetPjrtApi() {
+  std::memset(&api, 0, sizeof(api));
+  api.struct_size = PJRT_Api_STRUCT_SIZE;
+  api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  return &api;
+}
+"""
+
+PROBE_DRIVER = r"""
+#include <stdio.h>
+extern int pds_probe(const char* plugin_path, int* major, int* minor);
+extern const char* pds_last_error(void);
+int main(int argc, char** argv) {
+  int major = -1, minor = -1;
+  if (pds_probe(argv[1], &major, &minor) != 0) {
+    fprintf(stderr, "probe: %s\n", pds_last_error());
+    return 2;
+  }
+  printf("pjrt api %d.%d\n", major, minor);
+  return 0;
+}
+"""
+
+
+@pytest.mark.skipif(TF_INC is None, reason="pjrt_c_api.h not found")
+def test_c_driver_probe_handshake_no_python(tmp_path):
+    from paddle_tpu.native import _build
+
+    lib = _build("pjrt_serving")
+
+    # the serving library itself must be libpython-free
+    ldd = subprocess.run(["ldd", lib], capture_output=True, text=True)
+    assert "python" not in ldd.stdout.lower(), ldd.stdout
+
+    stub_src = tmp_path / "stub_plugin.cc"
+    stub_src.write_text(STUB_PLUGIN)
+    stub = tmp_path / "libstub_pjrt.so"
+    subprocess.run(["g++", "-shared", "-fPIC", "-std=c++17",
+                    str(stub_src), "-I", TF_INC, "-o", str(stub)],
+                   check=True, capture_output=True)
+
+    drv_src = tmp_path / "driver.c"
+    drv_src.write_text(PROBE_DRIVER)
+    drv = tmp_path / "driver"
+    subprocess.run(["gcc", str(drv_src), lib,
+                    "-Wl,-rpath," + os.path.dirname(lib), "-o", str(drv)],
+                   check=True, capture_output=True)
+
+    # the whole driver process is Python-free
+    ldd = subprocess.run(["ldd", str(drv)], capture_output=True, text=True)
+    assert "python" not in ldd.stdout.lower(), ldd.stdout
+
+    out = subprocess.run([str(drv), str(stub)], capture_output=True,
+                         text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.startswith("pjrt api 0."), out.stdout
+
+
+@pytest.mark.skipif(not os.environ.get("PD_PJRT_PLUGIN"),
+                    reason="set PD_PJRT_PLUGIN=<plugin.so> to run the "
+                           "hardware execute path (see tools/tpu_validate)")
+def test_pds_load_and_run_on_real_plugin(tmp_path):
+    """Full execute path against a real PJRT plugin (TPU window only;
+    single-client tunnel: run alone)."""
+    import ctypes
+
+    from paddle_tpu.inference import AnalysisConfig, Predictor
+    from paddle_tpu.inference.export_serving import save_serving_artifact
+    from paddle_tpu.native import _build
+
+    mdl = _save_model(tmp_path)
+    art = str(tmp_path / "artifact")
+    save_serving_artifact(mdl, art, batch_sizes=(4,))
+    X = np.random.RandomState(0).rand(4, 8).astype("float32")
+    ref = Predictor(AnalysisConfig(model_dir=mdl)).run({"x": X})[0]
+
+    lib = ctypes.CDLL(_build("pjrt_serving"))
+    lib.pds_load.restype = ctypes.c_void_p
+    lib.pds_last_error.restype = ctypes.c_char_p
+    h = lib.pds_load(art.encode(), os.environ["PD_PJRT_PLUGIN"].encode())
+    assert h, lib.pds_last_error().decode()
+    in_ptrs = (ctypes.c_void_p * 1)(
+        X.ctypes.data_as(ctypes.c_void_p).value)
+    out_data = (ctypes.POINTER(ctypes.c_float) * 4)()
+    out_shapes = (ctypes.POINTER(ctypes.c_longlong) * 4)()
+    out_ndims = (ctypes.c_int * 4)()
+    n = lib.pds_run(ctypes.c_void_p(h), 4, in_ptrs, out_data, out_shapes,
+                    out_ndims, 4)
+    assert n == 1, lib.pds_last_error().decode()
+    shape = [out_shapes[0][d] for d in range(out_ndims[0])]
+    got = np.ctypeslib.as_array(
+        out_data[0], shape=(int(np.prod(shape)),)).reshape(shape)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    lib.pds_destroy(ctypes.c_void_p(h))
