@@ -23,7 +23,11 @@ of 1 is Mosaic-legal and verified on TPU v5e — never as rank-2 [B*H, S]
 with (1, bq) blocks (1 is neither 8-divisible nor equal to B*H).
 ``_assert_mosaic_ok`` re-implements that rule and gates every
 pallas_call here, including in interpret mode, so the CPU test suite
-fails on any spec real TPU lowering would reject.
+fails on any spec real TPU lowering would reject. Beyond the mirror,
+the REAL Mosaic lowering path runs in CI via TPU-target jax.export
+(tests/test_tpu_lowering.py): forward + both backward kernels lower to
+``tpu_custom_call`` on a CPU-only machine — only the Mosaic->LLO compile
+(VMEM limits) and execution remain hardware-gated.
 
 Ragged sequence lengths are padded to the block size with key-side
 additive masking (-1e9) rather than falling back to whole-sequence
