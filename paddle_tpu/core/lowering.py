@@ -27,7 +27,7 @@ class LowerContext:
 
     def __init__(self, block: Optional[Block] = None, rng: Optional[jax.Array] = None,
                  is_test: bool = False, amp: bool = False, mesh=None,
-                 data_axis: str = "data"):
+                 data_axis: str = "data", model_axis: str = "model"):
         self.block = block
         self._rng = rng
         self.is_test = is_test
@@ -36,6 +36,7 @@ class LowerContext:
         #                   ops with explicit-collective paths (pipeline,
         #                   moe) pick their shard_map axis from it
         self.data_axis = data_axis  # the engine's batch axis name
+        self.model_axis = model_axis  # the engine's tensor-parallel axis
         self.rng_used = False
 
     def next_rng(self) -> jax.Array:
@@ -54,7 +55,7 @@ class LowerContext:
 
     def sub(self, block: Block) -> "LowerContext":
         c = LowerContext(block, self._rng, self.is_test, self.amp, self.mesh,
-                         self.data_axis)
+                         self.data_axis, self.model_axis)
         return c
 
     def pure(self) -> "LowerContext":
@@ -62,7 +63,7 @@ class LowerContext:
         Keeps the mesh: the re-trace must pick the same (shard_map vs
         sequential) path as the forward emission or XLA cannot CSE them."""
         return LowerContext(self.block, None, self.is_test, self.amp,
-                            self.mesh, self.data_axis)
+                            self.mesh, self.data_axis, self.model_axis)
 
 
 def lower_op(ctx: LowerContext, op, env: Dict[str, Any]) -> None:

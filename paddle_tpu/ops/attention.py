@@ -641,6 +641,61 @@ def flash_attention(q, k, v, bias=None, scale=1.0, bias_grad=False):
     return _fa_maskbias(q, k, v, jax.lax.stop_gradient(bias), scale)
 
 
+def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale):
+    """Mosaic kernels cannot be auto-partitioned by the SPMD partitioner
+    (jax raises at multi-device lowering), so under a ParallelEngine mesh
+    the op-level flash call wraps itself in shard_map: batch shards over
+    the engine's data axis, heads over the 'model' axis (when they
+    divide), everything else replicated inside. A 'seq'-sharded activation
+    is all-gathered at the shard_map boundary — correct but memory-heavy;
+    the sp-native long-context path is ring_attention, which brings its
+    own shard_map. CPU interpret mode lowers to plain jax ops
+    (partitionable), so the wrap only engages on the compiled path —
+    pinned by tests/test_tpu_lowering.py::test_dp_tp_train_step_lowers_for_tpu,
+    which fails with NotImplementedError without it."""
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is None or mesh.size <= 1 or _use_interpret():
+        return flash_attention(q, k, v, bias, scale)
+    if _in_manual_mesh():
+        # already inside a shard_map region (e.g. a pipeline stage body):
+        # Mosaic-in-manual-mesh is the supported pattern, and nesting
+        # another shard_map over the same mesh is a trace error
+        return flash_attention(q, k, v, bias, scale)
+    from jax.sharding import PartitionSpec as P
+
+    B, H = q.shape[0], q.shape[1]
+    d_ax = getattr(ctx, "data_axis", "data")
+    m_ax = getattr(ctx, "model_axis", "model")
+    b_ax = d_ax if (d_ax in mesh.axis_names and mesh.shape[d_ax] > 1
+                    and B % mesh.shape[d_ax] == 0) else None
+    h_ax = m_ax if (m_ax in mesh.axis_names
+                    and mesh.shape[m_ax] > 1
+                    and H % mesh.shape[m_ax] == 0) else None
+    qs = P(b_ax, h_ax)
+    if bias is None:
+        fn = jax.shard_map(
+            lambda a, b, c: flash_attention(a, b, c, None, scale),
+            mesh=mesh, in_specs=(qs, qs, qs), out_specs=qs)
+        return fn(q, k, v)
+    bspec = P(b_ax if bias.shape[0] != 1 else None,
+              h_ax if bias.shape[1] != 1 else None)
+    fn = jax.shard_map(
+        lambda a, b, c, d: flash_attention(a, b, c, d, scale),
+        mesh=mesh, in_specs=(qs, qs, qs, bspec), out_specs=qs)
+    return fn(q, k, v, bias)
+
+
+def _in_manual_mesh() -> bool:
+    """True when tracing inside a shard_map region (some mesh axis is
+    already Manual) — nesting another shard_map there is a trace error."""
+    try:
+        cur = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return False
+    return cur is not None and any(
+        "Manual" in str(t) for t in getattr(cur, "axis_types", ()))
+
+
 @register_op("fused_attention", diff_inputs=["Q", "K", "V"], uses_rng=True)
 def _fused_attention(ctx, ins, attrs):
     q = ins["Q"][0]
@@ -651,7 +706,7 @@ def _fused_attention(ctx, ins, attrs):
     dropout = attrs.get("dropout", 0.0)
     if bias is not None:
         bias = bias.astype(jnp.float32)  # mask bias adds in f32 in-kernel
-    out = flash_attention(q, k, v, bias, scale)
+    out = _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale)
     if dropout and not ctx.is_test:
         # dropout on the *output* (weights-dropout does not commute with the
         # fused kernel; divergence from the layer-composed path documented).
@@ -677,6 +732,7 @@ def _fused_attention_grad(ctx, ins, attrs):
         bias = bias.astype(jnp.float32)
     scale = attrs.get("scale", 1.0)
     _, vjp = jax.vjp(
-        lambda a, b, c: flash_attention(a, b, c, bias, scale), q, k, v)
+        lambda a, b, c: _maybe_shard_mapped_flash(ctx, a, b, c, bias,
+                                                  scale), q, k, v)
     dq, dk, dv = vjp(g.astype(q.dtype))
     return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}

@@ -197,7 +197,8 @@ class ParallelEngine:
         (feed_names, fetch_names, const_state, mut_state, pure_written,
          needs_rng, step) = analyze_block(
             self.program, sorted(feed_vals), fetch_names, scope,
-            mesh=self.mesh, data_axis=self.rules.data_axis)
+            mesh=self.mesh, data_axis=self.rules.data_axis,
+            model_axis=getattr(self.rules, "model_axis", "model"))
 
         mesh = self.mesh
         repl = NamedSharding(mesh, P())

@@ -22,7 +22,8 @@ __all__ = ["ShardingRules", "P"]
 class ShardingRules:
     def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None,
                  data_axis: str = "data",
-                 feed_rules: Optional[Sequence[Tuple[str, P]]] = None):
+                 feed_rules: Optional[Sequence[Tuple[str, P]]] = None,
+                 model_axis: str = "model"):
         self.rules: List[Tuple[re.Pattern, P]] = [
             (re.compile(pat), spec) for pat, spec in (rules or [])
         ]
@@ -32,6 +33,9 @@ class ShardingRules:
             (re.compile(pat), spec) for pat, spec in (feed_rules or [])
         ]
         self.data_axis = data_axis
+        # the tensor-parallel axis name: ops that shard_map kernels
+        # (fused attention) shard heads over it when it divides
+        self.model_axis = model_axis
 
     def add(self, pattern: str, spec: P) -> "ShardingRules":
         self.rules.append((re.compile(pattern), spec))
