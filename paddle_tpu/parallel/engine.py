@@ -133,30 +133,7 @@ class ParallelEngine:
         return plan.hlo_text[stage]
 
     def _with_ext_rules(self) -> ShardingRules:
-        """User rules + automatic stage/expert sharding: parameters the
-        `layers.pipeline` / `layers.moe_ffn` layers created stacked are
-        sharded over the 'pipe' / 'expert' mesh axis (leading dim), and —
-        via prefix match — so are their optimizer accumulator slots
-        (named '<param>_<slot>'; slots whose shape the axis doesn't
-        divide, like beta-pow scalars, fall back to replicated inside
-        spec_for). User rules are matched first, so an explicit rule for
-        a stacked param wins."""
-        import re as _re
-
-        ext = []
-        for attr, axis in (("_pipeline_params", "pipe"),
-                           ("_expert_params", "expert")):
-            if axis not in self.mesh.axis_names:
-                continue
-            for pname in getattr(self.program, attr, ()):
-                ext.append(("^" + _re.escape(pname), P(axis)))
-        if not ext:
-            return self.rules
-        merged = ShardingRules(data_axis=self.rules.data_axis)
-        merged.rules = list(self.rules.rules) + [
-            (_re.compile(pat), spec) for pat, spec in ext]
-        merged.feed_rules = list(self.rules.feed_rules)
-        return merged
+        return merged_ext_rules(self.program, self.mesh, self.rules)
 
     def _gather(self, feed, fetch_list, scope):
         """Shared run()/lowered_hlo() plumbing: feed conversion, plan
@@ -232,6 +209,35 @@ class ParallelEngine:
         return _ParallelPlan(feed_names, fetch_names, const_state, mut_state,
                              pure_written, needs_rng, fn,
                              feed_shardings, state_shardings)
+
+
+def merged_ext_rules(program, mesh, rules: ShardingRules) -> ShardingRules:
+    """User rules + automatic stage/expert sharding: parameters the
+    `layers.pipeline` / `layers.moe_ffn` layers created stacked are
+    sharded over the 'pipe' / 'expert' mesh axis (leading dim), and —
+    via prefix match — so are their optimizer accumulator slots (named
+    '<param>_<slot>'; slots whose shape the axis doesn't divide, like
+    beta-pow scalars, fall back to replicated inside spec_for). User
+    rules are matched first, so an explicit rule for a stacked param
+    wins. Module-level so the TPU-lowering tests shard state exactly
+    the way the engine compiles it (works with AbstractMesh too)."""
+    import re as _re
+
+    ext = []
+    for attr, axis in (("_pipeline_params", "pipe"),
+                       ("_expert_params", "expert")):
+        if axis not in mesh.axis_names:
+            continue
+        for pname in getattr(program, attr, ()):
+            ext.append(("^" + _re.escape(pname), P(axis)))
+    if not ext:
+        return rules
+    merged = ShardingRules(data_axis=rules.data_axis,
+                           model_axis=getattr(rules, "model_axis", "model"))
+    merged.rules = list(rules.rules) + [
+        (_re.compile(pat), spec) for pat, spec in ext]
+    merged.feed_rules = list(rules.feed_rules)
+    return merged
 
 
 def _require(scope, name):

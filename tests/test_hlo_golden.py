@@ -225,3 +225,37 @@ def test_grad_accum_lowers_to_exactly_one_scan():
     with scope_guard(scope2):
         txt2 = _train_step_hlo(scope2, stage="stablehlo")
     assert len(re.findall(r"stablehlo\.while", txt2)) == 0
+
+
+# ---------------------------------------------------------------- precision
+
+def test_amp_step_runs_dots_in_bf16():
+    """main.set_amp(True) must put the matmuls on the bf16 path — the
+    MXU-rate contract. If the AMP policy silently stops applying, dots
+    revert to f32 and throughput halves without any numeric failure.
+    Checked on the pre-XLA lowering; the non-AMP control proves the scan
+    detects the difference."""
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss = _build_mlp()
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        main.set_amp(True)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        txt = exe.lowered_hlo(main, feed=_feed(), fetch_list=[loss],
+                              scope=scope, stage="stablehlo")
+    dots = [l for l in txt.splitlines() if "dot_general" in l]
+    bf16_dots = [l for l in dots if "bf16" in l]
+    assert bf16_dots, "AMP step emitted no bf16 dot_general"
+    # ALL matmuls must take the bf16 path — a partial AMP regression
+    # (backward dots reverting to f32) halves MXU throughput silently
+    f32_dots = [l for l in dots if "bf16" not in l]
+    assert not f32_dots, f32_dots[:3]
+
+    scope2 = Scope()
+    with scope_guard(scope2):
+        txt2 = _train_step_hlo(scope2, stage="stablehlo")
+    assert not [l for l in txt2.splitlines()
+                if "dot_general" in l and "bf16" in l]

@@ -166,17 +166,67 @@ def test_ring_flash_attention_lowers_for_tpu_sharded(monkeypatch):
     assert "collective_permute" in txt       # the ring hop
 
 
+def _export_sharded_step(main, scope, feed, loss_name, mesh, rules,
+                         flash_compiled=False):
+    """Shared scaffold: analyze the program under `mesh` (exactly as
+    ParallelEngine._prepare does, including the automatic pipe/expert
+    ext rules with their optimizer-slot prefix sharding), then
+    jax.export the full train step for TPU with the production
+    shardings. Returns the Exported."""
+    import os
+
+    from jax.sharding import NamedSharding
+
+    from paddle_tpu.core.executor import analyze_block
+    from paddle_tpu.parallel.engine import merged_ext_rules
+
+    (feed_names, fetch_names, const_state, mut_state, pure_written,
+     needs_rng, step) = analyze_block(
+        main, sorted(feed), [loss_name], scope, mesh=mesh,
+        data_axis=rules.data_axis)
+    rules = merged_ext_rules(main, mesh, rules)
+    params = {n: np.asarray(scope.find_var(n))
+              for n in const_state + mut_state}
+    rng = jax.random.PRNGKey(0)
+
+    def fn(feeds, const_vals, mut_vals):
+        fetches, new_mut, _, _ = step(feeds, const_vals, mut_vals, rng)
+        return fetches[0], new_mut
+
+    in_sh = (
+        [NamedSharding(mesh, rules.feed_spec(feed[n].shape, mesh, name=n))
+         for n in feed_names],
+        [NamedSharding(mesh, rules.spec_for(n, params[n].shape, mesh))
+         for n in const_state],
+        [NamedSharding(mesh, rules.spec_for(n, params[n].shape, mesh))
+         for n in mut_state],
+    )
+    abstract = tuple(
+        [jax.ShapeDtypeStruct(params.get(n, feed.get(n)).shape,
+                              params.get(n, feed.get(n)).dtype,
+                              sharding=sh)
+         for n, sh in zip(names, shs)]
+        for names, shs in ((feed_names, in_sh[0]),
+                           (const_state, in_sh[1]),
+                           (mut_state, in_sh[2])))
+    if flash_compiled:
+        os.environ["PADDLE_TPU_FLASH_INTERPRET"] = "0"
+    try:
+        return jax.export.export(
+            jax.jit(fn, in_shardings=in_sh), platforms=["tpu"])(*abstract)
+    finally:
+        if flash_compiled:
+            os.environ.pop("PADDLE_TPU_FLASH_INTERPRET", None)
+
+
 def test_dp_tp_train_step_lowers_for_tpu():
     """The dp x tp sharded train step (megatron rules, fused attention,
     Adam) lowers for an 8-device TPU mesh from a CPU-only machine — the
     multi-chip analog of test_transformer_fused_train_step_lowers_for_tpu
     and the CI twin of the driver's dryrun, but against the REAL TPU
     lowering rules."""
-    import os
+    from jax.sharding import AbstractMesh, PartitionSpec as P
 
-    from jax.sharding import AbstractMesh, NamedSharding, PartitionSpec as P
-
-    from paddle_tpu.core.executor import analyze_block
     from paddle_tpu.models import transformer
     from paddle_tpu.parallel.sharding import ShardingRules
 
@@ -196,12 +246,6 @@ def test_dp_tp_train_step_lowers_for_tpu():
         feed = {n: rs.randint(1, 128, (8, 32)).astype("int32")
                 for n in ("src_ids", "trg_ids", "lbl_ids")}
         mesh = AbstractMesh((4, 2), ("data", "model"))
-        # mesh threaded exactly as ParallelEngine._prepare does: the
-        # fused-attention lowering needs it to shard_map the Mosaic
-        # kernel (auto-partitioning Mosaic calls is a lowering error)
-        (feed_names, fetch_names, const_state, mut_state, pure_written,
-         needs_rng, step) = analyze_block(
-            main, sorted(feed), [loss.name], scope, mesh=mesh)
         rules = ShardingRules([
             (r"_(q|k|v)\.w_0$", P(None, "model")),
             (r"_ffn1\.w_0$", P(None, "model")),
@@ -209,41 +253,8 @@ def test_dp_tp_train_step_lowers_for_tpu():
             (r"word_emb", P("model", None)),
             (r"out_proj\.w_0$", P(None, "model")),
         ])
-
-        def shard_of(name, shape):
-            return NamedSharding(mesh, rules.spec_for(name, shape, mesh))
-
-        params = {n: np.asarray(scope.find_var(n))
-                  for n in const_state + mut_state}
-        rng = jax.random.PRNGKey(0)
-
-        def fn(feeds, const_vals, mut_vals):
-            fetches, new_mut, _, _ = step(feeds, const_vals, mut_vals, rng)
-            return fetches[0], new_mut
-
-        feed_shard = NamedSharding(mesh, P("data"))
-        in_shardings = (
-            [feed_shard for _ in feed_names],
-            [shard_of(n, params[n].shape) for n in const_state],
-            [shard_of(n, params[n].shape) for n in mut_state],
-        )
-        abstract = (
-            [jax.ShapeDtypeStruct(feed[n].shape, feed[n].dtype,
-                                  sharding=feed_shard) for n in feed_names],
-            [jax.ShapeDtypeStruct(params[n].shape, params[n].dtype,
-                                  sharding=in_shardings[1][i])
-             for i, n in enumerate(const_state)],
-            [jax.ShapeDtypeStruct(params[n].shape, params[n].dtype,
-                                  sharding=in_shardings[2][i])
-             for i, n in enumerate(mut_state)],
-        )
-        os.environ["PADDLE_TPU_FLASH_INTERPRET"] = "0"
-        try:
-            exp = jax.export.export(
-                jax.jit(fn, in_shardings=in_shardings),
-                platforms=["tpu"])(*abstract)
-        finally:
-            os.environ.pop("PADDLE_TPU_FLASH_INTERPRET", None)
+        exp = _export_sharded_step(main, scope, feed, loss.name, mesh,
+                                   rules, flash_compiled=True)
     assert exp.nr_devices == 8
     assert "tpu_custom_call" in exp.mlir_module()
 
@@ -285,3 +296,75 @@ def test_flash_wrap_skips_inside_manual_mesh(monkeypatch):
         jax.jit(outer, in_shardings=(spec,) * 3), platforms=["tpu"])(*args)
     assert seen == [True]
     assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_pipeline_step_lowers_for_tpu():
+    """layers.pipeline under a (data, pipe) mesh: the GPipe schedule
+    (ppermute hops between stage devices) lowers for TPU, with the
+    stacked stage params (and their Adam slots, via the production
+    prefix rules) sharded on the pipe axis."""
+    from jax.sharding import AbstractMesh
+
+    D = 16
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+
+            def stage(pb, xin):
+                w = pb.param([D, D])
+                b = pb.param([D], is_bias=True)
+                h = fluid.layers.elementwise_add(
+                    fluid.layers.matmul(xin, w), b)
+                return fluid.layers.relu(h)
+
+            h = fluid.layers.pipeline(x, n_stages=4, stage_fn=stage)
+            pred = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+
+        mesh = AbstractMesh((2, 4), ("data", "pipe"))
+        feed = {"x": np.zeros((8, D), "float32"),
+                "y": np.zeros((8, 1), "float32")}
+        from paddle_tpu.parallel.sharding import ShardingRules
+
+        exp = _export_sharded_step(main, scope, feed, loss.name, mesh,
+                                   ShardingRules())
+    assert exp.nr_devices == 8
+    assert "collective_permute" in exp.mlir_module()
+
+
+def test_moe_step_lowers_for_tpu():
+    """layers.moe_ffn under an (expert,) mesh: the expert all_gather
+    path lowers for TPU with production expert-axis sharding."""
+    from jax.sharding import AbstractMesh
+
+    D = 16
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h, aux = fluid.layers.moe_ffn(x, n_experts=8, d_hidden=32)
+            pred = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.elementwise_add(
+                fluid.layers.mean(fluid.layers.square(pred - y)),
+                fluid.layers.scale(aux, scale=0.01))
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+
+        mesh = AbstractMesh((8,), ("expert",))
+        feed = {"x": np.zeros((16, D), "float32"),
+                "y": np.zeros((16, 1), "float32")}
+        from paddle_tpu.parallel.sharding import ShardingRules
+
+        exp = _export_sharded_step(main, scope, feed, loss.name, mesh,
+                                   ShardingRules())
+    assert exp.nr_devices == 8
+    assert "all_gather" in exp.mlir_module()
