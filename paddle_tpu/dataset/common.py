@@ -18,13 +18,20 @@ import pickle
 import shutil
 from typing import Callable, List
 
-__all__ = ["DATA_HOME", "md5file", "must_mkdirs", "download", "split",
-           "cluster_files_reader"]
+__all__ = ["DATA_HOME", "data_home", "md5file", "must_mkdirs", "download",
+           "split", "cluster_files_reader"]
 
 DATA_HOME = os.environ.get(
     "PADDLE_TPU_DATA_HOME",
     os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
                  "dataset"))
+
+
+def data_home() -> str:
+    """The data root, honoring PADDLE_TPU_DATA_HOME set AFTER import
+    (tests, notebooks); falls back to the cached default. Loaders use
+    this, not the import-time DATA_HOME constant."""
+    return os.environ.get("PADDLE_TPU_DATA_HOME", DATA_HOME)
 
 
 def must_mkdirs(path: str) -> None:
@@ -45,7 +52,7 @@ def download(url: str, module_name: str, md5sum: str,
     file:// URLs copy from the local filesystem; a cache hit with the
     right md5 is served as-is; anything needing network raises (this
     environment has no egress — see the module docstring)."""
-    dirname = os.path.join(DATA_HOME, module_name)
+    dirname = os.path.join(data_home(), module_name)
     must_mkdirs(dirname)
     filename = os.path.join(
         dirname, save_name or url.split("/")[-1])

@@ -1,7 +1,15 @@
 """IMDB-schema dataset (reference: python/paddle/dataset/imdb.py).
-Samples: (word-id sequence, 0/1 label). Synthetic sentiment-by-lexicon."""
+Samples: (word-id sequence, 0/1 label). Synthetic sentiment-by-lexicon
+by default; point PADDLE_TPU_DATA_HOME/imdb/aclImdb.tar.gz at the real
+archive (the reference's layout: aclImdb/{train,test}/{pos,neg}/*.txt,
+imdb.py:36 tokenize) — the parse path is CI-tested against a fixture
+archive in tests/test_dataset_real_parse.py."""
 
 from __future__ import annotations
+
+import os
+import re
+import tarfile
 
 import numpy as np
 
@@ -10,8 +18,83 @@ __all__ = ["train", "test", "word_dict"]
 VOCAB = 5148  # reference vocab size ballpark
 
 
+def _archive():
+    from .common import data_home
+
+    path = os.path.join(data_home(), "imdb", "aclImdb.tar.gz")
+    return path if os.path.exists(path) else None
+
+
+def _tokenize(text: str):
+    # reference imdb.py tokenize(): lowercase, strip punctuation, split
+    return re.sub(r"[^a-z0-9\s]", "", text.lower()).split()
+
+
+_DICT_CACHE = {}  # (path, mtime) -> word dict
+
+
+def _build_word_dict(path):
+    """Frequency-ranked vocabulary over the train split (reference
+    build_dict), byte keys for API parity, b'<unk>' appended at
+    len(words) exactly as the reference does — OOV ids stay inside an
+    embedding table sized by len(word_dict()). Cached per archive
+    (building it decompresses and tokenizes the whole train split)."""
+    key = (path, os.path.getmtime(path))
+    if key in _DICT_CACHE:
+        return _DICT_CACHE[key]
+    freq = {}
+    pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+    with tarfile.open(path, "r:gz") as tf:
+        for member in tf.getmembers():
+            if not pat.search(member.name):
+                continue
+            text = tf.extractfile(member).read().decode("utf-8", "replace")
+            for w in _tokenize(text):
+                freq[w] = freq.get(w, 0) + 1
+    ranked = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+    d = {w.encode(): i for i, (w, _) in enumerate(ranked)}
+    d[b"<unk>"] = len(d)
+    _DICT_CACHE.clear()
+    _DICT_CACHE[key] = d
+    return d
+
+
 def word_dict():
+    arch = _archive()
+    if arch:
+        return _build_word_dict(arch)
     return {("w%d" % i).encode(): i for i in range(VOCAB)}
+
+
+def _archive_reader(path, split, word_idx, n):
+    unk = word_idx.get(b"<unk>", len(word_idx) - 1)
+
+    def reader():
+        count = 0
+        pat = re.compile(r"aclImdb/%s/(pos|neg)/.*\.txt$" % split)
+        with tarfile.open(path, "r:gz") as tf:
+            # tar members group by directory (all neg/ then all pos/):
+            # interleave the classes so a truncated read (n < corpus)
+            # still sees a balanced label distribution
+            pos, neg = [], []
+            for member in tf.getmembers():
+                m = pat.search(member.name)
+                if m is None:
+                    continue
+                (pos if m.group(1) == "pos" else neg).append(member)
+            order = [m for pair in zip(pos, neg) for m in pair]
+            order += pos[len(neg):] + neg[len(pos):]
+            for member in order:
+                if n is not None and count >= n:
+                    return
+                text = tf.extractfile(member).read().decode(
+                    "utf-8", "replace")
+                ids = [word_idx.get(w.encode(), unk)
+                       for w in _tokenize(text)]
+                yield ids, 1 if "/pos/" in member.name else 0
+                count += 1
+
+    return reader
 
 
 def _reader(n, seed):
@@ -32,9 +115,17 @@ def _reader(n, seed):
     return reader
 
 
-def train(word_idx=None, n=4096):
-    return _reader(n, seed=3)
+def train(word_idx=None, n=None):
+    """n=None reads the whole corpus on the archive path (synthetic
+    surrogate defaults to 4096 samples)."""
+    arch = _archive()
+    if arch:
+        return _archive_reader(arch, "train", word_idx or word_dict(), n)
+    return _reader(n or 4096, seed=3)
 
 
-def test(word_idx=None, n=512):
-    return _reader(n, seed=4)
+def test(word_idx=None, n=None):
+    arch = _archive()
+    if arch:
+        return _archive_reader(arch, "test", word_idx or word_dict(), n)
+    return _reader(n or 512, seed=4)

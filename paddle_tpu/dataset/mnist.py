@@ -38,9 +38,9 @@ _TEMPLATES = None
 
 
 def _real_path(split):
-    home = os.environ.get("PADDLE_TPU_DATA_HOME")
-    if not home:
-        return None
+    from .common import data_home
+
+    home = data_home()
     name = {"train": "train", "test": "t10k"}[split]
     img = os.path.join(home, "mnist", "%s-images-idx3-ubyte" % name)
     lbl = os.path.join(home, "mnist", "%s-labels-idx1-ubyte" % name)
@@ -51,6 +51,8 @@ def _real_path(split):
 
 def _reader(split, n, seed):
     real = _real_path(split)
+    if real is None:
+        n = n or (8192 if split == "train" else 1024)
     if real:
         img_path, lbl_path = real
 
@@ -61,7 +63,8 @@ def _reader(split, n, seed):
             with open(lbl_path, "rb") as f:
                 f.read(8)
                 lbls = np.frombuffer(f.read(), np.uint8)
-            for i in range(min(n, len(lbls))):
+            stop = len(lbls) if n is None else min(n, len(lbls))
+            for i in range(stop):
                 yield imgs[i].astype("float32") / 127.5 - 1.0, int(lbls[i])
 
         return real_reader
@@ -79,9 +82,11 @@ def _reader(split, n, seed):
     return synth_reader
 
 
-def train(n=8192):
+def train(n=None):
+    """n=None reads the whole corpus on the real-data path (synthetic
+    surrogate defaults to 8192 samples)."""
     return _reader("train", n, seed=42)
 
 
-def test(n=1024):
+def test(n=None):
     return _reader("test", n, seed=7)

@@ -28,10 +28,9 @@ _MAX_LEN = 80
 
 
 def _data_dir():
-    home = os.environ.get("PADDLE_TPU_DATA_HOME")
-    if not home:
-        return None
-    d = os.path.join(home, "wmt14")
+    from .common import data_home
+
+    d = os.path.join(data_home(), "wmt14")
     return d if os.path.isdir(d) else None
 
 

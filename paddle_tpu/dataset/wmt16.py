@@ -21,10 +21,9 @@ _UNK_IDX = 2
 
 
 def _data_dir():
-    home = os.environ.get("PADDLE_TPU_DATA_HOME")
-    if not home:
-        return None
-    d = os.path.join(home, "wmt16")
+    from .common import data_home
+
+    d = os.path.join(data_home(), "wmt16")
     return d if os.path.isdir(d) else None
 
 
