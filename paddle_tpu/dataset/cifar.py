@@ -59,7 +59,8 @@ def _reader(num_classes, n, seed, split):
     arch = _archive(num_classes)
     if arch:
         return _archive_reader(arch, num_classes, split, n)
-    n = n or (4096 if split == "train" else 512)
+    if n is None:
+        n = 4096 if split == "train" else 512
 
     def reader():
         if num_classes not in _T:

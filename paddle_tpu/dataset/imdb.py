@@ -67,32 +67,40 @@ def word_dict():
 
 
 def _archive_reader(path, split, word_idx, n):
-    unk = word_idx.get(b"<unk>", len(word_idx) - 1)
+    if b"<unk>" not in word_idx:
+        raise ValueError(
+            "word_idx must contain b'<unk>' (imdb.word_dict() provides "
+            "it); silently aliasing OOV words onto a real id would "
+            "corrupt training")
+    unk = word_idx[b"<unk>"]
 
     def reader():
-        count = 0
+        # Read texts in MEMBER order (gzip streams have no random
+        # access: seeking backward re-decompresses from byte 0, so an
+        # interleaved extractfile() order would be quadratic), then
+        # interleave the decoded samples: tar members group by directory
+        # (all neg/ then all pos/), and a truncated read (n < corpus)
+        # must still see a balanced label distribution.
         pat = re.compile(r"aclImdb/%s/(pos|neg)/.*\.txt$" % split)
+        pos, neg = [], []
         with tarfile.open(path, "r:gz") as tf:
-            # tar members group by directory (all neg/ then all pos/):
-            # interleave the classes so a truncated read (n < corpus)
-            # still sees a balanced label distribution
-            pos, neg = [], []
             for member in tf.getmembers():
                 m = pat.search(member.name)
                 if m is None:
                     continue
-                (pos if m.group(1) == "pos" else neg).append(member)
-            order = [m for pair in zip(pos, neg) for m in pair]
-            order += pos[len(neg):] + neg[len(pos):]
-            for member in order:
-                if n is not None and count >= n:
-                    return
                 text = tf.extractfile(member).read().decode(
                     "utf-8", "replace")
                 ids = [word_idx.get(w.encode(), unk)
                        for w in _tokenize(text)]
-                yield ids, 1 if "/pos/" in member.name else 0
-                count += 1
+                (pos if m.group(1) == "pos" else neg).append(ids)
+        order = [s for pair in zip(pos, neg)
+                 for s in ((pair[0], 1), (pair[1], 0))]
+        order += [(s, 1) for s in pos[len(neg):]]
+        order += [(s, 0) for s in neg[len(pos):]]
+        for count, sample in enumerate(order):
+            if n is not None and count >= n:
+                return
+            yield sample
 
     return reader
 
@@ -121,11 +129,11 @@ def train(word_idx=None, n=None):
     arch = _archive()
     if arch:
         return _archive_reader(arch, "train", word_idx or word_dict(), n)
-    return _reader(n or 4096, seed=3)
+    return _reader(4096 if n is None else n, seed=3)
 
 
 def test(word_idx=None, n=None):
     arch = _archive()
     if arch:
         return _archive_reader(arch, "test", word_idx or word_dict(), n)
-    return _reader(n or 512, seed=4)
+    return _reader(512 if n is None else n, seed=4)

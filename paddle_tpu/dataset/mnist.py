@@ -52,7 +52,8 @@ def _real_path(split):
 def _reader(split, n, seed):
     real = _real_path(split)
     if real is None:
-        n = n or (8192 if split == "train" else 1024)
+        if n is None:
+            n = 8192 if split == "train" else 1024
     if real:
         img_path, lbl_path = real
 
