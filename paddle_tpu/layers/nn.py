@@ -1267,10 +1267,14 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None
     return out
 
 
-def fused_attention(q, k, v, bias=None, scale=1.0, dropout=0.0, name=None):
+def fused_attention(q, k, v, bias=None, scale=1.0, dropout=0.0,
+                    causal=False, name=None):
     """Single-kernel scaled-dot-product attention over [B,H,S,D] tensors
     (Pallas flash kernel; see ops/attention.py). The reference composes
-    this from matmul+softmax layer calls — SURVEY §5."""
+    this from matmul+softmax layer calls — SURVEY §5. ``causal=True``
+    applies the lower-triangular mask in-kernel and SKIPS above-diagonal
+    key blocks (~2x decoder-self-attention FLOPs at long S) — pass it
+    instead of materializing a [S,S] causal bias."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     mask = helper.create_variable_for_type_inference(q.dtype)
@@ -1280,7 +1284,8 @@ def fused_attention(q, k, v, bias=None, scale=1.0, dropout=0.0, name=None):
         inputs["Bias"] = [bias]
     helper.append_op(type="fused_attention", inputs=inputs,
                      outputs={"Out": [out], "Mask": [mask]},
-                     attrs={"scale": float(scale), "dropout": float(dropout)})
+                     attrs={"scale": float(scale), "dropout": float(dropout),
+                            "causal": bool(causal)})
     out.shape = q.shape
     return out
 

@@ -63,7 +63,11 @@ def _split_heads(x, seq_len, n_head, d_head):
 
 
 def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
-                         is_test, name, use_fused_attention=False):
+                         is_test, name, use_fused_attention=False,
+                         causal=False):
+    """causal=True only affects the fused path (in-kernel triangular
+    mask + above-diagonal block skipping); the composed path expects the
+    causal mask folded into `bias` as before."""
     d_head = d_model // n_head
     seq_q = q_in.shape[1]
     seq_kv = kv_in.shape[1]
@@ -78,7 +82,8 @@ def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
     v = _split_heads(v, seq_kv, n_head, d_head)
     if use_fused_attention:
         ctxv = layers.fused_attention(q, k, v, bias, scale=d_head ** -0.5,
-                                      dropout=dropout if not is_test else 0.0)
+                                      dropout=dropout if not is_test else 0.0,
+                                      causal=causal)
     else:
         scores = layers.matmul(q, k, transpose_y=True, alpha=d_head ** -0.5)
         if bias is not None:
@@ -129,13 +134,18 @@ def encoder(src_emb, self_bias, cfg, is_test=False, use_fused_attention=False,
 
 
 def decoder(trg_emb, enc_out, self_bias, cross_bias, cfg, is_test=False,
-            use_fused_attention=False, checkpoints=None):
+            use_fused_attention=False, checkpoints=None,
+            self_causal=False):
+    """self_causal=True: the fused kernel applies the causal mask itself
+    (self_bias then carries only the pad mask) and skips above-diagonal
+    blocks — build() picks this automatically on the fused path."""
     x = trg_emb
     for i in range(cfg["n_layer"]):
         nm = "dec_%d" % i
         x = _prenorm(x, lambda h, nm=nm: multi_head_attention(
             h, h, self_bias, cfg["d_model"], cfg["n_head"], cfg["dropout"],
-            is_test, nm + "_satt", use_fused_attention),
+            is_test, nm + "_satt", use_fused_attention,
+            causal=self_causal),
             cfg["dropout"], is_test, nm + "_pre1")
         x = _prenorm(x, lambda h, nm=nm: multi_head_attention(
             h, enc_out, cross_bias, cfg["d_model"], cfg["n_head"],
@@ -185,7 +195,14 @@ def build(cfg=None, seq_len=64, is_test=False, label_smooth_eps=0.1,
     lbl = layers.data("lbl_ids", [seq_len], dtype="int64")
 
     src_bias = _pad_bias(src)
-    trg_bias = layers.elementwise_add(_pad_bias(trg), _causal_bias(seq_len))
+    if use_fused_attention:
+        # the flash kernel applies causality in-kernel and skips the
+        # above-diagonal key blocks — only the pad mask rides as a bias
+        trg_bias, trg_causal = _pad_bias(trg), True
+    else:
+        trg_bias = layers.elementwise_add(_pad_bias(trg),
+                                          _causal_bias(seq_len))
+        trg_causal = False
 
     src_emb = _embed(src, cfg["src_vocab"], cfg["d_model"], cfg["max_length"],
                      cfg["dropout"], is_test, "src")
@@ -195,7 +212,8 @@ def build(cfg=None, seq_len=64, is_test=False, label_smooth_eps=0.1,
     enc_out = encoder(src_emb, src_bias, cfg, is_test, use_fused_attention,
                       checkpoints=checkpoints)
     dec_out = decoder(trg_emb, enc_out, trg_bias, src_bias, cfg, is_test,
-                      use_fused_attention, checkpoints=checkpoints)
+                      use_fused_attention, checkpoints=checkpoints,
+                      self_causal=trg_causal)
 
     logits = layers.fc(dec_out, cfg["trg_vocab"], num_flatten_dims=2,
                        bias_attr=False,

@@ -226,9 +226,27 @@ def _bias_spec_and_operand(bias, H, bq, bk, iq_pos, ik_pos):
     return pl.BlockSpec((1, 1, blk_q, blk_k), bias_map), bias
 
 
+# --------------------------------------------------------------- causal
+def _causal_mask(s, iq, ik, bq, bk):
+    """Lower-triangular mask for the (iq, ik) block: s[r, c] survives iff
+    global query position iq*bq+r >= key position ik*bk+c."""
+    qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(qpos >= kpos, s, _MASK)
+
+
+def _block_visible(iq, ik, bq, bk):
+    """False when the (iq, ik) block lies entirely above the causal
+    diagonal (every key position > every query position) — the kernels
+    wrap their compute in pl.when(visible), so Mosaic skips the block's
+    MXU work entirely: ~2x step FLOPs saved at long causal S."""
+    return ik * bk <= iq * bq + bq - 1
+
+
 # --------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, nk):
+                acc_ref, m_ref, l_ref, *, scale, nk, causal, bq, bk):
+    iq = pl.program_id(1)
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -237,26 +255,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # dots run at the INPUT dtype (bf16 hits the MXU at full rate) with
-    # f32 accumulation; only the softmax state is explicitly f32
-    q = q_ref[0]                              # [bq, D]
-    k = k_ref[0]                              # [bk, D]
-    v = v_ref[0]                              # [bk, D]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if b_ref is not None:
-        s = s + b_ref[0, 0].astype(jnp.float32)
+    @pl.when(_block_visible(iq, ik, bq, bk) if causal else True)
+    def _compute():
+        # dots run at the INPUT dtype (bf16 hits the MXU at full rate)
+        # with f32 accumulation; only the softmax state is explicitly f32
+        q = q_ref[0]                              # [bq, D]
+        k = k_ref[0]                              # [bk, D]
+        v = v_ref[0]                              # [bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if b_ref is not None:
+            s = s + b_ref[0, 0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk)
 
-    m_prev = m_ref[...]                       # [bq, 1]
-    l_prev = l_ref[...]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                    # [bq, bk] f32
-    l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-    m_ref[...] = m_new
-    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        m_prev = m_ref[...]                       # [bq, 1]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                    # [bq, bk] f32
+        l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
     def _emit():
@@ -265,9 +287,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         lse_ref[0] = m_ref[...] + jnp.log(l)  # [bq, 1]
 
 
-def _forward_pallas(q, k, v, bias, scale):
+def _forward_pallas(q, k, v, bias, scale, causal=False):
     B, H, S, D = q.shape
     Sk = k.shape[2]
+    if causal and S != Sk:
+        raise ValueError(
+            "causal flash attention requires Sq == Sk (self-attention); "
+            "got %d/%d" % (S, Sk))
     _BQ, _BK = _block_sizes()
     Sp, Skp = _pad_len(S, _BQ), _pad_len(Sk, _BK)
     bias = _pad_bias(bias, S, Sp, Sk, Skp)
@@ -288,11 +314,13 @@ def _forward_pallas(q, k, v, bias, scale):
         spec, opnd = _bias_spec_and_operand(bias, H, bq, bk, 1, 2)
         in_specs.append(spec)
         operands.append(opnd)
-        kern = functools.partial(_fwd_kernel, scale=scale, nk=nk)
+        kern = functools.partial(_fwd_kernel, scale=scale, nk=nk,
+                                 causal=causal, bq=bq, bk=bk)
     else:
         def kern(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
             _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
-                        acc, m, l, scale=scale, nk=nk)
+                        acc, m, l, scale=scale, nk=nk, causal=causal,
+                        bq=bq, bk=bk)
 
     out, lse = _checked_pallas_call(
         kern,
@@ -319,7 +347,9 @@ def _forward_pallas(q, k, v, bias, scale):
 
 # -------------------------------------------------------------- backward
 def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
-                dk_ref, dv_ref, ds_ref, dk_acc, dv_acc, *, scale, nq):
+                dk_ref, dv_ref, ds_ref, dk_acc, dv_acc, *, scale, nq,
+                causal, bq, bk):
+    ik = pl.program_id(1)
     iq = pl.program_id(2)
 
     @pl.when(iq == 0)
@@ -327,33 +357,37 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0]                              # [bq, D]
-    k = k_ref[0]                              # [bk, D]
-    v = v_ref[0]                              # [bk, D]
-    g = g_ref[0]                              # [bq, D]
-    lse = lse_ref[0]                          # [bq, 1]
-    delta = d_ref[0]                          # [bq, 1]
+    @pl.when(_block_visible(iq, ik, bq, bk) if causal else True)
+    def _compute():
+        q = q_ref[0]                              # [bq, D]
+        k = k_ref[0]                              # [bk, D]
+        v = v_ref[0]                              # [bk, D]
+        g = g_ref[0]                              # [bq, D]
+        lse = lse_ref[0]                          # [bq, 1]
+        delta = d_ref[0]                          # [bq, 1]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if b_ref is not None:
-        s = s + b_ref[0, 0].astype(jnp.float32)
-    p = jnp.exp(s - lse)                      # [bq, bk] f32
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if b_ref is not None:
+            s = s + b_ref[0, 0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk)
+        p = jnp.exp(s - lse)                      # [bq, bk] f32
 
-    # dv += p^T g ; dp = g v^T ; ds = p*(dp - delta)*scale ; dk += ds^T q
-    dv_acc[...] += jax.lax.dot_general(
-        p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
-    dk_acc[...] += jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    if ds_ref is not None:
-        # raw score gradient (pre-scale is ds/scale; bias adds after the
-        # scale, so its cotangent is ds without the trailing *scale)
-        ds_ref[0] = p * (dp - delta)
+        # dv += p^T g ; dp = g v^T ; ds = p*(dp-delta)*scale ; dk += ds^T q
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if ds_ref is not None:
+            # raw score gradient (pre-scale is ds/scale; bias adds after
+            # the scale, so its cotangent drops the trailing *scale)
+            ds_ref[0] = p * (dp - delta)
 
     @pl.when(iq == nq - 1)
     def _emit():
@@ -362,31 +396,36 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
-               dq_ref, dq_acc, *, scale, nk):
+               dq_ref, dq_acc, *, scale, nk, causal, bq, bk):
+    iq = pl.program_id(1)
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    g = g_ref[0]
-    lse = lse_ref[0]                          # [bq, 1]
-    delta = d_ref[0]                          # [bq, 1]
+    @pl.when(_block_visible(iq, ik, bq, bk) if causal else True)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]
+        lse = lse_ref[0]                          # [bq, 1]
+        delta = d_ref[0]                          # [bq, 1]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if b_ref is not None:
-        s = s + b_ref[0, 0].astype(jnp.float32)
-    p = jnp.exp(s - lse)
-    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale             # [bq, bk] f32
-    dq_acc[...] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if b_ref is not None:
+            s = s + b_ref[0, 0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale             # [bq, bk] f32
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
     def _emit():
@@ -394,7 +433,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, d_ref,
 
 
 def _backward_pallas(q, k, v, bias, o, lse, g, scale, want_db=False,
-                     g_lse=None):
+                     g_lse=None, causal=False):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     _BQ, _BK = _block_sizes()
@@ -444,7 +483,8 @@ def _backward_pallas(q, k, v, bias, o, lse, g, scale, want_db=False,
             dk_r, dv_r, dka, dva = outs
             ds_r = None
         _dkv_kernel(q_r, k_r, v_r, b_r, g_r, lse_r, d_r,
-                    dk_r, dv_r, ds_r, dka, dva, scale=scale, nq=nq)
+                    dk_r, dv_r, ds_r, dka, dva, scale=scale, nq=nq,
+                    causal=causal, bq=bq, bk=bk)
 
     in_specs += [
         pl.BlockSpec((1, bq, D), lambda bh, ik, iq: (bh, iq, 0)),
@@ -497,11 +537,13 @@ def _backward_pallas(q, k, v, bias, o, lse, g, scale, want_db=False,
         spec, opnd = _bias_spec_and_operand(bias, H, bq, bk, 1, 2)
         in_specs.append(spec)
         operands.append(opnd)
-        kern = functools.partial(_dq_kernel, scale=scale, nk=nk)
+        kern = functools.partial(_dq_kernel, scale=scale, nk=nk,
+                                 causal=causal, bq=bq, bk=bk)
     else:
         def kern(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dq_ref, dqa):
             _dq_kernel(q_ref, k_ref, v_ref, None, g_ref, lse_ref, d_ref,
-                       dq_ref, dqa, scale=scale, nk=nk)
+                       dq_ref, dqa, scale=scale, nk=nk, causal=causal,
+                       bq=bq, bk=bk)
     in_specs += [
         pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
         pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),
@@ -547,20 +589,21 @@ def _attention_reference(q, k, v, bias, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _fa_maskbias(q, k, v, bias, scale):
-    out, _ = _forward_pallas(q, k, v, bias, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fa_maskbias(q, k, v, bias, scale, causal=False):
+    out, _ = _forward_pallas(q, k, v, bias, scale, causal=causal)
     return out
 
 
-def _fa_maskbias_fwd(q, k, v, bias, scale):
-    out, lse = _forward_pallas(q, k, v, bias, scale)
+def _fa_maskbias_fwd(q, k, v, bias, scale, causal=False):
+    out, lse = _forward_pallas(q, k, v, bias, scale, causal=causal)
     return out, (q, k, v, bias, out, lse)
 
 
-def _fa_maskbias_bwd(scale, res, g):
+def _fa_maskbias_bwd(scale, causal, res, g):
     q, k, v, bias, o, lse = res
-    dq, dk, dv, _ = _backward_pallas(q, k, v, bias, o, lse, g, scale)
+    dq, dk, dv, _ = _backward_pallas(q, k, v, bias, o, lse, g, scale,
+                                     causal=causal)
     # bias enters through stop_gradient (see flash_attention), so this
     # zero cotangent is discarded upstream — it is structural, not a
     # silently-wrong trainable-bias gradient.
@@ -629,19 +672,33 @@ def flash_attention_with_lse(q, k, v, bias=None, scale=1.0):
     return out, lse.reshape(B, H, S)
 
 
-def flash_attention(q, k, v, bias=None, scale=1.0, bias_grad=False):
+def flash_attention(q, k, v, bias=None, scale=1.0, bias_grad=False,
+                    causal=False):
     """Fused attention. ``bias`` is a constant additive mask by default
     (non-differentiable: stop_gradient is applied); pass
     ``bias_grad=True`` to get the true bias cotangent, at the cost of an
-    O(Sq*Sk) score-gradient buffer in the backward pass."""
+    O(Sq*Sk) score-gradient buffer in the backward pass.
+
+    ``causal=True`` applies the lower-triangular mask IN-KERNEL and
+    skips key blocks entirely above the diagonal via pl.when — ~2x the
+    step FLOPs of a dense mask at long S (decoder self-attention should
+    pass this instead of a materialized causal bias; a padding bias may
+    still be passed alongside). Requires Sq == Sk; not supported
+    together with bias_grad (the trainable-bias path keeps dense
+    blocks)."""
+    if causal and bias_grad:
+        raise ValueError("causal=True with bias_grad=True is not "
+                         "supported; materialize the causal mask into "
+                         "the trainable bias instead")
     if bias is None:
-        return _fa_maskbias(q, k, v, None, scale)
+        return _fa_maskbias(q, k, v, None, scale, causal)
     if bias_grad:
         return _fa_trainbias(q, k, v, bias, scale)
-    return _fa_maskbias(q, k, v, jax.lax.stop_gradient(bias), scale)
+    return _fa_maskbias(q, k, v, jax.lax.stop_gradient(bias), scale,
+                        causal)
 
 
-def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale):
+def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale, causal=False):
     """Mosaic kernels cannot be auto-partitioned by the SPMD partitioner
     (jax raises at multi-device lowering), so under a ParallelEngine mesh
     the op-level flash call wraps itself in shard_map: batch shards over
@@ -655,12 +712,12 @@ def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale):
     which fails with NotImplementedError without it."""
     mesh = getattr(ctx, "mesh", None)
     if mesh is None or mesh.size <= 1 or _use_interpret():
-        return flash_attention(q, k, v, bias, scale)
+        return flash_attention(q, k, v, bias, scale, causal=causal)
     if _in_manual_mesh():
         # already inside a shard_map region (e.g. a pipeline stage body):
         # Mosaic-in-manual-mesh is the supported pattern, and nesting
         # another shard_map over the same mesh is a trace error
-        return flash_attention(q, k, v, bias, scale)
+        return flash_attention(q, k, v, bias, scale, causal=causal)
     from jax.sharding import PartitionSpec as P
 
     B, H = q.shape[0], q.shape[1]
@@ -674,13 +731,15 @@ def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale):
     qs = P(b_ax, h_ax)
     if bias is None:
         fn = jax.shard_map(
-            lambda a, b, c: flash_attention(a, b, c, None, scale),
+            lambda a, b, c: flash_attention(a, b, c, None, scale,
+                                            causal=causal),
             mesh=mesh, in_specs=(qs, qs, qs), out_specs=qs)
         return fn(q, k, v)
     bspec = P(b_ax if bias.shape[0] != 1 else None,
               h_ax if bias.shape[1] != 1 else None)
     fn = jax.shard_map(
-        lambda a, b, c, d: flash_attention(a, b, c, d, scale),
+        lambda a, b, c, d: flash_attention(a, b, c, d, scale,
+                                           causal=causal),
         mesh=mesh, in_specs=(qs, qs, qs, bspec), out_specs=qs)
     return fn(q, k, v, bias)
 
@@ -704,9 +763,10 @@ def _fused_attention(ctx, ins, attrs):
     bias = (ins.get("Bias") or [None])[0]
     scale = attrs.get("scale", 1.0)
     dropout = attrs.get("dropout", 0.0)
+    causal = bool(attrs.get("causal", False))
     if bias is not None:
         bias = bias.astype(jnp.float32)  # mask bias adds in f32 in-kernel
-    out = _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale)
+    out = _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale, causal)
     if dropout and not ctx.is_test:
         # dropout on the *output* (weights-dropout does not commute with the
         # fused kernel; divergence from the layer-composed path documented).
@@ -731,8 +791,9 @@ def _fused_attention_grad(ctx, ins, attrs):
     if bias is not None:
         bias = bias.astype(jnp.float32)
     scale = attrs.get("scale", 1.0)
+    causal = bool(attrs.get("causal", False))
     _, vjp = jax.vjp(
         lambda a, b, c: _maybe_shard_mapped_flash(ctx, a, b, c, bias,
-                                                  scale), q, k, v)
+                                                  scale, causal), q, k, v)
     dq, dk, dv = vjp(g.astype(q.dtype))
     return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}

@@ -180,3 +180,68 @@ def test_flash_block_size_env_validated_at_use(monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_FLASH_BQ", "7")
     with pytest.raises(ValueError, match="multiple of 8"):
         attention._block_sizes()
+
+
+def test_causal_flash_matches_dense_causal_reference():
+    """In-kernel causal (block skip + intra-block triangle) must equal
+    the composed path with a materialized causal bias — forward AND all
+    three gradients, including ragged S (block padding) and a pad-mask
+    bias riding alongside."""
+    rs = np.random.RandomState(0)
+    for S, with_pad_bias in ((64, False), (200, True)):
+        B, H, D = 2, 3, 16
+        q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
+                   for _ in range(3))
+        tri = np.triu(np.full((S, S), -1e9, "float32"), k=1)[None, None]
+        dense_bias = jnp.asarray(tri)
+        pad_bias = None
+        if with_pad_bias:
+            pad = np.where(rs.rand(B, 1, 1, S) > 0.1, 0, -1e9)
+            pad_bias = jnp.asarray(pad.astype("float32"))
+            dense_bias = dense_bias + pad_bias
+
+        def loss_causal(q, k, v):
+            out = flash_attention(q, k, v, pad_bias, D ** -0.5,
+                                  causal=True)
+            return jnp.sum(out ** 2), out
+
+        def loss_dense(q, k, v):
+            out = _attention_reference(q, k, v, dense_bias, D ** -0.5)
+            return jnp.sum(out ** 2), out
+
+        (lc, oc), gc = jax.value_and_grad(loss_causal, argnums=(0, 1, 2),
+                                          has_aux=True)(q, k, v)
+        (ld, od), gd = jax.value_and_grad(loss_dense, argnums=(0, 1, 2),
+                                          has_aux=True)(q, k, v)
+        np.testing.assert_allclose(np.asarray(oc), np.asarray(od),
+                                   atol=2e-5, rtol=2e-5)
+        for a, b in zip(gc, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-4, rtol=3e-4)
+
+
+def test_causal_flash_bf16():
+    rs = np.random.RandomState(1)
+    B, H, S, D = 2, 2, 128, 32
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D)).astype(jnp.bfloat16)
+               for _ in range(3))
+    out = flash_attention(q, k, v, None, D ** -0.5, causal=True)
+    tri = jnp.asarray(np.triu(np.full((S, S), -1e9, "float32"), k=1)
+                      [None, None])
+    ref = _attention_reference(q, k, v, tri, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out).astype("float32"),
+                               np.asarray(ref).astype("float32"),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_causal_flash_error_paths():
+    import pytest
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 1, 32, 8).astype("float32"))
+    kv = jnp.asarray(rs.randn(1, 1, 64, 8).astype("float32"))
+    with pytest.raises(ValueError, match="Sq == Sk"):
+        flash_attention(q, kv, kv, None, 1.0, causal=True)
+    bias = jnp.zeros((1, 1, 32, 32), jnp.float32)
+    with pytest.raises(ValueError, match="bias_grad"):
+        flash_attention(q, q, q, bias, 1.0, bias_grad=True, causal=True)

@@ -368,3 +368,22 @@ def test_moe_step_lowers_for_tpu():
                                    ShardingRules())
     assert exp.nr_devices == 8
     assert "all_gather" in exp.mlir_module()
+
+
+def test_causal_flash_lowers_to_mosaic(monkeypatch):
+    """The causal path (pl.when block skip + in-kernel triangle mask)
+    must survive the real Mosaic lowering, forward and backward."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_INTERPRET", "0")
+    from paddle_tpu.ops.attention import flash_attention
+
+    B, H, S, D = 2, 4, 512, 64
+    rs = np.random.RandomState(0)
+    q, k, v = (rs.randn(B, H, S, D).astype("float32") for _ in range(3))
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, None, D ** -0.5,
+                                       causal=True) ** 2)
+
+    exp = _tpu_export(jax.value_and_grad(loss, argnums=(0, 1, 2)),
+                      q, k, v)
+    assert exp.mlir_module().count("tpu_custom_call") >= 3
