@@ -636,22 +636,22 @@ def _fa_trainbias_bwd(scale, res, g):
 _fa_trainbias.defvjp(_fa_trainbias_fwd, _fa_trainbias_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _fa_with_lse(q, k, v, bias, scale):
-    return _forward_pallas(q, k, v, bias, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fa_with_lse(q, k, v, bias, scale, causal=False):
+    return _forward_pallas(q, k, v, bias, scale, causal=causal)
 
 
-def _fa_with_lse_fwd(q, k, v, bias, scale):
-    out, lse = _forward_pallas(q, k, v, bias, scale)
+def _fa_with_lse_fwd(q, k, v, bias, scale, causal=False):
+    out, lse = _forward_pallas(q, k, v, bias, scale, causal=causal)
     return (out, lse), (q, k, v, bias, out, lse)
 
 
-def _fa_with_lse_bwd(scale, res, gs):
+def _fa_with_lse_bwd(scale, causal, res, gs):
     q, k, v, bias, o, lse = res
     g_out, g_lse = gs
     dq, dk, dv, _ = _backward_pallas(q, k, v, bias, o, lse,
                                      g_out.astype(q.dtype), scale,
-                                     g_lse=g_lse)
+                                     g_lse=g_lse, causal=causal)
     db = None if bias is None else jnp.zeros_like(bias)
     return dq, dk, dv, db
 
@@ -659,15 +659,17 @@ def _fa_with_lse_bwd(scale, res, gs):
 _fa_with_lse.defvjp(_fa_with_lse_fwd, _fa_with_lse_bwd)
 
 
-def flash_attention_with_lse(q, k, v, bias=None, scale=1.0):
+def flash_attention_with_lse(q, k, v, bias=None, scale=1.0, causal=False):
     """Fused attention returning (out [B,H,S,D], lse [B,H,S] row
     log-sum-exps). The lse output is differentiable (its cotangent folds
     into the backward's delta shift), which lets callers merge partial
     attentions over key shards with logaddexp weights —
     parallel/ring_attention.py's flash path builds on this. bias is a
-    constant mask here (stop_gradient)."""
+    constant mask here (stop_gradient); causal=True applies the
+    triangular mask in-kernel with above-diagonal block skipping (the
+    ring path's diagonal step)."""
     bias = None if bias is None else jax.lax.stop_gradient(bias)
-    out, lse = _fa_with_lse(q, k, v, bias, scale)
+    out, lse = _fa_with_lse(q, k, v, bias, scale, causal)
     B, H, S, _ = q.shape
     return out, lse.reshape(B, H, S)
 

@@ -111,13 +111,6 @@ def _rotate(axis_name, perm, *vals):
             for v in vals]
 
 
-def _diag_causal_mask(Sl):
-    """Static in-block lower-triangular mask [1, 1, Sl, Sl]."""
-    col = jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 1)
-    row = jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 0)
-    return jnp.where(col > row, jnp.float32(-1e9), 0.0)[None, None]
-
-
 def _ring_attention_flash(q, k, v, scale, axis_name, causal, kv_bias):
     """Flash-kernel ring: each step yields a NORMALIZED partial (out, lse)
     from the Pallas kernel; partials over key shards merge with
@@ -136,14 +129,15 @@ def _ring_attention_flash(q, k, v, scale, axis_name, causal, kv_bias):
     idx = lax.axis_index(axis_name)
     B, H, Sl, D = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
-    diag_mask = _diag_causal_mask(Sl) if causal else None
 
     def step(i, carry):
         o_acc, lse_acc, k_cur, v_cur, b_cur = carry
         bias = None if b_cur is None else b_cur.astype(jnp.float32)
-        if causal and i == 0:  # src == idx: the diagonal block
-            bias = diag_mask if bias is None else diag_mask + bias
-        o_i, lse_i = flash_attention_with_lse(q, k_cur, v_cur, bias, scale)
+        # diagonal block (ring step 0, src == idx): the kernel's causal
+        # path masks in-VMEM and skips above-diagonal key blocks — no
+        # materialized [Sl, Sl] diagonal bias
+        o_i, lse_i = flash_attention_with_lse(
+            q, k_cur, v_cur, bias, scale, causal=causal and i == 0)
         new_lse = jnp.logaddexp(lse_acc, lse_i)
         w_acc = jnp.exp(lse_acc - new_lse)[..., None]
         w_i = jnp.exp(lse_i - new_lse)[..., None]
