@@ -259,3 +259,39 @@ def test_amp_step_runs_dots_in_bf16():
         txt2 = _train_step_hlo(scope2, stage="stablehlo")
     assert not [l for l in txt2.splitlines()
                 if "dot_general" in l and "bf16" in l]
+
+
+# --------------------------------------------------------------- recompute
+
+def test_recompute_emits_optimization_barrier():
+    """RecomputeOptimizer's rematerialization contract is structural:
+    the backward re-trace sits behind an optimization barrier so XLA
+    cannot CSE it with the forward emission (core/recompute.py /
+    ops/recompute_ops.py). If the barrier disappears, 'recompute' runs
+    silently degrade to plain activation-keeping — same numerics, none
+    of the memory savings. Control: no barrier without recompute."""
+    def build(with_recompute):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = Scope()
+        with scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[32], dtype="float32")
+                y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+                h1 = fluid.layers.fc(x, size=64, act="relu")
+                h2 = fluid.layers.fc(h1, size=64, act="relu")
+                pred = fluid.layers.fc(h2, size=1)
+                loss = fluid.layers.mean(fluid.layers.square(pred - y))
+                opt = fluid.optimizer.SGD(learning_rate=0.1)
+                if with_recompute:
+                    opt = fluid.optimizer.RecomputeOptimizer(opt)
+                    opt._set_checkpoints([h1, h2])
+                opt.minimize(loss)
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup, scope=scope)
+            feed = {"x": np.zeros((8, 32), "float32"),
+                    "y": np.zeros((8, 1), "float32")}
+            return exe.lowered_hlo(main, feed=feed, fetch_list=[loss],
+                                   scope=scope, stage="stablehlo")
+
+    assert "optimization_barrier" in build(True)
+    assert "optimization_barrier" not in build(False)

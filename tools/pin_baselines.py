@@ -1,0 +1,91 @@
+"""Pin measured bench rows into bench.py's BASELINES dict.
+
+The contract (VERDICT r3 weak #2): the first committed hardware numbers
+and the baseline pinning must land in the SAME commit, or regression
+tracking slips a round. This tool makes that a one-liner in the
+hardware window:
+
+    python bench.py | tee BENCH_r04.json
+    python tools/pin_baselines.py BENCH_r04.json
+    git add bench.py BENCH_r04.json && git commit ...
+
+Only rows with a real value pin; error rows are skipped. A row pins
+when it beats (or first sets) the current baseline — regressions are
+reported, not silently pinned over.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def load_rows(path):
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict) and "value" in row and "metric" in row:
+                rows.append(row)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("bench_json", help="file of bench.py JSON lines")
+    ap.add_argument("--force", action="store_true",
+                    help="pin even when the new value is a regression")
+    args = ap.parse_args()
+
+    rows = load_rows(args.bench_json)
+    if not rows:
+        print("no result rows in %s" % args.bench_json, file=sys.stderr)
+        return 1
+
+    src = open(BENCH).read()
+    m = re.search(r"BASELINES = \{(.*?)\}", src, re.S)
+    if not m:
+        print("BASELINES dict not found in bench.py", file=sys.stderr)
+        return 1
+    current = eval("{" + m.group(1) + "}")  # noqa: S307 - our own literal
+
+    changed = False
+    for row in rows:
+        name, value = row["metric"], float(row["value"])
+        old = current.get(name)
+        if old is not None and value < old and not args.force:
+            print("SKIP %s: %.1f is a regression vs baseline %.1f "
+                  "(--force to pin anyway)" % (name, value, old))
+            continue
+        if old != value:
+            current[name] = value
+            changed = True
+            print("PIN  %s: %s -> %.1f" % (name, old, value))
+
+    if not changed:
+        print("nothing to pin")
+        return 0
+
+    body = "\n".join('    "%s": %.1f,' % (k, v)
+                     for k, v in sorted(current.items()))
+    src = src[:m.start()] + "BASELINES = {\n" + body + "\n}" + src[m.end():]
+    with open(BENCH, "w") as f:
+        f.write(src)
+    print("bench.py BASELINES updated (%d entries)" % len(current))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
