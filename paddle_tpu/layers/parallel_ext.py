@@ -129,18 +129,24 @@ def pipeline(x: Variable, n_stages: int,
 
 
 def moe_ffn(x: Variable, n_experts: int, d_hidden: int,
-            capacity: Optional[int] = None,
+            capacity: Optional[int] = None, top_k: int = 1,
             name: Optional[str] = None):
-    """Top-1 switch-routed mixture-of-experts FFN (see ops/moe_ops.py).
+    """Switch/GShard mixture-of-experts FFN (see ops/moe_ops.py).
 
     x: [B, D] (or [B, S, D], flattened internally). Returns (out, aux)
-    where out has x's shape and aux is the Switch load-balancing loss —
+    where out has x's shape and aux is the Switch load-balancing loss
+    (top_k=1 is Switch routing; top_k=2 routes each token to its two
+    best experts with renormalized gates, GShard-style) —
     add ``aux_weight * aux`` into the training objective or routing
     collapses. Expert weights are stored stacked [n_experts, ...]; under
     a ParallelEngine mesh with an 'expert' axis of size n_experts the
     tokens shuffle to their expert's device with all_to_all, otherwise
     every expert computes locally (identical math).
     """
+    if not 1 <= int(top_k) <= int(n_experts):
+        raise ValueError(
+            "moe_ffn top_k must be in [1, n_experts]; got top_k=%s with "
+            "n_experts=%s" % (top_k, n_experts))
     helper = LayerHelper("moe_ffn", name=name)
     D = int(x.shape[-1])
     mk = helper.create_parameter  # stacked expert weights + router
@@ -160,6 +166,7 @@ def moe_ffn(x: Variable, n_experts: int, d_hidden: int,
         outputs={"Out": [out], "AuxLoss": [aux]},
         attrs={"n_experts": int(n_experts),
                "capacity": int(capacity) if capacity else 0,
+               "top_k": int(top_k),
                "axis": "expert"})
     out.shape = x.shape
     aux.shape = ()

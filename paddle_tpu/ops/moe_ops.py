@@ -1,4 +1,5 @@
-"""moe_ffn op: top-1 switch-routed expert FFN as one graph op.
+"""moe_ffn op: switch-routed expert FFN as one graph op (top-1
+Switch by default, top_k=2 GShard-style).
 
 The reference (Fluid v1.3) has no mixture-of-experts; this op promotes
 `parallel/moe.py` into the Program/layers API (the 'ep' axis). Expert
@@ -12,8 +13,8 @@ their expert's device via all_to_all, lives in `parallel/moe.py`'s
 ``moe_apply`` for shard_map users). Without the axis, every expert
 computes locally. All paths share ``route_tokens``, so single-device
 and expert-parallel runs agree exactly (the parity contract the tests
-pin): Switch Transformer discipline — static capacity, overflow tokens
-contribute zero, aux load-balancing loss.
+pin): Switch/GShard discipline — static capacity with choice-major
+priority, overflow tokens contribute zero, aux load-balancing loss.
 """
 
 from __future__ import annotations
@@ -30,20 +31,22 @@ from ..core.registry import register_op
 __all__: List[str] = []
 
 
-def _moe_local(x, w1, b1, w2, b2, gate_w, E, capacity):
+def _moe_local(x, w1, b1, w2, b2, gate_w, E, capacity, top_k=1):
     """Single-device path: every expert computes on the full token set,
     outputs select by routing — matching the parallel path's keep/drop
     discipline through the shared route_tokens."""
     from ..parallel.moe import route_tokens
 
-    expert_idx, gate, _pos, keep, aux = route_tokens(x, gate_w, E, capacity)
+    expert_idx, gate, _pos, keep, aux = route_tokens(x, gate_w, E,
+                                                     capacity, top_k)
     out = jnp.zeros_like(x)
     for e in range(E):
         h = jax.nn.relu(x @ w1[e] + b1[e])
         y = h @ w2[e] + b2[e]
-        sel = ((expert_idx == e) & keep)[:, None]
-        out = out + jnp.where(sel, y, 0.0)
-    return out * gate[:, None], aux
+        for kk in range(top_k):
+            sel = ((expert_idx[kk] == e) & keep[kk])[:, None]
+            out = out + jnp.where(sel, y * gate[kk][:, None], 0.0)
+    return out, aux
 
 
 @register_op("moe_ffn",
@@ -57,11 +60,12 @@ def _moe_ffn(ctx, ins, attrs):
     gate_w = ins["Gate"][0]
     E = int(attrs["n_experts"])
     axis = attrs.get("axis", "expert")
+    top_k = int(attrs.get("top_k", 1))
 
     D = x.shape[-1]
     xf = x.reshape(-1, D)
     T = xf.shape[0]
-    capacity = int(attrs.get("capacity") or -(-2 * T // E))
+    capacity = int(attrs.get("capacity") or -(-2 * T * top_k // E))
 
     mesh = ctx.mesh
     use_ep = mesh is not None and axis in mesh.axis_names \
@@ -73,7 +77,8 @@ def _moe_ffn(ctx, ins, attrs):
                                                       mesh.shape[axis]))
 
     if not use_ep:
-        out, aux = _moe_local(xf, w1, b1, w2, b2, gate_w, E, capacity)
+        out, aux = _moe_local(xf, w1, b1, w2, b2, gate_w, E, capacity,
+                              top_k)
         return {"Out": out.reshape(x.shape), "AuxLoss": aux}
 
     def shard_body(xl, w1l, b1l, w2l, b2l, gl):
@@ -81,11 +86,14 @@ def _moe_ffn(ctx, ins, attrs):
         # each device fills the send buffer, runs ITS expert on its
         # [capacity, D] slice, and one all_gather rebuilds [E, capacity,
         # D] results for the (replicated) token-side gather.
-        expert_idx, gate, pos, keep, aux = route_tokens(xl, gl, E, capacity)
-        safe_e = jnp.where(keep, expert_idx, 0)
+        expert_idx, gate, pos, keep, aux = route_tokens(xl, gl, E,
+                                                        capacity, top_k)
+        safe_e = jnp.where(keep, expert_idx, 0)       # [K, T]
         safe_p = jnp.where(keep, pos, 0)
         buf = jnp.zeros((E, capacity, D), xl.dtype)
-        buf = buf.at[safe_e, safe_p].add(jnp.where(keep[:, None], xl, 0.0))
+        for kk in range(top_k):
+            buf = buf.at[safe_e[kk], safe_p[kk]].add(
+                jnp.where(keep[kk][:, None], xl, 0.0))
 
         d = lax.axis_index(axis)
         mine = lax.dynamic_index_in_dim(buf, d, axis=0, keepdims=False)
@@ -93,8 +101,11 @@ def _moe_ffn(ctx, ins, attrs):
         y = h @ w2l[0] + b2l[0]                       # [capacity, D]
         ys = lax.all_gather(y, axis)                  # [E, capacity, D]
 
-        out = ys[safe_e, safe_p]
-        out = jnp.where(keep[:, None], out, 0.0) * gate[:, None]
+        out = jnp.zeros_like(xl)
+        for kk in range(top_k):
+            got = ys[safe_e[kk], safe_p[kk]]
+            got = jnp.where(keep[kk][:, None], got, 0.0)
+            out = out + got * gate[kk][:, None]
         return out, aux
 
     # check_vma off: ys is the same on every device after the

@@ -1,4 +1,5 @@
-"""Expert parallelism: top-1 switch-style MoE over an 'expert' mesh axis.
+"""Expert parallelism: switch-style MoE over an 'expert' mesh axis
+(top-1 Switch routing by default; top_k=2 for GShard-style).
 
 The reference (Fluid v1.3) has no mixture-of-experts; this is the
 TPU-first 'ep' extension completing the dp/tp/sp/pp/ep set: experts are
@@ -27,27 +28,48 @@ from jax import lax
 __all__ = ["moe_apply", "route_tokens"]
 
 
-def route_tokens(x, gate_w, E, capacity):
-    """Shared top-1 routing/capacity math — the ONE derivation both the
-    distributed path below and the single-device dense fallback
+def route_tokens(x, gate_w, E, capacity, top_k=1):
+    """Shared top-k routing/capacity math — the ONE derivation both the
+    distributed paths and the single-device dense fallback
     (ops/moe_ops.py) use, so their exact-parity contract can't drift.
 
-    Returns (expert_idx [T], gate [T], pos [T], keep [T], aux scalar).
+    top_k=1 is Switch routing; top_k>1 is GShard-style: each token goes
+    to its k best experts with gates renormalized over the chosen
+    probabilities, and capacity claims happen in CHOICE-MAJOR priority
+    (every token's 1st choice before any 2nd choice — a token never
+    loses its primary expert slot to another token's secondary).
+
+    Returns (expert_idx [K,T], gate [K,T], pos [K,T], keep [K,T],
+    aux scalar). The aux load-balancing loss follows Switch/GShard:
+    first-choice dispatch fraction x mean router probability.
     """
+    T = x.shape[0]
     probs = jax.nn.softmax(x @ gate_w, axis=-1)          # [T, E]
-    expert_idx = jnp.argmax(probs, axis=-1)
-    gate = jnp.max(probs, axis=-1)
-    onehot = jax.nn.one_hot(expert_idx, E)
-    # Switch aux loss: E * mean(fraction_per_expert * prob_per_expert)
-    aux = E * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
-    # position of each token within its expert's send buffer
+    top_p, top_e = jax.lax.top_k(probs, top_k)           # [T, K] each
+    if top_k == 1:
+        # Switch: the output scales by the RAW router probability — that
+        # product is how gradients reach the router at all
+        gate = top_p.T                                   # [1, T]
+    else:
+        # GShard: gates renormalized over the chosen experts
+        gate = (top_p / jnp.sum(top_p, axis=-1, keepdims=True)).T
+    expert_idx = top_e.T                                 # [K, T]
+
+    onehot1 = jax.nn.one_hot(expert_idx[0], E)
+    aux = E * jnp.sum(jnp.mean(onehot1, axis=0) * jnp.mean(probs, axis=0))
+
+    # positions: flatten choice-major so cumsum gives 1st choices
+    # priority over 2nd within each expert's capacity
+    flat_e = expert_idx.reshape(-1)                      # [K*T]
+    onehot = jax.nn.one_hot(flat_e, E)
     pos = (jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
-           ).astype(jnp.int32)
+           ).astype(jnp.int32).reshape(top_k, T)
     keep = pos < capacity
     return expert_idx, gate, pos, keep, aux
 
 
-def moe_apply(expert_params, gate_w, x, axis_name, capacity=None):
+def moe_apply(expert_params, gate_w, x, axis_name, capacity=None,
+              top_k=1):
     """Route tokens to per-device experts and back.
 
     expert_params: pytree with leading expert dim sharded on `axis_name`
@@ -56,22 +78,26 @@ def moe_apply(expert_params, gate_w, x, axis_name, capacity=None):
     gate_w: [D, E] router weights (replicated).
     x: [T, D] local tokens (the data may also be sharded on another axis).
     capacity: max tokens each device routes to EACH expert (static);
-        default ceil(2 * T / E).
+        default ceil(2 * T * top_k / E). top_k: experts per token
+        (1 = Switch, 2 = GShard-style).
 
     Returns ([T, D] outputs, aux_loss scalar).
     """
     E = int(lax.psum(1, axis_name))
     T, D = x.shape
-    capacity = int(capacity or -(-2 * T // E))
+    capacity = int(capacity or -(-2 * T * top_k // E))
 
-    expert_idx, gate, pos, keep, aux = route_tokens(x, gate_w, E, capacity)
+    expert_idx, gate, pos, keep, aux = route_tokens(x, gate_w, E,
+                                                    capacity, top_k)
 
-    # scatter tokens into the [E, capacity, D] send buffer
+    # scatter tokens into the [E, capacity, D] send buffer (a top-2
+    # token appears in both its experts' buffers)
     buf = jnp.zeros((E, capacity, D), x.dtype)
-    safe_e = jnp.where(keep, expert_idx, 0)
+    safe_e = jnp.where(keep, expert_idx, 0)              # [K, T]
     safe_p = jnp.where(keep, pos, 0)
-    buf = buf.at[safe_e, safe_p].add(
-        jnp.where(keep[:, None], x, 0.0))
+    for kk in range(safe_e.shape[0]):
+        buf = buf.at[safe_e[kk], safe_p[kk]].add(
+            jnp.where(keep[kk][:, None], x, 0.0))
 
     # all_to_all: dim 0 (expert) scatters, tokens from every device
     # gather on the expert's device -> [E, capacity, D] = per-source rows
@@ -86,6 +112,9 @@ def moe_apply(expert_params, gate_w, x, axis_name, capacity=None):
     back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
                           tiled=True)                    # [E, capacity, D]
 
-    out = back[safe_e, safe_p]                           # [T, D]
-    out = jnp.where(keep[:, None], out, 0.0)
-    return out * gate[:, None], aux
+    out = jnp.zeros((T, D), back.dtype)
+    for kk in range(safe_e.shape[0]):
+        got = back[safe_e[kk], safe_p[kk]]               # [T, D]
+        got = jnp.where(keep[kk][:, None], got, 0.0)
+        out = out + got * gate[kk][:, None]
+    return out, aux

@@ -98,3 +98,48 @@ def test_moe_gradients_match():
     for a, r in zip(jax.tree.leaves(gp), jax.tree.leaves(gd)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    atol=1e-4, rtol=1e-4)
+
+
+def test_moe_apply_top2_matches_dense():
+    """moe_apply(top_k=2): the all_to_all path equals an independent
+    dense transcription of GShard top-2 (renormalized gates, both
+    experts' outputs mixed), forward and backward."""
+    params, gw, x = _setup()
+    E = params[0].shape[0]
+
+    def dense2(params, gw, x):
+        w1, b1, w2, b2 = params
+        probs = jax.nn.softmax(x @ gw, axis=-1)
+        top_p, top_e = jax.lax.top_k(probs, 2)
+        gates = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+        def expert(e, v):
+            return jax.nn.relu(v @ w1[e] + b1[e]) @ w2[e] + b2[e]
+
+        o1 = jax.vmap(lambda v, e: expert(e, v))(x, top_e[:, 0])
+        o2 = jax.vmap(lambda v, e: expert(e, v))(x, top_e[:, 1])
+        out = o1 * gates[:, 0:1] + o2 * gates[:, 1:2]
+        onehot1 = jax.nn.one_hot(top_e[:, 0], E)
+        aux = E * jnp.sum(jnp.mean(onehot1, axis=0)
+                          * jnp.mean(probs, axis=0))
+        return out, aux
+
+    mesh = Mesh(np.array(jax.devices()), ("expert",))
+    fn = shard_map(
+        lambda w1, b1, w2, b2, g, xx: moe_apply(
+            (w1, b1, w2, b2), g, xx, "expert", capacity=64, top_k=2),
+        mesh=mesh,
+        in_specs=(P("expert"),) * 4 + (P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    out, aux = jax.jit(fn)(*params, gw, x)
+    ref, aux_ref = dense2(params, gw, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
+
+    # gradients flow through both experts and the renormalized gates
+    g1 = jax.grad(lambda g: jnp.sum(jax.jit(fn)(*params, g, x)[0] ** 2))(gw)
+    g2 = jax.grad(lambda g: jnp.sum(dense2(params, g, x)[0] ** 2))(gw)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               atol=2e-4, rtol=2e-4)
