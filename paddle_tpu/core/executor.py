@@ -243,7 +243,8 @@ class Executor:
 
 
 def analyze_block(program: Program, feed_names, fetch_names, scope,
-                  mesh=None, data_axis="data", model_axis="model"):
+                  mesh=None, data_axis="data", model_axis="model",
+                  seq_axis="seq"):
     """Classify block vars into feeds / read-only state / read-write state /
     write-only persistables, and build the pure whole-block step function.
     Shared by the single-device Executor and the mesh ParallelEngine — the
@@ -346,7 +347,7 @@ def analyze_block(program: Program, feed_names, fetch_names, scope,
     if accum > 1:
         step = _accum_step(program, block, feed_names, fetch_names,
                            const_state, mut_state, pure_written, amp, accum,
-                           mesh, data_axis, model_axis)
+                           mesh, data_axis, model_axis, seq_axis)
     else:
         def step(feeds, const_vals, mut_vals, rng):
             env: Dict[str, Any] = {}
@@ -354,7 +355,8 @@ def analyze_block(program: Program, feed_names, fetch_names, scope,
             env.update(zip(mut_state, mut_vals))
             env.update(zip(feed_names, feeds))
             ctx = LowerContext(block, rng, amp=amp, mesh=mesh,
-                               data_axis=data_axis, model_axis=model_axis)
+                               data_axis=data_axis, model_axis=model_axis,
+                               seq_axis=seq_axis)
             lower_block(ctx, block, env)
             missing_f = [n for n in fetch_names if n not in env]
             if missing_f:
@@ -375,7 +377,7 @@ def analyze_block(program: Program, feed_names, fetch_names, scope,
 
 def _accum_step(program, block, feed_names, fetch_names, const_state,
                 mut_state, pure_written, amp, k, mesh=None,
-                data_axis="data", model_axis="model"):
+                data_axis="data", model_axis="model", seq_axis="seq"):
     """Gradient-accumulation step: lax.scan the compute ops (forward +
     backward) over k microbatch slices of the feeds, average the float
     values crossing into the optimize-role ops (the gradients), and run
@@ -425,7 +427,8 @@ def _accum_step(program, block, feed_names, fetch_names, const_state,
             env.update(zip(mut_state, mut_c))
             env.update(zip(feed_names, xs))
             ctx = LowerContext(block, rng_c, amp=amp, mesh=mesh,
-                               data_axis=data_axis, model_axis=model_axis)
+                               data_axis=data_axis, model_axis=model_axis,
+                               seq_axis=seq_axis)
             lower_ops(ctx, scan_ops, env)
             new_rng = ctx.final_rng() if ctx.rng_used else rng_c
             new_mut = [env.get(n, m) for n, m in zip(mut_state, mut_c)]
@@ -459,7 +462,8 @@ def _accum_step(program, block, feed_names, fetch_names, const_state,
                 env[name] = stacked[-1]
 
         ctx = LowerContext(block, rng, amp=amp, mesh=mesh,
-                           data_axis=data_axis, model_axis=model_axis)
+                           data_axis=data_axis, model_axis=model_axis,
+                           seq_axis=seq_axis)
         lower_ops(ctx, apply_ops, env)
         fetches = [env[n] for n in fetch_names]
         new_mut = [env[n] for n in mut_state]

@@ -27,7 +27,8 @@ class LowerContext:
 
     def __init__(self, block: Optional[Block] = None, rng: Optional[jax.Array] = None,
                  is_test: bool = False, amp: bool = False, mesh=None,
-                 data_axis: str = "data", model_axis: str = "model"):
+                 data_axis: str = "data", model_axis: str = "model",
+                 seq_axis: str = "seq"):
         self.block = block
         self._rng = rng
         self.is_test = is_test
@@ -37,6 +38,7 @@ class LowerContext:
         #                   moe) pick their shard_map axis from it
         self.data_axis = data_axis  # the engine's batch axis name
         self.model_axis = model_axis  # the engine's tensor-parallel axis
+        self.seq_axis = seq_axis  # the engine's sequence-parallel axis
         self.rng_used = False
 
     def next_rng(self) -> jax.Array:
@@ -55,7 +57,7 @@ class LowerContext:
 
     def sub(self, block: Block) -> "LowerContext":
         c = LowerContext(block, self._rng, self.is_test, self.amp, self.mesh,
-                         self.data_axis, self.model_axis)
+                         self.data_axis, self.model_axis, self.seq_axis)
         return c
 
     def pure(self) -> "LowerContext":
@@ -63,7 +65,8 @@ class LowerContext:
         Keeps the mesh: the re-trace must pick the same (shard_map vs
         sequential) path as the forward emission or XLA cannot CSE them."""
         return LowerContext(self.block, None, self.is_test, self.amp,
-                            self.mesh, self.data_axis, self.model_axis)
+                            self.mesh, self.data_axis, self.model_axis,
+                            self.seq_axis)
 
 
 def lower_op(ctx: LowerContext, op, env: Dict[str, Any]) -> None:

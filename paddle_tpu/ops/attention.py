@@ -705,31 +705,65 @@ def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale, causal=False):
     (jax raises at multi-device lowering), so under a ParallelEngine mesh
     the op-level flash call wraps itself in shard_map: batch shards over
     the engine's data axis, heads over the 'model' axis (when they
-    divide), everything else replicated inside. A 'seq'-sharded activation
-    is all-gathered at the shard_map boundary — correct but memory-heavy;
-    the sp-native long-context path is ring_attention, which brings its
-    own shard_map. CPU interpret mode lowers to plain jax ops
-    (partitionable), so the wrap only engages on the compiled path —
-    pinned by tests/test_tpu_lowering.py::test_dp_tp_train_step_lowers_for_tpu,
-    which fails with NotImplementedError without it."""
+    divide), everything else replicated inside. When the mesh carries a
+    sequence axis ('seq') that divides S — and the bias is in key-mask
+    form [B|1,1,1,S] — self-attention rides RING ATTENTION instead: the
+    sequence stays sharded, K/V blocks hop the ring via lax.ppermute,
+    and per-shard partials merge by logsumexp (parallel/ring_attention
+    .py) — the sp-native long-context path, never an S-gather. The ring
+    branch engages on every backend (its composed per-step path is plain
+    jnp on CPU; the flash per-step kernels on TPU); the plain wrap only
+    engages on the compiled path — CPU interpret mode lowers to
+    partitionable jax ops. Pinned by tests/test_tpu_lowering.py::
+    test_dp_tp_train_step_lowers_for_tpu (NotImplementedError without
+    the wrap) and the sp ring tests."""
     mesh = getattr(ctx, "mesh", None)
-    if mesh is None or mesh.size <= 1 or _use_interpret():
+    if mesh is None or mesh.size <= 1 or _in_manual_mesh():
+        # _in_manual_mesh: already inside a shard_map region (pipeline
+        # stage bodies, ring steps) — Mosaic-in-manual-mesh is the
+        # supported pattern; nesting shard_map is a trace error
         return flash_attention(q, k, v, bias, scale, causal=causal)
-    if _in_manual_mesh():
-        # already inside a shard_map region (e.g. a pipeline stage body):
-        # Mosaic-in-manual-mesh is the supported pattern, and nesting
-        # another shard_map over the same mesh is a trace error
-        return flash_attention(q, k, v, bias, scale, causal=causal)
+
     from jax.sharding import PartitionSpec as P
 
-    B, H = q.shape[0], q.shape[1]
+    B, H, S, _D = q.shape
     d_ax = getattr(ctx, "data_axis", "data")
     m_ax = getattr(ctx, "model_axis", "model")
+    s_ax = getattr(ctx, "seq_axis", "seq")
     b_ax = d_ax if (d_ax in mesh.axis_names and mesh.shape[d_ax] > 1
                     and B % mesh.shape[d_ax] == 0) else None
     h_ax = m_ax if (m_ax in mesh.axis_names
                     and mesh.shape[m_ax] > 1
                     and H % mesh.shape[m_ax] == 0) else None
+
+    ring_ok = (s_ax in mesh.axis_names and mesh.shape[s_ax] > 1
+               and q.shape == k.shape and S % mesh.shape[s_ax] == 0
+               and (bias is None or (bias.shape[1] == 1
+                                     and bias.shape[2] == 1
+                                     and bias.shape[3] == S)))
+    if ring_ok:
+        from ..parallel.ring_attention import ring_attention
+
+        use_flash = not _use_interpret()
+        qs = P(b_ax, h_ax, s_ax, None)
+        bspec = None if bias is None else P(
+            b_ax if bias.shape[0] != 1 else None, None, None, s_ax)
+
+        def ring(a, b, c, d=None):
+            return ring_attention(a, b, c, scale, s_ax, causal=causal,
+                                  kv_bias=d, use_flash=use_flash)
+
+        if bias is None:
+            fn = jax.shard_map(ring, mesh=mesh, in_specs=(qs,) * 3,
+                               out_specs=qs, check_vma=False)
+            return fn(q, k, v)
+        fn = jax.shard_map(ring, mesh=mesh, in_specs=(qs,) * 3 + (bspec,),
+                           out_specs=qs, check_vma=False)
+        return fn(q, k, v, bias)
+
+    if _use_interpret():
+        return flash_attention(q, k, v, bias, scale, causal=causal)
+
     qs = P(b_ax, h_ax)
     if bias is None:
         fn = jax.shard_map(

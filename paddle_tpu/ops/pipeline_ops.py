@@ -41,7 +41,7 @@ def _stage_fn(ctx, sub, slice_names, in_name, out_name):
         env: Dict[str, Any] = dict(zip(slice_names, param_slices))
         env[in_name] = x
         sctx = LowerContext(sub, None, ctx.is_test, ctx.amp, ctx.mesh,
-                            ctx.data_axis, ctx.model_axis)
+                            ctx.data_axis, ctx.model_axis, ctx.seq_axis)
         lower_ops(sctx, sub.ops, env)
         return env[out_name]
 

@@ -46,7 +46,7 @@ def _run_segment(ctx, block, in_names, out_names, in_vals, key):
 
     env: Dict[str, Any] = dict(zip(in_names, in_vals))
     sctx = LowerContext(block, key, ctx.is_test, ctx.amp, ctx.mesh,
-                        ctx.data_axis, ctx.model_axis)
+                        ctx.data_axis, ctx.model_axis, ctx.seq_axis)
     lower_ops(sctx, block.ops, env)
     missing = [n for n in out_names if n not in env]
     if missing:

@@ -175,7 +175,8 @@ class ParallelEngine:
          needs_rng, step) = analyze_block(
             self.program, sorted(feed_vals), fetch_names, scope,
             mesh=self.mesh, data_axis=self.rules.data_axis,
-            model_axis=getattr(self.rules, "model_axis", "model"))
+            model_axis=getattr(self.rules, "model_axis", "model"),
+            seq_axis=getattr(self.rules, "seq_axis", "seq"))
 
         mesh = self.mesh
         repl = NamedSharding(mesh, P())

@@ -23,7 +23,7 @@ class ShardingRules:
     def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None,
                  data_axis: str = "data",
                  feed_rules: Optional[Sequence[Tuple[str, P]]] = None,
-                 model_axis: str = "model"):
+                 model_axis: str = "model", seq_axis: str = "seq"):
         self.rules: List[Tuple[re.Pattern, P]] = [
             (re.compile(pat), spec) for pat, spec in (rules or [])
         ]
@@ -36,6 +36,9 @@ class ShardingRules:
         # the tensor-parallel axis name: ops that shard_map kernels
         # (fused attention) shard heads over it when it divides
         self.model_axis = model_axis
+        # the sequence-parallel axis: fused attention rides ring
+        # attention over it (ops/attention.py)
+        self.seq_axis = seq_axis
 
     def add(self, pattern: str, spec: P) -> "ShardingRules":
         self.rules.append((re.compile(pattern), spec))

@@ -182,3 +182,54 @@ def test_parallel_executor_api():
         pe2 = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
                                      main_program=main, share_vars_from=pe)
         assert pe2._scope is scope
+
+
+def test_sp_fused_attention_rides_ring():
+    """Under a (data, seq) mesh the fused-attention op must ride ring
+    attention — sequence stays sharded, K/V blocks hop via ppermute —
+    and the losses must match the single-device run through training.
+    (VERDICT-r3-style promotion: sp is a framework path, not a library
+    function.)"""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.models import transformer
+    from paddle_tpu.parallel.engine import ParallelEngine, make_mesh
+    from paddle_tpu.parallel.sharding import ShardingRules, P
+
+    cfg = dict(d_model=32, d_ff=64, n_head=2, n_layer=1, src_vocab=64,
+               trg_vocab=64, max_length=16, dropout=0.0)
+    rs = np.random.RandomState(0)
+    feed = {n: rs.randint(1, 64, (4, 16)).astype("int64")
+            for n in ("src_ids", "trg_ids", "lbl_ids")}
+
+    losses = {}
+    for mode in ("single", "sp"):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = Scope()
+        with scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                loss, _ = transformer.build(cfg, seq_len=16,
+                                            use_fused_attention=True,
+                                            label_smooth_eps=0.0)
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup, scope=scope)
+            if mode == "single":
+                run = lambda: exe.run(  # noqa: E731
+                    main, feed=feed, fetch_list=[loss], scope=scope)[0]
+            else:
+                mesh = make_mesh(jax.devices(), ("data", "seq"), (2, 4))
+                rules = ShardingRules(
+                    feed_rules=[(r"^(src|trg|lbl)_ids$", P("data", "seq"))])
+                eng = ParallelEngine(main, loss_name=loss.name, mesh=mesh,
+                                     rules=rules)
+                run = lambda: eng.run(feed, [loss], scope)[0]  # noqa: E731
+                txt = eng.lowered_hlo(feed=feed, fetch_list=[loss],
+                                      scope=scope)
+                # the ring's signature collective
+                assert "collective-permute" in txt
+            vals = [float(np.asarray(run()).reshape(-1)[0])
+                    for _ in range(4)]
+            losses[mode] = vals
+    np.testing.assert_allclose(losses["sp"], losses["single"],
+                               rtol=2e-4, atol=2e-5)

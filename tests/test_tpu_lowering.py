@@ -387,3 +387,38 @@ def test_causal_flash_lowers_to_mosaic(monkeypatch):
     exp = _tpu_export(jax.value_and_grad(loss, argnums=(0, 1, 2)),
                       q, k, v)
     assert exp.mlir_module().count("tpu_custom_call") >= 3
+
+
+def test_sp_train_step_lowers_for_tpu_with_ring(monkeypatch):
+    """dp x sp mesh: the fused-attention op rides ring attention (the
+    sequence stays sharded; flash kernels per ring step + ppermute
+    hops) — the whole train step lowers for TPU."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_INTERPRET", "0")
+    from jax.sharding import AbstractMesh, PartitionSpec as P
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.parallel.sharding import ShardingRules
+
+    cfg = dict(d_model=64, d_ff=128, n_head=4, n_layer=1, src_vocab=128,
+               trg_vocab=128, max_length=32, dropout=0.1)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss, _ = transformer.build(cfg, seq_len=32,
+                                        use_fused_attention=True)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        feed = {n: rs.randint(1, 128, (8, 32)).astype("int32")
+                for n in ("src_ids", "trg_ids", "lbl_ids")}
+        mesh = AbstractMesh((2, 4), ("data", "seq"))
+        rules = ShardingRules(
+            feed_rules=[(r"^(src|trg|lbl)_ids$", P("data", "seq"))])
+        exp = _export_sharded_step(main, scope, feed, loss.name, mesh,
+                                   rules, flash_compiled=True)
+    assert exp.nr_devices == 8
+    txt = exp.mlir_module()
+    assert "tpu_custom_call" in txt      # per-ring-step flash kernels
+    assert "collective_permute" in txt   # the ring hops
