@@ -180,3 +180,41 @@ def test_pds_load_and_run_on_real_plugin(tmp_path):
         out_data[0], shape=(int(np.prod(shape)),)).reshape(shape)
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
     lib.pds_destroy(ctypes.c_void_p(h))
+
+
+def test_int8_calibrated_model_exports_to_artifact(tmp_path):
+    """Deployment completeness: a post-training int8-calibrated model
+    (contrib.int8_inference.Calibrator.save_int8_model) exports through
+    the same AOT artifact and reproduces the quantized predictor."""
+    from paddle_tpu.contrib.int8_inference import Calibrator
+    from paddle_tpu.inference import AnalysisConfig, Predictor
+    from paddle_tpu.inference.export_serving import (
+        load_serving_artifact, save_serving_artifact)
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    rs = np.random.RandomState(0)
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            pred = fluid.layers.fc(h, size=4)
+            infer = main.clone(for_test=True)
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+
+        calib = Calibrator(infer, scope=scope, algo="max")
+        for _ in range(2):
+            calib.sample_data(
+                exe, feed={"x": rs.rand(16, 8).astype("float32")},
+                fetch_list=[pred])
+        mdl = str(tmp_path / "int8_model")
+        calib.save_int8_model(mdl, exe, ["x"], [pred])
+
+    art = str(tmp_path / "artifact")
+    save_serving_artifact(mdl, art, batch_sizes=(4,))
+    _, runners = load_serving_artifact(art)
+    X = rs.rand(4, 8).astype("float32")
+    got = runners[4]({"x": X})[0]
+    ref = Predictor(AnalysisConfig(model_dir=mdl)).run({"x": X})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
