@@ -316,7 +316,9 @@ def _ctc_greedy_decoder(ctx, ins, attrs):
     compact = jnp.take_along_axis(ids, order, axis=1)
     nkeep = jnp.sum(keep, axis=1)
     out = jnp.where(jnp.arange(T)[None, :] < nkeep[:, None], compact, -1)
-    return {"Out": [out], "OutLength": [nkeep.astype(jnp.int64)]}
+    # int32 on device: int64 is an API-boundary type (as_jax_dtype) —
+    # astype(int64) under disabled x64 truncates with a UserWarning
+    return {"Out": [out], "OutLength": [nkeep.astype(jnp.int32)]}
 
 
 @register_op("spectral_norm", diff_inputs=["Weight"])
@@ -477,7 +479,7 @@ def _hash_op(ctx, ins, attrs):
     mixed = x[..., None] * primes + jnp.asarray(
         [k * 2246822519 for k in range(num_hash)], jnp.uint32)
     mixed = mixed ^ (mixed >> 15)
-    out = (mixed % jnp.uint32(mod_by)).astype(jnp.int64)
+    out = (mixed % jnp.uint32(mod_by)).astype(jnp.int32)
     return {"Out": [out]}
 
 

@@ -72,7 +72,7 @@ def test_understand_sentiment_lstm(fresh_programs):
                                        "label": LAB[i:i + B],
                                        "length": LEN[i:i + B]},
                            fetch_list=[loss.name, acc.name], scope=scope)
-            accs.append(float(a))
+            accs.append(np.asarray(a).item())
     assert np.mean(accs[-10:]) > 0.85, np.mean(accs[-10:])
 
 
@@ -111,7 +111,7 @@ def test_word2vec(fresh_programs):
             feed["target"] = nxt[i:i + B]
             _, a = exe.run(main, feed=feed, fetch_list=[loss.name, acc.name],
                            scope=scope)
-            accs.append(float(a))
+            accs.append(np.asarray(a).item())
     assert np.mean(accs[-10:]) > 0.9, np.mean(accs[-10:])
 
 

@@ -52,7 +52,7 @@ def _train(net_fn, steps=80, lr=0.01):
     accs = []
     for batch in reader_mod.batch(mnist.test(n=512), 128)():
         (a,) = exe.run(test_prog, feed=feeder.feed(batch), fetch_list=[acc])
-        accs.append(float(a))
+        accs.append(np.asarray(a).item())
     return float(np.mean(accs)), main, test_prog, img, probs, exe
 
 

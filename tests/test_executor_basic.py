@@ -89,4 +89,4 @@ def test_persistable_state_updates(fresh_programs):
     exe.run(startup)
     for i in range(3):
         (c,) = exe.run(main, fetch_list=[counter])
-    assert float(c) == 3.0
+    assert np.asarray(c).item() == 3.0

@@ -113,8 +113,8 @@ def test_while_loop_sums(fresh_programs):
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup, scope=scope)
     (sv, iv) = exe.run(main, fetch_list=[s, i], scope=scope)
-    assert float(sv) == 45.0
-    assert float(iv) == 10.0
+    assert np.asarray(sv).item() == 45.0
+    assert np.asarray(iv).item() == 10.0
 
 
 def test_conditional_block(fresh_programs):

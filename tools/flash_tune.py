@@ -1,0 +1,87 @@
+"""Flash-attention block-size sweep for the hardware window.
+
+Runs `bench.py --only <workload>` in killable subprocesses across a
+BQ x BK grid (PADDLE_TPU_FLASH_BQ/BK env, the kernels' only tuning
+knobs) and reports the best throughput. One command converts a rare
+TPU window into a committed kernel configuration instead of a manual
+env-juggling session (docs/PERF.md step 6).
+
+    python tools/flash_tune.py transformer_long
+    python tools/flash_tune.py transformer --bq 128,256 --bk 128,256
+
+Prints one JSON line per configuration plus a final `best` line. Runs
+serially (single-client tunnel — never two TPU processes at once).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config(workload, bq, bk, timeout_s, quick):
+    env = dict(os.environ)
+    env["PADDLE_TPU_FLASH_BQ"] = str(bq)
+    env["PADDLE_TPU_FLASH_BK"] = str(bk)
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--only", workload]
+    if quick:
+        cmd.append("--quick")
+    try:
+        out = subprocess.run(cmd, env=env, timeout=timeout_s,
+                             capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return {"bq": bq, "bk": bk, "error": "timeout"}
+    for line in out.stdout.splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict) and "value" in row:
+            return {"bq": bq, "bk": bk, "value": row["value"],
+                    "unit": row.get("unit"), "mfu": row.get("mfu"),
+                    "pallas_mode": row.get("pallas_mode")}
+    return {"bq": bq, "bk": bk,
+            "error": "no result row (rc=%s)" % out.returncode}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workload", nargs="?", default="transformer_long")
+    ap.add_argument("--bq", default="128,256,512",
+                    help="comma-separated BQ values (multiples of 8)")
+    ap.add_argument("--bk", default="128,256",
+                    help="comma-separated BK values (multiples of 128)")
+    ap.add_argument("--timeout", type=int, default=900,
+                    help="per-config deadline, seconds")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    results = []
+    for bq in (int(v) for v in args.bq.split(",")):
+        for bk in (int(v) for v in args.bk.split(",")):
+            row = run_config(args.workload, bq, bk, args.timeout,
+                             args.quick)
+            print(json.dumps(row), flush=True)
+            results.append(row)
+
+    ok = [r for r in results if "value" in r]
+    if not ok:
+        print(json.dumps({"best": None,
+                          "error": "no configuration produced a row"}),
+              flush=True)
+        return 1
+    best = max(ok, key=lambda r: r["value"])
+    print(json.dumps({"best": best,
+                      "env": "PADDLE_TPU_FLASH_BQ=%d PADDLE_TPU_FLASH_BK=%d"
+                             % (best["bq"], best["bk"])}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
