@@ -334,6 +334,36 @@ def bench_bert(amp, quick, uses_flash=False):
                          uses_flash=uses_flash)
 
 
+def bench_gpt_causal(amp, quick, uses_flash=False):
+    """Decoder-only causal LM at S=1024: the causal flash kernel's
+    block-skipping showcase (~2x the dense-causal step FLOPs)."""
+    import paddle_tpu.models.gpt as gpt
+
+    seq, batch = 1024, (2 if quick else 16)
+    cfg = dict(d_model=512, d_ff=2048, n_head=8, n_layer=6, vocab=32000,
+               max_length=seq, dropout=0.1)
+
+    def build():
+        import paddle_tpu as fluid
+
+        ckpts = []
+        loss, _ = gpt.build(cfg, seq_len=seq, checkpoints=ckpts)
+        opt = _maybe_recompute(
+            fluid.optimizer.Adam(learning_rate=1e-4), ckpts)
+        opt.minimize(loss)
+        return loss
+
+    def feed():
+        rs = np.random.RandomState(0)
+        return {"ids": rs.randint(1, cfg["vocab"],
+                                  (batch, seq)).astype("int64")}
+
+    return _run_workload("gpt_causal_s1024_train_tokens_per_sec_per_chip",
+                         "tokens/sec", batch * seq, build, feed, amp,
+                         quick=quick, recompute=_recompute_requested(),
+                         uses_flash=uses_flash)
+
+
 def bench_deepfm(amp, quick, uses_flash=False):
     import paddle_tpu.models.ctr as ctr
 
@@ -366,17 +396,18 @@ WORKLOADS = {
     "vgg16": bench_vgg16,
     "bert": bench_bert,
     "deepfm": bench_deepfm,
+    "gpt_causal": bench_gpt_causal,
 }
 
 # Safe (no custom-kernel) workloads first: if the tunnel wedges or a
 # Pallas compile hangs partway through, the rows already printed stand.
 ORDER = ["resnet50", "vgg16", "deepfm", "transformer", "bert",
-         "transformer_long"]
+         "transformer_long", "gpt_causal"]
 
 # Workloads whose default path runs the Pallas flash-attention kernel;
 # eligible for one retry with PADDLE_TPU_FUSED_ATTENTION=0.
 ATTENTION_WORKLOADS = frozenset(
-    {"transformer", "transformer_long", "bert"})
+    {"transformer", "transformer_long", "bert", "gpt_causal"})
 
 assert set(ORDER) == set(WORKLOADS), "ORDER out of sync with WORKLOADS"
 

@@ -8,8 +8,8 @@ program-builder: call inside a fluid.program_guard and it appends ops to
 the current main/startup programs, returning the loss/feed variables.
 """
 
-from . import (mnist, resnet, se_resnext, vgg, transformer, bert, ctr,
+from . import (gpt, mnist, resnet, se_resnext, vgg, transformer, bert, ctr,
                stacked_lstm, machine_translation)
 
-__all__ = ["mnist", "resnet", "vgg", "transformer", "bert", "ctr",
-           "stacked_lstm", "machine_translation"]
+__all__ = ["gpt", "mnist", "resnet", "se_resnext", "vgg", "transformer",
+           "bert", "ctr", "stacked_lstm", "machine_translation"]

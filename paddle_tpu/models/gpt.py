@@ -1,0 +1,98 @@
+"""Decoder-only causal language model (GPT-style).
+
+Beyond-reference model family: the reference era (Fluid v1.3) predates
+decoder-only LMs, but the long-context story this framework is built
+around (causal flash attention with above-diagonal block skipping, ring
+attention under an sp mesh, recompute boundaries) is exactly a
+decoder-only workload — this model is its showcase. Built from the same
+fluid-style layer calls as models/transformer.py (whose provenance is
+/root/reference/python/paddle/fluid/tests/unittests/dist_transformer.py).
+
+Feeds: ids [B, S] int64 tokens; the loss is next-token cross entropy
+with the final position dropped (labels are ids shifted left), pad id 0
+masked out of the loss.
+"""
+
+from .. import layers
+from ..param_attr import ParamAttr
+from .transformer import (_causal_bias, _ffn, _pad_bias, _prenorm,
+                          multi_head_attention)
+
+__all__ = ["base_config", "build"]
+
+
+def base_config():
+    return dict(d_model=768, d_ff=3072, n_head=12, n_layer=12,
+                vocab=50304, max_length=1024, dropout=0.1)
+
+
+def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
+          checkpoints=None):
+    """Causal LM training graph; returns (avg_loss, feed_names).
+
+    On the fused path, decoder self-attention uses the kernel's causal
+    mask with above-diagonal block skipping; the composed path folds a
+    dense causal bias. checkpoints collects per-layer recompute
+    boundaries for RecomputeOptimizer.
+    """
+    if use_fused_attention is None:
+        from ..ops.attention import fused_attention_enabled
+
+        use_fused_attention = fused_attention_enabled()
+    cfg = cfg or base_config()
+    ids = layers.data("ids", [seq_len], dtype="int64")
+    pad_bias = _pad_bias(ids)
+    if use_fused_attention:
+        self_bias, self_causal = pad_bias, True
+    else:
+        self_bias = layers.elementwise_add(pad_bias, _causal_bias(seq_len))
+        self_causal = False
+
+    word = layers.embedding(ids, [cfg["vocab"], cfg["d_model"]],
+                            param_attr=ParamAttr(name="gpt_word_emb"))
+    pos_ids = layers.reshape(layers.range(0, seq_len, 1, "int64"),
+                             [1, seq_len])
+    pos = layers.embedding(pos_ids, [cfg["max_length"], cfg["d_model"]],
+                           param_attr=ParamAttr(name="gpt_pos_emb"))
+    x = layers.elementwise_add(word, pos)
+    if cfg["dropout"]:
+        x = layers.dropout(x, cfg["dropout"], is_test=is_test)
+
+    for i in range(cfg["n_layer"]):
+        nm = "gpt_%d" % i
+        x = _prenorm(x, lambda h, nm=nm: multi_head_attention(
+            h, h, self_bias, cfg["d_model"], cfg["n_head"], cfg["dropout"],
+            is_test, nm + "_att", use_fused_attention,
+            causal=self_causal),
+            cfg["dropout"], is_test, nm + "_pre1")
+        x = _prenorm(x, lambda h, nm=nm: _ffn(h, cfg["d_model"],
+                                              cfg["d_ff"], nm),
+                     cfg["dropout"], is_test, nm + "_pre2")
+        if checkpoints is not None:
+            checkpoints.append(x)
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name="gpt_ln_f_s"),
+                          bias_attr=ParamAttr(name="gpt_ln_f_b"))
+
+    logits = layers.fc(x, cfg["vocab"], num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=ParamAttr(name="gpt_out_proj.w_0"))
+
+    # next-token targets: ids shifted left; the last position has no
+    # target, and pad positions (id 0) are masked out of the loss
+    labels = layers.concat([
+        layers.slice(ids, axes=[1], starts=[1], ends=[seq_len]),
+        layers.fill_constant_batch_size_like(ids, [-1, 1], "int64", 0),
+    ], axis=1)
+    cost = layers.softmax_with_cross_entropy(
+        logits, layers.reshape(labels, [-1, seq_len, 1]))
+    valid = layers.cast(
+        layers.greater_than(
+            labels, layers.fill_constant([1], "int64", 0)), "float32")
+    valid = layers.reshape(valid, [-1, seq_len, 1])
+    total = layers.reduce_sum(layers.elementwise_mul(cost, valid))
+    count = layers.elementwise_max(
+        layers.reduce_sum(valid), layers.fill_constant([1], "float32", 1.0))
+    avg = layers.elementwise_div(total, count)
+    return avg, ["ids"]
+

@@ -65,6 +65,7 @@ SLOW_TESTS = {
     "test_models.py::test_se_resnext_builds_and_steps",
     "test_models.py::test_stacked_lstm_trains",
     "test_models.py::test_transformer_trains",
+    "test_models.py::test_gpt_causal_lm_trains_fused_matches_composed",
     "test_moe_engine.py::test_moe_aux_loss_changes_routing",
     "test_moe_engine.py::test_moe_expert_parallel_matches_dense_fallback",
     "test_moe_engine.py::test_moe_step_hlo_contains_expert_collective",

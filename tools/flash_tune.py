@@ -22,32 +22,57 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 
-def run_config(workload, bq, bk, timeout_s, quick):
+def run_config(workload, bq, bk, timeout_s, quick, require_fused):
+    import signal
+
     env = dict(os.environ)
     env["PADDLE_TPU_FLASH_BQ"] = str(bq)
     env["PADDLE_TPU_FLASH_BK"] = str(bk)
+    # keep bench's own deadlines INSIDE ours so its killpg cleanup runs
+    # before we ever have to kill anything
+    env["PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT"] = str(max(60, timeout_s - 90))
+    env["PADDLE_TPU_BENCH_TOTAL_BUDGET"] = str(timeout_s)
     cmd = [sys.executable, os.path.join(REPO, "bench.py"),
            "--only", workload]
     if quick:
         cmd.append("--quick")
+    # own process group: a timeout must kill bench AND its --worker
+    # grandchild, or a wedged config leaks a live TPU process into the
+    # next config's run (single-client tunnel)
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True,
+                            start_new_session=True)
     try:
-        out = subprocess.run(cmd, env=env, timeout=timeout_s,
-                             capture_output=True, text=True)
+        stdout, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
         return {"bq": bq, "bk": bk, "error": "timeout"}
-    for line in out.stdout.splitlines():
+    for line in stdout.splitlines():
         try:
             row = json.loads(line)
         except ValueError:
             continue
-        if isinstance(row, dict) and "value" in row:
-            return {"bq": bq, "bk": bk, "value": row["value"],
-                    "unit": row.get("unit"), "mfu": row.get("mfu"),
-                    "pallas_mode": row.get("pallas_mode")}
+        if not (isinstance(row, dict) and "value" in row):
+            continue
+        if require_fused and "pallas_mode" not in row:
+            # bench's unfused-attention retry row: the kernel this
+            # config tunes never ran — a crashing BQ/BK must not get
+            # credited with composed-path throughput
+            return {"bq": bq, "bk": bk,
+                    "error": "fused path failed (composed-retry row "
+                             "rejected)"}
+        return {"bq": bq, "bk": bk, "value": row["value"],
+                "unit": row.get("unit"), "mfu": row.get("mfu"),
+                "pallas_mode": row.get("pallas_mode")}
     return {"bq": bq, "bk": bk,
-            "error": "no result row (rc=%s)" % out.returncode}
+            "error": "no result row (rc=%s)" % proc.returncode}
 
 
 def main():
@@ -62,11 +87,14 @@ def main():
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
 
+    import bench as _bench
+
+    require_fused = args.workload in _bench.ATTENTION_WORKLOADS
     results = []
     for bq in (int(v) for v in args.bq.split(",")):
         for bk in (int(v) for v in args.bk.split(",")):
             row = run_config(args.workload, bq, bk, args.timeout,
-                             args.quick)
+                             args.quick, require_fused)
             print(json.dumps(row), flush=True)
             results.append(row)
 
