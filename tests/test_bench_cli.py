@@ -34,8 +34,10 @@ def _run(args, env_extra, timeout):
 
 
 def test_bench_orchestrator_happy_path():
+    # generous deadline: under full-suite contention a cold deepfm
+    # compile has been observed to exceed 420s (flaky otherwise)
     rc, rows = _run(["--only", "deepfm", "--quick"],
-                    {"PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT": "420"}, 450)
+                    {"PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT": "560"}, 590)
     assert rc == 0
     assert len(rows) == 1
     row = rows[0]
@@ -49,7 +51,7 @@ def test_bench_fused_row_records_pallas_mode():
     # On the CPU backend interpret mode is expected and legal; the row
     # must say so (hardware rows carry "compiled" or fail — below).
     rc, rows = _run(["--only", "transformer", "--quick"],
-                    {"PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT": "420"}, 450)
+                    {"PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT": "560"}, 590)
     assert rc == 0
     result = [r for r in rows if "error" not in r]
     assert result and result[0]["pallas_mode"] == "interpret"

@@ -27,7 +27,7 @@ def _train(build_fn, feed_fn, steps=4, lr=1e-3):
         losses = []
         for _ in range(steps):
             (l,) = exe.run(main, feed=feed_fn(), fetch_list=[loss], scope=scope)
-            losses.append(float(l))
+            losses.append(np.asarray(l).item())
     return losses
 
 
