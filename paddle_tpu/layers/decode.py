@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from ..layer_helper import LayerHelper
 
-__all__ = ["beam_search", "beam_search_decode", "beam_gather", "py_func"]
+__all__ = ["kv_cache_write", "beam_search", "beam_search_decode", "beam_gather", "py_func"]
 
 
 def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None,
@@ -90,3 +90,17 @@ def beam_gather(x, parent_idx, name=None):
                      inputs={"X": [x], "Index": [parent_idx]},
                      outputs={"Out": [out]})
     return out
+
+
+def kv_cache_write(cache, update, pos, name=None):
+    """Write `update` [B, H, 1, D] into persistable `cache` [B, H, S, D]
+    at sequence position `pos` (a [1] int var). Returns the cache var
+    (the op writes the var in place graph-wise; the executor's donation
+    makes it in-place on device). See models/gpt.py build_decode_step."""
+    helper = LayerHelper("kv_cache_write", name=name)
+    helper.append_op(
+        type="kv_cache_write",
+        inputs={"Cache": [cache], "Update": [update], "Pos": [pos]},
+        outputs={"Out": [cache]},
+        attrs={})
+    return cache

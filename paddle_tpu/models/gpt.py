@@ -96,3 +96,138 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
     avg = layers.elementwise_div(total, count)
     return avg, ["ids"]
 
+
+
+def build_decode_step(cfg=None, batch=1, max_len=None):
+    """Incremental decoding step graph with donated KV caches.
+
+    Feeds: token [B, 1] int64 (the current position's input token) and
+    pos [1] int64 (its position). Per-layer K/V caches live as
+    persistable [B, H, max_len, Dh] state the executor DONATES — the
+    `kv_cache_write` update is in-place on device, so a decode step
+    moves O(1) data. Weights share the training graph's parameter names
+    (gpt_*), so after running this program's startup, overwrite them
+    with trained values (same names) — see `generate`.
+
+    Returns (logits_var, cache_names). Fetch logits [B, 1, vocab].
+    """
+    cfg = cfg or base_config()
+    if max_len is None:
+        max_len = cfg["max_length"]
+    d_model, n_head = cfg["d_model"], cfg["n_head"]
+    d_head = d_model // n_head
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("gpt_decode")
+    token = layers.data("token", [1], dtype="int64")
+    pos = layers.data("pos", [1], dtype="int64", append_batch_size=False)
+
+    # lookup_table squeezes trailing-1 id dims (reference semantics):
+    # [B,1] ids -> [B,D]; restore the [B,1,D] step layout explicitly
+    word = layers.reshape(
+        layers.embedding(token, [cfg["vocab"], d_model],
+                         param_attr=ParamAttr(name="gpt_word_emb")),
+        [-1, 1, d_model])
+    posv = layers.reshape(
+        layers.embedding(layers.reshape(pos, [1, 1]),
+                         [cfg["max_length"], d_model],
+                         param_attr=ParamAttr(name="gpt_pos_emb")),
+        [1, 1, d_model])
+    x = layers.elementwise_add(word, posv)    # [B, 1, D]
+
+    # visibility over cache rows: positions <= pos attend, later rows
+    # (zeros from init) mask out
+    ar = layers.reshape(layers.range(0, max_len, 1, "int64"), [1, max_len])
+    vis = layers.cast(layers.less_equal(
+        ar, layers.reshape(pos, [1, 1])), "float32")
+    bias = layers.scale(layers.elementwise_sub(
+        layers.fill_constant([1], "float32", 1.0), vis), scale=-1e9)
+    bias = layers.reshape(bias, [1, 1, 1, max_len])
+
+    cache_names = []
+    for i in range(cfg["n_layer"]):
+        nm = "gpt_%d" % i
+        ck = helper.create_global_variable(
+            name=nm + "_cache_k", shape=(batch, n_head, max_len, d_head))
+        cv = helper.create_global_variable(
+            name=nm + "_cache_v", shape=(batch, n_head, max_len, d_head))
+        cache_names += [ck.name, cv.name]
+
+        h = layers.layer_norm(x, begin_norm_axis=2,
+                              param_attr=ParamAttr(name=nm + "_pre1_ln_s"),
+                              bias_attr=ParamAttr(name=nm + "_pre1_ln_b"))
+        q = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=ParamAttr(name=nm + "_att_q.w_0"))
+        k = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=ParamAttr(name=nm + "_att_k.w_0"))
+        v = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=ParamAttr(name=nm + "_att_v.w_0"))
+
+        def heads(t):
+            t = layers.reshape(t, [-1, 1, n_head, d_head])
+            return layers.transpose(t, perm=[0, 2, 1, 3])  # [B,H,1,Dh]
+
+        q, k, v = heads(q), heads(k), heads(v)
+        ck = layers.kv_cache_write(ck, k, pos)
+        cv = layers.kv_cache_write(cv, v, pos)
+        scores = layers.matmul(q, ck, transpose_y=True,
+                               alpha=d_head ** -0.5)    # [B,H,1,S]
+        scores = layers.elementwise_add(scores, bias)
+        w = layers.softmax(scores)
+        ctxv = layers.matmul(w, cv)                     # [B,H,1,Dh]
+        ctxv = layers.transpose(ctxv, perm=[0, 2, 1, 3])
+        ctxv = layers.reshape(ctxv, [-1, 1, d_model])
+        att = layers.fc(ctxv, d_model, num_flatten_dims=2, bias_attr=False,
+                        param_attr=ParamAttr(name=nm + "_att_o.w_0"))
+        x = layers.elementwise_add(x, att)
+
+        h2 = layers.layer_norm(x, begin_norm_axis=2,
+                               param_attr=ParamAttr(name=nm + "_pre2_ln_s"),
+                               bias_attr=ParamAttr(name=nm + "_pre2_ln_b"))
+        f = layers.fc(h2, cfg["d_ff"], num_flatten_dims=2, act="relu",
+                      param_attr=ParamAttr(name=nm + "_ffn1.w_0"))
+        f = layers.fc(f, d_model, num_flatten_dims=2,
+                      param_attr=ParamAttr(name=nm + "_ffn2.w_0"))
+        x = layers.elementwise_add(x, f)
+
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name="gpt_ln_f_s"),
+                          bias_attr=ParamAttr(name="gpt_ln_f_b"))
+    logits = layers.fc(x, cfg["vocab"], num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=ParamAttr(name="gpt_out_proj.w_0"))
+    return logits, cache_names
+
+
+def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope):
+    """Greedy autoregressive generation with the KV-cache decode step.
+
+    prompt_ids: [B, P] int array. Runs P prefill steps (one token at a
+    time through the same compiled step — ONE executable for the whole
+    session) then n_new greedy steps. Returns [B, P + n_new] ids.
+    """
+    import numpy as np
+
+    ids = np.asarray(prompt_ids, dtype="int64")
+    B, P = ids.shape
+    max_len = None
+    for v in decode_prog.global_block().vars.values():
+        if v.name.endswith("_cache_k"):
+            max_len = v.shape[2]
+    if max_len is not None and P + n_new > max_len:
+        raise ValueError(
+            "generate: prompt (%d) + new tokens (%d) exceeds the decode "
+            "step's max_len=%d — positions past the cache silently clamp "
+            "(dynamic_update_slice) and would corrupt output" %
+            (P, n_new, max_len))
+    out = [ids[:, i] for i in range(P)]
+    for t in range(P + n_new - 1):
+        tok = out[t][:, None]
+        (logits,) = exe.run(
+            decode_prog,
+            feed={"token": tok, "pos": np.array([t], dtype="int64")},
+            fetch_list=[logits_var], scope=scope)
+        next_tok = np.argmax(logits[:, 0], axis=-1).astype("int64")
+        if t + 1 >= P:
+            out.append(next_tok)
+    return np.stack(out, axis=1)

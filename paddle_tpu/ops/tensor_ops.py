@@ -282,3 +282,20 @@ def _roll(ctx, ins, attrs):
 def _meshgrid(ctx, ins, attrs):
     outs = jnp.meshgrid(*ins["X"], indexing="ij")
     return {"Out": list(outs)}
+
+
+@register_op("kv_cache_write", no_grad=True)
+def _kv_cache_write(ctx, ins, attrs):
+    """Write a decode step's K or V rows into a [B, H, S, D] cache at a
+    runtime position (lax.dynamic_update_slice on the sequence axis) —
+    the incremental-decoding primitive (models/gpt.py decode step). The
+    cache is persistable state: the executor donates it, so the update
+    is in-place on device. Inference-only (no_grad)."""
+    import jax
+
+    cache, upd, pos = ins["Cache"][0], ins["Update"][0], ins["Pos"][0]
+    pos = pos.reshape(()).astype(jnp.int32)
+    zero = jnp.int32(0)
+    out = jax.lax.dynamic_update_slice(cache, upd.astype(cache.dtype),
+                                       (zero, zero, pos, zero))
+    return {"Out": [out]}

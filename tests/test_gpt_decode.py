@@ -1,0 +1,136 @@
+"""KV-cache incremental decoding for the GPT model family.
+
+The decode-step graph (models/gpt.py build_decode_step) holds per-layer
+K/V caches as persistable state the executor donates — updates are
+in-place on device via `kv_cache_write` (lax.dynamic_update_slice), and
+the whole generation session reuses ONE compiled executable. The
+contract pinned here: greedy generation through the cache path equals
+argmax over the full training model's logits at every position.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.scope import Scope, scope_guard
+from paddle_tpu.models import gpt
+
+CFG = dict(d_model=32, d_ff=64, n_head=2, n_layer=2, vocab=64,
+           max_length=16, dropout=0.0)
+
+
+def _trained_scope():
+    """A couple of Adam steps so the weights are non-degenerate."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    rs = np.random.RandomState(0)
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss, _ = gpt.build(CFG, seq_len=8, use_fused_attention=False)
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        feed = {"ids": rs.randint(1, 64, (2, 8)).astype("int64")}
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    params = {n: np.asarray(scope.find_var(n))
+              for n in main.global_block().vars
+              if scope.find_var(n) is not None
+              and getattr(main.global_block().vars[n], "persistable",
+                          False)}
+    return params
+
+
+def test_kv_cache_decode_matches_full_forward():
+    params = _trained_scope()
+
+    B, P, NEW, S = 2, 3, 4, 12
+    rs = np.random.RandomState(1)
+    prompt = rs.randint(1, 64, (B, P)).astype("int64")
+
+    # decode path: fresh program/scope, weights overwritten by name
+    dec_prog, dec_start = fluid.Program(), fluid.Program()
+    dscope = Scope()
+    with scope_guard(dscope):
+        with fluid.program_guard(dec_prog, dec_start):
+            logits, cache_names = gpt.build_decode_step(CFG, batch=B,
+                                                        max_len=S)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(dec_start, scope=dscope)
+        for n, v in params.items():
+            if dscope.find_var(n) is not None:
+                dscope.set_var(n, v)
+        got = gpt.generate(exe, dec_prog, logits, prompt, NEW, dscope)
+    assert got.shape == (B, P + NEW)
+    assert (got[:, :P] == prompt).all()
+
+    # reference: full forward of the training graph (is_test) on each
+    # prefix; next token = argmax at the last real position
+    full_prog, full_start = fluid.Program(), fluid.Program()
+    fscope = Scope()
+    seq_len = P + NEW
+    with scope_guard(fscope):
+        with fluid.program_guard(full_prog, full_start):
+            # rebuild WITHOUT loss tail: reuse build and fetch its
+            # logits by reconstructing — simplest: rebuild graph and
+            # fetch the pre-loss projection via a fresh is_test build
+            loss, _ = gpt.build(CFG, seq_len=seq_len, is_test=True,
+                                use_fused_attention=False)
+        exe2 = fluid.Executor(fluid.TPUPlace())
+        exe2.run(full_start, scope=fscope)
+        for n, v in params.items():
+            if fscope.find_var(n) is not None:
+                fscope.set_var(n, v)
+        # find the logits var: output of the gpt_out_proj fc
+        logits_name = None
+        for op in full_prog.global_block().ops:
+            if op.type == "mul" and "gpt_out_proj.w_0" in op.inputs.get(
+                    "Y", []):
+                logits_name = op.outputs["Out"][0]
+        assert logits_name is not None
+        ref = np.array(prompt)
+        for t in range(NEW):
+            cur = ref
+            pad = np.zeros((B, seq_len - cur.shape[1]), dtype="int64")
+            (lg,) = exe2.run(full_prog,
+                             feed={"ids": np.concatenate([cur, pad], 1)},
+                             fetch_list=[logits_name], scope=fscope)
+            nxt = np.argmax(lg[:, cur.shape[1] - 1], axis=-1)
+            ref = np.concatenate([ref, nxt[:, None].astype("int64")], 1)
+
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_kv_cache_is_donated_state():
+    """The caches must be mutable donated state of the decode step —
+    in-place on device, visible in the executable's aliasing."""
+    B, S = 1, 8
+    dec_prog, dec_start = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(dec_prog, dec_start):
+            logits, cache_names = gpt.build_decode_step(CFG, batch=B,
+                                                        max_len=S)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(dec_start, scope=scope)
+        feed = {"token": np.array([[3]], dtype="int64"),
+                "pos": np.array([0], dtype="int64")}
+        txt = exe.lowered_hlo(dec_prog, feed=feed, fetch_list=[logits],
+                              scope=scope)
+    assert "input_output_alias" in txt
+    # every per-layer cache is donated (aliased) state
+    assert len(cache_names) == 2 * CFG["n_layer"]
+
+
+def test_generate_rejects_overflow_past_cache():
+    B, S = 1, 8
+    dec_prog, dec_start = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(dec_prog, dec_start):
+            logits, _ = gpt.build_decode_step(CFG, batch=B, max_len=S)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(dec_start, scope=scope)
+        with pytest.raises(ValueError, match="max_len"):
+            gpt.generate(exe, dec_prog, logits,
+                         np.ones((B, 5), dtype="int64"), 4, scope)
