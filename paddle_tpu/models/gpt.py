@@ -199,12 +199,18 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
     return logits, cache_names
 
 
-def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope):
-    """Greedy autoregressive generation with the KV-cache decode step.
+def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope,
+             temperature=0.0, top_k=0, seed=0):
+    """Autoregressive generation with the KV-cache decode step.
 
     prompt_ids: [B, P] int array. Runs P prefill steps (one token at a
     time through the same compiled step — ONE executable for the whole
-    session) then n_new greedy steps. Returns [B, P + n_new] ids.
+    session) then n_new sampling steps. Returns [B, P + n_new] ids.
+
+    temperature=0 (default) is greedy argmax; temperature>0 samples from
+    softmax(logits / temperature), optionally truncated to the top_k
+    most likely tokens. Sampling happens host-side (numpy, seeded) —
+    the device step stays deterministic and cache-compatible.
     """
     import numpy as np
 
@@ -220,6 +226,10 @@ def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope):
             "step's max_len=%d — positions past the cache silently clamp "
             "(dynamic_update_slice) and would corrupt output" %
             (P, n_new, max_len))
+    if temperature < 0:
+        raise ValueError("temperature must be >= 0 (0 = greedy); got %r"
+                         % (temperature,))
+    rng = np.random.RandomState(seed)
     out = [ids[:, i] for i in range(P)]
     for t in range(P + n_new - 1):
         tok = out[t][:, None]
@@ -227,7 +237,21 @@ def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope):
             decode_prog,
             feed={"token": tok, "pos": np.array([t], dtype="int64")},
             fetch_list=[logits_var], scope=scope)
-        next_tok = np.argmax(logits[:, 0], axis=-1).astype("int64")
-        if t + 1 >= P:
-            out.append(next_tok)
+        if t + 1 < P:
+            continue  # prefill: only the cache write matters
+        lg = logits[:, 0].astype("float64")
+        if temperature > 0:
+            lg = lg / float(temperature)
+            if top_k and top_k > 0:
+                k = min(int(top_k), lg.shape[-1])
+                kth = np.partition(lg, -k, axis=-1)[:, -k, None]
+                lg = np.where(lg < kth, -np.inf, lg)
+            p = np.exp(lg - lg.max(axis=-1, keepdims=True))
+            p = p / p.sum(axis=-1, keepdims=True)
+            next_tok = np.array(
+                [rng.choice(p.shape[1], p=p[b]) for b in range(B)],
+                dtype="int64")
+        else:
+            next_tok = np.argmax(lg, axis=-1).astype("int64")
+        out.append(next_tok)
     return np.stack(out, axis=1)

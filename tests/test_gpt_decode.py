@@ -134,3 +134,31 @@ def test_generate_rejects_overflow_past_cache():
         with pytest.raises(ValueError, match="max_len"):
             gpt.generate(exe, dec_prog, logits,
                          np.ones((B, 5), dtype="int64"), 4, scope)
+
+
+def test_generate_sampling_modes():
+    """temperature>0 samples (seeded, reproducible; top_k truncates to
+    the k most likely tokens); temperature=0 stays greedy."""
+    params = _trained_scope()
+    B, S = 1, 10
+    dec_prog, dec_start = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(dec_prog, dec_start):
+            logits, _ = gpt.build_decode_step(CFG, batch=B, max_len=S)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(dec_start, scope=scope)
+        for n, v in params.items():
+            if scope.find_var(n) is not None:
+                scope.set_var(n, v)
+        prompt = np.array([[5, 9]], dtype="int64")
+        a = gpt.generate(exe, dec_prog, logits, prompt, 5, scope,
+                         temperature=1.0, top_k=8, seed=3)
+        b = gpt.generate(exe, dec_prog, logits, prompt, 5, scope,
+                         temperature=1.0, top_k=8, seed=3)
+        c = gpt.generate(exe, dec_prog, logits, prompt, 5, scope,
+                         temperature=1.0, top_k=8, seed=4)
+        g = gpt.generate(exe, dec_prog, logits, prompt, 5, scope)
+    np.testing.assert_array_equal(a, b)      # seeded: reproducible
+    assert a.shape == c.shape == g.shape == (1, 7)
+    assert not np.array_equal(a, c) or not np.array_equal(a, g)
