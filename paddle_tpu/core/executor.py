@@ -153,10 +153,29 @@ class Executor:
                 # pre- and post-fusion
                 cost = lowered.cost_analysis()
             except Exception:
-                cost = lowered.compile().cost_analysis()
+                cost = None
             if isinstance(cost, (list, tuple)):  # one dict per computation
-                cost = cost[0] if cost else {}
-            plan.cost = dict(cost or {})
+                cost = cost[0] if cost else None
+            if not cost or not cost.get("flops"):
+                # some backends (e.g. the axon TPU tunnel) return None or a
+                # flop-less dict from the client-side estimate instead of
+                # raising — fall through to the compiled executable's
+                # analysis, which is authoritative. Never let this second
+                # path sink the caller (bench rows must complete even when
+                # the backend can't produce flops): keep the client dict.
+                try:
+                    compiled = lowered.compile().cost_analysis()
+                    if isinstance(compiled, (list, tuple)):
+                        compiled = compiled[0] if compiled else {}
+                    cost = compiled or cost
+                except Exception:
+                    pass
+            # cache only a usable result: a transiently-failing backend
+            # (wedged tunnel) must not pin {} on the plan — leave the cache
+            # empty so a later retry can succeed
+            if cost:
+                plan.cost = dict(cost)
+            return dict(cost or {})
         return dict(plan.cost)
 
     def lowered_hlo(

@@ -156,9 +156,16 @@ def test_generate_sampling_modes():
                          temperature=1.0, top_k=8, seed=3)
         b = gpt.generate(exe, dec_prog, logits, prompt, 5, scope,
                          temperature=1.0, top_k=8, seed=3)
-        c = gpt.generate(exe, dec_prog, logits, prompt, 5, scope,
-                         temperature=1.0, top_k=8, seed=4)
+        k1 = gpt.generate(exe, dec_prog, logits, prompt, 5, scope,
+                          temperature=1.0, top_k=1, seed=3)
+        hot = gpt.generate(exe, dec_prog, logits, prompt, 5, scope,
+                           temperature=100.0, seed=4)
         g = gpt.generate(exe, dec_prog, logits, prompt, 5, scope)
     np.testing.assert_array_equal(a, b)      # seeded: reproducible
-    assert a.shape == c.shape == g.shape == (1, 7)
-    assert not np.array_equal(a, c) or not np.array_equal(a, g)
+    assert a.shape == hot.shape == g.shape == (1, 7)
+    # top_k=1 masks everything but the argmax: must equal greedy exactly
+    np.testing.assert_array_equal(k1, g)
+    # temperature=100 over the full 64-token vocab is near-uniform: the
+    # chance of reproducing all 5 greedy tokens is ~(1/64)^5 — if this
+    # matches, sampling is silently falling back to greedy
+    assert not np.array_equal(hot, g)
