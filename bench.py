@@ -115,7 +115,7 @@ def _check_pallas_mode(uses_flash):
 
 def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
                   steps=10, warmup=3, quick=False, recompute=False,
-                  uses_flash=False):
+                  uses_flash=False, attention=False):
     """Build, warm up, time, and report one workload in its own Scope."""
     if quick:
         steps, warmup = 2, 1
@@ -168,6 +168,12 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # "compiled" (Mosaic) / "interpret"; absent on non-attention
             # workloads and on composed-path (unfused) runs
             **({"pallas_mode": pallas} if pallas else {}),
+            # attention workloads always say which attention math ran —
+            # "flash" (Pallas kernel) or "composed" (XLA-fused dense
+            # scores; via either the short-S dispatch or
+            # PADDLE_TPU_FUSED_ATTENTION=0)
+            **({"attention_path": "flash" if uses_flash else "composed"}
+               if attention else {}),
             "value": round(throughput, 1),
             "unit": unit,
             # recompute rows never compare against the plain-activation
@@ -175,8 +181,12 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # batch) — they anchor at 1.0 until a recompute baseline exists
             "vs_baseline": round(throughput / BASELINES[name], 3)
             if (name in BASELINES and not recompute) else 1.0,
-            "tflops_per_sec": round(achieved / 1e12, 2),
-            "mfu": round(achieved / peak, 4) if peak else None,
+            # None (not 0.0) when the backend produced no flop count —
+            # an unmeasured MFU must never masquerade as a measured zero
+            "tflops_per_sec": round(achieved / 1e12, 2)
+            if step_flops else None,
+            "mfu": round(achieved / peak, 4)
+            if (peak and step_flops) else None,
         }
         print(json.dumps(rec), flush=True)
         return rec
@@ -203,7 +213,7 @@ def _maybe_recompute(opt, checkpoints):
 def bench_transformer(amp, quick, uses_flash=False):
     import paddle_tpu.models.transformer as transformer
 
-    seq, batch = 128, (8 if quick else 256)
+    seq, batch = ATTENTION_SEQ["transformer"], (8 if quick else 256)
     cfg = transformer.base_config()
     cfg["max_length"] = seq
 
@@ -228,7 +238,7 @@ def bench_transformer(amp, quick, uses_flash=False):
     return _run_workload("transformer_base_train_tokens_per_sec_per_chip",
                          "tokens/sec", batch * seq, build, feed, amp,
                          quick=quick, recompute=_recompute_requested(),
-                         uses_flash=uses_flash)
+                         uses_flash=uses_flash, attention=True)
 
 
 def bench_transformer_long(amp, quick, uses_flash=False):
@@ -236,7 +246,7 @@ def bench_transformer_long(amp, quick, uses_flash=False):
     showcase — the composed path materializes [S, S] scores per head."""
     import paddle_tpu.models.transformer as transformer
 
-    seq, batch = 1024, (2 if quick else 32)
+    seq, batch = ATTENTION_SEQ["transformer_long"], (2 if quick else 32)
     cfg = transformer.base_config()
     cfg["max_length"] = seq
 
@@ -261,7 +271,7 @@ def bench_transformer_long(amp, quick, uses_flash=False):
     return _run_workload("transformer_base_s1024_train_tokens_per_sec_per_chip",
                          "tokens/sec", batch * seq, build, feed, amp,
                          quick=quick, recompute=_recompute_requested(),
-                         uses_flash=uses_flash)
+                         uses_flash=uses_flash, attention=True)
 
 
 def bench_resnet50(amp, quick, uses_flash=False):
@@ -313,7 +323,7 @@ def bench_vgg16(amp, quick, uses_flash=False):
 def bench_bert(amp, quick, uses_flash=False):
     import paddle_tpu.models.bert as bert
 
-    seq, max_mask = 128, 20
+    seq, max_mask = ATTENTION_SEQ["bert"], 20
     batch = 2 if quick else 64
     cfg = bert.base_config()
 
@@ -342,7 +352,7 @@ def bench_bert(amp, quick, uses_flash=False):
     return _run_workload("bert_base_mlm_train_tokens_per_sec_per_chip",
                          "tokens/sec", batch * seq, build, feed, amp,
                          quick=quick, recompute=_recompute_requested(),
-                         uses_flash=uses_flash)
+                         uses_flash=uses_flash, attention=True)
 
 
 def bench_gpt_causal(amp, quick, uses_flash=False):
@@ -350,7 +360,7 @@ def bench_gpt_causal(amp, quick, uses_flash=False):
     block-skipping showcase (~2x the dense-causal step FLOPs)."""
     import paddle_tpu.models.gpt as gpt
 
-    seq, batch = 1024, (2 if quick else 16)
+    seq, batch = ATTENTION_SEQ["gpt_causal"], (2 if quick else 16)
     cfg = dict(d_model=512, d_ff=2048, n_head=8, n_layer=6, vocab=32000,
                max_length=seq, dropout=0.1)
 
@@ -372,7 +382,7 @@ def bench_gpt_causal(amp, quick, uses_flash=False):
     return _run_workload("gpt_causal_s1024_train_tokens_per_sec_per_chip",
                          "tokens/sec", batch * seq, build, feed, amp,
                          quick=quick, recompute=_recompute_requested(),
-                         uses_flash=uses_flash)
+                         uses_flash=uses_flash, attention=True)
 
 
 def bench_deepfm(amp, quick, uses_flash=False):
@@ -415,10 +425,14 @@ WORKLOADS = {
 ORDER = ["resnet50", "vgg16", "deepfm", "transformer", "bert",
          "transformer_long", "gpt_causal"]
 
-# Workloads whose default path runs the Pallas flash-attention kernel;
-# eligible for one retry with PADDLE_TPU_FUSED_ATTENTION=0.
-ATTENTION_WORKLOADS = frozenset(
-    {"transformer", "transformer_long", "bert", "gpt_causal"})
+# Workloads with fused_attention ops in the graph, with their sequence
+# length; eligible for one retry with PADDLE_TPU_FUSED_ATTENTION=0.
+# Whether the Pallas kernel ACTUALLY runs is flash_effective(S): below
+# PADDLE_TPU_FLASH_MIN_SEQ the op lowers to the composed XLA math, and
+# the row's attention_path records which one was measured.
+ATTENTION_SEQ = {"transformer": 128, "transformer_long": 1024,
+                 "bert": 128, "gpt_causal": 1024}
+ATTENTION_WORKLOADS = frozenset(ATTENTION_SEQ)
 
 assert set(ORDER) == set(WORKLOADS), "ORDER out of sync with WORKLOADS"
 
@@ -464,12 +478,22 @@ def _run_worker(name, amp, quick):
         # single source of truth for "this row exercises the flash
         # kernel": the ATTENTION_WORKLOADS set + the fused-attention
         # env knob — per-call-site kwargs would drift (and default off)
-        uses_flash = name in ATTENTION_WORKLOADS and _fused_attention_on()
-        if uses_flash:
-            from paddle_tpu.ops.attention import pallas_mode
+        # — AND the short-S dispatch (flash_effective): a fused op that
+        # lowers to composed math must not be labeled a kernel row
+        fused = name in ATTENTION_WORKLOADS and _fused_attention_on()
+        uses_flash = fused
+        if fused:
+            from paddle_tpu.ops.attention import (flash_effective,
+                                                  pallas_mode)
 
-            _log("%s: flash-attention pallas mode = %s"
-                 % (name, pallas_mode()))
+            uses_flash = flash_effective(ATTENTION_SEQ[name])
+            if uses_flash:
+                _log("%s: flash-attention pallas mode = %s"
+                     % (name, pallas_mode()))
+            else:
+                _log("%s: S=%d below flash_min_seq — fused op dispatches "
+                     "to the composed XLA path"
+                     % (name, ATTENTION_SEQ[name]))
         WORKLOADS[name](amp, quick, uses_flash=uses_flash)
         return 0
     except Exception as exc:  # noqa: BLE001

@@ -170,10 +170,11 @@ class Executor:
                     cost = compiled or cost
                 except Exception:
                     pass
-            # cache only a usable result: a transiently-failing backend
-            # (wedged tunnel) must not pin {} on the plan — leave the cache
-            # empty so a later retry can succeed
-            if cost:
+            # cache only a usable (flop-bearing) result: a transiently-
+            # failing backend (wedged tunnel) must not pin a flop-less
+            # dict on the plan — leave the cache empty so a later retry
+            # can succeed
+            if cost and cost.get("flops"):
                 plan.cost = dict(cost)
             return dict(cost or {})
         return dict(plan.cost)

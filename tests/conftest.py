@@ -10,6 +10,10 @@ session's live TPU tunnel (JAX_PLATFORMS=axon) and crawls.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# kernel tests must keep exercising the Pallas path (interpret mode on
+# CPU) regardless of the short-S composed dispatch; policy tests
+# monkeypatch PADDLE_TPU_FLASH_MIN_SEQ themselves
+os.environ.setdefault("PADDLE_TPU_FLASH_MIN_SEQ", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,124 @@
+"""Short-S dispatch policy: below PADDLE_TPU_FLASH_MIN_SEQ the
+fused-attention entry points run the composed XLA math instead of the
+Pallas kernel (the 2026-07-31 v5e window measured the S=128 transformer
+slower on the kernel than the r1 composed baseline — flash pays off at
+long S). The policy must be numerics-neutral and honestly labeled.
+
+Note: tests/conftest.py pins PADDLE_TPU_FLASH_MIN_SEQ=0 suite-wide so
+kernel tests keep kernel coverage; these tests set the env themselves.
+"""
+
+import numpy as np
+import pytest
+
+
+def _qkv(B=2, H=2, S=64, D=32, seed=0):
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
+    return mk(), mk(), mk()
+
+
+def test_flash_effective_threshold(monkeypatch):
+    from paddle_tpu.ops import attention as A
+
+    monkeypatch.delenv("PADDLE_TPU_FLASH_MIN_SEQ", raising=False)
+    assert A.flash_min_seq() == 256
+    assert not A.flash_effective(128)
+    assert A.flash_effective(256)
+    assert A.flash_effective(1024)
+    # cross-attention: the longer side decides
+    assert A.flash_effective(64, 512)
+
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "0")
+    assert A.flash_effective(1)
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "100000")
+    assert not A.flash_effective(4096)
+
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "128k")
+    with pytest.raises(ValueError, match="PADDLE_TPU_FLASH_MIN_SEQ"):
+        A.flash_min_seq()
+
+
+def test_short_seq_dispatches_composed_same_numerics(monkeypatch):
+    """flash_attention at S<threshold returns the composed result, and it
+    matches the kernel (forced) within interpret-mode tolerance — fwd
+    and all three input grads."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import attention as A
+
+    q, k, v = _qkv()
+    scale = q.shape[-1] ** -0.5
+
+    def loss(fn):
+        return lambda a, b, c: (fn(a, b, c, None, scale) ** 2).sum()
+
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "256")
+    out_short = A.flash_attention(q, k, v, scale=scale)
+    g_short = jax.grad(loss(lambda a, b, c, bias, s: A.flash_attention(
+        a, b, c, bias, s)), argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_short),
+        np.asarray(A.composed_attention(q, k, v, scale=scale)),
+        rtol=0, atol=0)  # identical: it IS the composed path
+
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "0")
+    out_kernel = A.flash_attention(q, k, v, scale=scale)
+    g_kernel = jax.grad(loss(lambda a, b, c, bias, s: A.flash_attention(
+        a, b, c, bias, s)), argnums=(0, 1, 2))(q, k, v)
+
+    np.testing.assert_allclose(np.asarray(out_short),
+                               np.asarray(out_kernel), atol=2e-5)
+    for gs, gk in zip(g_short, g_kernel):
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gk),
+                                   atol=5e-5)
+    del jnp
+
+
+def test_short_seq_causal_and_bias_parity(monkeypatch):
+    """Causal masking and additive key bias agree between the dispatch
+    target and the kernel at short S."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import attention as A
+
+    q, k, v = _qkv(S=64)
+    scale = q.shape[-1] ** -0.5
+    # pad-style key bias: mask out the last 7 keys
+    bias = jnp.zeros((2, 1, 1, 64), jnp.float32).at[:, :, :, 57:].set(-1e9)
+
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "256")
+    out_c = A.flash_attention(q, k, v, bias, scale=scale, causal=True)
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "0")
+    out_k = A.flash_attention(q, k, v, bias, scale=scale, causal=True)
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_k),
+                               atol=2e-5)
+
+
+def test_fused_attention_op_short_seq_trains(monkeypatch):
+    """The fused_attention op in a Program at S<threshold lowers through
+    the composed dispatch and trains (grad path included)."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "256")
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(Scope()):
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [2, 8, 32], dtype="float32")  # [H,S,D]
+            q = layers.fc(x, 32, num_flatten_dims=3)
+            out = layers.fused_attention(q, q, q, scale=32 ** -0.5)
+            loss = layers.mean(out)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        val, = exe.run(
+            main,
+            feed={"x": np.random.RandomState(0)
+                  .randn(4, 2, 8, 32).astype("float32")},
+            fetch_list=[loss])
+    assert np.isfinite(np.asarray(val)).all()

@@ -31,6 +31,10 @@ def run_config(workload, bq, bk, timeout_s, quick, require_fused):
     env = dict(os.environ)
     env["PADDLE_TPU_FLASH_BQ"] = str(bq)
     env["PADDLE_TPU_FLASH_BK"] = str(bk)
+    # this tool tunes the KERNEL: pin the dispatch so a short-S workload
+    # (e.g. transformer at S=128) can't silently sweep the composed path,
+    # where BQ/BK are meaningless
+    env["PADDLE_TPU_FLASH_MIN_SEQ"] = "0"
     # keep bench's own deadlines INSIDE ours so its killpg cleanup runs
     # before we ever have to kill anything
     env["PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT"] = str(max(60, timeout_s - 90))

@@ -50,6 +50,21 @@ def probe():
 
 
 def flash():
+    # this stage validates the KERNEL: pin the dispatch for its duration
+    # only — later stages must see the production policy, where short S
+    # dispatches to the composed path
+    prior = os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ")
+    os.environ["PADDLE_TPU_FLASH_MIN_SEQ"] = "0"
+    try:
+        _flash_body()
+    finally:
+        if prior is None:
+            os.environ.pop("PADDLE_TPU_FLASH_MIN_SEQ", None)
+        else:
+            os.environ["PADDLE_TPU_FLASH_MIN_SEQ"] = prior
+
+
+def _flash_body():
     import jax
     import jax.numpy as jnp
     import numpy as np
