@@ -140,16 +140,35 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
         import jax.numpy as jnp
 
         feed = {k: jnp.asarray(v) for k, v in feed.items()}
-        _log("%s: compiling + %d warmup steps" % (name, warmup))
-        for _ in range(warmup):
-            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        # device-side K-step loop: one host dispatch per K steps
+        # (run_repeated's lax.scan) instead of K round-trips — isolates
+        # per-step host/tunnel dispatch latency from the device step
+        # time. Rows record steps_per_call so modes never mix.
+        spc = int(os.environ.get("PADDLE_TPU_BENCH_STEPS_PER_CALL", "1"))
+        if spc > 1:
+            steps = spc
+            _log("%s: compiling K-step scan + warmup (%d steps/call)"
+                 % (name, spc))
+            exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                             scope=scope, steps=spc)
+            _log("%s: timing one %d-step call" % (name, spc))
+            t0 = time.perf_counter()
+            vals = exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                    scope=scope, steps=spc)
+            float(np.asarray(vals[0]).reshape(-1)[0])  # block on the result
+            dt = time.perf_counter() - t0
+        else:
+            _log("%s: compiling + %d warmup steps" % (name, warmup))
+            for _ in range(warmup):
+                exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
 
-        _log("%s: timing %d steps" % (name, steps))
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            vals = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
-        float(np.asarray(vals[0]).reshape(-1)[0])  # block on the result
-        dt = time.perf_counter() - t0
+            _log("%s: timing %d steps" % (name, steps))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                vals = exe.run(main, feed=feed, fetch_list=[loss],
+                               scope=scope)
+            float(np.asarray(vals[0]).reshape(-1)[0])  # block on the result
+            dt = time.perf_counter() - t0
 
         throughput = items_per_batch * steps / dt
         _log("%s: cost_analysis" % name)
@@ -174,6 +193,9 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # PADDLE_TPU_FUSED_ATTENTION=0)
             **({"attention_path": "flash" if uses_flash else "composed"}
                if attention else {}),
+            # K steps per host dispatch (run_repeated lax.scan); absent
+            # means the classic one-dispatch-per-step loop
+            **({"steps_per_call": spc} if spc > 1 else {}),
             "value": round(throughput, 1),
             "unit": unit,
             # recompute rows never compare against the plain-activation

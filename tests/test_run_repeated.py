@@ -1,0 +1,154 @@
+"""Executor.run_repeated: K train steps as ONE device-side executable
+(lax.scan over the whole-block step). Must be semantically identical to
+K sequential Executor.run calls with the same feed — params, optimizer
+slots, the RNG chain (dropout differs per iteration), and the last
+step's fetches all match the unrolled sequence.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope, scope_guard
+
+
+def _build(seed=7, dropout=0.0):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.fc(x, 16, act="relu")
+        if dropout:
+            h = layers.dropout(h, dropout_prob=dropout)
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(pred - y))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _feed():
+    rs = np.random.RandomState(0)
+    return {"x": rs.randn(16, 8).astype("float32"),
+            "y": rs.randn(16, 1).astype("float32")}
+
+
+def _param_names(scope):
+    """fc layer numbering is a process-global counter, so two _build()
+    calls name the same params fc_0/fc_1 then fc_2/fc_3 — normalize the
+    layer index to its ordinal within this scope."""
+    names = sorted(n for n in scope.local_var_names()
+                   if n.startswith("fc_") and not n.endswith("@GRAD"))
+    prefixes = sorted({n.split(".", 1)[0] for n in names},
+                      key=lambda p: int(p.split("_")[1]))
+    ordinal = {p: i for i, p in enumerate(prefixes)}
+    return {n: "fc#%d.%s" % (ordinal[n.split(".", 1)[0]],
+                             n.split(".", 1)[1]) for n in names}
+
+
+def _run(mode, steps, dropout=0.0):
+    main, startup, loss = _build(dropout=dropout)
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = _feed()
+        if mode == "sequential":
+            for _ in range(steps):
+                vals = exe.run(main, feed=feed, fetch_list=[loss],
+                               scope=scope)
+        else:
+            vals = exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                    scope=scope, steps=steps)
+        params = {norm: np.asarray(scope.find_var(n))
+                  for n, norm in _param_names(scope).items()}
+    return float(np.asarray(vals[0]).reshape(-1)[0]), params
+
+
+def test_run_repeated_matches_sequential():
+    l_seq, p_seq = _run("sequential", 4)
+    l_rep, p_rep = _run("repeated", 4)
+    assert abs(l_seq - l_rep) < 1e-5, (l_seq, l_rep)
+    assert p_seq.keys() == p_rep.keys() and p_seq
+    for n in p_seq:
+        np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-5,
+                                   err_msg=n)
+
+
+def test_run_repeated_rng_chain_matches_with_dropout():
+    """The scan carries the RNG key exactly as the sequential chain
+    does — with dropout on, step t's mask must match the unrolled
+    run's, so final params agree."""
+    l_seq, p_seq = _run("sequential", 3, dropout=0.3)
+    l_rep, p_rep = _run("repeated", 3, dropout=0.3)
+    assert abs(l_seq - l_rep) < 1e-5, (l_seq, l_rep)
+    for n in p_seq:
+        np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-5,
+                                   err_msg=n)
+
+
+def test_run_repeated_steps_one_delegates():
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        vals = exe.run_repeated(main, feed=_feed(), fetch_list=[loss],
+                                scope=scope, steps=1)
+    assert np.isfinite(np.asarray(vals[0])).all()
+
+
+def test_run_repeated_advances_training():
+    """K scanned steps actually train: loss after run_repeated(8) is
+    well below the first step's loss."""
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = _feed()
+        first = float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[loss],
+                    scope=scope)[0]).reshape(-1)[0])
+        vals = exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                scope=scope, steps=30)
+        last = float(np.asarray(vals[0]).reshape(-1)[0])
+    assert last < first * 0.7, (first, last)
+
+
+def test_run_repeated_rejects_compiled_program():
+    import pytest
+
+    main, startup, loss = _build()
+    from paddle_tpu.compiler import CompiledProgram
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        with pytest.raises(ValueError, match="ParallelEngine"):
+            exe.run_repeated(CompiledProgram(main), feed=_feed(),
+                             fetch_list=[loss], scope=scope, steps=4)
+
+
+def test_run_repeated_check_nan_inf():
+    import pytest
+
+    from paddle_tpu import flags
+
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = _feed()
+        feed["x"] = np.full_like(feed["x"], np.nan)
+        old = flags.get_flag("check_nan_inf")
+        flags.set_flag("check_nan_inf", True)
+        try:
+            with pytest.raises(FloatingPointError, match="scanned"):
+                exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                 scope=scope, steps=3)
+        finally:
+            flags.set_flag("check_nan_inf", old)
