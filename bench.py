@@ -113,6 +113,20 @@ def _check_pallas_mode(uses_flash):
     return mode
 
 
+def _bscale():
+    return max(1, int(os.environ.get("PADDLE_TPU_BENCH_BATCH_SCALE", "1")))
+
+
+def _batch(default, quick, quick_default):
+    """Per-workload batch size: the non-quick default scales by
+    PADDLE_TPU_BENCH_BATCH_SCALE (int, default 1) so hardware batch
+    sweeps (MFU ladder step 3) are one env var, no code edit. Rows
+    record batch_scale when it differs from 1."""
+    if quick:
+        return quick_default
+    return default * _bscale()
+
+
 def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
                   steps=10, warmup=3, quick=False, recompute=False,
                   uses_flash=False, attention=False):
@@ -196,13 +210,19 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # K steps per host dispatch (run_repeated lax.scan); absent
             # means the classic one-dispatch-per-step loop
             **({"steps_per_call": spc} if spc > 1 else {}),
+            # batch multiplier (PADDLE_TPU_BENCH_BATCH_SCALE): scaled
+            # rows never regression-compare against the default-batch
+            # baseline silently
+            **({"batch_scale": _bscale()}
+               if (_bscale() > 1 and not quick) else {}),
             "value": round(throughput, 1),
             "unit": unit,
-            # recompute rows never compare against the plain-activation
-            # baseline (deliberately fewer effective FLOPs/s at the same
-            # batch) — they anchor at 1.0 until a recompute baseline exists
+            # recompute / scaled-batch rows never compare against the
+            # plain default-config baseline (different effective config)
+            # — they anchor at 1.0 until a matching baseline exists
             "vs_baseline": round(throughput / BASELINES[name], 3)
-            if (name in BASELINES and not recompute) else 1.0,
+            if (name in BASELINES and not recompute and _bscale() == 1)
+            else 1.0,
             # None (not 0.0) when the backend produced no flop count —
             # an unmeasured MFU must never masquerade as a measured zero
             "tflops_per_sec": round(achieved / 1e12, 2)
@@ -235,7 +255,7 @@ def _maybe_recompute(opt, checkpoints):
 def bench_transformer(amp, quick, uses_flash=False):
     import paddle_tpu.models.transformer as transformer
 
-    seq, batch = ATTENTION_SEQ["transformer"], (8 if quick else 256)
+    seq, batch = ATTENTION_SEQ["transformer"], _batch(256, quick, 8)
     cfg = transformer.base_config()
     cfg["max_length"] = seq
 
@@ -268,7 +288,7 @@ def bench_transformer_long(amp, quick, uses_flash=False):
     showcase — the composed path materializes [S, S] scores per head."""
     import paddle_tpu.models.transformer as transformer
 
-    seq, batch = ATTENTION_SEQ["transformer_long"], (2 if quick else 32)
+    seq, batch = ATTENTION_SEQ["transformer_long"], _batch(32, quick, 2)
     cfg = transformer.base_config()
     cfg["max_length"] = seq
 
@@ -299,7 +319,7 @@ def bench_transformer_long(amp, quick, uses_flash=False):
 def bench_resnet50(amp, quick, uses_flash=False):
     import paddle_tpu.models.resnet as resnet
 
-    batch = 4 if quick else 128
+    batch = _batch(128, quick, 4)
 
     def build():
         import paddle_tpu as fluid
@@ -322,7 +342,7 @@ def bench_resnet50(amp, quick, uses_flash=False):
 def bench_vgg16(amp, quick, uses_flash=False):
     import paddle_tpu.models.vgg as vgg
 
-    batch = 4 if quick else 128
+    batch = _batch(128, quick, 4)
 
     def build():
         import paddle_tpu as fluid
@@ -346,7 +366,7 @@ def bench_bert(amp, quick, uses_flash=False):
     import paddle_tpu.models.bert as bert
 
     seq, max_mask = ATTENTION_SEQ["bert"], 20
-    batch = 2 if quick else 64
+    batch = _batch(64, quick, 2)
     cfg = bert.base_config()
 
     def build():
@@ -382,7 +402,7 @@ def bench_gpt_causal(amp, quick, uses_flash=False):
     block-skipping showcase (~2x the dense-causal step FLOPs)."""
     import paddle_tpu.models.gpt as gpt
 
-    seq, batch = ATTENTION_SEQ["gpt_causal"], (2 if quick else 16)
+    seq, batch = ATTENTION_SEQ["gpt_causal"], _batch(16, quick, 2)
     cfg = dict(d_model=512, d_ff=2048, n_head=8, n_layer=6, vocab=32000,
                max_length=seq, dropout=0.1)
 
@@ -410,7 +430,7 @@ def bench_gpt_causal(amp, quick, uses_flash=False):
 def bench_deepfm(amp, quick, uses_flash=False):
     import paddle_tpu.models.ctr as ctr
 
-    batch = 256 if quick else 8192
+    batch = _batch(8192, quick, 256)
     n_fields, n_dense, vocab = 26, 13, 1000001
 
     def build():

@@ -105,6 +105,14 @@ class Executor:
             fetches, new_mut, new_pure, new_rng = plan.fn(
                 feeds, const_state, mut_state, rng)
 
+        return self._finish(plan, scope, fetches, new_mut, new_pure,
+                            new_rng, return_numpy, "")
+
+    @staticmethod
+    def _finish(plan, scope, fetches, new_mut, new_pure, new_rng,
+                return_numpy, nan_suffix):
+        """Shared run()/run_repeated() epilogue: state write-back, RNG
+        store, numpy conversion, FLAGS_check_nan_inf."""
         for n, v in zip(plan.mut_state, new_mut):
             scope.set_var(n, v)
         for n, v in zip(plan.pure_written, new_pure):
@@ -121,8 +129,8 @@ class Executor:
                     if np.issubdtype(v.dtype, np.floating) and \
                             not np.isfinite(v).all():
                         raise FloatingPointError(
-                            "NaN/Inf in fetched var %r (FLAGS_check_nan_inf)"
-                            % name)
+                            "NaN/Inf in fetched var %r%s "
+                            "(FLAGS_check_nan_inf)" % (name, nan_suffix))
             return out
         return list(fetches)
 
@@ -205,25 +213,9 @@ class Executor:
         else:
             fetches, new_mut, new_pure, new_rng = fn(
                 feeds, const_state, mut_state, rng)
-        for n, v in zip(plan.mut_state, new_mut):
-            scope.set_var(n, v)
-        for n, v in zip(plan.pure_written, new_pure):
-            scope.set_var(n, v)
-        if plan.needs_rng:
-            scope.set_var(RNG_VAR, new_rng)
-        if return_numpy:
-            out = [np.asarray(v) for v in fetches]
-            from ..flags import get_flag
-
-            if get_flag("check_nan_inf"):
-                for name, v in zip(plan.fetch_names, out):
-                    if np.issubdtype(v.dtype, np.floating) and \
-                            not np.isfinite(v).all():
-                        raise FloatingPointError(
-                            "NaN/Inf in fetched var %r after %d scanned "
-                            "steps (FLAGS_check_nan_inf)" % (name, steps))
-            return out
-        return list(fetches)
+        return self._finish(plan, scope, fetches, new_mut, new_pure,
+                            new_rng, return_numpy,
+                            " after %d scanned steps" % steps)
 
     def cost_analysis(
         self,

@@ -59,8 +59,10 @@ def pipeline(x: Variable, n_stages: int,
     layer calls on ``x``); per-stage weights come from ``pb.param(...)``
     and are stored stacked. The classic GPipe contract applies: every
     stage maps activations of one shape to the same shape (y.shape ==
-    x.shape), and the body must be deterministic (no dropout — the op
-    lowers through an RNG-free context so its vjp re-trace is CSE-able).
+    x.shape). Stochastic bodies (dropout) are supported: one base PRNG
+    key per pipeline op is folded per (stage, microbatch) and replayed
+    in the backward (recompute's RngKey pattern), so the pipelined and
+    sequential paths produce identical masks.
 
     Single device: the stages apply sequentially. Under ParallelEngine
     with a mesh 'pipe' axis of size n_stages: stages run one-per-device
@@ -89,27 +91,26 @@ def pipeline(x: Variable, n_stages: int,
         raise ValueError(
             "pipeline stage must preserve the activation shape (GPipe "
             "contract): body maps %s -> %s" % (x.shape, out_var.shape))
-    from ..core.registry import get_op
+    # stochastic stage bodies (dropout) are supported via recompute's
+    # RngKey pattern: one base key per pipeline op, folded per
+    # (stage, microbatch) and replayed in the grad (ops/pipeline_ops.py)
+    from ..core.recompute import segment_uses_rng
 
-    def _check_deterministic(block):
-        for op in block.ops:
-            if get_op(op.type).uses_rng:
-                raise ValueError(
-                    "pipeline stage bodies must be deterministic; op %r "
-                    "uses RNG (move dropout outside the pipelined stack)"
-                    % op.type)
-            if "sub_block" in op.attrs:
-                _check_deterministic(prog.block(op.attrs["sub_block"]))
-
-    _check_deterministic(sub)
+    uses_rng = segment_uses_rng(sub.ops, prog)
 
     out = parent.create_var(
         name=unique_name.generate(helper.name + ".out"),
         shape=x.shape, dtype=x.dtype)
+    outputs = {"Out": [out]}
+    if uses_rng:
+        rng_var = parent.create_var(
+            name=unique_name.generate(helper.name + ".rngkey"),
+            shape=[], dtype="float32", persistable=False)
+        outputs["RngKey"] = [rng_var]
     parent.append_op(
         type="pipeline",
         inputs={"X": [x], "StackedParams": [p.name for p in pb.stacked]},
-        outputs={"Out": [out]},
+        outputs=outputs,
         attrs={
             "sub_block": sub.idx,
             "n_stages": int(n_stages),
@@ -118,6 +119,7 @@ def pipeline(x: Variable, n_stages: int,
             "in_name": x_in.name,
             "out_name": out_var.name,
             "axis": "pipe",
+            "uses_rng": uses_rng,
             "__sub_bound__": [x_in.name] + list(pb.slice_names),
         })
     # record for ParallelEngine's automatic 'pipe' sharding rules

@@ -15,11 +15,20 @@ lowering then either
     per-sample maps, so microbatch boundaries don't change results) on a
     single device / mesh without a pipe axis.
 
-Gradients come from the generic vjp synthesis (core/autodiff.py): jax
-transposes ppermute into the reverse hop, so the backward pass is
-automatically the reverse-order pipeline — no hand-built 1F1B schedule.
-Stage bodies must be deterministic (no dropout): the op lowers through a
-pure (RNG-free) context so the vjp re-trace CSEs against the forward.
+Stochastic stage bodies (dropout) follow recompute's RngKey pattern
+(ops/recompute_ops.py): the forward draws ONE base key, derives a
+per-(stage, microbatch) key by ``fold_in(base, stage * n_mb + mb)``, and
+exports the base key through the ``RngKey`` output; the custom grad
+lowering replays it, so the backward re-trace reproduces every dropout
+mask bit-for-bit. The sequential fallback microbatches too whenever the
+body is stochastic, applying the SAME folded key per (stage, mb) — the
+pipelined and unpipelined paths stay parity-exact.
+
+Gradients: jax transposes ppermute into the reverse hop, so the backward
+pass is automatically the reverse-order pipeline — no hand-built 1F1B
+schedule. The custom grad exists only to replay the key; for
+deterministic bodies it computes exactly what the generic vjp synthesis
+did.
 """
 
 from __future__ import annotations
@@ -27,9 +36,10 @@ from __future__ import annotations
 from typing import Any, Dict, List
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..core.registry import register_op
+from ..core.registry import register_grad_lowering, register_op
 
 __all__: List[str] = []
 
@@ -37,10 +47,10 @@ __all__: List[str] = []
 def _stage_fn(ctx, sub, slice_names, in_name, out_name):
     from ..core.lowering import LowerContext, lower_ops
 
-    def stage(param_slices, x):
+    def stage(param_slices, x, key=None):
         env: Dict[str, Any] = dict(zip(slice_names, param_slices))
         env[in_name] = x
-        sctx = LowerContext(sub, None, ctx.is_test, ctx.amp, ctx.mesh,
+        sctx = LowerContext(sub, key, ctx.is_test, ctx.amp, ctx.mesh,
                             ctx.data_axis, ctx.model_axis, ctx.seq_axis)
         lower_ops(sctx, sub.ops, env)
         return env[out_name]
@@ -48,12 +58,10 @@ def _stage_fn(ctx, sub, slice_names, in_name, out_name):
     return stage
 
 
-@register_op("pipeline", diff_inputs=["X", "StackedParams"], needs_env=False)
-def _pipeline(ctx, ins, attrs):
-    from ..parallel.pipeline import pipeline_apply
-
-    x = ins["X"][0]
-    stacked = list(ins["StackedParams"])
+def _apply_pipeline(ctx, x, stacked, attrs, base_key):
+    """Forward computation shared by the op lowering and its grad replay.
+    ``base_key`` is None for deterministic bodies; otherwise the drawn
+    (forward) or replayed (backward) segment key."""
     n_stages = int(attrs["n_stages"])
     n_mb = int(attrs["n_microbatches"])
     axis = attrs.get("axis", "pipe")
@@ -70,14 +78,30 @@ def _pipeline(ctx, ins, attrs):
             "%d devices — stages map one-per-device; reshape the mesh or "
             "the stage count" % (n_stages, axis, mesh.shape[axis]))
 
-    if not use_pipe:
-        # sequential fallback: same per-sample math, no microbatching
-        out = x
-        for s in range(n_stages):
-            out = stage([p[s] for p in stacked], out)
-        return {"Out": out}
-
     B = x.shape[0]
+
+    if not use_pipe:
+        if base_key is None:
+            # sequential fallback: same per-sample math, no microbatching
+            out = x
+            for s in range(n_stages):
+                out = stage([p[s] for p in stacked], out)
+            return out
+        # stochastic body: microbatch exactly like the pipelined path
+        # and fold the SAME per-(stage, mb) key, so dropout masks match
+        # the pipe schedule bit-for-bit (sequential-vs-pipe parity)
+        if B % n_mb:
+            raise ValueError(
+                "pipeline batch %d is not divisible by n_microbatches=%d"
+                % (B, n_mb))
+        mbs = list(x.reshape((n_mb, B // n_mb) + x.shape[1:]))
+        for s in range(n_stages):
+            params_s = [p[s] for p in stacked]
+            mbs = [stage(params_s, mb,
+                         jax.random.fold_in(base_key, s * n_mb + m))
+                   for m, mb in enumerate(mbs)]
+        return jnp.stack(mbs).reshape((B,) + x.shape[1:])
+
     if B % n_mb:
         raise ValueError(
             "pipeline batch %d is not divisible by n_microbatches=%d"
@@ -91,15 +115,99 @@ def _pipeline(ctx, ins, attrs):
         and (B // n_mb) % mesh.shape[data_axis] == 0
     x_spec = P(None, data_axis) if has_data else P()
 
-    def shard_body(x_mb_l, *stacked_l):
-        return pipeline_apply(
-            lambda ps, xi: stage(list(ps), xi), list(stacked_l), x_mb_l, axis)
+    from ..parallel.pipeline import pipeline_apply
 
-    fn = jax.shard_map(
-        shard_body,
-        mesh=mesh,
-        in_specs=(x_spec,) + (P(axis),) * len(stacked),
-        out_specs=x_spec,
-    )
-    out_mb = fn(x_mb, *stacked)
-    return {"Out": out_mb.reshape((B,) + x.shape[1:])}
+    if base_key is None:
+        def shard_body(x_mb_l, *stacked_l):
+            return pipeline_apply(
+                lambda ps, xi: stage(list(ps), xi), list(stacked_l),
+                x_mb_l, axis)
+
+        fn = jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(x_spec,) + (P(axis),) * len(stacked),
+            out_specs=x_spec,
+        )
+        out_mb = fn(x_mb, *stacked)
+    else:
+        key_data = jax.random.key_data(base_key)
+
+        def shard_body(x_mb_l, kd, *stacked_l):
+            from jax import lax
+
+            idx = lax.axis_index(axis)
+            base = jax.random.wrap_key_data(kd)
+            if has_data:
+                # microbatch rows are sharded over the data axis: each
+                # shard must draw an INDEPENDENT mask (the same folded
+                # key at the same local shape would replicate one mask
+                # across shards — correlated dropout). Folding the data
+                # index means dp x pp masks are a different (equally
+                # valid) realization than the sequential path's; exact
+                # bit-parity with sequential holds on pp-only meshes.
+                base = jax.random.fold_in(base, lax.axis_index(data_axis))
+
+            def sfn(ps, xi, mb):
+                # same fold as the sequential fallback: stage*n_mb + mb
+                return stage(list(ps), xi,
+                             jax.random.fold_in(base, idx * n_mb + mb))
+
+            return pipeline_apply(sfn, list(stacked_l), x_mb_l, axis,
+                                  mb_arg=True)
+
+        fn = jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(x_spec, P()) + (P(axis),) * len(stacked),
+            out_specs=x_spec,
+        )
+        out_mb = fn(x_mb, key_data, *stacked)
+    return out_mb.reshape((B,) + x.shape[1:])
+
+
+@register_op("pipeline", diff_inputs=["X", "StackedParams"],
+             needs_env=False, uses_rng=True)
+def _pipeline(ctx, ins, attrs):
+    x = ins["X"][0]
+    stacked = list(ins["StackedParams"])
+    if attrs.get("uses_rng"):
+        if ctx.is_test or attrs.get("is_test", False):
+            base_key = jax.random.PRNGKey(0)  # dropout is identity in test
+        else:
+            # next_rng() raises in pure contexts BY DESIGN: a generic-vjp
+            # re-trace must never silently draw different masks than the
+            # forward — this op's own grad replays the RngKey output
+            base_key = ctx.next_rng()
+    else:
+        base_key = None
+    out = _apply_pipeline(ctx, x, stacked, attrs, base_key)
+    res = {"Out": [out]}
+    if attrs.get("uses_rng"):
+        res["RngKey"] = [jax.random.key_data(base_key)]
+    return res
+
+
+@register_grad_lowering("pipeline")
+def _pipeline_grad(ctx, ins, attrs):
+    """vjp over the forward with the SAME base key (replayed from the
+    RngKey output): dropout masks in the re-trace match the forward
+    bit-for-bit, exactly as recompute_block's grad replays its segment
+    key."""
+    x = ins["X"][0]
+    stacked = list(ins["StackedParams"])
+    base_key = None
+    if attrs.get("uses_rng"):
+        base_key = jax.random.wrap_key_data(ins["RngKey"][0])
+
+    def f(xi, ps):
+        return _apply_pipeline(ctx, xi, ps, attrs, base_key)
+
+    primal, vjp = jax.vjp(f, x, stacked)
+    g = (ins.get("Out@GRAD") or [None])[0]
+    if g is None:
+        g = jnp.zeros_like(primal)
+    elif g.dtype != primal.dtype or g.shape != primal.shape:
+        g = jnp.broadcast_to(g.astype(primal.dtype), primal.shape)
+    dx, dps = vjp(g)
+    return {"X@GRAD": [dx], "StackedParams@GRAD": list(dps)}

@@ -36,11 +36,17 @@ from jax import lax
 __all__ = ["pipeline_apply"]
 
 
-def pipeline_apply(stage_fn, stage_params, x_mb, axis_name):
+def pipeline_apply(stage_fn, stage_params, x_mb, axis_name, mb_arg=False):
     """Run microbatches through the stage pipeline.
 
     stage_fn: (params_slice, x) -> y, one stage's computation; activation
         shapes must be identical across stages (classic GPipe contract).
+        With ``mb_arg=True`` the signature is (params_slice, x, mb) where
+        ``mb`` is the (traced int32) index of the microbatch this stage
+        is processing this step — the hook stochastic bodies use to fold
+        a per-(stage, microbatch) PRNG key (ops/pipeline_ops.py); during
+        pipeline bubbles it is clamped to a valid index and the result
+        is discarded.
     stage_params: pytree whose leaves have a leading stage dim, sharded
         over `axis_name` (inside shard_map each device sees its slice of
         size 1, which is squeezed before stage_fn).
@@ -56,7 +62,15 @@ def pipeline_apply(stage_fn, stage_params, x_mb, axis_name):
     steps = M + int(n) - 1
     fwd = [(j, j + 1) for j in range(int(n) - 1)]  # shift toward last stage
 
-    probe = jax.eval_shape(stage_fn, local_params, x_mb[0])
+    def run_stage(params, x, t):
+        if not mb_arg:
+            return stage_fn(params, x)
+        # stage `idx` is working on microbatch t - idx at step t (a
+        # bubble outside [0, M) — clamped; its output is never kept)
+        mb = jnp.clip(t - idx, 0, M - 1).astype(jnp.int32)
+        return stage_fn(params, x, mb)
+
+    probe = jax.eval_shape(run_stage, local_params, x_mb[0], 0)
     state = jnp.zeros(probe.shape, probe.dtype)
     outputs = jnp.zeros((M,) + probe.shape, probe.dtype)
 
@@ -66,7 +80,7 @@ def pipeline_apply(stage_fn, stage_params, x_mb, axis_name):
         # stage 0 starts microbatch t (while it exists); later stages
         # consume what arrived from the previous stage last step
         inp = jnp.where(idx == 0, inject.astype(state.dtype), state)
-        out = stage_fn(local_params, inp)
+        out = run_stage(local_params, inp, t)
         done_mb = t - (int(n) - 1)  # microbatch the LAST stage just finished
         if 0 <= done_mb < M:
             is_last = (idx == int(n) - 1)
