@@ -48,7 +48,8 @@ class _Plan:
         self.fn = fn
         self.step = step   # the raw (unjitted) step — run_repeated wraps
         #                    it in a device-side lax.scan
-        self.multi = {}    # steps -> jitted K-step executable
+        self.multi = {}    # (steps, feed_stacked) -> jitted K-step
+        #                    executable
         self.cost = None  # cost_analysis() result, filled on first request
         self.hlo_text = {}  # stage -> lowered_hlo() text (AOT compiles
         #                     can't reuse the jit cache; amortize them)
@@ -142,6 +143,7 @@ class Executor:
         scope: Optional[Scope] = None,
         steps: int = 1,
         return_numpy: bool = True,
+        feed_stacked: bool = False,
     ):
         """Run ``steps`` train iterations as ONE device-side executable
         (a ``lax.scan`` over the whole-block step, donated state carry):
@@ -149,16 +151,36 @@ class Executor:
         the in-device analog of the reference's AsyncExecutor /
         multi-iteration trainer loop (async_executor.cc), and the lever
         that removes per-step host/tunnel dispatch latency from the
-        steady-state training path.
+        steady-state training path (measured 2026-07-31: 2.16x resnet50
+        throughput through the TPU tunnel at 10 steps/call).
 
-        Semantics: identical to calling ``run`` ``steps`` times with the
-        SAME feed dict — state (params, optimizer slots) and the RNG
-        chain advance exactly as in the unrolled sequence (dropout masks
-        differ per iteration); returned fetches are the LAST step's.
-        Feeds are constant across the K steps, so this fits steady-state
-        measurement and synthetic-data loops; per-step data should ride
-        a reader op / dataset feed inside the program instead."""
+        Semantics: identical to calling ``run`` ``steps`` times — state
+        (params, optimizer slots) and the RNG chain advance exactly as
+        in the unrolled sequence (dropout masks differ per iteration);
+        returned fetches are the LAST step's.
+
+        With ``feed_stacked=False`` the same feed dict is re-used every
+        step — steady-state measurement and synthetic-data loops. With
+        ``feed_stacked=True`` every feed value carries a leading
+        ``steps`` axis and the scan consumes one slice per iteration —
+        K *different* minibatches per dispatch, the shape a PyReader /
+        DataLoader hands over when it batches K microbatches ahead
+        (``paddle_tpu.reader.stack_feed_window`` builds it)."""
         if steps <= 1:
+            if feed_stacked:
+                # a window of length 1 still carries the leading axis —
+                # unstack before delegating to the single-step path.
+                # Same leading-axis check as the scan path: a K>1 window
+                # with steps=1 must raise, not silently train on slice 0.
+                for n, v in (feed or {}).items():
+                    shape = np.shape(v)
+                    if not shape or shape[0] != 1:
+                        raise ValueError(
+                            "feed_stacked=True with steps=1: feed %r "
+                            "must carry a leading axis of 1 (got shape "
+                            "%s)" % (n, (shape,)))
+                feed = {k: v[0] if hasattr(v, "ndim") else np.asarray(v)[0]
+                        for k, v in (feed or {}).items()}
             return self.run(program, feed, fetch_list, scope,
                             return_numpy=return_numpy)
         from ..compiler import CompiledProgram
@@ -173,7 +195,15 @@ class Executor:
         scope = scope if scope is not None else global_scope()
         plan, feeds, const_state, mut_state, rng = self._gather(
             program, feed, fetch_list, scope)
-        fn = plan.multi.get(steps)
+        if feed_stacked:
+            for n, f in zip(plan.feed_names, feeds):
+                if f.ndim == 0 or f.shape[0] != steps:
+                    raise ValueError(
+                        "feed_stacked=True: feed %r must carry a leading "
+                        "steps axis of %d (got shape %s) — stack K "
+                        "per-step batches with reader.stack_feed_window"
+                        % (n, steps, (f.shape,)))
+        fn = plan.multi.get((steps, feed_stacked))
         if fn is None:
             raw_step = plan.step
 
@@ -182,24 +212,28 @@ class Executor:
                 # output shapes), not stacked scan ys: only the last
                 # step's values are wanted, and a [K, ...] stacked
                 # buffer per fetch would shrink the usable batch size
-                out_sh = jax.eval_shape(raw_step, feeds, const_vals,
+                step_feeds = ([f[0] for f in feeds] if feed_stacked
+                              else feeds)
+                out_sh = jax.eval_shape(raw_step, step_feeds, const_vals,
                                         mut_vals, rng_key)
                 zeros = lambda tree: jax.tree.map(
                     lambda s: jnp.zeros(s.shape, s.dtype), tree)
 
-                def body(carry, _):
+                def body(carry, xs):
                     mut, key, _f, _p = carry
                     fetches, new_mut, new_pure, new_key = raw_step(
-                        feeds, const_vals, mut, key)
+                        xs if feed_stacked else feeds, const_vals, mut,
+                        key)
                     return (new_mut, new_key, fetches, new_pure), None
 
                 (mut, key, fetches, pures), _ = jax.lax.scan(
                     body, (mut_vals, rng_key, zeros(out_sh[0]),
-                           zeros(out_sh[2])), None, length=steps)
+                           zeros(out_sh[2])),
+                    feeds if feed_stacked else None, length=steps)
                 return fetches, mut, pures, key
 
             fn = jax.jit(multi, donate_argnums=(2,))
-            plan.multi[steps] = fn
+            plan.multi[(steps, feed_stacked)] = fn
 
         from ..profiler import RecordEvent, is_profiler_enabled
 

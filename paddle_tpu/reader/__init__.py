@@ -12,7 +12,27 @@ import threading
 __all__ = ["Fake", "PipeReader",
            "batch", "shuffle", "buffered", "cache", "map_readers",
            "xmap_readers", "chain", "compose", "firstn",
-           "multiprocess_reader"]
+           "multiprocess_reader", "stack_feed_window"]
+
+
+def stack_feed_window(feed_dicts):
+    """Stack K per-step feed dicts into one dict of [K, ...] arrays for
+    ``Executor.run_repeated(..., steps=K, feed_stacked=True)`` — K
+    different minibatches per device dispatch (one lax.scan executable
+    instead of K host/tunnel round-trips). All dicts must share keys and
+    per-key shapes/dtypes; K is ``len(feed_dicts)``."""
+    import numpy as np
+
+    if not feed_dicts:
+        raise ValueError("stack_feed_window: need at least one feed dict")
+    keys = set(feed_dicts[0])
+    for i, d in enumerate(feed_dicts[1:], 1):
+        if set(d) != keys:
+            raise ValueError(
+                "stack_feed_window: feed dict %d has keys %s, expected %s"
+                % (i, sorted(d), sorted(keys)))
+    return {k: np.stack([np.asarray(d[k]) for d in feed_dicts])
+            for k in keys}
 
 
 def batch(reader, batch_size, drop_last=False):

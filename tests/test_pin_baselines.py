@@ -1,0 +1,104 @@
+"""tools/pin_baselines.py: baseline pinning rules — first-set pins,
+regressions skip, dispatch-mode changes re-anchor (value comparison
+across steps_per_call modes is meaningless), recompute/scaled-batch
+rows never pin over the plain-config baseline. Runs against a COPY of
+bench.py (--bench) so the real file is untouched.
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+TOOL = os.path.join(ROOT, "tools", "pin_baselines.py")
+BENCH = os.path.join(ROOT, "bench.py")
+
+
+def _pin(tmp_path, rows, extra=()):
+    bench_copy = str(tmp_path / "bench_copy.py")
+    shutil.copy(BENCH, bench_copy)
+    rows_file = str(tmp_path / "rows.json")
+    with open(rows_file, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    proc = subprocess.run(
+        [sys.executable, TOOL, rows_file, "--bench", bench_copy,
+         *extra], capture_output=True, text=True, cwd=ROOT)
+    src = open(bench_copy).read()
+    base = eval("{" + re.search(
+        r"BASELINES = \{(.*?)\}", src, re.S).group(1) + "}")
+    spc = eval("{" + re.search(
+        r"BASELINE_SPC = \{(.*?)\}", src, re.S).group(1) + "}")
+    return proc, base, spc
+
+
+ROW = "vgg16_train_images_per_sec_per_chip"
+
+
+RESNET = "resnet50_train_images_per_sec_per_chip"  # baseline spc=10
+
+
+def test_improvement_pins_value_and_spc(tmp_path):
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 999.9, "steps_per_call": 10,
+         "unit": "images/sec"}])
+    assert proc.returncode == 0, proc.stderr
+    assert base[ROW] == 999.9 and spc[ROW] == 10
+    # the rewritten copy still parses
+    compile(open(str(tmp_path / "bench_copy.py")).read(), "bench", "exec")
+
+
+def test_regression_skips_without_force(tmp_path):
+    # resnet50's baseline is already in the default mode (spc=10), so a
+    # slower default-mode row exercises the regression guard proper
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": RESNET, "value": 1.0, "steps_per_call": 10,
+         "unit": "images/sec"}])
+    assert "regression" in proc.stdout and base[RESNET] == 2272.1
+
+
+def test_mode_change_reanchors_even_lower_value(tmp_path):
+    # spc=10 row below the spc=1 baseline: NOT a regression — a mode
+    # re-anchor (old value isn't comparable)
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 400.0, "steps_per_call": 10,
+         "unit": "images/sec"}])
+    assert "MODE" in proc.stdout, proc.stdout
+    assert base[ROW] == 400.0 and spc[ROW] == 10
+
+
+def test_recompute_and_scaled_rows_never_pin(tmp_path):
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 9999.0, "recompute": True},
+        {"metric": ROW, "value": 9999.0, "batch_scale": 2}])
+    assert proc.stdout.count("SKIP") == 2
+    assert base[ROW] == 509.8
+
+
+def test_error_rows_ignored(tmp_path):
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": "vgg16", "error": "deadline"}])
+    assert proc.returncode == 1  # no result rows
+    assert base[ROW] == 509.8
+
+
+def test_sweep_rows_never_reanchor_off_default(tmp_path):
+    # an A/B file containing default-mode and sweep rows: the default
+    # (spc=10) row pins; the spc=50 sweep row must NOT steal the anchor
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 600.0, "steps_per_call": 10},
+        {"metric": ROW, "value": 700.0, "steps_per_call": 50}])
+    assert base[ROW] == 600.0 and spc[ROW] == 10, proc.stdout
+    assert "A/B sweep" in proc.stdout
+
+
+def test_spc1_row_skips_when_default_is_10(tmp_path):
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 9999.0}])  # spc absent = 1
+    assert "A/B sweep" in proc.stdout
+    assert base[ROW] == 509.8 and spc[ROW] == 1

@@ -152,3 +152,113 @@ def test_run_repeated_check_nan_inf():
                                  scope=scope, steps=3)
         finally:
             flags.set_flag("check_nan_inf", old)
+
+def _feeds_k(k):
+    rs = np.random.RandomState(3)
+    return [{"x": rs.randn(16, 8).astype("float32"),
+             "y": rs.randn(16, 1).astype("float32")} for _ in range(k)]
+
+
+def test_run_repeated_feed_stacked_matches_sequential():
+    """feed_stacked=True consumes one stacked slice per scanned step —
+    K DIFFERENT minibatches per dispatch must train identically to K
+    sequential run() calls over those minibatches."""
+    from paddle_tpu import reader as rd
+
+    k = 4
+    feeds = _feeds_k(k)
+
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for f in feeds:
+            vals = exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+        l_seq = float(np.asarray(vals[0]).reshape(-1)[0])
+        p_seq = {norm: np.asarray(scope.find_var(n))
+                 for n, norm in _param_names(scope).items()}
+
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        stacked = rd.stack_feed_window(feeds)
+        assert stacked["x"].shape == (k, 16, 8)
+        vals = exe.run_repeated(main, feed=stacked, fetch_list=[loss],
+                                scope=scope, steps=k, feed_stacked=True)
+        l_rep = float(np.asarray(vals[0]).reshape(-1)[0])
+        p_rep = {norm: np.asarray(scope.find_var(n))
+                 for n, norm in _param_names(scope).items()}
+
+    assert abs(l_seq - l_rep) < 1e-5, (l_seq, l_rep)
+    assert p_seq.keys() == p_rep.keys() and p_seq
+    for n in p_seq:
+        np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-5,
+                                   err_msg=n)
+
+
+def test_run_repeated_feed_stacked_wrong_leading_axis():
+    import pytest
+
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        stacked = {k: np.stack([v, v]) for k, v in _feed().items()}  # K=2
+        with pytest.raises(ValueError, match="leading"):
+            exe.run_repeated(main, feed=stacked, fetch_list=[loss],
+                             scope=scope, steps=3, feed_stacked=True)
+
+
+def test_stack_feed_window_validates_keys():
+    import pytest
+
+    from paddle_tpu import reader as rd
+
+    with pytest.raises(ValueError, match="keys"):
+        rd.stack_feed_window([{"a": np.zeros(2)}, {"b": np.zeros(2)}])
+    with pytest.raises(ValueError, match="at least one"):
+        rd.stack_feed_window([])
+
+
+def test_run_repeated_feed_stacked_steps_one_unstacks():
+    """A window of length 1 must unstack (drop the leading axis) before
+    delegating to the single-step path — not trace the program with a
+    wrong-rank batch."""
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        f = _feed()
+        stacked = {k: v[None] for k, v in f.items()}  # K=1 leading axis
+        v_stacked = exe.run_repeated(main, feed=stacked, fetch_list=[loss],
+                                     scope=scope, steps=1,
+                                     feed_stacked=True)
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        v_plain = exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+    np.testing.assert_allclose(np.asarray(v_stacked[0]),
+                               np.asarray(v_plain[0]), atol=1e-6)
+
+
+def test_run_repeated_feed_stacked_steps_one_rejects_wider_window():
+    """steps=1 with a K>1 window is a caller bug — must raise, never
+    silently train on slice 0 and drop the rest of the data."""
+    import pytest
+
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        stacked = {k: np.stack([v, v, v]) for k, v in _feed().items()}
+        with pytest.raises(ValueError, match="leading axis of 1"):
+            exe.run_repeated(main, feed=stacked, fetch_list=[loss],
+                             scope=scope, steps=1, feed_stacked=True)

@@ -47,6 +47,8 @@ def main():
     ap.add_argument("bench_json", help="file of bench.py JSON lines")
     ap.add_argument("--force", action="store_true",
                     help="pin even when the new value is a regression")
+    ap.add_argument("--bench", default=BENCH,
+                    help="bench.py path to rewrite (tests use a copy)")
     args = ap.parse_args()
 
     rows = load_rows(args.bench_json)
@@ -54,25 +56,54 @@ def main():
         print("no result rows in %s" % args.bench_json, file=sys.stderr)
         return 1
 
-    src = open(BENCH).read()
+    src = open(args.bench).read()
     m = re.search(r"BASELINES = \{(.*?)\}", src, re.S)
-    if not m:
-        print("BASELINES dict not found in bench.py", file=sys.stderr)
+    ms = re.search(r"BASELINE_SPC = \{(.*?)\}", src, re.S)
+    if not m or not ms:
+        print("BASELINES / BASELINE_SPC dict not found in bench.py",
+              file=sys.stderr)
         return 1
     current = eval("{" + m.group(1) + "}")  # noqa: S307 - our own literal
+    cur_spc = eval("{" + ms.group(1) + "}")  # noqa: S307
+    # bench's default dispatch mode: baselines track the DEFAULT config
+    # so every future plain `python bench.py` run regression-compares.
+    # A/B rows measured at other steps_per_call values (sweeps like the
+    # 2026-07-31 spc=50 probe) are informational — they must not
+    # re-anchor the baseline away from the default mode (--force pins
+    # them anyway).
+    md = re.search(
+        r'PADDLE_TPU_BENCH_STEPS_PER_CALL",\s*\n?\s*"1" if quick else '
+        r'"(\d+)"', src)
+    default_spc = int(md.group(1)) if md else 1
 
     changed = False
     for row in rows:
         name, value = row["metric"], float(row["value"])
-        old = current.get(name)
-        if old is not None and value < old and not args.force:
+        if row.get("recompute") or row.get("batch_scale", 1) != 1:
+            print("SKIP %s: recompute/scaled-batch rows never pin over "
+                  "the plain-config baseline" % name)
+            continue
+        spc = int(row.get("steps_per_call", 1))
+        old, old_spc = current.get(name), cur_spc.get(name, 1)
+        if spc != default_spc and not args.force:
+            print("SKIP %s: steps_per_call=%d row is an A/B sweep, not "
+                  "bench's default mode (%d) — baselines track the "
+                  "default config (--force to pin anyway)"
+                  % (name, spc, default_spc))
+            continue
+        if old is not None and spc != old_spc:
+            # dispatch-mode change: value comparison vs the old mode is
+            # meaningless — pin the new (value, mode) pair and say so
+            print("MODE %s: baseline re-anchored at steps_per_call=%d "
+                  "(was %d)" % (name, spc, old_spc))
+        elif old is not None and value < old and not args.force:
             print("SKIP %s: %.1f is a regression vs baseline %.1f "
                   "(--force to pin anyway)" % (name, value, old))
             continue
-        if old != value:
-            current[name] = value
+        if old != value or old_spc != spc:
+            current[name], cur_spc[name] = value, spc
             changed = True
-            print("PIN  %s: %s -> %.1f" % (name, old, value))
+            print("PIN  %s: %s -> %.1f (spc=%d)" % (name, old, value, spc))
 
     if not changed:
         print("nothing to pin")
@@ -80,8 +111,14 @@ def main():
 
     body = "\n".join('    "%s": %.1f,' % (k, v)
                      for k, v in sorted(current.items()))
+    spc_body = "\n".join('    "%s": %d,' % (k, cur_spc.get(k, 1))
+                         for k in sorted(current))
+    # replace BASELINE_SPC first: its span sits after BASELINES, so the
+    # earlier slice indices stay valid
+    src = (src[:ms.start()] + "BASELINE_SPC = {\n" + spc_body + "\n}"
+           + src[ms.end():])
     src = src[:m.start()] + "BASELINES = {\n" + body + "\n}" + src[m.end():]
-    with open(BENCH, "w") as f:
+    with open(args.bench, "w") as f:
         f.write(src)
     print("bench.py BASELINES updated (%d entries)" % len(current))
     return 0
