@@ -55,6 +55,11 @@ PEAKS = {
     "v2": 45e12,
 }
 
+# Non-quick default for PADDLE_TPU_BENCH_STEPS_PER_CALL (and the mode
+# pin_baselines treats as baseline-comparable). Module-level so tools
+# parse ONE literal instead of pattern-matching an expression.
+DEFAULT_STEPS_PER_CALL = 10
+
 # Self-baseline: best committed measurement per workload from earlier
 # rounds (the reference ships no absolute numbers — BASELINE.md). Round 1
 # committed only the transformer (BENCH_r01.json); the others anchor on
@@ -181,8 +186,9 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
         # unrepresentative mode. Set =1 to measure dispatch overhead.
         # Quick (CI smoke) mode defaults to 1: a 10-step scan would 5x
         # the smoke work and its rows never feed regression tracking.
-        spc = int(os.environ.get("PADDLE_TPU_BENCH_STEPS_PER_CALL",
-                                 "1" if quick else "10"))
+        spc = int(os.environ.get(
+            "PADDLE_TPU_BENCH_STEPS_PER_CALL",
+            "1" if quick else str(DEFAULT_STEPS_PER_CALL)))
         if spc > 1:
             steps = spc
             _log("%s: compiling K-step scan + warmup (%d steps/call)"

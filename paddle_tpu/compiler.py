@@ -89,6 +89,25 @@ class CompiledProgram:
             )
         return self._engine.run(feed, fetch_list, scope, return_numpy)
 
+    def _run_repeated(self, executor, feed, fetch_list, scope, steps,
+                      return_numpy, feed_stacked):
+        if not self._is_data_parallel:
+            return executor.run_repeated(
+                self._program, feed, fetch_list, scope, steps=steps,
+                return_numpy=return_numpy, feed_stacked=feed_stacked)
+        from .parallel.engine import ParallelEngine
+
+        if self._engine is None:
+            self._engine = ParallelEngine(
+                self._program,
+                loss_name=self._loss_name,
+                build_strategy=self._build_strategy,
+                places=self._places,
+            )
+        return self._engine.run_repeated(
+            feed, fetch_list, scope, steps=steps,
+            return_numpy=return_numpy, feed_stacked=feed_stacked)
+
 
 class ParallelExecutor:
     """User-facing multi-device executor (reference

@@ -168,71 +168,25 @@ class Executor:
         (``paddle_tpu.reader.stack_feed_window`` builds it)."""
         if steps <= 1:
             if feed_stacked:
-                # a window of length 1 still carries the leading axis —
-                # unstack before delegating to the single-step path.
-                # Same leading-axis check as the scan path: a K>1 window
-                # with steps=1 must raise, not silently train on slice 0.
-                for n, v in (feed or {}).items():
-                    shape = np.shape(v)
-                    if not shape or shape[0] != 1:
-                        raise ValueError(
-                            "feed_stacked=True with steps=1: feed %r "
-                            "must carry a leading axis of 1 (got shape "
-                            "%s)" % (n, (shape,)))
-                feed = {k: v[0] if hasattr(v, "ndim") else np.asarray(v)[0]
-                        for k, v in (feed or {}).items()}
+                feed = unstack_singleton_feed(feed)
             return self.run(program, feed, fetch_list, scope,
                             return_numpy=return_numpy)
         from ..compiler import CompiledProgram
 
         if isinstance(program, CompiledProgram):
-            raise ValueError(
-                "run_repeated does not take a CompiledProgram: the "
-                "data-parallel engine runs through ParallelEngine — pass "
-                "the plain Program (SPMD sharding composes with the "
-                "scan via the engine's own mesh rules), or loop run()")
+            # data-parallel: the engine owns the sharded K-step scan
+            return program._run_repeated(self, feed, fetch_list, scope,
+                                         steps, return_numpy, feed_stacked)
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         plan, feeds, const_state, mut_state, rng = self._gather(
             program, feed, fetch_list, scope)
         if feed_stacked:
-            for n, f in zip(plan.feed_names, feeds):
-                if f.ndim == 0 or f.shape[0] != steps:
-                    raise ValueError(
-                        "feed_stacked=True: feed %r must carry a leading "
-                        "steps axis of %d (got shape %s) — stack K "
-                        "per-step batches with reader.stack_feed_window"
-                        % (n, steps, (f.shape,)))
+            validate_stacked_feeds(plan.feed_names, feeds, steps)
         fn = plan.multi.get((steps, feed_stacked))
         if fn is None:
-            raw_step = plan.step
-
-            def multi(feeds, const_vals, mut_vals, rng_key):
-                # fetches/pure ride the CARRY (init zeros of the step's
-                # output shapes), not stacked scan ys: only the last
-                # step's values are wanted, and a [K, ...] stacked
-                # buffer per fetch would shrink the usable batch size
-                step_feeds = ([f[0] for f in feeds] if feed_stacked
-                              else feeds)
-                out_sh = jax.eval_shape(raw_step, step_feeds, const_vals,
-                                        mut_vals, rng_key)
-                zeros = lambda tree: jax.tree.map(
-                    lambda s: jnp.zeros(s.shape, s.dtype), tree)
-
-                def body(carry, xs):
-                    mut, key, _f, _p = carry
-                    fetches, new_mut, new_pure, new_key = raw_step(
-                        xs if feed_stacked else feeds, const_vals, mut,
-                        key)
-                    return (new_mut, new_key, fetches, new_pure), None
-
-                (mut, key, fetches, pures), _ = jax.lax.scan(
-                    body, (mut_vals, rng_key, zeros(out_sh[0]),
-                           zeros(out_sh[2])),
-                    feeds if feed_stacked else None, length=steps)
-                return fetches, mut, pures, key
-
-            fn = jax.jit(multi, donate_argnums=(2,))
+            fn = jax.jit(make_scan_fn(plan.step, steps, feed_stacked),
+                         donate_argnums=(2,))
             plan.multi[(steps, feed_stacked)] = fn
 
         from ..profiler import RecordEvent, is_profiler_enabled
@@ -388,6 +342,67 @@ class Executor:
         fn = jax.jit(step, donate_argnums=(2,))
         return _Plan(feed_names, fetch_names, const_state, mut_state,
                      pure_written, needs_rng, fn, step=step)
+
+
+def validate_stacked_feeds(feed_names, feeds, steps):
+    """feed_stacked contract: every feed carries a leading ``steps`` axis."""
+    for n, f in zip(feed_names, feeds):
+        shape = np.shape(f)
+        if not shape or shape[0] != steps:
+            raise ValueError(
+                "feed_stacked=True: feed %r must carry a leading "
+                "steps axis of %d (got shape %s) — stack K "
+                "per-step batches with reader.stack_feed_window"
+                % (n, steps, (shape,)))
+
+
+def unstack_singleton_feed(feed):
+    """steps<=1 with feed_stacked: a window of length 1 still carries the
+    leading axis — validate it IS length 1 (a K>1 window with steps=1
+    must raise, never silently train on slice 0) and drop it."""
+    for n, v in (feed or {}).items():
+        shape = np.shape(v)
+        if not shape or shape[0] != 1:
+            raise ValueError(
+                "feed_stacked=True with steps=1: feed %r must carry a "
+                "leading axis of 1 (got shape %s)" % (n, (shape,)))
+    return {k: v[0] if hasattr(v, "ndim") else np.asarray(v)[0]
+            for k, v in (feed or {}).items()}
+
+
+def make_scan_fn(raw_step, steps, feed_stacked):
+    """The (unjitted) K-step ``lax.scan`` wrapper over a whole-block step
+    — ONE set of scan semantics shared by ``Executor.run_repeated`` and
+    ``ParallelEngine`` (which adds mesh shardings when jitting it):
+    donated state + RNG chain ride the carry exactly as the unrolled
+    sequence would thread them; with ``feed_stacked`` the feeds are the
+    scanned xs (one real minibatch per iteration), else they close over
+    the body as constants."""
+
+    def multi(feeds, const_vals, mut_vals, rng_key):
+        # fetches/pure ride the CARRY (init zeros of the step's output
+        # shapes), not stacked scan ys: only the last step's values are
+        # wanted, and a [K, ...] stacked buffer per fetch would shrink
+        # the usable batch size
+        step_feeds = [f[0] for f in feeds] if feed_stacked else feeds
+        out_sh = jax.eval_shape(raw_step, step_feeds, const_vals,
+                                mut_vals, rng_key)
+        zeros = lambda tree: jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), tree)
+
+        def body(carry, xs):
+            mut, key, _f, _p = carry
+            fetches, new_mut, new_pure, new_key = raw_step(
+                xs if feed_stacked else feeds, const_vals, mut, key)
+            return (new_mut, new_key, fetches, new_pure), None
+
+        (mut, key, fetches, pures), _ = jax.lax.scan(
+            body, (mut_vals, rng_key, zeros(out_sh[0]),
+                   zeros(out_sh[2])),
+            feeds if feed_stacked else None, length=steps)
+        return fetches, mut, pures, key
+
+    return multi
 
 
 def analyze_block(program: Program, feed_names, fetch_names, scope,

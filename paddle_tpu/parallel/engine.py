@@ -23,7 +23,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.executor import RNG_VAR, _feed_to_device, analyze_block
+from ..core.executor import (RNG_VAR, Executor, _feed_to_device,
+                             analyze_block, make_scan_fn,
+                             unstack_singleton_feed,
+                             validate_stacked_feeds)
 from ..core.program import Program, Variable
 from ..core.scope import Scope, global_scope
 from .sharding import ShardingRules
@@ -55,6 +58,9 @@ class _ParallelPlan:
         self.feed_shardings = feed_shardings      # name -> NamedSharding
         self.state_shardings = state_shardings    # name -> NamedSharding
         self.hlo_text = {}  # stage -> lowered_hlo() text cache
+        self.step = None   # raw (unjitted) step — run_repeated scans it
+        self.multi = {}    # (steps, feed_stacked) -> jitted K-step fn
+        self.feed_shapes = {}  # name -> shape the plan was prepared with
 
 
 class ParallelEngine:
@@ -83,12 +89,90 @@ class ParallelEngine:
         scope = scope if scope is not None else global_scope()
         plan, feeds, const_state, mut_state, rng = self._gather(
             feed, fetch_list, scope)
+        return self._execute(plan, plan.fn,
+                             [plan.feed_shardings[n]
+                              for n in plan.feed_names],
+                             feeds, const_state, mut_state, rng, scope,
+                             return_numpy, "")
 
-        # Place inputs: feeds split over the data axis, state per its spec.
-        feeds = [
-            jax.device_put(v, plan.feed_shardings[n])
-            for n, v in zip(plan.feed_names, feeds)
-        ]
+    def run_repeated(self, feed, fetch_list, scope: Optional[Scope] = None,
+                     steps: int = 1, return_numpy: bool = True,
+                     feed_stacked: bool = False):
+        """K sharded train steps as ONE SPMD executable (`lax.scan` over
+        the partitioned whole-block step, donated state carry) — one
+        host dispatch per K steps, composed with the engine's mesh
+        sharding. Semantics match K sequential ``run`` calls exactly
+        (state, RNG chain, last step's fetches) — see
+        ``Executor.run_repeated``. With ``feed_stacked=True`` every feed
+        carries a leading ``steps`` axis (one REAL minibatch per
+        iteration, ``reader.stack_feed_window`` builds it); the stacked
+        axis is unsharded and each per-step slice keeps the feed's data-
+        axis sharding."""
+        scope = scope if scope is not None else global_scope()
+        if steps <= 1:
+            if feed_stacked:
+                feed = unstack_singleton_feed(feed)
+            return self.run(feed, fetch_list, scope, return_numpy)
+        plan, feeds, const_state, mut_state, rng = self._gather(
+            feed, fetch_list, scope)
+        if feed_stacked:
+            validate_stacked_feeds(plan.feed_names, feeds, steps)
+        fn, feed_in = self._multi_fn(plan, steps, feed_stacked)
+        return self._execute(plan, fn, feed_in, feeds, const_state,
+                             mut_state, rng, scope, return_numpy,
+                             " after %d scanned steps" % steps)
+
+    def _multi_fn(self, plan, steps, feed_stacked):
+        """The jitted sharded K-step scan for a plan plus the feed
+        shardings its inputs expect — the (fn, feed_in) pair is cached
+        per (steps, feed_stacked) so the steady-state dispatch is a dict
+        lookup, not a per-call respec of the feed shardings."""
+        cached = plan.multi.get((steps, feed_stacked))
+        if cached is not None:
+            return cached
+        mesh, repl = self.mesh, NamedSharding(self.mesh, P())
+        if feed_stacked:
+            # leading K axis unsharded; per-step slices take the spec of
+            # their UNSTACKED shape — plan.feed_shardings was computed
+            # from the stacked [K, ...] shapes, where batch-dim-0
+            # sharding falls back to replicated (K rarely divides the
+            # mesh), which would silently serialize data parallelism
+            feed_in = [
+                NamedSharding(mesh, P(None, *self.rules.feed_spec(
+                    plan.feed_shapes[n][1:], mesh, name=n)))
+                for n in plan.feed_names
+            ]
+        else:
+            feed_in = [plan.feed_shardings[n] for n in plan.feed_names]
+        in_shardings = (
+            feed_in,
+            [plan.state_shardings[n] for n in plan.const_state],
+            [plan.state_shardings[n] for n in plan.mut_state],
+            repl,
+        )
+        out_shardings = (
+            [repl for _ in plan.fetch_names],
+            [plan.state_shardings[n] for n in plan.mut_state],
+            [repl for _ in plan.pure_written],
+            repl,
+        )
+        with mesh:
+            fn = jax.jit(make_scan_fn(plan.step, steps, feed_stacked),
+                         in_shardings=in_shardings,
+                         out_shardings=out_shardings,
+                         donate_argnums=(2,))
+        plan.multi[(steps, feed_stacked)] = (fn, feed_in)
+        return fn, feed_in
+
+    def _execute(self, plan, fn, feed_shardings, feeds, const_state,
+                 mut_state, rng, scope, return_numpy, nan_suffix):
+        """Place inputs per their shardings (feeds split over the data
+        axis, state per its spec), run one compiled dispatch, write the
+        new state back to the scope. The epilogue (state write-back,
+        numpy conversion, FLAGS_check_nan_inf) is the Executor's — the
+        mesh path must not lose the NaN tripwire the plain path has."""
+        feeds = [jax.device_put(v, s)
+                 for v, s in zip(feeds, feed_shardings)]
         const_state = [
             jax.device_put(v, plan.state_shardings[n])
             for n, v in zip(plan.const_state, const_state)
@@ -99,38 +183,53 @@ class ParallelEngine:
         ]
         rng = jax.device_put(rng, NamedSharding(self.mesh, P()))
 
-        fetches, new_mut, new_pure, new_rng = plan.fn(feeds, const_state, mut_state, rng)
+        from ..profiler import RecordEvent, is_profiler_enabled
 
-        for n, v in zip(plan.mut_state, new_mut):
-            scope.set_var(n, v)
-        for n, v in zip(plan.pure_written, new_pure):
-            scope.set_var(n, v)
-        if plan.needs_rng:
-            scope.set_var(RNG_VAR, new_rng)
-
-        if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+        if is_profiler_enabled():
+            with RecordEvent("parallel_engine_run%s" % nan_suffix):
+                fetches, new_mut, new_pure, new_rng = fn(
+                    feeds, const_state, mut_state, rng)
+                fetches = [f.block_until_ready()
+                           if hasattr(f, "block_until_ready") else f
+                           for f in fetches]
+        else:
+            fetches, new_mut, new_pure, new_rng = fn(
+                feeds, const_state, mut_state, rng)
+        return Executor._finish(plan, scope, fetches, new_mut, new_pure,
+                                new_rng, return_numpy, nan_suffix)
 
     def lowered_hlo(self, feed, fetch_list, scope: Optional[Scope] = None,
-                    stage: str = "optimized") -> str:
+                    stage: str = "optimized", steps: int = 1,
+                    feed_stacked: bool = False) -> str:
         """Post-SPMD-partitioner HLO text of the sharded step (or the
         pre-XLA ``"stablehlo"``). Golden-structure tests assert the
         data-parallel gradient all-reduces are present — the CPU-side
-        tripwire for a dropped sharding rule (see Executor.lowered_hlo)."""
+        tripwire for a dropped sharding rule (see Executor.lowered_hlo).
+        ``steps > 1`` lowers the K-step ``run_repeated`` scan instead
+        (pass the stacked feed when ``feed_stacked``) — collectives and
+        donation must survive inside the scan body too."""
         if stage not in ("stablehlo", "optimized"):
             raise ValueError("stage must be 'stablehlo' or 'optimized', "
                              "got %r" % (stage,))
+        if steps <= 1 and feed_stacked:
+            raise ValueError(
+                "steps=1 with feed_stacked has no scanned executable "
+                "(run_repeated unstacks and runs the plain step) — "
+                "lower the unstacked feed instead")
         scope = scope if scope is not None else global_scope()
         plan, feeds, const_state, mut_state, rng = self._gather(
             feed, fetch_list, scope)
-        if stage not in plan.hlo_text:
+        fn = plan.fn
+        if steps > 1:
+            fn, _ = self._multi_fn(plan, steps, feed_stacked)
+        key = (stage, steps, feed_stacked)
+        if key not in plan.hlo_text:
             with self.mesh:
-                lowered = plan.fn.lower(feeds, const_state, mut_state, rng)
-            plan.hlo_text[stage] = (
+                lowered = fn.lower(feeds, const_state, mut_state, rng)
+            plan.hlo_text[key] = (
                 lowered.as_text() if stage == "stablehlo"
                 else lowered.compile().as_text())
-        return plan.hlo_text[stage]
+        return plan.hlo_text[key]
 
     def _with_ext_rules(self) -> ShardingRules:
         return merged_ext_rules(self.program, self.mesh, self.rules)
@@ -207,9 +306,12 @@ class ParallelEngine:
         with mesh:
             fn = jax.jit(step, in_shardings=in_shardings,
                          out_shardings=out_shardings, donate_argnums=(2,))
-        return _ParallelPlan(feed_names, fetch_names, const_state, mut_state,
+        plan = _ParallelPlan(feed_names, fetch_names, const_state, mut_state,
                              pure_written, needs_rng, fn,
                              feed_shardings, state_shardings)
+        plan.step = step
+        plan.feed_shapes = {n: tuple(feed_vals[n].shape) for n in feed_names}
+        return plan
 
 
 def merged_ext_rules(program, mesh, rules: ShardingRules) -> ShardingRules:

@@ -295,3 +295,39 @@ def test_recompute_emits_optimization_barrier():
 
     assert "optimization_barrier" in build(True)
     assert "optimization_barrier" not in build(False)
+
+
+def test_dp_scanned_multi_step_keeps_all_reduce_and_donation():
+    """run_repeated through the mesh engine: the gradient all-reduce
+    must survive INSIDE the lax.scan body (a regression that replicated
+    the scanned feeds would silently serialize data parallelism), and
+    the donated state carry must still alias — the K-step executable is
+    the steady-state training artifact, so it is the one that matters."""
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss = _build_mlp()
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+
+        engine = ParallelEngine(main, loss_name=loss.name)
+        txt = engine.lowered_hlo(feed=_feed(), fetch_list=[loss],
+                                 scope=scope, steps=4)
+        n_ar = len(_hlo_ops(txt, "all-reduce")) + \
+            len(_hlo_ops(txt, "all-reduce-start"))
+        assert n_ar >= 1, "no all-reduce in the scanned DP step HLO"
+        assert len(_alias_entries(txt)) == 4, \
+            "state carry lost donation in the K-step executable"
+
+        # stacked-feed variant: same invariants with the window feed
+        import paddle_tpu.reader as rd
+
+        window = rd.stack_feed_window([_feed(), _feed(), _feed()])
+        txt2 = engine.lowered_hlo(feed=window, fetch_list=[loss],
+                                  scope=scope, steps=3, feed_stacked=True)
+        n_ar2 = len(_hlo_ops(txt2, "all-reduce")) + \
+            len(_hlo_ops(txt2, "all-reduce-start"))
+        assert n_ar2 >= 1, "no all-reduce in the stacked-window HLO"
+        assert len(_alias_entries(txt2)) == 4

@@ -117,19 +117,30 @@ def test_run_repeated_advances_training():
     assert last < first * 0.7, (first, last)
 
 
-def test_run_repeated_rejects_compiled_program():
-    import pytest
-
-    main, startup, loss = _build()
+def test_run_repeated_compiled_program_delegates_to_engine():
+    """A data-parallel CompiledProgram routes run_repeated through the
+    mesh engine's sharded K-step scan — same result as the plain
+    Executor path on the same (deterministic) program."""
     from paddle_tpu.compiler import CompiledProgram
 
-    exe = fluid.Executor(fluid.CPUPlace())
+    l_plain, p_plain = _run("repeated", 4)
+
+    main, startup, loss = _build()
     scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
     with scope_guard(scope):
         exe.run(startup, scope=scope)
-        with pytest.raises(ValueError, match="ParallelEngine"):
-            exe.run_repeated(CompiledProgram(main), feed=_feed(),
-                             fetch_list=[loss], scope=scope, steps=4)
+        compiled = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        vals = exe.run_repeated(compiled, feed=_feed(), fetch_list=[loss],
+                                scope=scope, steps=4)
+        l_dp = float(np.asarray(vals[0]).reshape(-1)[0])
+        p_dp = {norm: np.asarray(scope.find_var(n))
+                for n, norm in _param_names(scope).items()}
+    assert abs(l_plain - l_dp) < 1e-4, (l_plain, l_dp)
+    for n in p_plain:
+        np.testing.assert_allclose(p_plain[n], p_dp[n], atol=1e-4,
+                                   err_msg=n)
 
 
 def test_run_repeated_check_nan_inf():

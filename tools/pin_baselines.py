@@ -71,10 +71,12 @@ def main():
     # 2026-07-31 spc=50 probe) are informational — they must not
     # re-anchor the baseline away from the default mode (--force pins
     # them anyway).
-    md = re.search(
-        r'PADDLE_TPU_BENCH_STEPS_PER_CALL",\s*\n?\s*"1" if quick else '
-        r'"(\d+)"', src)
-    default_spc = int(md.group(1)) if md else 1
+    md = re.search(r"^DEFAULT_STEPS_PER_CALL\s*=\s*(\d+)", src, re.M)
+    if not md:
+        print("DEFAULT_STEPS_PER_CALL not found in bench.py — cannot "
+              "tell sweep rows from default-mode rows", file=sys.stderr)
+        return 1
+    default_spc = int(md.group(1))
 
     changed = False
     for row in rows:
