@@ -75,9 +75,10 @@ class CompiledProgram:
         self._places = places
         return self
 
-    def _run(self, executor, feed, fetch_list, scope, return_numpy):
-        if not self._is_data_parallel:
-            return executor.run(self._program, feed, fetch_list, scope, return_numpy)
+    def _ensure_engine(self):
+        """Lazily build the ONE mesh engine this program runs through —
+        run() and run_repeated() must share it (same compile cache, same
+        sharding configuration)."""
         from .parallel.engine import ParallelEngine
 
         if self._engine is None:
@@ -87,7 +88,12 @@ class CompiledProgram:
                 build_strategy=self._build_strategy,
                 places=self._places,
             )
-        return self._engine.run(feed, fetch_list, scope, return_numpy)
+        return self._engine
+
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        if not self._is_data_parallel:
+            return executor.run(self._program, feed, fetch_list, scope, return_numpy)
+        return self._ensure_engine().run(feed, fetch_list, scope, return_numpy)
 
     def _run_repeated(self, executor, feed, fetch_list, scope, steps,
                       return_numpy, feed_stacked):
@@ -95,16 +101,7 @@ class CompiledProgram:
             return executor.run_repeated(
                 self._program, feed, fetch_list, scope, steps=steps,
                 return_numpy=return_numpy, feed_stacked=feed_stacked)
-        from .parallel.engine import ParallelEngine
-
-        if self._engine is None:
-            self._engine = ParallelEngine(
-                self._program,
-                loss_name=self._loss_name,
-                build_strategy=self._build_strategy,
-                places=self._places,
-            )
-        return self._engine.run_repeated(
+        return self._ensure_engine().run_repeated(
             feed, fetch_list, scope, steps=steps,
             return_numpy=return_numpy, feed_stacked=feed_stacked)
 

@@ -25,6 +25,8 @@ __all__ = [
     "save_vars",
     "save_params",
     "save_persistables",
+    "save_persistables_async",
+    "AsyncCheckpoint",
     "load_vars",
     "load_params",
     "load_persistables",
@@ -79,7 +81,16 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         arrays[n] = np.asarray(val)
     from .native.tensor_store import save_tensors
 
-    save_tensors(os.path.join(dirname, filename or _COMBINED), arrays)
+    path = os.path.join(dirname, filename or _COMBINED)
+    # a sync save racing an in-flight async write to the same path:
+    # staging files are unique (tensor_store), but the caller of the
+    # SYNC save expects ITS snapshot to be the final file — drain the
+    # background write first so ordering is deterministic
+    with _pending_lock():
+        prev = _PENDING.get(path)
+    if prev is not None:
+        prev._thread.join()
+    save_tensors(path, arrays)
 
 
 def save_params(executor, dirname, main_program=None, filename=None,
@@ -94,6 +105,107 @@ def save_persistables(executor, dirname, main_program=None, filename=None,
     save_vars(executor, dirname, main_program,
               predicate=lambda v: v.persistable, filename=filename,
               scope=scope)
+
+
+class AsyncCheckpoint:
+    """Handle for a background checkpoint write started by
+    ``save_persistables_async``. ``wait()`` blocks until the file is
+    durably in place and re-raises any write error; ``done()`` polls.
+    The checkpoint is atomic either way (tensor_store writes a temp
+    file and ``os.replace``\\ s it), so a crash mid-write never leaves
+    a torn file at the target path."""
+
+    def __init__(self, thread, path):
+        self._thread = thread
+        self._err = []
+        self.path = path
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def wait(self) -> None:
+        self._thread.join()
+        if self._err:
+            raise self._err[0]
+
+    result = wait
+
+
+# in-flight background writes keyed by target path: a second async save
+# to the same path must wait for the first (both would stage the same
+# '<path>.tmp' file), and interpreter exit must not truncate a write
+_PENDING_LOCK = None
+_PENDING = {}
+
+
+def _pending_lock():
+    global _PENDING_LOCK
+    import threading
+
+    if _PENDING_LOCK is None:
+        _PENDING_LOCK = threading.Lock()
+    return _PENDING_LOCK
+
+
+def save_persistables_async(executor, dirname, main_program=None,
+                            filename=None, scope=None) -> AsyncCheckpoint:
+    """Non-blocking ``save_persistables``: the device→host transfer is
+    SYNCHRONOUS (overlapped across arrays via ``copy_to_host_async``,
+    and required for correctness — the next train step donates the
+    state buffers, so the snapshot must be off-device before control
+    returns), then serialization + atomic rename run on a background
+    thread while training continues. Returns an :class:`AsyncCheckpoint`
+    — call ``wait()`` before depending on the file (e.g. at the end of
+    the epoch, or before shutdown).
+
+    TPU-native analog of the reference's trainer-thread saves (io.py:441
+    save_persistables + the PS checkpoint_notify path): there the RPC
+    layer hides the write latency; here the train loop keeps the chip
+    busy while the host writes."""
+    import threading
+
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    names = _persistable_names(program, lambda v: v.persistable)
+    vals = []
+    for n in names:
+        v = scope.find_var(n)
+        if v is None:
+            raise RuntimeError("variable %r not initialized; cannot save" % n)
+        vals.append((n, v))
+    # start all D2H copies, then gather: transfers overlap each other
+    # instead of serializing behind each np.asarray
+    for _, v in vals:
+        if hasattr(v, "copy_to_host_async"):
+            v.copy_to_host_async()
+    arrays = {n: np.asarray(v) for n, v in vals}
+
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or _COMBINED)
+
+    def write(prev, handle):
+        try:
+            if prev is not None:
+                prev._thread.join()  # serialize same-path writes
+            from .native.tensor_store import save_tensors
+
+            save_tensors(path, arrays)
+        except BaseException as e:  # surfaced by wait()
+            handle._err.append(e)
+        finally:
+            with _pending_lock():
+                if _PENDING.get(path) is handle:
+                    del _PENDING[path]
+
+    with _pending_lock():
+        prev = _PENDING.get(path)
+        handle = AsyncCheckpoint(None, path)
+        handle._thread = threading.Thread(
+            target=write, args=(prev, handle), daemon=False,
+            name="paddle-tpu-ckpt-write")
+        _PENDING[path] = handle
+        handle._thread.start()
+    return handle
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,

@@ -7,6 +7,7 @@ existing good checkpoint."""
 from __future__ import annotations
 
 import ctypes
+import itertools as _itertools
 import os
 from typing import Dict
 
@@ -18,6 +19,7 @@ from .dtypes import code_of, dtype_of
 __all__ = ["save_tensors", "load_tensors", "MAGIC"]
 
 MAGIC = b"PTCK"
+_TMP_SEQ = _itertools.count(1)  # thread-safe staging-file uniquifier
 
 
 def _lib():
@@ -64,7 +66,11 @@ def save_tensors(path: str, tensors: Dict[str, np.ndarray]) -> None:
             a = np.ascontiguousarray(a).reshape(a.shape)
         prepared.append((name, a, code_of(a.dtype)))
 
-    tmp = path + ".tmp"
+    # unique staging name: concurrent writers to the same target (e.g. a
+    # sync save racing an async background write) each stage their own
+    # temp file — the final os.replace is last-writer-wins, never a torn
+    # or interleaved file
+    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), next(_TMP_SEQ))
     h = lib.ts_write_begin(tmp.encode())
     if not h:
         raise IOError("cannot open %s for writing" % tmp)

@@ -93,7 +93,7 @@ class ParallelEngine:
                              [plan.feed_shardings[n]
                               for n in plan.feed_names],
                              feeds, const_state, mut_state, rng, scope,
-                             return_numpy, "")
+                             return_numpy, "", "engine_run")
 
     def run_repeated(self, feed, fetch_list, scope: Optional[Scope] = None,
                      steps: int = 1, return_numpy: bool = True,
@@ -120,7 +120,8 @@ class ParallelEngine:
         fn, feed_in = self._multi_fn(plan, steps, feed_stacked)
         return self._execute(plan, fn, feed_in, feeds, const_state,
                              mut_state, rng, scope, return_numpy,
-                             " after %d scanned steps" % steps)
+                             " after %d scanned steps" % steps,
+                             "engine_run_repeated[%d]" % steps)
 
     def _multi_fn(self, plan, steps, feed_stacked):
         """The jitted sharded K-step scan for a plan plus the feed
@@ -165,7 +166,7 @@ class ParallelEngine:
         return fn, feed_in
 
     def _execute(self, plan, fn, feed_shardings, feeds, const_state,
-                 mut_state, rng, scope, return_numpy, nan_suffix):
+                 mut_state, rng, scope, return_numpy, nan_suffix, event):
         """Place inputs per their shardings (feeds split over the data
         axis, state per its spec), run one compiled dispatch, write the
         new state back to the scope. The epilogue (state write-back,
@@ -186,7 +187,7 @@ class ParallelEngine:
         from ..profiler import RecordEvent, is_profiler_enabled
 
         if is_profiler_enabled():
-            with RecordEvent("parallel_engine_run%s" % nan_suffix):
+            with RecordEvent(event):
                 fetches, new_mut, new_pure, new_rng = fn(
                     feeds, const_state, mut_state, rng)
                 fetches = [f.block_until_ready()
@@ -221,6 +222,8 @@ class ParallelEngine:
             feed, fetch_list, scope)
         fn = plan.fn
         if steps > 1:
+            if feed_stacked:
+                validate_stacked_feeds(plan.feed_names, feeds, steps)
             fn, _ = self._multi_fn(plan, steps, feed_stacked)
         key = (stage, steps, feed_stacked)
         if key not in plan.hlo_text:

@@ -1,0 +1,179 @@
+"""io.save_persistables_async: the device->host snapshot happens before
+control returns (so the next step's buffer donation can't corrupt it),
+the disk write runs in the background, the file lands atomically, and
+errors surface on wait() — never silently.
+"""
+
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io, layers
+from paddle_tpu.core.scope import Scope, scope_guard
+
+pytestmark = pytest.mark.fast
+
+
+def _build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(layers.fc(x, 16, act="relu"), 1)
+        loss = layers.mean(layers.square(pred - y))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"x": rs.randn(16, 8).astype("float32"),
+            "y": rs.randn(16, 1).astype("float32")}
+
+
+def test_async_save_snapshot_isolated_from_later_steps(tmp_path):
+    """The checkpoint must hold the values AT CALL TIME even when
+    training (with donated state buffers) continues before wait()."""
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, feed=_feed(), fetch_list=[loss], scope=scope)
+        snap = {n: np.array(scope.find_var(n))
+                for n in scope.local_var_names()
+                if main.global_block().vars.get(n) is not None
+                and main.global_block().vars[n].persistable}
+        ckpt = io.save_persistables_async(exe, str(tmp_path / "ck"),
+                                          main, scope=scope)
+        # keep training while the write is (possibly) in flight —
+        # donation invalidates the old device buffers
+        for i in range(5):
+            exe.run(main, feed=_feed(i), fetch_list=[loss], scope=scope)
+        ckpt.wait()
+        assert ckpt.done()
+
+        # load into a fresh scope: values match the call-time snapshot
+        scope2 = Scope()
+        with scope_guard(scope2):
+            exe.run(startup, scope=scope2)
+            io.load_persistables(exe, str(tmp_path / "ck"), main,
+                                 scope=scope2)
+            for n, v in snap.items():
+                got = np.asarray(scope2.find_var(n))
+                np.testing.assert_array_equal(v, got, err_msg=n)
+
+
+def test_async_save_matches_sync_save(tmp_path):
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, feed=_feed(), fetch_list=[loss], scope=scope)
+        io.save_persistables(exe, str(tmp_path / "sync"), main,
+                             scope=scope)
+        io.save_persistables_async(exe, str(tmp_path / "async"), main,
+                                   scope=scope).wait()
+    from paddle_tpu.native.tensor_store import load_tensors
+
+    a = load_tensors(str(tmp_path / "sync" / "__model_combined__"))
+    b = load_tensors(str(tmp_path / "async" / "__model_combined__"))
+    assert sorted(a) == sorted(b)
+    for n in a:
+        np.testing.assert_array_equal(a[n], b[n], err_msg=n)
+
+
+def test_async_save_error_surfaces_on_wait(tmp_path, monkeypatch):
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, feed=_feed(), fetch_list=[loss], scope=scope)
+        target = tmp_path / "ro"
+        ckpt = io.save_persistables_async(exe, str(target), main,
+                                          scope=scope)
+        ckpt.wait()  # baseline save works
+
+        # inject a write failure (chmod is useless under root) -> the
+        # background error must re-raise on wait(), not be swallowed
+        import paddle_tpu.native.tensor_store as ts
+
+        def boom(path, tensors):
+            raise IOError("injected write failure")
+
+        monkeypatch.setattr(ts, "save_tensors", boom)
+        ckpt2 = io.save_persistables_async(exe, str(target), main,
+                                           scope=scope)
+        with pytest.raises(IOError, match="injected"):
+            ckpt2.wait()
+
+
+def test_two_async_saves_same_path_serialize(tmp_path):
+    """Back-to-back saves to one path must not interleave their temp
+    files: the second waits for the first; the final file is the
+    SECOND snapshot."""
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, feed=_feed(), fetch_list=[loss], scope=scope)
+        c1 = io.save_persistables_async(exe, str(tmp_path / "ck"), main,
+                                        scope=scope)
+        exe.run(main, feed=_feed(1), fetch_list=[loss], scope=scope)
+        snap2 = {n: np.array(scope.find_var(n))
+                 for n in scope.local_var_names()
+                 if main.global_block().vars.get(n) is not None
+                 and main.global_block().vars[n].persistable}
+        c2 = io.save_persistables_async(exe, str(tmp_path / "ck"), main,
+                                        scope=scope)
+        c1.wait()
+        c2.wait()
+    from paddle_tpu.native.tensor_store import load_tensors
+
+    final = load_tensors(str(tmp_path / "ck" / "__model_combined__"))
+    for n, v in snap2.items():
+        np.testing.assert_array_equal(v, final[n], err_msg=n)
+
+
+def test_uninitialized_var_raises_immediately(tmp_path):
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        # startup NOT run: the failure must be synchronous (caller
+        # context), not deferred to wait()
+        with pytest.raises(RuntimeError, match="not initialized"):
+            io.save_persistables_async(exe, str(tmp_path / "ck"), main,
+                                       scope=scope)
+
+
+def test_sync_save_drains_inflight_async_to_same_path(tmp_path):
+    """save_persistables during an in-flight async save to the same
+    path: staging files are unique and the sync snapshot is final."""
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, feed=_feed(), fetch_list=[loss], scope=scope)
+        c1 = io.save_persistables_async(exe, str(tmp_path / "ck"), main,
+                                        scope=scope)
+        exe.run(main, feed=_feed(1), fetch_list=[loss], scope=scope)
+        snap = {n: np.array(scope.find_var(n))
+                for n in scope.local_var_names()
+                if main.global_block().vars.get(n) is not None
+                and main.global_block().vars[n].persistable}
+        io.save_persistables(exe, str(tmp_path / "ck"), main, scope=scope)
+        c1.wait()
+    from paddle_tpu.native.tensor_store import load_tensors
+
+    final = load_tensors(str(tmp_path / "ck" / "__model_combined__"))
+    for n, v in snap.items():
+        np.testing.assert_array_equal(v, final[n], err_msg=n)
+    # no staging litter left behind
+    leftover = [p for p in (tmp_path / "ck").iterdir() if ".tmp" in p.name]
+    assert not leftover, leftover

@@ -286,10 +286,12 @@ def test_run_repeated_stacked_feeds_shard_and_match():
         (l_rep,) = engine.run_repeated(window, [loss], scope, steps=4,
                                        feed_stacked=True)
         # the stacked feed's sharding: leading K axis unsharded, batch
-        # axis (dim 1) split over 'data'
+        # axis (dim 1) split over 'data' — a regression that replicates
+        # the window (the sharding-from-stacked-shape bug) fails HERE
         plan = next(iter(engine._cache.values()))
-        fn = plan.multi[(4, True)]
-        assert fn is not None
+        _, feed_in = plan.multi[(4, True)]
+        x_idx = plan.feed_names.index("x")
+        assert feed_in[x_idx].spec == P(None, "data"), feed_in[x_idx].spec
 
     assert abs(float(l_seq) - float(l_rep)) < 1e-5, (l_seq, l_rep)
 
@@ -334,3 +336,22 @@ def test_engine_lowered_hlo_rejects_stacked_single_step():
         with pytest.raises(ValueError, match="unstack"):
             engine.lowered_hlo({"x": x[None], "y": y[None]}, [loss],
                                scope, steps=1, feed_stacked=True)
+
+
+def test_engine_lowered_hlo_validates_stacked_window():
+    """lowered_hlo must give the same contract error run_repeated does
+    when the window's leading axis disagrees with steps — not a deep
+    lax.scan length error."""
+    import pytest
+
+    main, startup, loss = _build_mlp_program()
+    scope = fluid.core.scope.Scope()
+    with fluid.core.scope.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        engine = ParallelEngine(main, loss_name=loss.name)
+        x, y = next(iter(_batches(1)))
+        window = {"x": np.stack([x] * 4), "y": np.stack([y] * 4)}
+        with pytest.raises(ValueError, match="leading steps axis of 3"):
+            engine.lowered_hlo(window, [loss], scope, steps=3,
+                               feed_stacked=True)
