@@ -85,6 +85,20 @@ def _block_sizes():
 _MASK = -1e9  # additive mask for padded key columns
 
 
+def causal_bias_block(s, dtype=None):
+    """[1, 1, s, s] additive causal bias: ``_MASK`` strictly above the
+    diagonal, 0 elsewhere — the ONE construction shared by the
+    trainable-bias causal fold (flash_attention), the ring schedules
+    (parallel/ring_attention.py), and tests, so the mask constant and
+    dtype can never diverge across paths."""
+    import jax.numpy as _jnp
+
+    r = _jnp.arange(s)
+    return _jnp.where(r[None, :] > r[:, None], _jnp.asarray(_MASK),
+                      _jnp.asarray(0.0)).astype(
+        dtype or _jnp.float32)[None, None]
+
+
 def _use_interpret() -> bool:
     """Pallas interpret mode off only on real TPU backends (including the
     'axon' PJRT tunnel, whose platform name is not 'tpu').
@@ -738,13 +752,30 @@ def flash_attention(q, k, v, bias=None, scale=1.0, bias_grad=False,
     skips key blocks entirely above the diagonal via pl.when — ~2x the
     step FLOPs of a dense mask at long S (decoder self-attention should
     pass this instead of a materialized causal bias; a padding bias may
-    still be passed alongside). Requires Sq == Sk; not supported
-    together with bias_grad (the trainable-bias path keeps dense
-    blocks)."""
+    still be passed alongside). Requires Sq == Sk. Composes with
+    ``bias_grad=True`` by materializing the triangular mask into the
+    bias term (the trainable-bias kernels keep dense blocks anyway, so
+    no block-skip is lost relative to that path)."""
     if causal and bias_grad:
-        raise ValueError("causal=True with bias_grad=True is not "
-                         "supported; materialize the causal mask into "
-                         "the trainable bias instead")
+        # trainable bias + causal (e.g. a learned relative-position
+        # bias on a decoder): materialize the triangular mask INTO the
+        # bias term. Nothing is lost vs an in-kernel mask — the
+        # trainable-bias kernels keep dense blocks anyway (the O(Sq*Sk)
+        # score-grad buffer forbids block skipping) — and the bias
+        # cotangent stays exact: masked positions carry zero
+        # probability, hence zero ds. The mask rides outside the
+        # custom_vjp, so autodiff routes the ds cotangent through the
+        # add to the caller's bias only.
+        if bias is None:
+            bias_grad = False  # nothing trainable: plain causal path
+        else:
+            S, Sk = q.shape[2], k.shape[2]
+            if S != Sk:
+                raise ValueError(
+                    "causal flash attention requires Sq == Sk "
+                    "(self-attention); got Sq=%d Sk=%d" % (S, Sk))
+            bias = bias + jax.lax.stop_gradient(causal_bias_block(S))
+            causal = False
     if not flash_effective(q.shape[2], k.shape[2]):
         # short-S dispatch: the composed XLA path wins below the
         # threshold (see flash_min_seq). Same numerics, same bias

@@ -69,30 +69,26 @@ def ring_attention(q, k, v, scale: float, axis_name: str,
     plain-jnp merge).
 
     schedule: "auto" (default) runs the zigzag/striped chunk assignment
-    for causal flash rings (requires >1 ring devices and an even local
-    shard length; falls back to contiguous otherwise) — balanced causal
-    work, ~2x the contiguous schedule's wall-clock at long S.
-    "contiguous" forces the plain assignment; "zigzag" demands the
-    striped one and raises when its requirements don't hold.
+    for causal rings — flash AND plain per-pair kernels (requires >1
+    ring devices and an even local shard length; falls back to
+    contiguous otherwise) — balanced causal work, ~2x the contiguous
+    schedule's wall-clock at long S. "contiguous" forces the plain
+    assignment; "zigzag" demands the striped one and raises when its
+    requirements don't hold.
     """
     if schedule not in ("auto", "contiguous", "zigzag"):
         raise ValueError("schedule must be auto|contiguous|zigzag")
-    if schedule == "zigzag" and not use_flash:
+    n_static = int(lax.psum(1, axis_name))
+    want_zigzag = (schedule == "zigzag"
+                   or (schedule == "auto" and causal))
+    if want_zigzag and causal and n_static > 1 and q.shape[2] % 2 == 0:
+        return _ring_attention_zigzag(q, k, v, scale, axis_name,
+                                      kv_bias, use_flash)
+    if schedule == "zigzag":
         raise ValueError(
-            "schedule='zigzag' requires use_flash=True (the plain path "
-            "only implements the contiguous schedule)")
+            "zigzag schedule requires causal=True, >1 ring devices "
+            "and an even local shard length")
     if use_flash:
-        n_static = int(lax.psum(1, axis_name))
-        want_zigzag = (schedule == "zigzag"
-                       or (schedule == "auto" and causal))
-        if want_zigzag and causal and n_static > 1 \
-                and q.shape[2] % 2 == 0:
-            return _ring_attention_flash_zigzag(q, k, v, scale,
-                                                axis_name, kv_bias)
-        if schedule == "zigzag":
-            raise ValueError(
-                "zigzag schedule requires causal=True, >1 ring devices "
-                "and an even local shard length")
         return _ring_attention_flash(q, k, v, scale, axis_name, causal,
                                      kv_bias)
     n = lax.psum(1, axis_name)
@@ -206,18 +202,22 @@ def _zigzag_permutes(n):
     return fwd_even, fwd_odd, inv_even, inv_odd
 
 
-def _ring_attention_flash_zigzag(q, k, v, scale, axis_name, kv_bias):
-    """Causal flash ring on the ZIGZAG (striped) chunk assignment:
+def _ring_attention_zigzag(q, k, v, scale, axis_name, kv_bias,
+                           use_flash):
+    """Causal ring on the ZIGZAG (striped) chunk assignment:
     device d owns global chunks {d, 2n-1-d} (each Sl/2 rows), so the
     causal visible-work per (device, step) is a CONSTANT two of the four
     chunk pairs (three on the self step) — the naive contiguous causal
     ring leaves late devices computing every step while early devices
     discard theirs, capping wall-clock at the dense cost; zigzag halves
     it. Invisible pairs skip entirely through lax.cond; the two diagonal
-    pairs (self step only — a statically known step) use the kernel's
-    in-VMEM causal mask. Partials merge by logsumexp per q chunk, and
-    two ppermute pairs re-shard contiguous->zigzag->contiguous at the
-    boundaries (no device ever holds the full sequence).
+    pairs (self step only — a statically known step) apply the causal
+    mask (in-VMEM on the flash path, a materialized triangular block on
+    the plain path — which materializes score blocks anyway). Partials
+    merge by logsumexp per q chunk, and two ppermute pairs re-shard
+    contiguous->zigzag->contiguous at the boundaries (no device ever
+    holds the full sequence). The schedule is shared by the flash and
+    plain per-pair kernels: both yield normalized (out, lse) partials.
     """
     from ..ops.attention import flash_attention_with_lse
 
@@ -254,9 +254,23 @@ def _ring_attention_flash_zigzag(q, k, v, scale, axis_name, kv_bias):
     qg0, qg1 = idx, 2 * n - 1 - idx
 
     def pair(qc, kc, vc, bc, causal_pair):
-        o, lse = flash_attention_with_lse(qc, kc, vc, bc, scale,
-                                          causal=causal_pair)
-        return o.astype(jnp.float32), lse
+        if use_flash:
+            o, lse = flash_attention_with_lse(qc, kc, vc, bc, scale,
+                                              causal=causal_pair)
+            return o.astype(jnp.float32), lse
+        # plain pair: materialized score block -> normalized partial.
+        # lse = m + log(l) merges identically to the kernel's.
+        from ..ops.attention import causal_bias_block
+
+        mask = None
+        if causal_pair:
+            mask = causal_bias_block(qc.shape[2])
+        if bc is not None:
+            bm = bc.astype(jnp.float32)
+            mask = bm if mask is None else mask + bm
+        o_hat, m, l = _block_partials(qc.astype(jnp.float32), kc, vc,
+                                      scale, mask)
+        return o_hat / l[..., None], m + jnp.log(l)
 
     def neutral(qc):
         # mark the constants sp-varying so lax.cond branch types match
@@ -277,9 +291,11 @@ def _ring_attention_flash_zigzag(q, k, v, scale, axis_name, kv_bias):
         return o_a * w_a[..., None] + o_i * w_i[..., None], new
 
     def visible_pair(acc, pred, qc, kc, vc, bc):
-        # bc closes over the branches (cond branches may capture
-        # tracers; the kernel stop_gradients the bias, so no cotangent
-        # needs to flow through the capture)
+        # bc closes over the branches — lax.cond supports captured
+        # tracers including ones that carry cotangents (the flash
+        # kernel stop_gradients its bias; the plain pair's bias grad
+        # DOES flow through this capture, pinned by
+        # test_zigzag_plain_causal_with_bias_and_grads)
         part = lax.cond(
             pred,
             lambda qq, kk, vv: pair(qq, kk, vv, bc, False),

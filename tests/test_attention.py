@@ -242,6 +242,75 @@ def test_causal_flash_error_paths():
     kv = jnp.asarray(rs.randn(1, 1, 64, 8).astype("float32"))
     with pytest.raises(ValueError, match="Sq == Sk"):
         flash_attention(q, kv, kv, None, 1.0, causal=True)
-    bias = jnp.zeros((1, 1, 32, 32), jnp.float32)
-    with pytest.raises(ValueError, match="bias_grad"):
-        flash_attention(q, q, q, bias, 1.0, bias_grad=True, causal=True)
+    # causal+bias_grad IS supported (mask materialized into the bias) —
+    # but still self-attention only
+    bias = jnp.zeros((1, 1, 32, 64), jnp.float32)
+    with pytest.raises(ValueError, match="Sq == Sk"):
+        flash_attention(q, kv, kv, bias, 1.0, bias_grad=True, causal=True)
+
+
+def test_flash_causal_with_trainable_bias():
+    """causal=True composes with bias_grad=True: the triangular mask is
+    materialized into the bias OUTSIDE the custom_vjp, so the caller's
+    bias cotangent is exact (zero in masked positions) and matches the
+    dense composed reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import (_attention_reference,
+                                          flash_attention)
+
+    rs = np.random.RandomState(21)
+    B, H, S, D = 1, 2, 32, 8
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
+               for _ in range(3))
+    bias = jnp.asarray(rs.randn(1, H, S, S).astype("float32") * 0.3)
+    scale = D ** -0.5
+    causal_bias = jnp.asarray(
+        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+
+    def f(a, b, c, bb):
+        return jnp.sum(flash_attention(a, b, c, bb, scale, bias_grad=True,
+                                       causal=True) ** 2)
+
+    def ref(a, b, c, bb):
+        return jnp.sum(_attention_reference(a, b, c, bb + causal_bias,
+                                            scale) ** 2)
+
+    out = flash_attention(q, k, v, bias, scale, bias_grad=True,
+                          causal=True)
+    expect = _attention_reference(q, k, v, bias + causal_bias, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-4, rtol=1e-4)
+
+    g = jax.grad(f, (0, 1, 2, 3))(q, k, v, bias)
+    gr = jax.grad(ref, (0, 1, 2, 3))(q, k, v, bias)
+    for x, r in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(r),
+                                   atol=3e-4, rtol=3e-4)
+    # masked (strictly-upper) positions carry zero bias cotangent
+    db = np.asarray(g[3])
+    iu = np.triu_indices(S, 1)
+    assert np.abs(db[:, :, iu[0], iu[1]]).max() < 1e-6
+
+
+def test_flash_causal_bias_grad_none_bias_is_plain_causal():
+    """bias_grad=True with bias=None degrades to the plain causal path
+    (nothing trainable) instead of erroring or wasting a ds buffer."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import (_attention_reference,
+                                          flash_attention)
+
+    rs = np.random.RandomState(22)
+    B, H, S, D = 1, 1, 32, 8
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
+               for _ in range(3))
+    scale = D ** -0.5
+    causal_bias = jnp.asarray(
+        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    out = flash_attention(q, k, v, None, scale, bias_grad=True,
+                          causal=True)
+    expect = _attention_reference(q, k, v, causal_bias, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-4, rtol=1e-4)

@@ -229,3 +229,64 @@ def test_contiguous_causal_schedule_still_covered():
     ref = _attention_reference(q, k, v, causal_bias, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_zigzag_plain_causal_with_bias_and_grads():
+    """The zigzag schedule on the PLAIN (non-flash) path: materialized
+    per-pair score blocks, same balanced causal schedule — forward and
+    gradients must match the dense reference (pad bias riding along)."""
+    rs = np.random.RandomState(11)
+    B, H, S, D = 2, 2, 64, 8
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
+               for _ in range(3))
+    scale = D ** -0.5
+    keep = np.zeros((B, 1, 1, S), "float32")
+    keep[:, :, :, 7 * S // 8:] = -1e9
+    kv_bias = jnp.asarray(keep)
+    causal_bias = jnp.asarray(
+        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+
+    fn = shard_map(
+        lambda a, b, c, bb: ring_attention(a, b, c, scale, "sp",
+                                           causal=True, kv_bias=bb,
+                                           schedule="zigzag"),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3
+        + (P(None, None, None, "sp"),),
+        out_specs=P(None, None, "sp", None), check_vma=False)
+    out = jax.jit(fn)(q, k, v, kv_bias)
+    ref = _attention_reference(q, k, v, causal_bias + kv_bias, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+    # grads including the BIAS cotangent: on the plain path the bias is
+    # not stop_gradient'd, and its cotangent flows through the lax.cond
+    # captures (see visible_pair) — trainable-bias sp training
+    ga = jax.jit(jax.grad(
+        lambda a, b, c, bb: jnp.sum(fn(a, b, c, bb) ** 2),
+        (0, 1, 2, 3)))(q, k, v, kv_bias)
+    gr = jax.grad(lambda a, b, c, bb: jnp.sum(
+        _attention_reference(a, b, c, causal_bias + bb, scale) ** 2),
+        (0, 1, 2, 3))(q, k, v, kv_bias)
+    for x, r in zip(ga, gr):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(r),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_plain_auto_causal_routes_zigzag_and_odd_shard_falls_back():
+    """auto + causal on the plain path takes the zigzag schedule when
+    the local shard is even (parity pinned above); an ODD local shard
+    must quietly fall back to the contiguous schedule and stay exact."""
+    rs = np.random.RandomState(12)
+    B, H, D = 1, 2, 8
+    S = 8 * 3  # Sl = 3: odd -> contiguous fallback
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
+               for _ in range(3))
+    scale = D ** -0.5
+    causal_bias = jnp.asarray(
+        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    out = _run_ring(q, k, v, scale, causal=True)
+    ref = _attention_reference(q, k, v, causal_bias, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
