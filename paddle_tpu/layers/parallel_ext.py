@@ -132,18 +132,21 @@ def pipeline(x: Variable, n_stages: int,
 
 def moe_ffn(x: Variable, n_experts: int, d_hidden: int,
             capacity: Optional[int] = None, top_k: int = 1,
-            name: Optional[str] = None):
+            z_loss: float = 0.0, name: Optional[str] = None):
     """Switch/GShard mixture-of-experts FFN (see ops/moe_ops.py).
 
     x: [B, D] (or [B, S, D], flattened internally). Returns (out, aux)
     where out has x's shape and aux is the Switch load-balancing loss
-    (top_k=1 is Switch routing; top_k=2 routes each token to its two
+    (top_k=1 is Switch routing; top_k>=2 routes each token to its k
     best experts with renormalized gates, GShard-style) —
     add ``aux_weight * aux`` into the training objective or routing
-    collapses. Expert weights are stored stacked [n_experts, ...]; under
-    a ParallelEngine mesh with an 'expert' axis of size n_experts the
-    tokens shuffle to their expert's device with all_to_all, otherwise
-    every expert computes locally (identical math).
+    collapses. ``z_loss`` > 0 folds the ST-MoE router z-loss
+    (``z_loss * mean(logsumexp(router logits)^2)``) into aux, keeping
+    router logits small — the bf16-stability regularizer. Expert
+    weights are stored stacked [n_experts, ...]; under a ParallelEngine
+    mesh with an 'expert' axis of size n_experts the tokens shuffle to
+    their expert's device with all_to_all, otherwise every expert
+    computes locally (identical math).
     """
     if not 1 <= int(top_k) <= int(n_experts):
         raise ValueError(
@@ -169,6 +172,7 @@ def moe_ffn(x: Variable, n_experts: int, d_hidden: int,
         attrs={"n_experts": int(n_experts),
                "capacity": int(capacity) if capacity else 0,
                "top_k": int(top_k),
+               "z_loss": float(z_loss),
                "axis": "expert"})
     out.shape = x.shape
     aux.shape = ()

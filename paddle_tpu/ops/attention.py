@@ -91,12 +91,10 @@ def causal_bias_block(s, dtype=None):
     trainable-bias causal fold (flash_attention), the ring schedules
     (parallel/ring_attention.py), and tests, so the mask constant and
     dtype can never diverge across paths."""
-    import jax.numpy as _jnp
-
-    r = _jnp.arange(s)
-    return _jnp.where(r[None, :] > r[:, None], _jnp.asarray(_MASK),
-                      _jnp.asarray(0.0)).astype(
-        dtype or _jnp.float32)[None, None]
+    r = jnp.arange(s)
+    return jnp.where(r[None, :] > r[:, None], jnp.asarray(_MASK),
+                     jnp.asarray(0.0)).astype(
+        dtype or jnp.float32)[None, None]
 
 
 def _use_interpret() -> bool:
@@ -774,7 +772,8 @@ def flash_attention(q, k, v, bias=None, scale=1.0, bias_grad=False,
                 raise ValueError(
                     "causal flash attention requires Sq == Sk "
                     "(self-attention); got Sq=%d Sk=%d" % (S, Sk))
-            bias = bias + jax.lax.stop_gradient(causal_bias_block(S))
+            bias = bias + jax.lax.stop_gradient(
+                causal_bias_block(S, bias.dtype))
             causal = False
     if not flash_effective(q.shape[2], k.shape[2]):
         # short-S dispatch: the composed XLA path wins below the

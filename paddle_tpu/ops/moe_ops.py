@@ -31,14 +31,16 @@ from ..core.registry import register_op
 __all__: List[str] = []
 
 
-def _moe_local(x, w1, b1, w2, b2, gate_w, E, capacity, top_k=1):
+def _moe_local(x, w1, b1, w2, b2, gate_w, E, capacity, top_k=1,
+               z_loss=0.0):
     """Single-device path: every expert computes on the full token set,
     outputs select by routing — matching the parallel path's keep/drop
     discipline through the shared route_tokens."""
     from ..parallel.moe import route_tokens
 
     expert_idx, gate, _pos, keep, aux = route_tokens(x, gate_w, E,
-                                                     capacity, top_k)
+                                                     capacity, top_k,
+                                                     z_loss)
     out = jnp.zeros_like(x)
     for e in range(E):
         h = jax.nn.relu(x @ w1[e] + b1[e])
@@ -61,6 +63,7 @@ def _moe_ffn(ctx, ins, attrs):
     E = int(attrs["n_experts"])
     axis = attrs.get("axis", "expert")
     top_k = int(attrs.get("top_k", 1))
+    z_loss = float(attrs.get("z_loss", 0.0))
 
     D = x.shape[-1]
     xf = x.reshape(-1, D)
@@ -78,7 +81,7 @@ def _moe_ffn(ctx, ins, attrs):
 
     if not use_ep:
         out, aux = _moe_local(xf, w1, b1, w2, b2, gate_w, E, capacity,
-                              top_k)
+                              top_k, z_loss)
         return {"Out": out.reshape(x.shape), "AuxLoss": aux}
 
     def shard_body(xl, w1l, b1l, w2l, b2l, gl):
@@ -87,7 +90,8 @@ def _moe_ffn(ctx, ins, attrs):
         # [capacity, D] slice, and one all_gather rebuilds [E, capacity,
         # D] results for the (replicated) token-side gather.
         expert_idx, gate, pos, keep, aux = route_tokens(xl, gl, E,
-                                                        capacity, top_k)
+                                                        capacity, top_k,
+                                                        z_loss)
         safe_e = jnp.where(keep, expert_idx, 0)       # [K, T]
         safe_p = jnp.where(keep, pos, 0)
         buf = jnp.zeros((E, capacity, D), xl.dtype)

@@ -28,7 +28,7 @@ from jax import lax
 __all__ = ["moe_apply", "route_tokens"]
 
 
-def route_tokens(x, gate_w, E, capacity, top_k=1):
+def route_tokens(x, gate_w, E, capacity, top_k=1, z_loss=0.0):
     """Shared top-k routing/capacity math — the ONE derivation both the
     distributed paths and the single-device dense fallback
     (ops/moe_ops.py) use, so their exact-parity contract can't drift.
@@ -41,10 +41,15 @@ def route_tokens(x, gate_w, E, capacity, top_k=1):
 
     Returns (expert_idx [K,T], gate [K,T], pos [K,T], keep [K,T],
     aux scalar). The aux load-balancing loss follows Switch/GShard:
-    first-choice dispatch fraction x mean router probability.
+    first-choice dispatch fraction x mean router probability. With
+    ``z_loss > 0`` the ST-MoE router z-loss —
+    ``z_loss * mean(logsumexp(logits)^2)`` — folds into aux: it keeps
+    router logits small (numerically stable under bf16) without
+    changing which experts win.
     """
     T = x.shape[0]
-    probs = jax.nn.softmax(x @ gate_w, axis=-1)          # [T, E]
+    logits = x @ gate_w                                  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)              # [T, E]
     top_p, top_e = jax.lax.top_k(probs, top_k)           # [T, K] each
     if top_k == 1:
         # Switch: the output scales by the RAW router probability — that
@@ -57,6 +62,9 @@ def route_tokens(x, gate_w, E, capacity, top_k=1):
 
     onehot1 = jax.nn.one_hot(expert_idx[0], E)
     aux = E * jnp.sum(jnp.mean(onehot1, axis=0) * jnp.mean(probs, axis=0))
+    if z_loss:
+        aux = aux + z_loss * jnp.mean(
+            jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1) ** 2)
 
     # positions: flatten choice-major so cumsum gives 1st choices
     # priority over 2nd within each expert's capacity
@@ -69,7 +77,7 @@ def route_tokens(x, gate_w, E, capacity, top_k=1):
 
 
 def moe_apply(expert_params, gate_w, x, axis_name, capacity=None,
-              top_k=1):
+              top_k=1, z_loss=0.0):
     """Route tokens to per-device experts and back.
 
     expert_params: pytree with leading expert dim sharded on `axis_name`
@@ -79,7 +87,8 @@ def moe_apply(expert_params, gate_w, x, axis_name, capacity=None,
     x: [T, D] local tokens (the data may also be sharded on another axis).
     capacity: max tokens each device routes to EACH expert (static);
         default ceil(2 * T * top_k / E). top_k: experts per token
-        (1 = Switch, 2 = GShard-style).
+        (1 = Switch, k>1 = GShard-style). z_loss: ST-MoE router z-loss
+        weight folded into aux (see route_tokens).
 
     Returns ([T, D] outputs, aux_loss scalar).
     """
@@ -88,7 +97,8 @@ def moe_apply(expert_params, gate_w, x, axis_name, capacity=None,
     capacity = int(capacity or -(-2 * T * top_k // E))
 
     expert_idx, gate, pos, keep, aux = route_tokens(x, gate_w, E,
-                                                    capacity, top_k)
+                                                    capacity, top_k,
+                                                    z_loss)
 
     # scatter tokens into the [E, capacity, D] send buffer (a top-2
     # token appears in both its experts' buffers)

@@ -266,8 +266,8 @@ def test_flash_causal_with_trainable_bias():
                for _ in range(3))
     bias = jnp.asarray(rs.randn(1, H, S, S).astype("float32") * 0.3)
     scale = D ** -0.5
-    causal_bias = jnp.asarray(
-        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    from paddle_tpu.ops.attention import causal_bias_block
+    causal_bias = causal_bias_block(S)
 
     def f(a, b, c, bb):
         return jnp.sum(flash_attention(a, b, c, bb, scale, bias_grad=True,
@@ -307,8 +307,8 @@ def test_flash_causal_bias_grad_none_bias_is_plain_causal():
     q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
                for _ in range(3))
     scale = D ** -0.5
-    causal_bias = jnp.asarray(
-        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    from paddle_tpu.ops.attention import causal_bias_block
+    causal_bias = causal_bias_block(S)
     out = flash_attention(q, k, v, None, scale, bias_grad=True,
                           causal=True)
     expect = _attention_reference(q, k, v, causal_bias, scale)

@@ -227,3 +227,38 @@ def test_moe_top2_first_choice_has_capacity_priority():
     assert np.asarray(keep[0]).tolist() == [True] * 4 + [False] * 2
     # expert 1 receives the 6 second-choice claims; first 4 kept
     assert np.asarray(keep[1]).tolist() == [True] * 4 + [False] * 2
+
+
+def test_moe_z_loss_through_program_and_engine():
+    """moe_ffn(z_loss=...) from the layers API: the aux fetch includes
+    the z term (exactly aux_plain + z * mean(lse^2)) on the single-
+    device path AND the expert-parallel engine path."""
+    import jax.numpy as jnp
+
+    z = 1e-2
+
+    def run(z_loss, parallel):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+            _h, aux = fluid.layers.moe_ffn(x, n_experts=E, d_hidden=H,
+                                           z_loss=z_loss)
+        scope = Scope()
+        with scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup, scope=scope)
+            feed = _feed()
+            if parallel:
+                mesh = make_mesh(jax.devices(), ("expert",), (E,))
+                eng = ParallelEngine(main, mesh=mesh)
+                (a,) = eng.run(feed, [aux], scope)
+            else:
+                (a,) = exe.run(main, feed=feed, fetch_list=[aux],
+                               scope=scope)
+        return float(np.asarray(a).reshape(-1)[0])
+
+    a0 = run(0.0, parallel=False)
+    az = run(z, parallel=False)
+    az_ep = run(z, parallel=True)
+    assert az > a0  # the z term is positive
+    np.testing.assert_allclose(az, az_ep, rtol=1e-5)

@@ -53,8 +53,8 @@ def test_ring_causal():
     q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
                for _ in range(3))
     scale = D ** -0.5
-    causal_bias = jnp.asarray(
-        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    from paddle_tpu.ops.attention import causal_bias_block
+    causal_bias = causal_bias_block(S)
     out = _run_ring(q, k, v, scale, causal=True)
     ref = _attention_reference(q, k, v, causal_bias, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -110,8 +110,8 @@ def test_ring_flash_causal_grads_match_dense():
     q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
                for _ in range(3))
     scale = D ** -0.5
-    causal_bias = jnp.asarray(
-        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    from paddle_tpu.ops.attention import causal_bias_block
+    causal_bias = causal_bias_block(S)
     mesh = Mesh(np.array(jax.devices()), ("sp",))
 
     fn = shard_map(
@@ -164,8 +164,8 @@ def test_zigzag_causal_matches_dense_with_padding_bias():
     keep = np.zeros((B, 1, 1, S), "float32")
     keep[:, :, :, 7 * S // 8:] = -1e9
     kv_bias = jnp.asarray(keep)
-    causal_bias = jnp.asarray(
-        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    from paddle_tpu.ops.attention import causal_bias_block
+    causal_bias = causal_bias_block(S)
     mesh = Mesh(np.array(jax.devices()), ("sp",))
 
     fn = shard_map(
@@ -216,8 +216,8 @@ def test_contiguous_causal_schedule_still_covered():
     q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
                for _ in range(3))
     scale = D ** -0.5
-    causal_bias = jnp.asarray(
-        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    from paddle_tpu.ops.attention import causal_bias_block
+    causal_bias = causal_bias_block(S)
     mesh = Mesh(np.array(jax.devices()), ("sp",))
     fn = shard_map(
         lambda a, b, c: ring_attention(a, b, c, scale, "sp", causal=True,
@@ -243,8 +243,8 @@ def test_zigzag_plain_causal_with_bias_and_grads():
     keep = np.zeros((B, 1, 1, S), "float32")
     keep[:, :, :, 7 * S // 8:] = -1e9
     kv_bias = jnp.asarray(keep)
-    causal_bias = jnp.asarray(
-        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    from paddle_tpu.ops.attention import causal_bias_block
+    causal_bias = causal_bias_block(S)
     mesh = Mesh(np.array(jax.devices()), ("sp",))
 
     fn = shard_map(
@@ -284,8 +284,8 @@ def test_plain_auto_causal_routes_zigzag_and_odd_shard_falls_back():
     q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
                for _ in range(3))
     scale = D ** -0.5
-    causal_bias = jnp.asarray(
-        np.triu(np.full((S, S), -1e9, "float32"), 1)[None, None])
+    from paddle_tpu.ops.attention import causal_bias_block
+    causal_bias = causal_bias_block(S)
     out = _run_ring(q, k, v, scale, causal=True)
     ref = _attention_reference(q, k, v, causal_bias, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
