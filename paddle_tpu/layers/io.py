@@ -14,6 +14,8 @@ from __future__ import annotations
 import queue as _queue
 import threading
 
+import numpy as np
+
 from ..core.program import default_main_program, default_startup_program
 
 __all__ = ["data", "PyReader", "py_reader", "double_buffer",
@@ -126,6 +128,39 @@ class PyReader:
                 yield dict(zip(names, item))
         finally:
             cancelled.set()  # unblock + retire the producer on early exit
+
+    def windows(self, k):
+        """Group the reader's feeds into stacked K-windows for
+        ``Executor.run_repeated(..., feed_stacked=True)`` — K real
+        minibatches per device dispatch (the tunnel/host round-trip
+        amortization measured at 2.16x on the v5e):
+
+            for window, steps in reader.windows(8):
+                exe.run_repeated(main, feed=window, fetch_list=[loss],
+                                 steps=steps, feed_stacked=True)
+
+        Yields ``(stacked_feed, steps)``; ``steps`` is the window
+        length. The tail window may be shorter, and a batch whose
+        shapes differ from the window in progress (e.g. the final
+        partial batch) flushes the window early so stacking never mixes
+        shapes — each distinct (steps, shape) pair compiles once."""
+        if k < 1:
+            raise ValueError("windows(k) needs k >= 1; got %r" % (k,))
+        from ..reader import stack_feed_window
+
+        buf, shapes = [], None
+        for feed in self:
+            sig = {n: tuple(np.shape(v)) for n, v in feed.items()}
+            if buf and sig != shapes:
+                yield stack_feed_window(buf), len(buf)
+                buf = []
+            shapes = sig
+            buf.append(feed)
+            if len(buf) == k:
+                yield stack_feed_window(buf), len(buf)
+                buf = []
+        if buf:
+            yield stack_feed_window(buf), len(buf)
 
 
 def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,

@@ -20,7 +20,9 @@ def stack_feed_window(feed_dicts):
     ``Executor.run_repeated(..., steps=K, feed_stacked=True)`` — K
     different minibatches per device dispatch (one lax.scan executable
     instead of K host/tunnel round-trips). All dicts must share keys and
-    per-key shapes/dtypes; K is ``len(feed_dicts)``."""
+    per-key shapes/dtypes; K is ``len(feed_dicts)``. Values already on
+    device (e.g. PyReader's double-buffered batches) stack on device —
+    no host round-trip."""
     import numpy as np
 
     if not feed_dicts:
@@ -31,8 +33,16 @@ def stack_feed_window(feed_dicts):
             raise ValueError(
                 "stack_feed_window: feed dict %d has keys %s, expected %s"
                 % (i, sorted(d), sorted(keys)))
-    return {k: np.stack([np.asarray(d[k]) for d in feed_dicts])
-            for k in keys}
+
+    import jax
+    import jax.numpy as jnp
+
+    def stack(vals):
+        if all(isinstance(v, jax.Array) for v in vals):
+            return jnp.stack(vals)
+        return np.stack([np.asarray(v) for v in vals])
+
+    return {k: stack([d[k] for d in feed_dicts]) for k in keys}
 
 
 def batch(reader, batch_size, drop_last=False):

@@ -273,3 +273,100 @@ def test_run_repeated_feed_stacked_steps_one_rejects_wider_window():
         with pytest.raises(ValueError, match="leading axis of 1"):
             exe.run_repeated(main, feed=stacked, fetch_list=[loss],
                              scope=scope, steps=1, feed_stacked=True)
+
+
+def test_run_repeated_lr_schedule_advances_per_scanned_step():
+    """The decay step counter is program state, so LR schedules advance
+    INSIDE the scan — K scanned steps must land on the same learning
+    rate and params as K sequential steps (a frozen counter would decay
+    K times slower and silently overtrain early steps)."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 11
+        startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [8], dtype="float32")
+            y = layers.data("y", [1], dtype="float32")
+            pred = layers.fc(layers.fc(x, 16, act="relu"), 1)
+            loss = layers.mean(layers.square(pred - y))
+            lr = layers.exponential_decay(learning_rate=0.1,
+                                          decay_steps=2, decay_rate=0.5)
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        return main, startup, loss
+
+    def run(mode, steps=6):
+        main, startup, loss = build()
+        scope = Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(scope):
+            exe.run(startup, scope=scope)
+            feed = _feed()
+            if mode == "sequential":
+                for _ in range(steps):
+                    vals = exe.run(main, feed=feed, fetch_list=[loss],
+                                   scope=scope)
+            else:
+                vals = exe.run_repeated(main, feed=feed,
+                                        fetch_list=[loss], scope=scope,
+                                        steps=steps)
+            counter = np.asarray(scope.find_var("@LR_DECAY_COUNTER@")) \
+                if scope.find_var("@LR_DECAY_COUNTER@") is not None else None
+            params = {norm: np.asarray(scope.find_var(n))
+                      for n, norm in _param_names(scope).items()}
+        return float(np.asarray(vals[0]).reshape(-1)[0]), params, counter
+
+    l_seq, p_seq, c_seq = run("sequential")
+    l_rep, p_rep, c_rep = run("repeated")
+    assert abs(l_seq - l_rep) < 1e-6, (l_seq, l_rep)
+    if c_seq is not None:
+        np.testing.assert_array_equal(c_seq, c_rep)
+    for n in p_seq:
+        np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-6,
+                                   err_msg=n)
+
+
+def test_pyreader_windows_drive_run_repeated():
+    """The full steady-state loop: PyReader prefetches, windows(K)
+    stacks, run_repeated consumes — identical params to the per-batch
+    exe.run loop over the same data, including a 10-batch epoch with
+    K=4 (two full windows + a tail of 2) and a short final batch that
+    flushes its window early."""
+    batches = _feeds_k(9)
+    # a final partial batch (8 rows instead of 16): must form its own
+    # window, never stacked with the full-size ones
+    batches.append({"x": batches[0]["x"][:8], "y": batches[0]["y"][:8]})
+
+    def gen():
+        for b in batches:
+            yield (b["x"], b["y"])
+
+    def final_params(mode):
+        main, startup, loss = _build()
+        x_var = main.global_block().var("x")
+        y_var = main.global_block().var("y")
+        scope = Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(scope):
+            exe.run(startup, scope=scope)
+            reader = layers.PyReader(feed_list=[x_var, y_var])
+            reader.decorate_batch_generator(gen)
+            if mode == "windows":
+                seen = []
+                for window, steps in reader.windows(4):
+                    seen.append(steps)
+                    exe.run_repeated(main, feed=window, fetch_list=[loss],
+                                     scope=scope, steps=steps,
+                                     feed_stacked=True)
+                assert seen == [4, 4, 1, 1], seen  # tail + flushed short
+            else:
+                for feed in reader():
+                    exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)
+            return {norm: np.asarray(scope.find_var(n))
+                    for n, norm in _param_names(scope).items()}
+
+    p_win = final_params("windows")
+    p_seq = final_params("sequential")
+    for n in p_seq:
+        np.testing.assert_allclose(p_seq[n], p_win[n], atol=1e-5,
+                                   err_msg=n)
