@@ -45,6 +45,19 @@ def pytest_configure(config):
 # subsystem keeps at least one un-listed test so the fast tier smokes it.
 SLOW_TESTS = {
     "test_amp.py::TestAmp::test_matches_f32_training",
+    # re-tiered 2026-07-31 (fast tier crept past 8 min): each demoted
+    # test has a cheaper fast-tier sibling covering the same path
+    "test_ring_attention.py::test_zigzag_plain_causal_with_bias_and_grads",
+    "test_moe_engine.py::test_moe_top2_expert_parallel_matches_dense_fallback",
+    "test_gpt_decode.py::test_kv_cache_decode_matches_full_forward",
+    "test_gpt_decode.py::test_generate_sampling_modes",
+    "test_tpu_lowering.py::test_sp_train_step_lowers_for_tpu_with_ring",
+    "test_pipeline_engine.py::test_pipeline_dropout_dp_pp_trains_deterministically",
+    "test_pipeline_engine.py::test_pipeline_dropout_exact_parity_on_pipe_mesh",
+    "test_pipeline_engine.py::test_pipeline_with_grad_accum_matches_plain",
+    "test_moe_engine.py::test_moe_z_loss_through_program_and_engine",
+    "test_models.py::test_machine_translation_trains",
+    "test_datasets.py::test_wmt14_seq2seq_book_trains",
     "test_attention.py::test_transformer_with_fused_attention_trains",
     "test_bench_cli.py::test_bench_fused_row_records_pallas_mode",
     "test_bench_cli.py::test_bench_orchestrator_happy_path",
