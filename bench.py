@@ -237,6 +237,12 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # PADDLE_TPU_FUSED_ATTENTION=0)
             **({"attention_path": "flash" if uses_flash else "composed"}
                if attention else {}),
+            # a non-default dispatch threshold (e.g. the playbook's
+            # forced-kernel S=128 A/B) marks the row so pin_baselines
+            # never anchors a baseline to an override config
+            **({"flash_min_seq": int(os.environ["PADDLE_TPU_FLASH_MIN_SEQ"])}
+               if (attention and "PADDLE_TPU_FLASH_MIN_SEQ" in os.environ)
+               else {}),
             # K steps per host dispatch (run_repeated lax.scan); absent
             # means the classic one-dispatch-per-step loop
             **({"steps_per_call": spc} if spc > 1 else {}),
@@ -252,7 +258,9 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # — they anchor at 1.0 until a matching baseline exists
             "vs_baseline": round(throughput / BASELINES[name], 3)
             if (name in BASELINES and not recompute and _bscale() == 1
-                and spc == BASELINE_SPC.get(name, 1))
+                and spc == BASELINE_SPC.get(name, 1)
+                and not (attention
+                         and "PADDLE_TPU_FLASH_MIN_SEQ" in os.environ))
             else 1.0,
             # None (not 0.0) when the backend produced no flop count —
             # an unmeasured MFU must never masquerade as a measured zero

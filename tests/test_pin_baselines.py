@@ -112,3 +112,11 @@ def test_spc1_row_skips_when_default_is_10(tmp_path):
         {"metric": ROW, "value": 9999.0}])  # spc absent = 1
     assert "A/B sweep" in proc.stdout
     assert base[ROW] == 509.8 and spc[ROW] == 1
+
+
+def test_dispatch_override_rows_never_pin(tmp_path):
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 9999.0, "steps_per_call": 10,
+         "flash_min_seq": 0}])
+    assert "dispatch-override" in proc.stdout
+    assert base[ROW] == 509.8

@@ -465,3 +465,55 @@ def test_gpt_causal_train_step_lowers_for_tpu():
         finally:
             os.environ.pop("PADDLE_TPU_FLASH_INTERPRET", None)
     assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_fused_train_step_scan_lowers_for_tpu():
+    """run_repeated's K-step lax.scan around the fused AMP Adam train
+    step — the bench's steady-state executable now that
+    steps_per_call defaults to 10 — must lower for TPU: the Mosaic
+    kernel has to be legal INSIDE the scan body (constant feed and
+    stacked-window variants), or the next hardware window burns time
+    rediscovering it."""
+    import os
+
+    from paddle_tpu.core.executor import analyze_block, make_scan_fn
+    from paddle_tpu.models import transformer
+
+    cfg = dict(d_model=64, d_ff=128, n_head=4, n_layer=1, src_vocab=128,
+               trg_vocab=128, max_length=32, dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss, _ = transformer.build(cfg, seq_len=32,
+                                        use_fused_attention=True)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        main.set_amp(True)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+
+        rs = np.random.RandomState(0)
+        feed = {n: rs.randint(1, 128, (2, 32)).astype("int32")
+                for n in ("src_ids", "trg_ids", "lbl_ids")}
+        (feed_names, fetch_names, const_state, mut_state, pure_written,
+         needs_rng, step) = analyze_block(
+            main, sorted(feed), [loss.name], scope)
+        params = {n: np.asarray(scope.find_var(n))
+                  for n in const_state + mut_state}
+        rng = jax.random.PRNGKey(0)
+        feeds = [feed[n] for n in feed_names]
+        const_vals = [params[n] for n in const_state]
+        mut_vals = [params[n] for n in mut_state]
+
+        os.environ["PADDLE_TPU_FLASH_INTERPRET"] = "0"
+        try:
+            multi = make_scan_fn(step, 3, False)
+            exp = _tpu_export(multi, feeds, const_vals, mut_vals, rng)
+            assert "tpu_custom_call" in exp.mlir_module()
+
+            stacked = [np.stack([f] * 3) for f in feeds]
+            multi_w = make_scan_fn(step, 3, True)
+            exp2 = _tpu_export(multi_w, stacked, const_vals, mut_vals, rng)
+            assert "tpu_custom_call" in exp2.mlir_module()
+        finally:
+            os.environ.pop("PADDLE_TPU_FLASH_INTERPRET", None)

@@ -1,0 +1,138 @@
+"""tools/window_playbook.py plumbing: the deadline kill must take down
+the whole process GROUP (a wedged tunnel RPC blocks in C — round-2/3
+lesson), and row parsing tolerates noise lines.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.fast
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+import window_playbook as wp  # noqa: E402
+
+
+def test_run_deadline_kills_process_group(tmp_path):
+    out = str(tmp_path / "out.txt")
+    t0 = time.time()
+    # the child spawns its own child; both must die at the deadline
+    rc = wp.run([sys.executable, "-c",
+                 "import subprocess,sys,time;"
+                 "subprocess.Popen([sys.executable,'-c','import time;"
+                 "time.sleep(60)']); time.sleep(60)"],
+                deadline=2, out_path=out)
+    assert rc is None  # deadline, not an exit code
+    assert time.time() - t0 < 30
+
+
+def test_run_captures_output_and_rc(tmp_path):
+    out = str(tmp_path / "out.txt")
+    rc = wp.run([sys.executable, "-c", "print('hello-row')"], 30,
+                out_path=out)
+    assert rc == 0
+    assert "hello-row" in open(out).read()
+
+
+def test_parse_rows_tolerates_noise(tmp_path):
+    p = tmp_path / "rows.json"
+    p.write_text('not json\n{"metric": "m", "value": 1.0}\n'
+                 '{"metric": "x", "error": "boom"}\n')
+    rows = wp._parse_rows(str(p))
+    assert len(rows) == 2
+    assert rows[0]["value"] == 1.0 and "error" in rows[1]
+
+
+def test_killed_playbook_reaps_its_live_child(tmp_path):
+    """SIGTERM to the playbook must take the in-flight step's process
+    group with it — an orphaned bench/validate would keep a tunnel
+    claim alive (the wedge this tool exists to avoid)."""
+    import signal
+    import subprocess
+
+    marker = tmp_path / "child_alive"
+    grandchild = tmp_path / "grandchild.py"
+    grandchild.write_text(
+        "import time\n"
+        "open(%r, 'w').write('x')\n"
+        "time.sleep(120)\n" % str(marker))
+    parent = tmp_path / "parent.py"
+    parent.write_text(
+        "import sys, time, threading, atexit, signal\n"
+        "sys.path.insert(0, %r)\n"
+        "import window_playbook as wp\n"
+        "atexit.register(wp._kill_live_children)\n"
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))\n"
+        "t = threading.Thread(target=wp.run,\n"
+        "                     args=([sys.executable, %r], 120),\n"
+        "                     daemon=True)\n"
+        "t.start()\n"
+        "time.sleep(120)\n"
+        % (os.path.join(os.path.dirname(__file__), os.pardir, "tools"),
+           str(grandchild)))
+    proc = subprocess.Popen([sys.executable, str(parent)])
+    # wait for the grandchild to exist
+    for _ in range(100):
+        if marker.exists():
+            break
+        time.sleep(0.1)
+    assert marker.exists(), "child never started"
+    # find the grandchild pid before killing: it sleeps 120s
+    out = subprocess.run(
+        ["pgrep", "-f", str(grandchild)], capture_output=True, text=True)
+    pids = [int(p) for p in out.stdout.split() if int(p) != proc.pid]
+    assert pids, "no grandchild found"
+    proc.terminate()           # SIGTERM -> sys.exit -> atexit cleanup
+    proc.wait(timeout=15)
+    time.sleep(1.0)
+    for pid in pids:
+        alive = os.path.exists("/proc/%d" % pid)
+        if alive:  # zombie counts as dead
+            with open("/proc/%d/stat" % pid) as f:
+                alive = f.read().split()[2] != "Z"
+        assert not alive, "grandchild %d survived the playbook kill" % pid
+
+
+def test_sigterm_on_main_thread_run_kills_child(tmp_path):
+    """The REAL code path: run() blocking on the MAIN thread when
+    SIGTERM arrives — the exception unwind must kill the child group
+    before run()'s finally drops it from the live list."""
+    import subprocess
+
+    marker = tmp_path / "m2"
+    grandchild = tmp_path / "gc2.py"
+    grandchild.write_text(
+        "import time\n"
+        "open(%r, 'w').write('x')\n"
+        "time.sleep(120)\n" % str(marker))
+    parent = tmp_path / "p2.py"
+    parent.write_text(
+        "import sys, signal\n"
+        "sys.path.insert(0, %r)\n"
+        "import window_playbook as wp\n"
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))\n"
+        "wp.run([sys.executable, %r], 120)\n"
+        % (os.path.join(os.path.dirname(__file__), os.pardir, "tools"),
+           str(grandchild)))
+    proc = subprocess.Popen([sys.executable, str(parent)])
+    for _ in range(100):
+        if marker.exists():
+            break
+        time.sleep(0.1)
+    assert marker.exists(), "child never started"
+    out = subprocess.run(["pgrep", "-f", str(grandchild)],
+                         capture_output=True, text=True)
+    pids = [int(p) for p in out.stdout.split() if int(p) != proc.pid]
+    assert pids, "no grandchild found"
+    proc.terminate()
+    proc.wait(timeout=15)
+    time.sleep(1.0)
+    for pid in pids:
+        alive = os.path.exists("/proc/%d" % pid)
+        if alive:
+            with open("/proc/%d/stat" % pid) as f:
+                alive = f.read().split()[2] != "Z"
+        assert not alive, "grandchild %d survived main-thread SIGTERM" % pid

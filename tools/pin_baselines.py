@@ -26,8 +26,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
-def load_rows(path):
+def load_rows(path, require_value=True):
+    """Noise-tolerant bench JSON-lines parser (shared with
+    window_playbook): ``require_value=False`` keeps error rows too."""
     rows = []
+    if not os.path.exists(path):
+        return rows
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -37,8 +41,11 @@ def load_rows(path):
                 row = json.loads(line)
             except ValueError:
                 continue
-            if isinstance(row, dict) and "value" in row and "metric" in row:
-                rows.append(row)
+            if not isinstance(row, dict):
+                continue
+            if require_value and not ("value" in row and "metric" in row):
+                continue
+            rows.append(row)
     return rows
 
 
@@ -81,9 +88,10 @@ def main():
     changed = False
     for row in rows:
         name, value = row["metric"], float(row["value"])
-        if row.get("recompute") or row.get("batch_scale", 1) != 1:
-            print("SKIP %s: recompute/scaled-batch rows never pin over "
-                  "the plain-config baseline" % name)
+        if row.get("recompute") or row.get("batch_scale", 1) != 1 \
+                or "flash_min_seq" in row:
+            print("SKIP %s: recompute/scaled-batch/dispatch-override "
+                  "rows never pin over the plain-config baseline" % name)
             continue
         spc = int(row.get("steps_per_call", 1))
         old, old_spc = current.get(name), cur_spc.get(name, 1)

@@ -1,0 +1,204 @@
+"""Unattended hardware-window sequencer.
+
+TPU tunnel windows have been rare and short (4-20 min across rounds
+3-4), so the measurement queue must run without a human sequencing it.
+This runs the docs/PERF.md playbook top to bottom, each step in a
+deadline-bounded subprocess, re-probing the tunnel between steps and
+stopping cleanly the moment it wedges — a half-finished queue still
+leaves every completed step's artifact on disk:
+
+    python tools/window_playbook.py            # full queue
+    python tools/window_playbook.py --quick    # probe+validate+bench only
+
+Steps (artifacts):
+  1. probe                 (fail fast; repeated between steps)
+  2. tools/tpu_validate.py (kernel numerics on hardware + AMP step)
+  3. bench.py              -> BENCH_window.json (all rows, spc=10)
+  4. pin_baselines         -> bench.py BASELINES updated in tree; the
+                              operator commits BENCH+pin together
+  5. resnet50 batch-256    -> appended A/B row (MFU ladder step 3)
+  6. transformer S=128 forced-kernel A/B (flash_min_seq=0) — quantifies
+     the kernel-vs-composed gap at short S
+  7. dump_step_hlo resnet50 -> docs/perf/resnet50_* (op mix, aliasing)
+  8. flash_tune transformer_long (longest; only if still healthy)
+
+Never run this concurrently with any other TPU-touching process: the
+tunnel is single-client and a SIGKILLed claim wedges the machine.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def log(msg):
+    print("[window %s] %s" % (time.strftime("%H:%M:%S"), msg), flush=True)
+
+
+_LIVE_PGID = []  # pgid of the step currently running (for cleanup)
+
+
+def _kill_live_children(*_):
+    """SIGTERM/exit cleanup: children run in their own sessions (so the
+    deadline kill can take a whole wedged process group), which means a
+    killed PLAYBOOK would otherwise orphan a live bench/validate still
+    holding a tunnel claim — the exact wedge this tool exists to avoid."""
+    import signal
+
+    for pgid in _LIVE_PGID:
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except OSError:
+            pass
+    _LIVE_PGID.clear()
+
+
+def run(cmd, deadline, env=None, out_path=None):
+    """One step in a killable subprocess (process group kill: a wedged
+    tunnel RPC blocks in C where signal handlers never run)."""
+    log("RUN (%ds deadline): %s" % (deadline, " ".join(cmd)))
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    out_f = open(out_path, "ab") if out_path else None
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            cmd, cwd=REPO, env=full_env, start_new_session=True,
+            stdout=out_f or None, stderr=subprocess.STDOUT if out_f else None)
+        _LIVE_PGID.append(proc.pid)
+        try:
+            rc = proc.wait(timeout=deadline)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            log("DEADLINE: killing process group")
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+            return None
+        except BaseException:
+            # interrupted mid-wait (SIGTERM -> SystemExit, Ctrl-C):
+            # kill the live group BEFORE unwinding — the finally below
+            # removes the pgid from _LIVE_PGID, so the atexit sweep
+            # would otherwise miss it and orphan a tunnel claim
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            raise
+        log("rc=%d" % rc)
+        return rc
+    finally:
+        if proc is not None and proc.pid in _LIVE_PGID:
+            _LIVE_PGID.remove(proc.pid)
+        if out_f:
+            out_f.close()
+
+
+def probe(timeout_s=90):
+    # PADDLE_TPU_PLAYBOOK_PLATFORM: test/smoke override. The site
+    # customization forces JAX_PLATFORMS=axon in every python process,
+    # so plain env vars can't redirect the probe — the jax.config call
+    # is the authoritative override (see .claude/skills/verify).
+    rc = run([PY, "-c",
+              "import os, jax\n"
+              "p = os.environ.get('PADDLE_TPU_PLAYBOOK_PLATFORM')\n"
+              "if p: jax.config.update('jax_platforms', p)\n"
+              "print(jax.devices())"], timeout_s)
+    return rc == 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="probe + validate + bench + pin only")
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_window.json"),
+                    help="bench output path (JSON lines)")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    if not probe():
+        log("tunnel dead at probe; nothing attempted")
+        return 2
+    log("TUNNEL ALIVE — starting the queue")
+
+    # 2. validator: kernel numerics + AMP step on hardware
+    rc = run([PY, "tools/tpu_validate.py"], 420)
+    if rc != 0:
+        log("validator failed/hung (rc=%s) — re-probing before bench"
+            % rc)
+        if not probe():
+            log("tunnel wedged during validation — stopping")
+            return 1
+        log("probe ok — continuing to bench; its per-row isolation "
+            "will classify the validator failure")
+
+    # 3. full bench at the default config
+    if os.path.exists(args.out):
+        os.rename(args.out, args.out + ".prev")
+    rc = run([PY, "bench.py"], 3600, out_path=args.out)
+    rows = _parse_rows(args.out)
+    log("bench: %d result rows, %d error rows"
+        % (len([r for r in rows if "value" in r]),
+           len([r for r in rows if "error" in r])))
+
+    # 4. pin baselines in-tree (same-commit contract: the operator
+    #    commits BENCH_window.json + bench.py together)
+    if any("value" in r for r in rows):
+        run([PY, "tools/pin_baselines.py", args.out], 60)
+
+    if not probe():
+        log("tunnel wedged after bench — stopping with artifacts in place")
+        return 1
+    if args.quick:
+        log("quick mode done in %.0fs" % (time.time() - t0))
+        return 0
+
+    # 5. MFU ladder step 3: resnet50 at batch 256
+    run([PY, "bench.py", "--only", "resnet50"], 1200,
+        env={"PADDLE_TPU_BENCH_BATCH_SCALE": "2"}, out_path=args.out)
+
+    # 6. short-S kernel A/B: force the flash kernel at S=128
+    run([PY, "bench.py", "--only", "transformer"], 1200,
+        env={"PADDLE_TPU_FLASH_MIN_SEQ": "0"}, out_path=args.out)
+
+    if not probe():
+        log("tunnel wedged after A/Bs — stopping")
+        return 1
+
+    # 7. step-HLO artifacts for the bottleneck analysis
+    run([PY, "tools/dump_step_hlo.py", "resnet50"], 900)
+
+    # 8. block-size sweep (longest; last)
+    run([PY, "tools/flash_tune.py", "transformer_long"], 1800)
+
+    log("queue complete in %.0fs" % (time.time() - t0))
+    return 0
+
+
+def _parse_rows(path):
+    from pin_baselines import load_rows  # sibling tool: one parser
+
+    return load_rows(path, require_value=False)
+
+
+if __name__ == "__main__":
+    import atexit
+    import signal
+
+    atexit.register(_kill_live_children)
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))
+    sys.exit(main())
