@@ -9,7 +9,7 @@ the current main/startup programs, returning the loss/feed variables.
 """
 
 from . import (gpt, mnist, resnet, se_resnext, vgg, transformer, bert, ctr,
-               stacked_lstm, machine_translation)
+               stacked_lstm, machine_translation, vit)
 
 __all__ = ["gpt", "mnist", "resnet", "se_resnext", "vgg", "transformer",
-           "bert", "ctr", "stacked_lstm", "machine_translation"]
+           "bert", "ctr", "stacked_lstm", "machine_translation", "vit"]

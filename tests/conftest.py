@@ -58,6 +58,8 @@ SLOW_TESTS = {
     "test_moe_engine.py::test_moe_z_loss_through_program_and_engine",
     "test_models.py::test_machine_translation_trains",
     "test_datasets.py::test_wmt14_seq2seq_book_trains",
+    "test_vit.py::test_vit_trains_and_paths_match",
+    "test_vit.py::test_vit_overfits_tiny_batch",
     "test_attention.py::test_transformer_with_fused_attention_trains",
     "test_bench_cli.py::test_bench_fused_row_records_pallas_mode",
     "test_bench_cli.py::test_bench_orchestrator_happy_path",

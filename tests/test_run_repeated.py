@@ -47,8 +47,11 @@ def _param_names(scope):
                              n.split(".", 1)[1]) for n in names}
 
 
-def _run(mode, steps, dropout=0.0):
-    main, startup, loss = _build(dropout=dropout)
+def _run(mode, steps, dropout=0.0, build=None):
+    """Shared harness: train `steps` iterations via sequential run() or
+    one run_repeated() scan, return (last loss, params). `build`
+    overrides the model (returns (main, startup, loss))."""
+    main, startup, loss = (build or (lambda: _build(dropout=dropout)))()
     scope = Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     with scope_guard(scope):
@@ -369,4 +372,62 @@ def test_pyreader_windows_drive_run_repeated():
     p_seq = final_params("sequential")
     for n in p_seq:
         np.testing.assert_allclose(p_seq[n], p_win[n], atol=1e-5,
+                                   err_msg=n)
+
+
+def test_run_repeated_composes_with_grad_accum():
+    """Grad accumulation already lowers to a scan inside the step;
+    run_repeated wraps it in an outer scan. K scanned accum-steps must
+    equal K sequential accum-steps exactly (scan-of-scan)."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 13
+        startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [8], dtype="float32")
+            y = layers.data("y", [1], dtype="float32")
+            pred = layers.fc(layers.fc(x, 16, act="relu"), 1)
+            loss = layers.mean(layers.square(pred - y))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        main.set_gradient_accumulation(4)
+        return main, startup, loss
+
+    # full batch; set_gradient_accumulation(4) splits it into 4
+    # microbatches inside the step's own scan
+    l_seq, p_seq = _run("sequential", 3, build=build)
+    l_rep, p_rep = _run("repeated", 3, build=build)
+    assert abs(l_seq - l_rep) < 1e-6, (l_seq, l_rep)
+    for n in p_seq:
+        np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-6,
+                                   err_msg=n)
+
+
+def test_run_repeated_composes_with_recompute():
+    """RecomputeOptimizer puts forward segments behind an
+    optimization_barrier with RngKey replay; the outer scan must thread
+    the same RNG chain — params after K scanned recompute-steps equal
+    the sequential run's (dropout inside the recomputed segment)."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 17
+        startup.random_seed = 17
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [8], dtype="float32")
+            y = layers.data("y", [1], dtype="float32")
+            h = layers.fc(x, 16, act="relu")
+            h = layers.dropout(h, dropout_prob=0.2)
+            ckpt = h
+            pred = layers.fc(h, 1)
+            loss = layers.mean(layers.square(pred - y))
+            opt = fluid.optimizer.RecomputeOptimizer(
+                fluid.optimizer.SGD(learning_rate=0.05))
+            opt._set_checkpoints([ckpt])
+            opt.minimize(loss)
+        return main, startup, loss
+
+    l_seq, p_seq = _run("sequential", 3, build=build)
+    l_rep, p_rep = _run("repeated", 3, build=build)
+    assert abs(l_seq - l_rep) < 1e-6, (l_seq, l_rep)
+    for n in p_seq:
+        np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-6,
                                    err_msg=n)
