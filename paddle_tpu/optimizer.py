@@ -91,6 +91,14 @@ class Optimizer:
             dtype=dtype or param.dtype,
             initializer=Constant(fill_value),
         )
+        # record the slot ON the program: ZeRO-1 (ShardingRules zero1)
+        # shards exactly these names — never a name-heuristic that
+        # could collide with a user parameter called '*_moment_0'
+        prog = helper.main_program
+        slots = getattr(prog, "_optimizer_slots", None)
+        if slots is None:
+            slots = prog._optimizer_slots = set()
+        slots.add(v.name)
         self._accumulators[key] = v
         return v
 

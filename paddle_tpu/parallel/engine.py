@@ -336,10 +336,24 @@ def merged_ext_rules(program, mesh, rules: ShardingRules) -> ShardingRules:
             continue
         for pname in getattr(program, attr, ()):
             ext.append(("^" + _re.escape(pname), P(axis)))
+    # ZeRO-1: one exact-name rule per RECORDED optimizer accumulator
+    # (optimizer.py _add_accumulator fills Program._optimizer_slots) —
+    # scoping by the program's own records means a user parameter that
+    # happens to be named '*_moment_0' can never be swept in. Appended
+    # after user rules, so an explicit rule for a slot wins; slots the
+    # axis doesn't divide (beta-pow scalars, odd dims) fall back to
+    # replicated inside spec_for.
+    if getattr(rules, "zero1", False) \
+            and rules.data_axis in mesh.axis_names:
+        for sname in sorted(getattr(program, "_optimizer_slots", ())):
+            ext.append(("^" + _re.escape(sname) + "$",
+                        P(rules.data_axis)))
     if not ext:
         return rules
     merged = ShardingRules(data_axis=rules.data_axis,
-                           model_axis=getattr(rules, "model_axis", "model"))
+                           model_axis=getattr(rules, "model_axis", "model"),
+                           seq_axis=getattr(rules, "seq_axis", "seq"),
+                           zero1=getattr(rules, "zero1", False))
     merged.rules = list(rules.rules) + [
         (_re.compile(pat), spec) for pat, spec in ext]
     merged.feed_rules = list(rules.feed_rules)

@@ -23,7 +23,8 @@ class ShardingRules:
     def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None,
                  data_axis: str = "data",
                  feed_rules: Optional[Sequence[Tuple[str, P]]] = None,
-                 model_axis: str = "model", seq_axis: str = "seq"):
+                 model_axis: str = "model", seq_axis: str = "seq",
+                 zero1: bool = False):
         self.rules: List[Tuple[re.Pattern, P]] = [
             (re.compile(pat), spec) for pat, spec in (rules or [])
         ]
@@ -39,6 +40,14 @@ class ShardingRules:
         # the sequence-parallel axis: fused attention rides ring
         # attention over it (ops/attention.py)
         self.seq_axis = seq_axis
+        # ZeRO-1: optimizer accumulators shard their leading dim over
+        # the data axis (each device keeps 1/N of every moment; XLA
+        # inserts the gather that reassembles updated params). Exact
+        # same numerics — the memory/collective trade is the point.
+        # Applied by the engine (merged_ext_rules) against the
+        # program's RECORDED accumulator names (Program._optimizer_
+        # slots), never a name heuristic; user rules always win.
+        self.zero1 = zero1
 
     def add(self, pattern: str, spec: P) -> "ShardingRules":
         self.rules.append((re.compile(pattern), spec))
@@ -50,7 +59,10 @@ class ShardingRules:
 
     def spec_for(self, name: str, shape, mesh: Mesh) -> P:
         """Spec for a state var. Falls back to replicated when no rule
-        matches or the matched spec doesn't divide the shape."""
+        matches or the matched spec doesn't divide the shape. (The
+        zero1 slot rules arrive as ordinary low-priority rules from
+        merged_ext_rules, which knows the program's accumulator names —
+        scalar slots like beta-pow don't divide and stay replicated.)"""
         for pat, spec in self.rules:
             if pat.search(name):
                 if _divides(spec, shape, mesh):
