@@ -72,6 +72,12 @@ def _adam(ctx, ins, attrs):
     b2p_ = b2p.reshape(()).astype(p.dtype)
     lr_t = lr * jnp.sqrt(1 - b2p_ * b2) / (1 - b1p_ * b1)
     p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    # AdamW decoupled weight decay (optimizer.AdamW): scaled by the
+    # SCHEDULE lr (not the bias-corrected lr_t), applied outside the
+    # moment math — never through the gradients
+    wd = attrs.get("weight_decay", 0.0)
+    if wd:
+        p_new = p_new - lr * wd * p
     return {
         "ParamOut": [p_new],
         "Moment1Out": [m_new],

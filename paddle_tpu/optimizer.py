@@ -36,6 +36,7 @@ __all__ = [
     "AdagradOptimizer",
     "Adam",
     "AdamOptimizer",
+    "AdamW",
     "Adamax",
     "AdamaxOptimizer",
     "DecayedAdagrad",
@@ -269,8 +270,39 @@ class Adam(Optimizer):
             {"ParamOut": [param], "Moment1Out": [m1], "Moment2Out": [m2],
              "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
             {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon,
+             **self._extra_adam_attrs(param),
              "__op_role__": "optimize"},
         )
+
+    def _extra_adam_attrs(self, param):
+        return {}
+
+
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter) — the
+    decay term `lr * weight_decay * param` applies outside the moment
+    math, never through the gradients (L2 regularization via
+    `regularization=` flows through the moments; that is a different
+    optimizer). Beyond reference: Fluid v1.3 predates AdamW; the
+    signature follows modern Paddle's `paddle.optimizer.AdamW`
+    (`apply_decay_param_fun(name) -> bool` selects decayed params —
+    return False for biases / layer norms)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weight_decay=0.01,
+                 apply_decay_param_fun=None, regularization=None,
+                 name=None, lazy_mode=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon,
+                         regularization, name or "adamw", lazy_mode)
+        self._weight_decay = float(weight_decay)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _extra_adam_attrs(self, param):
+        decay = self._weight_decay
+        if self._apply_decay_param_fun is not None \
+                and not self._apply_decay_param_fun(param.name):
+            decay = 0.0
+        return {"weight_decay": decay}
 
 
 class Adamax(Optimizer):

@@ -1,0 +1,95 @@
+"""optimizer.AdamW: decoupled weight decay — numerically Adam plus a
+`lr * wd * param` shrink applied OUTSIDE the moment math, with
+apply_decay_param_fun exempting selected params (biases)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope, scope_guard
+
+
+def _feed():
+    rs = np.random.RandomState(0)
+    return {"x": rs.randn(16, 8).astype("float32"),
+            "y": rs.randn(16, 1).astype("float32")}
+
+
+def _train(opt_fn, steps=4):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square(pred - y))
+        opt_fn().minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for _ in range(steps):
+            exe.run(main, feed=_feed(), fetch_list=[loss], scope=scope)
+        names = sorted(n for n in scope.local_var_names()
+                       if n.endswith(".w_0") or n.endswith(".b_0"))
+        return {n.split(".", 1)[1]: np.asarray(scope.find_var(n))
+                for n in names}
+
+
+def test_adamw_equals_adam_with_manual_decoupled_decay():
+    """One step from identical state: adamw(p) == adam(p) - lr*wd*p."""
+    lr, wd = 0.01, 0.1
+    p_adam = _train(lambda: fluid.optimizer.Adam(learning_rate=lr),
+                    steps=1)
+    p_adamw = _train(lambda: fluid.optimizer.AdamW(
+        learning_rate=lr, weight_decay=wd), steps=1)
+    # initial params are identical (same seeds); reconstruct the init
+    # value from the known decay relation: p_w = p_a - lr*wd*p0, where
+    # p0 is the pre-step param. p0 = p_a + lr_t*update... instead just
+    # verify the DIFFERENCE equals lr*wd*p0 by recovering p0 from a
+    # 0-step run.
+    p0 = _train(lambda: fluid.optimizer.Adam(learning_rate=lr), steps=0)
+    for k in p_adam:
+        np.testing.assert_allclose(
+            p_adamw[k], p_adam[k] - lr * wd * p0[k], atol=1e-6,
+            err_msg=k)
+
+
+def test_adamw_decay_param_fun_exempts_biases():
+    lr, wd = 0.01, 0.5
+    p_plain = _train(lambda: fluid.optimizer.AdamW(
+        learning_rate=lr, weight_decay=wd,
+        apply_decay_param_fun=lambda n: n.endswith(".w_0")), steps=1)
+    p_all = _train(lambda: fluid.optimizer.AdamW(
+        learning_rate=lr, weight_decay=wd), steps=1)
+    p0 = _train(lambda: fluid.optimizer.Adam(learning_rate=lr), steps=0)
+    # bias: exempted run has NO decay shrink; weights match the
+    # decayed run exactly
+    np.testing.assert_allclose(p_plain["b_0"],
+                               p_all["b_0"] + lr * wd * p0["b_0"],
+                               atol=1e-6)
+    np.testing.assert_allclose(p_plain["w_0"], p_all["w_0"], atol=1e-7)
+
+
+def test_adamw_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(layers.fc(x, 16, act="relu"), 1)
+        loss = layers.mean(layers.square(pred - y))
+        fluid.optimizer.AdamW(learning_rate=1e-2,
+                              weight_decay=1e-2).minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = _feed()
+        first = float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss],
+                                         scope=scope)[0]).reshape(-1)[0])
+        for _ in range(30):
+            vals = exe.run(main, feed=feed, fetch_list=[loss],
+                           scope=scope)
+        assert float(np.asarray(vals[0]).reshape(-1)[0]) < first * 0.5
