@@ -17,6 +17,17 @@ Import as `import paddle_tpu as fluid` — the API surface mirrors
 python/paddle/fluid.
 """
 
+import os as _os
+
+# PADDLE_TPU_PLATFORM=cpu forces the jax backend (local smoke runs of
+# examples/bench/tools on a machine whose site config pins JAX_PLATFORMS
+# to a TPU tunnel — a plain env var cannot override that; the jax.config
+# call can, as long as it lands before the first backend use).
+if _os.environ.get("PADDLE_TPU_PLATFORM"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["PADDLE_TPU_PLATFORM"])
+
 from . import ops as _ops  # registers all op lowerings  # noqa: F401
 from . import (  # noqa: F401
     backward,

@@ -60,6 +60,9 @@ SLOW_TESTS = {
     "test_datasets.py::test_wmt14_seq2seq_book_trains",
     "test_vit.py::test_vit_trains_and_paths_match",
     "test_vit.py::test_vit_overfits_tiny_batch",
+    "test_examples.py::test_train_mnist_example",
+    "test_examples.py::test_train_gpt_tpu_example",
+    "test_examples.py::test_train_multichip_example",
     "test_attention.py::test_transformer_with_fused_attention_trains",
     "test_bench_cli.py::test_bench_fused_row_records_pallas_mode",
     "test_bench_cli.py::test_bench_orchestrator_happy_path",

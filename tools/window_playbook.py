@@ -109,13 +109,13 @@ def run(cmd, deadline, env=None, out_path=None):
 
 
 def probe(timeout_s=90):
-    # PADDLE_TPU_PLAYBOOK_PLATFORM: test/smoke override. The site
+    # PADDLE_TPU_PLATFORM: test/smoke override. The site
     # customization forces JAX_PLATFORMS=axon in every python process,
     # so plain env vars can't redirect the probe — the jax.config call
     # is the authoritative override (see .claude/skills/verify).
     rc = run([PY, "-c",
               "import os, jax\n"
-              "p = os.environ.get('PADDLE_TPU_PLAYBOOK_PLATFORM')\n"
+              "p = os.environ.get('PADDLE_TPU_PLATFORM')\n"
               "if p: jax.config.update('jax_platforms', p)\n"
               "print(jax.devices())"], timeout_s)
     return rc == 0
