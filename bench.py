@@ -220,8 +220,14 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             main, feed=feed, fetch_list=[loss], scope=scope).get("flops", 0.0)
         achieved = step_flops * steps / dt
         peak = peak_flops()
+        import jax as _jax
+
         rec = {
             "metric": name,
+            # which backend actually ran — a CPU row must never pass
+            # for a hardware number (pin_baselines refuses platform
+            # "cpu"; the judge can see it either way)
+            "platform": _jax.devices()[0].platform.lower(),
             "precision": "bf16_amp" if amp else "f32",
             # recompute trades FLOPs for memory: mark the row so it is
             # never mistaken for (or regression-compared against) a

@@ -32,7 +32,11 @@ def main():
     penv = init_parallel_env(ParallelEnv())
     assert len(jax.devices()) == 4 * penv.world_size, jax.devices()
 
-    main_prog, startup, loss = lrm.build()
+    # Adam + 8-wide features: real moment slots whose [8, 1] leading
+    # dim shards over the cross-host data axis under zero1
+    main_prog, startup, loss = lrm.build(
+        optimizer=lambda: fluid.optimizer.Adam(learning_rate=lrm.LR),
+        features=8)
     # collective mode: the transpiler validates/records topology but the
     # program needs no surgery (grad all-reduce is the mesh partitioner's)
     cfg = fluid.DistributeTranspilerConfig()
@@ -47,12 +51,28 @@ def main():
 
     exe = fluid.Executor()
     exe.run(startup)
-    engine = ParallelEngine(main_prog, loss_name=loss.name)
+    from paddle_tpu.parallel import ShardingRules
+
+    # zero1: Adam moments shard 1/8 over the CROSS-HOST data axis —
+    # numerics must stay identical to the single-process run
+    engine = ParallelEngine(main_prog, loss_name=loss.name,
+                            rules=ShardingRules(zero1=True))
     losses = []
     for step in range(lrm.STEPS):
-        X, Y = lrm.data(step)  # every process feeds the same global batch
+        # every process feeds the same global batch
+        X, Y = lrm.data(step, features=8)
         lv, = engine.run(feed={"x": X, "y": Y}, fetch_list=[loss.name])
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    # the zero1 slot really sharded across hosts?
+    plan = next(iter(engine._cache.values()))
+    m = [n for n in plan.state_shardings if "_moment1_" in n]
+    assert m and str(plan.state_shardings[m[0]].spec) \
+        == "PartitionSpec('data',)", plan.state_shardings
+    # the K-step scan as one cross-host SPMD executable
+    X, Y = lrm.data(lrm.STEPS, features=8)
+    lv, = engine.run_repeated(feed={"x": X, "y": Y},
+                              fetch_list=[loss.name], steps=3)
+    losses.append(float(np.asarray(lv).reshape(-1)[0]))
     out = os.environ.get("LOSS_OUT")
     if out:
         with open(out, "w") as f:

@@ -20,10 +20,13 @@ LR = 0.1
 FEATURES = 6
 
 
-def build():
+def build(optimizer=None, features=FEATURES):
+    """optimizer: a zero-arg factory (default SGD(LR) — the PS tests'
+    contract); features: input width (the collective test uses 8 so
+    Adam moments can shard over the 8-device cross-host axis)."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        x = fluid.layers.data(name="x", shape=[FEATURES], dtype="float32")
+        x = fluid.layers.data(name="x", shape=[features], dtype="float32")
         y = fluid.layers.data(name="y", shape=[1], dtype="float32")
         pred = fluid.layers.fc(
             x, size=1,
@@ -32,14 +35,14 @@ def build():
             bias_attr=fluid.ParamAttr(
                 name="fc_b", initializer=fluid.initializer.Constant(0.0)))
         loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
-        fluid.optimizer.SGD(LR).minimize(loss)
+        (optimizer() if optimizer else fluid.optimizer.SGD(LR)).minimize(loss)
     return main, startup, loss
 
 
-def data(step):
+def data(step, features=FEATURES):
     rng = np.random.RandomState(100 + step)
-    X = rng.randn(32, FEATURES).astype(np.float32)
-    W = np.linspace(-1, 1, FEATURES).astype(np.float32).reshape(-1, 1)
+    X = rng.randn(32, features).astype(np.float32)
+    W = np.linspace(-1, 1, features).astype(np.float32).reshape(-1, 1)
     Y = X @ W + 0.3
     return X, Y
 

@@ -36,16 +36,26 @@ def _single_process_losses():
 
     from paddle_tpu.core.scope import Scope
 
-    main, startup, loss = m.build()
+    main, startup, loss = m.build(
+        optimizer=lambda: fluid.optimizer.Adam(learning_rate=m.LR),
+        features=8)
     scope = Scope()
     exe = fluid.Executor()
     exe.run(startup, scope=scope)
     losses = []
     for step in range(m.STEPS):
-        X, Y = m.data(step)
+        X, Y = m.data(step, features=8)
         lv, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss.name],
                       scope=scope)
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    # mirror the workers' final run_repeated(steps=3): 3 sequential
+    # steps of the same feed — the scanned cross-host executable (with
+    # zero1-sharded Adam moments) must land on the identical loss
+    X, Y = m.data(m.STEPS, features=8)
+    for _ in range(3):
+        lv, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss.name],
+                      scope=scope)
+    losses.append(float(np.asarray(lv).reshape(-1)[0]))
     return losses
 
 

@@ -120,3 +120,11 @@ def test_dispatch_override_rows_never_pin(tmp_path):
          "flash_min_seq": 0}])
     assert "dispatch-override" in proc.stdout
     assert base[ROW] == 509.8
+
+
+def test_cpu_platform_rows_never_pin(tmp_path):
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 9999.0, "steps_per_call": 10,
+         "platform": "cpu"}])
+    assert "CPU backend" in proc.stdout
+    assert base[ROW] == 509.8

@@ -136,3 +136,21 @@ def test_sigterm_on_main_thread_run_kills_child(tmp_path):
             with open("/proc/%d/stat" % pid) as f:
                 alive = f.read().split()[2] != "Z"
         assert not alive, "grandchild %d survived main-thread SIGTERM" % pid
+
+
+def test_playbook_refuses_platform_override(tmp_path):
+    """A lingering PADDLE_TPU_PLATFORM export must abort the hardware
+    queue before any step runs — CPU rows must never look like a
+    successful measurement window."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                      "window_playbook.py"),
+         "--out", str(tmp_path / "o.json")],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert proc.returncode == 3, (proc.returncode, proc.stdout)
+    assert "unset it first" in proc.stdout

@@ -93,6 +93,10 @@ def main():
             print("SKIP %s: recompute/scaled-batch/dispatch-override "
                   "rows never pin over the plain-config baseline" % name)
             continue
+        if row.get("platform") == "cpu" and not args.force:
+            print("SKIP %s: measured on the CPU backend — baselines "
+                  "hold HARDWARE numbers (--force to pin anyway)" % name)
+            continue
         spc = int(row.get("steps_per_call", 1))
         old, old_spc = current.get(name), cur_spc.get(name, 1)
         if spc != default_spc and not args.force:

@@ -129,6 +129,16 @@ def main():
                     help="bench output path (JSON lines)")
     args = ap.parse_args()
 
+    if os.environ.get("PADDLE_TPU_PLATFORM"):
+        # the README-advertised local-smoke override redirects EVERY
+        # paddle_tpu process (bench children included) — a lingering
+        # export would record CPU throughput as hardware rows with
+        # rc=0. This is a hardware tool: refuse loudly.
+        log("ERROR: PADDLE_TPU_PLATFORM=%r is set — the measurement "
+            "queue must run on the real backend; unset it first"
+            % os.environ["PADDLE_TPU_PLATFORM"])
+        return 3
+
     t0 = time.time()
     if not probe():
         log("tunnel dead at probe; nothing attempted")
