@@ -22,6 +22,9 @@ __all__ = ["base_config", "build"]
 
 
 def base_config():
+    """Set ``n_kv_head`` (< n_head, dividing it) for grouped-query
+    attention: smaller k/v projections in training and an
+    H/Hkv-times smaller KV cache in decode (build_decode_step)."""
     return dict(d_model=768, d_ff=3072, n_head=12, n_layer=12,
                 vocab=50304, max_length=1024, dropout=0.1)
 
@@ -63,7 +66,7 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
         x = _prenorm(x, lambda h, nm=nm: multi_head_attention(
             h, h, self_bias, cfg["d_model"], cfg["n_head"], cfg["dropout"],
             is_test, nm + "_att", use_fused_attention,
-            causal=self_causal),
+            causal=self_causal, n_kv_head=cfg.get("n_kv_head")),
             cfg["dropout"], is_test, nm + "_pre1")
         x = _prenorm(x, lambda h, nm=nm: _ffn(h, cfg["d_model"],
                                               cfg["d_ff"], nm),
@@ -144,13 +147,20 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
         layers.fill_constant([1], "float32", 1.0), vis), scale=-1e9)
     bias = layers.reshape(bias, [1, 1, 1, max_len])
 
+    n_kv = cfg.get("n_kv_head") or n_head
+    if n_head % n_kv:
+        raise ValueError("n_head %d must divide by n_kv_head %d"
+                         % (n_head, n_kv))
+    g = n_head // n_kv
     cache_names = []
     for i in range(cfg["n_layer"]):
         nm = "gpt_%d" % i
+        # GQA: the cache stores n_kv heads — H/Hkv-times less decode
+        # HBM, the whole point of grouped-query attention at inference
         ck = helper.create_global_variable(
-            name=nm + "_cache_k", shape=(batch, n_head, max_len, d_head))
+            name=nm + "_cache_k", shape=(batch, n_kv, max_len, d_head))
         cv = helper.create_global_variable(
-            name=nm + "_cache_v", shape=(batch, n_head, max_len, d_head))
+            name=nm + "_cache_v", shape=(batch, n_kv, max_len, d_head))
         cache_names += [ck.name, cv.name]
 
         h = layers.layer_norm(x, begin_norm_axis=2,
@@ -158,24 +168,32 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
                               bias_attr=ParamAttr(name=nm + "_pre1_ln_b"))
         q = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
                       param_attr=ParamAttr(name=nm + "_att_q.w_0"))
-        k = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
+        k = layers.fc(h, n_kv * d_head, num_flatten_dims=2,
+                      bias_attr=False,
                       param_attr=ParamAttr(name=nm + "_att_k.w_0"))
-        v = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
+        v = layers.fc(h, n_kv * d_head, num_flatten_dims=2,
+                      bias_attr=False,
                       param_attr=ParamAttr(name=nm + "_att_v.w_0"))
 
-        def heads(t):
-            t = layers.reshape(t, [-1, 1, n_head, d_head])
-            return layers.transpose(t, perm=[0, 2, 1, 3])  # [B,H,1,Dh]
+        def kv_heads(t):
+            t = layers.reshape(t, [-1, 1, n_kv, d_head])
+            return layers.transpose(t, perm=[0, 2, 1, 3])  # [B,Hkv,1,Dh]
 
-        q, k, v = heads(q), heads(k), heads(v)
+        k, v = kv_heads(k), kv_heads(v)
         ck = layers.kv_cache_write(ck, k, pos)
         cv = layers.kv_cache_write(cv, v, pos)
+        # GQA grouped attention: query heads fold as [B, Hkv, g, Dh]
+        # (h = kv*g + j, row-major — the same h//g mapping as
+        # transformer.repeat_kv_heads) and batch-matmul DIRECTLY
+        # against the n_kv-head cache: no H-head repeated cache is
+        # ever materialized, so the per-step working set stays at the
+        # n_kv size too. g == 1 degenerates to plain MHA.
+        q = layers.reshape(q, [-1, n_kv, g, d_head])
         scores = layers.matmul(q, ck, transpose_y=True,
-                               alpha=d_head ** -0.5)    # [B,H,1,S]
+                               alpha=d_head ** -0.5)    # [B,Hkv,g,S]
         scores = layers.elementwise_add(scores, bias)
         w = layers.softmax(scores)
-        ctxv = layers.matmul(w, cv)                     # [B,H,1,Dh]
-        ctxv = layers.transpose(ctxv, perm=[0, 2, 1, 3])
+        ctxv = layers.matmul(w, cv)                     # [B,Hkv,g,Dh]
         ctxv = layers.reshape(ctxv, [-1, 1, d_model])
         att = layers.fc(ctxv, d_model, num_flatten_dims=2, bias_attr=False,
                         param_attr=ParamAttr(name=nm + "_att_o.w_0"))

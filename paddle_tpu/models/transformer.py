@@ -62,24 +62,47 @@ def _split_heads(x, seq_len, n_head, d_head):
     return layers.transpose(x, perm=[0, 2, 1, 3])
 
 
+def repeat_kv_heads(x, n_kv_head, n_head, seq_len, d_head):
+    """GQA group-repeat: [B, Hkv, S, Dh] -> [B, H, S, Dh] where query
+    head h reads kv head h // (H/Hkv) — stack g copies on a new axis
+    next to the head axis, then fold."""
+    g = n_head // n_kv_head
+    if g == 1:
+        return x
+    x = layers.stack([x] * g, axis=2)          # [B, Hkv, g, S, Dh]
+    return layers.reshape(x, [-1, n_head, seq_len, d_head])
+
+
 def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
                          is_test, name, use_fused_attention=False,
-                         causal=False):
+                         causal=False, n_kv_head=None):
     """causal=True only affects the fused path (in-kernel triangular
     mask + above-diagonal block skipping); the composed path expects the
-    causal mask folded into `bias` as before."""
+    causal mask folded into `bias` as before. ``n_kv_head < n_head``
+    is grouped-query attention (GQA): k/v project to fewer heads and
+    group-repeat before the scores — fewer kv-projection FLOPs and,
+    on the decode path (models/gpt.py build_decode_step), an
+    H/Hkv-times smaller KV cache."""
+    n_kv_head = n_kv_head or n_head
+    if n_head % n_kv_head:
+        raise ValueError("n_head %d must divide by n_kv_head %d"
+                         % (n_head, n_kv_head))
     d_head = d_model // n_head
     seq_q = q_in.shape[1]
     seq_kv = kv_in.shape[1]
     q = layers.fc(q_in, d_model, num_flatten_dims=2, bias_attr=False,
                   param_attr=ParamAttr(name=name + "_q.w_0"))
-    k = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False,
+    k = layers.fc(kv_in, n_kv_head * d_head, num_flatten_dims=2,
+                  bias_attr=False,
                   param_attr=ParamAttr(name=name + "_k.w_0"))
-    v = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False,
+    v = layers.fc(kv_in, n_kv_head * d_head, num_flatten_dims=2,
+                  bias_attr=False,
                   param_attr=ParamAttr(name=name + "_v.w_0"))
     q = _split_heads(q, seq_q, n_head, d_head)
-    k = _split_heads(k, seq_kv, n_head, d_head)
-    v = _split_heads(v, seq_kv, n_head, d_head)
+    k = _split_heads(k, seq_kv, n_kv_head, d_head)
+    v = _split_heads(v, seq_kv, n_kv_head, d_head)
+    k = repeat_kv_heads(k, n_kv_head, n_head, seq_kv, d_head)
+    v = repeat_kv_heads(v, n_kv_head, n_head, seq_kv, d_head)
     if use_fused_attention:
         ctxv = layers.fused_attention(q, k, v, bias, scale=d_head ** -0.5,
                                       dropout=dropout if not is_test else 0.0,

@@ -50,6 +50,8 @@ SLOW_TESTS = {
     "test_ring_attention.py::test_zigzag_plain_causal_with_bias_and_grads",
     "test_moe_engine.py::test_moe_top2_expert_parallel_matches_dense_fallback",
     "test_gpt_decode.py::test_kv_cache_decode_matches_full_forward",
+    "test_gpt_decode.py::test_kv_cache_decode_matches_full_forward_gqa",
+    "test_gpt_decode.py::test_gqa_training_fused_matches_composed",
     "test_gpt_decode.py::test_generate_sampling_modes",
     "test_tpu_lowering.py::test_sp_train_step_lowers_for_tpu_with_ring",
     "test_pipeline_engine.py::test_pipeline_dropout_dp_pp_trains_deterministically",

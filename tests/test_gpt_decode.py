@@ -19,14 +19,14 @@ CFG = dict(d_model=32, d_ff=64, n_head=2, n_layer=2, vocab=64,
            max_length=16, dropout=0.0)
 
 
-def _trained_scope():
+def _trained_scope(cfg=CFG):
     """A couple of Adam steps so the weights are non-degenerate."""
     main, startup = fluid.Program(), fluid.Program()
     scope = Scope()
     rs = np.random.RandomState(0)
     with scope_guard(scope):
         with fluid.program_guard(main, startup):
-            loss, _ = gpt.build(CFG, seq_len=8, use_fused_attention=False)
+            loss, _ = gpt.build(cfg, seq_len=8, use_fused_attention=False)
             fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
         exe = fluid.Executor(fluid.TPUPlace())
         exe.run(startup, scope=scope)
@@ -41,8 +41,8 @@ def _trained_scope():
     return params
 
 
-def test_kv_cache_decode_matches_full_forward():
-    params = _trained_scope()
+def _assert_decode_matches_full(cfg):
+    params = _trained_scope(cfg)
 
     B, P, NEW, S = 2, 3, 4, 12
     rs = np.random.RandomState(1)
@@ -53,10 +53,14 @@ def test_kv_cache_decode_matches_full_forward():
     dscope = Scope()
     with scope_guard(dscope):
         with fluid.program_guard(dec_prog, dec_start):
-            logits, cache_names = gpt.build_decode_step(CFG, batch=B,
+            logits, cache_names = gpt.build_decode_step(cfg, batch=B,
                                                         max_len=S)
         exe = fluid.Executor(fluid.TPUPlace())
         exe.run(dec_start, scope=dscope)
+        # the cache honors n_kv_head (GQA: H/Hkv-times less decode HBM)
+        n_kv = cfg.get("n_kv_head") or cfg["n_head"]
+        ck = dscope.find_var(cache_names[0])
+        assert np.shape(ck)[1] == n_kv, np.shape(ck)
         for n, v in params.items():
             if dscope.find_var(n) is not None:
                 dscope.set_var(n, v)
@@ -74,7 +78,7 @@ def test_kv_cache_decode_matches_full_forward():
             # rebuild WITHOUT loss tail: reuse build and fetch its
             # logits by reconstructing — simplest: rebuild graph and
             # fetch the pre-loss projection via a fresh is_test build
-            loss, _ = gpt.build(CFG, seq_len=seq_len, is_test=True,
+            loss, _ = gpt.build(cfg, seq_len=seq_len, is_test=True,
                                 use_fused_attention=False)
         exe2 = fluid.Executor(fluid.TPUPlace())
         exe2.run(full_start, scope=fscope)
@@ -99,6 +103,17 @@ def test_kv_cache_decode_matches_full_forward():
             ref = np.concatenate([ref, nxt[:, None].astype("int64")], 1)
 
     np.testing.assert_array_equal(got, ref)
+
+
+def test_kv_cache_decode_matches_full_forward():
+    _assert_decode_matches_full(CFG)
+
+
+def test_kv_cache_decode_matches_full_forward_gqa():
+    """Grouped-query attention: n_kv_head=1 < n_head=2 — the decode
+    cache stores ONE kv head per layer and greedy decode still equals
+    the full forward at every position."""
+    _assert_decode_matches_full(dict(CFG, n_kv_head=1))
 
 
 def test_kv_cache_is_donated_state():
@@ -169,3 +184,40 @@ def test_generate_sampling_modes():
     # chance of reproducing all 5 greedy tokens is ~(1/64)^5 — if this
     # matches, sampling is silently falling back to greedy
     assert not np.array_equal(hot, g)
+
+
+def test_gqa_training_fused_matches_composed():
+    """GQA on the training path: the grouped-repeat happens before the
+    attention op, so the fused (flash causal) and composed paths see
+    identical [B,H,S,Dh] tensors — losses must match exactly
+    (dropout=0), and the k projection is genuinely smaller."""
+    cfg = dict(CFG, n_kv_head=1)
+    rs = np.random.RandomState(2)
+    feed = {"ids": rs.randint(1, 64, (2, 8)).astype("int64")}
+
+    def run(fused):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        startup.random_seed = 5
+        scope = Scope()
+        with scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                loss, _ = gpt.build(cfg, seq_len=8,
+                                    use_fused_attention=fused)
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup, scope=scope)
+            # the kv projection is [D, n_kv*d_head], not [D, D]
+            kw = np.asarray(scope.find_var("gpt_0_att_k.w_0"))
+            assert kw.shape == (32, 16), kw.shape
+            ls = []
+            for _ in range(3):
+                (l,) = exe.run(main, feed=feed, fetch_list=[loss],
+                               scope=scope)
+                ls.append(float(np.asarray(l).reshape(-1)[0]))
+        return ls
+
+    composed = run(False)
+    fused = run(True)
+    np.testing.assert_allclose(composed, fused, rtol=1e-4, atol=1e-5)
+    assert composed[-1] < composed[0]
