@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from ..layer_helper import LayerHelper
 
-__all__ = ["kv_cache_write", "beam_search", "beam_search_decode", "beam_gather", "py_func"]
+__all__ = ["kv_cache_write", "rope", "beam_search", "beam_search_decode", "beam_gather", "py_func"]
 
 
 def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None,
@@ -89,6 +89,22 @@ def beam_gather(x, parent_idx, name=None):
     helper.append_op(type="beam_gather",
                      inputs={"X": [x], "Index": [parent_idx]},
                      outputs={"Out": [out]})
+    return out
+
+
+def rope(x, pos, base=10000.0, name=None):
+    """Rotary position embedding on a head tensor [..., S, D] (D even,
+    rotate-half convention): position i rotates pair (x_j, x_{j+D/2})
+    by angle pos_i * base^(-2j/D). `pos` is a [S] (or [1] for a decode
+    step) int var — runtime positions, one executable for every step.
+    Apply to q and k after head split, BEFORE attention (and before any
+    GQA head repeat — the rotation is per head-dim, head-count blind).
+    """
+    helper = LayerHelper("rope", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="rope", inputs={"X": [x], "Pos": [pos]},
+                     outputs={"Out": [out]}, attrs={"base": float(base)})
+    out.shape = x.shape
     return out
 
 

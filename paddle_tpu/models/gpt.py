@@ -51,13 +51,22 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
         self_bias = layers.elementwise_add(pad_bias, _causal_bias(seq_len))
         self_causal = False
 
+    use_rope = cfg.get("pos_emb", "learned") == "rope"
     word = layers.embedding(ids, [cfg["vocab"], cfg["d_model"]],
                             param_attr=ParamAttr(name="gpt_word_emb"))
-    pos_ids = layers.reshape(layers.range(0, seq_len, 1, "int64"),
-                             [1, seq_len])
-    pos = layers.embedding(pos_ids, [cfg["max_length"], cfg["d_model"]],
-                           param_attr=ParamAttr(name="gpt_pos_emb"))
-    x = layers.elementwise_add(word, pos)
+    rope_pos = None
+    if use_rope:
+        # positions enter through the per-layer q/k rotation instead of
+        # an additive learned table
+        x = word
+        rope_pos = layers.range(0, seq_len, 1, "int64")
+    else:
+        pos_ids = layers.reshape(layers.range(0, seq_len, 1, "int64"),
+                                 [1, seq_len])
+        pos = layers.embedding(pos_ids,
+                               [cfg["max_length"], cfg["d_model"]],
+                               param_attr=ParamAttr(name="gpt_pos_emb"))
+        x = layers.elementwise_add(word, pos)
     if cfg["dropout"]:
         x = layers.dropout(x, cfg["dropout"], is_test=is_test)
 
@@ -66,7 +75,8 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
         x = _prenorm(x, lambda h, nm=nm: multi_head_attention(
             h, h, self_bias, cfg["d_model"], cfg["n_head"], cfg["dropout"],
             is_test, nm + "_att", use_fused_attention,
-            causal=self_causal, n_kv_head=cfg.get("n_kv_head")),
+            causal=self_causal, n_kv_head=cfg.get("n_kv_head"),
+            rope_pos=rope_pos),
             cfg["dropout"], is_test, nm + "_pre1")
         x = _prenorm(x, lambda h, nm=nm: _ffn(h, cfg["d_model"],
                                               cfg["d_ff"], nm),
@@ -106,9 +116,11 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
 
     Feeds: token [B, 1] int64 (the current position's input token) and
     pos [1] int64 (its position). Per-layer K/V caches live as
-    persistable [B, H, max_len, Dh] state the executor DONATES — the
-    `kv_cache_write` update is in-place on device, so a decode step
-    moves O(1) data. Weights share the training graph's parameter names
+    persistable [B, n_kv_head (default n_head), max_len, Dh] state the
+    executor DONATES — the `kv_cache_write` update is in-place on
+    device, so a decode step moves O(1) data (GQA shrinks the cache
+    H/Hkv-fold; RoPE caches store rotated keys). Weights share the
+    training graph's parameter names
     (gpt_*), so after running this program's startup, overwrite them
     with trained values (same names) — see `generate`.
 
@@ -127,16 +139,20 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
 
     # lookup_table squeezes trailing-1 id dims (reference semantics):
     # [B,1] ids -> [B,D]; restore the [B,1,D] step layout explicitly
+    use_rope = cfg.get("pos_emb", "learned") == "rope"
     word = layers.reshape(
         layers.embedding(token, [cfg["vocab"], d_model],
                          param_attr=ParamAttr(name="gpt_word_emb")),
         [-1, 1, d_model])
-    posv = layers.reshape(
-        layers.embedding(layers.reshape(pos, [1, 1]),
-                         [cfg["max_length"], d_model],
-                         param_attr=ParamAttr(name="gpt_pos_emb")),
-        [1, 1, d_model])
-    x = layers.elementwise_add(word, posv)    # [B, 1, D]
+    if use_rope:
+        x = word                              # positions rotate q/k below
+    else:
+        posv = layers.reshape(
+            layers.embedding(layers.reshape(pos, [1, 1]),
+                             [cfg["max_length"], d_model],
+                             param_attr=ParamAttr(name="gpt_pos_emb")),
+            [1, 1, d_model])
+        x = layers.elementwise_add(word, posv)    # [B, 1, D]
 
     # visibility over cache rows: positions <= pos attend, later rows
     # (zeros from init) mask out
@@ -180,6 +196,10 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
             return layers.transpose(t, perm=[0, 2, 1, 3])  # [B,Hkv,1,Dh]
 
         k, v = kv_heads(k), kv_heads(v)
+        if use_rope:
+            # rotate at THIS position; the cache stores rotated keys,
+            # so dot products against it are relative-position exact
+            k = layers.rope(k, pos)
         ck = layers.kv_cache_write(ck, k, pos)
         cv = layers.kv_cache_write(cv, v, pos)
         # GQA grouped attention: query heads fold as [B, Hkv, g, Dh]
@@ -189,6 +209,11 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
         # ever materialized, so the per-step working set stays at the
         # n_kv size too. g == 1 degenerates to plain MHA.
         q = layers.reshape(q, [-1, n_kv, g, d_head])
+        if use_rope:
+            # a [1] pos yields [1, Dh/2] sin/cos that broadcast over
+            # every leading layout — rotating the folded q directly is
+            # exact (all g query heads sit at the same position)
+            q = layers.rope(q, pos)
         scores = layers.matmul(q, ck, transpose_y=True,
                                alpha=d_head ** -0.5)    # [B,Hkv,g,S]
         scores = layers.elementwise_add(scores, bias)

@@ -75,14 +75,16 @@ def repeat_kv_heads(x, n_kv_head, n_head, seq_len, d_head):
 
 def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
                          is_test, name, use_fused_attention=False,
-                         causal=False, n_kv_head=None):
+                         causal=False, n_kv_head=None, rope_pos=None):
     """causal=True only affects the fused path (in-kernel triangular
     mask + above-diagonal block skipping); the composed path expects the
     causal mask folded into `bias` as before. ``n_kv_head < n_head``
     is grouped-query attention (GQA): k/v project to fewer heads and
     group-repeat before the scores — fewer kv-projection FLOPs and,
     on the decode path (models/gpt.py build_decode_step), an
-    H/Hkv-times smaller KV cache."""
+    H/Hkv-times smaller KV cache. ``rope_pos`` (a [S] int position
+    var) applies rotary position embeddings to q and k after the head
+    split (self-attention only: the positions index both sides)."""
     n_kv_head = n_kv_head or n_head
     if n_head % n_kv_head:
         raise ValueError("n_head %d must divide by n_kv_head %d"
@@ -101,6 +103,11 @@ def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
     q = _split_heads(q, seq_q, n_head, d_head)
     k = _split_heads(k, seq_kv, n_kv_head, d_head)
     v = _split_heads(v, seq_kv, n_kv_head, d_head)
+    if rope_pos is not None:
+        # per-head-dim rotation, head-count blind: rotate k at its
+        # n_kv_head width, before any GQA repeat
+        q = layers.rope(q, rope_pos)
+        k = layers.rope(k, rope_pos)
     k = repeat_kv_heads(k, n_kv_head, n_head, seq_kv, d_head)
     v = repeat_kv_heads(v, n_kv_head, n_head, seq_kv, d_head)
     if use_fused_attention:

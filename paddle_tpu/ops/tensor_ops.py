@@ -299,3 +299,27 @@ def _kv_cache_write(ctx, ins, attrs):
     out = jax.lax.dynamic_update_slice(cache, upd.astype(cache.dtype),
                                        (zero, zero, pos, zero))
     return {"Out": [out]}
+
+
+@register_op("rope", diff_inputs=["X"])
+def _rope(ctx, ins, attrs):
+    """Rotary position embedding (rotate-half convention) on [..., S, D]
+    head tensors: pairs (x_i, x_{i+D/2}) rotate by pos * base^(-2i/D).
+    Positions arrive as an INPUT ([S] int, or [1] for a decode step at
+    a runtime offset) so one compiled executable serves every position;
+    the gradient comes mechanically from jax.vjp of this lowering (a
+    rotation's vjp is the inverse rotation). No reference counterpart
+    (Fluid v1.3 predates RoPE); the modern-decoder position scheme the
+    GPT family uses with cfg['pos_emb']='rope'."""
+    x, pos = ins["X"][0], ins["Pos"][0]
+    base = float(attrs.get("base", 10000.0))
+    d = x.shape[-1]
+    half = d // 2
+    inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = pos.reshape(-1).astype(jnp.float32)[:, None] * inv[None, :]
+    sin = jnp.sin(ang).astype(x.dtype)      # [S, half]
+    cos = jnp.cos(ang).astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x1 * sin + x2 * cos], axis=-1)
+    return {"Out": [out]}
