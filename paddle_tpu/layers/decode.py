@@ -100,6 +100,11 @@ def rope(x, pos, base=10000.0, name=None):
     Apply to q and k after head split, BEFORE attention (and before any
     GQA head repeat — the rotation is per head-dim, head-count blind).
     """
+    if x.shape is not None and x.shape[-1] is not None \
+            and int(x.shape[-1]) % 2:
+        raise ValueError(
+            "rope needs an even head dim (rotate-half pairs); got %s"
+            % (x.shape[-1],))
     helper = LayerHelper("rope", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op(type="rope", inputs={"X": [x], "Pos": [pos]},

@@ -73,6 +73,7 @@ __all__ = [
     "adaptive_pool2d",
     "batch_norm",
     "layer_norm",
+    "rms_norm",
     "group_norm",
     "dropout",
     "softmax",
@@ -464,6 +465,24 @@ def layer_norm(
     )
     out.shape = input.shape
     return helper.append_activation(out)
+
+
+def rms_norm(input, begin_norm_axis=1, epsilon=1e-6, param_attr=None,
+             name=None):
+    """RMSNorm (scale only, f32 rsqrt): the modern-decoder norm; pair
+    with rope/swiglu via models.gpt cfg norm='rms'."""
+    helper = LayerHelper("rms_norm", name=name)
+    norm_shape = [_prod(input.shape[begin_norm_axis:])]
+    s = helper.create_parameter(param_attr, norm_shape, input.dtype,
+                                default_initializer=Constant(1.0))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="rms_norm", inputs={"X": [input], "Scale": [s]},
+        outputs={"Y": [out]},
+        attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
+    )
+    out.shape = input.shape
+    return out
 
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,

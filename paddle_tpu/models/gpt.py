@@ -22,11 +22,39 @@ __all__ = ["base_config", "build"]
 
 
 def base_config():
-    """Set ``n_kv_head`` (< n_head, dividing it) for grouped-query
-    attention: smaller k/v projections in training and an
-    H/Hkv-times smaller KV cache in decode (build_decode_step)."""
+    """Optional modern-decoder knobs (all compose, train AND decode):
+    ``n_kv_head`` (< n_head, dividing it) — grouped-query attention:
+    smaller k/v projections and an H/Hkv-times smaller KV cache;
+    ``pos_emb='rope'`` — rotary positions instead of the learned
+    table; ``norm='rms'`` — RMSNorm (scale-only, f32 rsqrt);
+    ``ffn_act='swiglu'`` — the gated FFN."""
     return dict(d_model=768, d_ff=3072, n_head=12, n_layer=12,
                 vocab=50304, max_length=1024, dropout=0.1)
+
+
+def _check_cfg(cfg):
+    """Knob typos must fail at build time, not silently fall back to
+    the default architecture (the n_kv_head contract, applied to the
+    string-valued knobs too)."""
+    for key, allowed in (("pos_emb", ("learned", "rope")),
+                         ("norm", ("layer", "rms")),
+                         ("ffn_act", ("relu", "gelu", "swish",
+                                      "swiglu"))):
+        val = cfg.get(key)
+        if val is not None and val not in allowed:
+            raise ValueError("cfg[%r] must be one of %s; got %r"
+                             % (key, allowed, val))
+
+
+def _final_norm(cfg, x):
+    """The shared final norm (training build + decode step use the SAME
+    parameter names, so decode can overwrite by name)."""
+    if cfg.get("norm", "layer") == "rms":
+        return layers.rms_norm(x, begin_norm_axis=2,
+                               param_attr=ParamAttr(name="gpt_ln_f_s"))
+    return layers.layer_norm(x, begin_norm_axis=2,
+                             param_attr=ParamAttr(name="gpt_ln_f_s"),
+                             bias_attr=ParamAttr(name="gpt_ln_f_b"))
 
 
 def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
@@ -43,6 +71,7 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
 
         use_fused_attention = fused_attention_enabled()
     cfg = cfg or base_config()
+    _check_cfg(cfg)
     ids = layers.data("ids", [seq_len], dtype="int64")
     pad_bias = _pad_bias(ids)
     if use_fused_attention:
@@ -70,6 +99,8 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
     if cfg["dropout"]:
         x = layers.dropout(x, cfg["dropout"], is_test=is_test)
 
+    norm = cfg.get("norm", "layer")
+    ffn_act = cfg.get("ffn_act", "relu")
     for i in range(cfg["n_layer"]):
         nm = "gpt_%d" % i
         x = _prenorm(x, lambda h, nm=nm: multi_head_attention(
@@ -77,15 +108,14 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
             is_test, nm + "_att", use_fused_attention,
             causal=self_causal, n_kv_head=cfg.get("n_kv_head"),
             rope_pos=rope_pos),
-            cfg["dropout"], is_test, nm + "_pre1")
+            cfg["dropout"], is_test, nm + "_pre1", norm=norm)
         x = _prenorm(x, lambda h, nm=nm: _ffn(h, cfg["d_model"],
-                                              cfg["d_ff"], nm),
-                     cfg["dropout"], is_test, nm + "_pre2")
+                                              cfg["d_ff"], nm,
+                                              act=ffn_act),
+                     cfg["dropout"], is_test, nm + "_pre2", norm=norm)
         if checkpoints is not None:
             checkpoints.append(x)
-    x = layers.layer_norm(x, begin_norm_axis=2,
-                          param_attr=ParamAttr(name="gpt_ln_f_s"),
-                          bias_attr=ParamAttr(name="gpt_ln_f_b"))
+    x = _final_norm(cfg, x)
 
     logits = layers.fc(x, cfg["vocab"], num_flatten_dims=2,
                        bias_attr=False,
@@ -127,6 +157,7 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
     Returns (logits_var, cache_names). Fetch logits [B, 1, vocab].
     """
     cfg = cfg or base_config()
+    _check_cfg(cfg)
     if max_len is None:
         max_len = cfg["max_length"]
     d_model, n_head = cfg["d_model"], cfg["n_head"]
@@ -168,6 +199,19 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
         raise ValueError("n_head %d must divide by n_kv_head %d"
                          % (n_head, n_kv))
     g = n_head // n_kv
+
+    def _norm(t, prefix):
+        # matches the training build's _prenorm norm choice by name
+        if cfg.get("norm", "layer") == "rms":
+            return layers.rms_norm(t, begin_norm_axis=2,
+                                   param_attr=ParamAttr(
+                                       name=prefix + "_ln_s"))
+        return layers.layer_norm(t, begin_norm_axis=2,
+                                 param_attr=ParamAttr(
+                                     name=prefix + "_ln_s"),
+                                 bias_attr=ParamAttr(
+                                     name=prefix + "_ln_b"))
+
     cache_names = []
     for i in range(cfg["n_layer"]):
         nm = "gpt_%d" % i
@@ -179,9 +223,7 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
             name=nm + "_cache_v", shape=(batch, n_kv, max_len, d_head))
         cache_names += [ck.name, cv.name]
 
-        h = layers.layer_norm(x, begin_norm_axis=2,
-                              param_attr=ParamAttr(name=nm + "_pre1_ln_s"),
-                              bias_attr=ParamAttr(name=nm + "_pre1_ln_b"))
+        h = _norm(x, nm + "_pre1")
         q = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
                       param_attr=ParamAttr(name=nm + "_att_q.w_0"))
         k = layers.fc(h, n_kv * d_head, num_flatten_dims=2,
@@ -224,18 +266,12 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
                         param_attr=ParamAttr(name=nm + "_att_o.w_0"))
         x = layers.elementwise_add(x, att)
 
-        h2 = layers.layer_norm(x, begin_norm_axis=2,
-                               param_attr=ParamAttr(name=nm + "_pre2_ln_s"),
-                               bias_attr=ParamAttr(name=nm + "_pre2_ln_b"))
-        f = layers.fc(h2, cfg["d_ff"], num_flatten_dims=2, act="relu",
-                      param_attr=ParamAttr(name=nm + "_ffn1.w_0"))
-        f = layers.fc(f, d_model, num_flatten_dims=2,
-                      param_attr=ParamAttr(name=nm + "_ffn2.w_0"))
+        h2 = _norm(x, nm + "_pre2")
+        f = _ffn(h2, d_model, cfg["d_ff"], nm,
+                 act=cfg.get("ffn_act", "relu"))
         x = layers.elementwise_add(x, f)
 
-    x = layers.layer_norm(x, begin_norm_axis=2,
-                          param_attr=ParamAttr(name="gpt_ln_f_s"),
-                          bias_attr=ParamAttr(name="gpt_ln_f_b"))
+    x = _final_norm(cfg, x)
     logits = layers.fc(x, cfg["vocab"], num_flatten_dims=2,
                        bias_attr=False,
                        param_attr=ParamAttr(name="gpt_out_proj.w_0"))

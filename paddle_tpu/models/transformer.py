@@ -128,16 +128,31 @@ def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
                      param_attr=ParamAttr(name=name + "_o.w_0"))
 
 
-def _ffn(x, d_model, d_ff, name):
-    h = layers.fc(x, d_ff, num_flatten_dims=2, act="relu",
-                  param_attr=ParamAttr(name=name + "_ffn1.w_0"))
+def _ffn(x, d_model, d_ff, name, act="relu"):
+    """act='swiglu' is the gated variant (LLaMA-style): swish(x W_g)
+    elementwise-times (x W_v), then the down projection — two up
+    projections instead of one, all three still plain MXU matmuls."""
+    if act == "swiglu":
+        g = layers.fc(x, d_ff, num_flatten_dims=2, act="swish",
+                      param_attr=ParamAttr(name=name + "_ffn1.w_0"))
+        u = layers.fc(x, d_ff, num_flatten_dims=2,
+                      param_attr=ParamAttr(name=name + "_ffn1v.w_0"))
+        h = layers.elementwise_mul(g, u)
+    else:
+        h = layers.fc(x, d_ff, num_flatten_dims=2, act=act,
+                      param_attr=ParamAttr(name=name + "_ffn1.w_0"))
     return layers.fc(h, d_model, num_flatten_dims=2,
                      param_attr=ParamAttr(name=name + "_ffn2.w_0"))
 
 
-def _prenorm(x, sub_fn, dropout, is_test, name):
-    h = layers.layer_norm(x, begin_norm_axis=2, param_attr=ParamAttr(name=name + "_ln_s"),
-                          bias_attr=ParamAttr(name=name + "_ln_b"))
+def _prenorm(x, sub_fn, dropout, is_test, name, norm="layer"):
+    if norm == "rms":
+        h = layers.rms_norm(x, begin_norm_axis=2,
+                            param_attr=ParamAttr(name=name + "_ln_s"))
+    else:
+        h = layers.layer_norm(x, begin_norm_axis=2,
+                              param_attr=ParamAttr(name=name + "_ln_s"),
+                              bias_attr=ParamAttr(name=name + "_ln_b"))
     h = sub_fn(h)
     if dropout:
         h = layers.dropout(h, dropout, is_test=is_test)

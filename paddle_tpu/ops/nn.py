@@ -213,6 +213,26 @@ def _layer_norm(ctx, ins, attrs):
     return {"Y": [y], "Mean": [mean.reshape(-1)], "Variance": [var.reshape(-1)]}
 
 
+@register_op("rms_norm", diff_inputs=["X", "Scale"])
+def _rms_norm(ctx, ins, attrs):
+    """Root-mean-square norm (no mean centering, no shift) — the
+    modern-decoder default (LLaMA-style). No reference counterpart
+    (Fluid v1.3 predates RMSNorm); normalization in f32 regardless of
+    the compute dtype so bf16 AMP keeps the rsqrt stable."""
+    x = ins["X"][0]
+    scale = ins.get("Scale", [None])[0]
+    eps = attrs.get("epsilon", 1e-6)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=axes, keepdims=True)
+    y = (xf * lax.rsqrt(ms + eps)).astype(x.dtype)
+    if scale is not None:
+        bshape = (1,) * begin + x.shape[begin:]
+        y = y * scale.reshape(bshape)
+    return {"Y": [y]}
+
+
 @register_op("group_norm", diff_inputs=["X", "Scale", "Bias"])
 def _group_norm(ctx, ins, attrs):
     x = ins["X"][0]

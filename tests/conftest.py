@@ -55,6 +55,8 @@ SLOW_TESTS = {
     "test_gpt_decode.py::test_generate_sampling_modes",
     "test_rope.py::test_gpt_rope_trains_and_paths_match",
     "test_rope.py::test_gpt_rope_decode_matches_full_forward",
+    "test_modern_decoder.py::test_llama_style_stack_fused_matches_composed",
+    "test_modern_decoder.py::test_llama_style_decode_matches_full_forward",
     "test_tpu_lowering.py::test_sp_train_step_lowers_for_tpu_with_ring",
     "test_pipeline_engine.py::test_pipeline_dropout_dp_pp_trains_deterministically",
     "test_pipeline_engine.py::test_pipeline_dropout_exact_parity_on_pipe_mesh",
