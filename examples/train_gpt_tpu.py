@@ -46,9 +46,12 @@ def main():
     ap.add_argument("--ckpt", default="/tmp/gpt_ckpt")
     args = ap.parse_args()
 
+    # the full modern-decoder stack: RMSNorm, SwiGLU, RoPE, GQA — all
+    # compose with the causal flash kernel and the decode cache
     cfg = dict(d_model=args.d_model, d_ff=4 * args.d_model, n_head=4,
-               n_layer=args.layers, vocab=1024, max_length=args.seq,
-               dropout=0.1)
+               n_kv_head=2, n_layer=args.layers, vocab=1024,
+               max_length=args.seq, dropout=0.1, pos_emb="rope",
+               norm="rms", ffn_act="swiglu")
 
     ckpts = []
     main_prog, startup = fluid.Program(), fluid.Program()

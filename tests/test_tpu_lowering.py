@@ -517,3 +517,51 @@ def test_fused_train_step_scan_lowers_for_tpu():
             assert "tpu_custom_call" in exp2.mlir_module()
         finally:
             os.environ.pop("PADDLE_TPU_FLASH_INTERPRET", None)
+
+
+def test_llama_style_fused_step_lowers_for_tpu():
+    """The modern-decoder composition (RMSNorm + SwiGLU + RoPE + GQA +
+    causal flash + AMP Adam) lowers to a TPU module in CI — the full
+    stack must be Mosaic-legal before a hardware window meets it."""
+    import os
+
+    from paddle_tpu.core.executor import analyze_block
+    from paddle_tpu.models import gpt
+
+    cfg = dict(d_model=64, d_ff=128, n_head=4, n_kv_head=2, n_layer=1,
+               vocab=128, max_length=32, dropout=0.0, pos_emb="rope",
+               norm="rms", ffn_act="swiglu")
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss, _ = gpt.build(cfg, seq_len=32,
+                                use_fused_attention=True)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        main.set_amp(True)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+
+        rs = np.random.RandomState(0)
+        feed = {"ids": rs.randint(1, 128, (2, 32)).astype("int32")}
+        (feed_names, fetch_names, const_state, mut_state, pure_written,
+         needs_rng, step) = analyze_block(
+            main, sorted(feed), [loss.name], scope)
+        params = {n: np.asarray(scope.find_var(n))
+                  for n in const_state + mut_state}
+        rng = jax.random.PRNGKey(0)
+
+        def fn(feeds, const_vals, mut_vals):
+            fetches, new_mut, _, _ = step(feeds, const_vals, mut_vals,
+                                          rng)
+            return fetches[0], new_mut
+
+        os.environ["PADDLE_TPU_FLASH_INTERPRET"] = "0"
+        try:
+            exp = _tpu_export(
+                fn, [feed[n] for n in feed_names],
+                [params[n] for n in const_state],
+                [params[n] for n in mut_state])
+        finally:
+            os.environ.pop("PADDLE_TPU_FLASH_INTERPRET", None)
+    assert "tpu_custom_call" in exp.mlir_module()
