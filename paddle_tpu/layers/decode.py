@@ -95,11 +95,12 @@ def beam_gather(x, parent_idx, name=None):
 def rope(x, pos, base=10000.0, name=None):
     """Rotary position embedding on a head tensor [..., S, D] (D even,
     rotate-half convention): position i rotates pair (x_j, x_{j+D/2})
-    by angle pos_i * base^(-2j/D). `pos` is a [S] (or [1] for a decode
-    step) int var — runtime positions, one executable for every step.
-    Apply to q and k after head split, BEFORE attention (and before any
-    GQA head repeat — the rotation is per head-dim, head-count blind).
-    """
+    by angle pos_i * base^(-2j/D). `pos` is a [S] int var (or [1] for
+    a decode step, or [B, S] for PACKED sequences whose positions
+    reset at segment starts) — runtime positions, one executable for
+    every step. Apply to q and k after head split, BEFORE attention
+    (and before any GQA head repeat — the rotation is per head-dim,
+    head-count blind)."""
     if x.shape is not None and x.shape[-1] is not None \
             and int(x.shape[-1]) % 2:
         raise ValueError(

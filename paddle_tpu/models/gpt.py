@@ -58,13 +58,21 @@ def _final_norm(cfg, x):
 
 
 def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
-          checkpoints=None):
+          checkpoints=None, packed=False):
     """Causal LM training graph; returns (avg_loss, feed_names).
 
     On the fused path, decoder self-attention uses the kernel's causal
     mask with above-diagonal block skipping; the composed path folds a
     dense causal bias. checkpoints collects per-layer recompute
     boundaries for RecomputeOptimizer.
+
+    ``packed=True`` trains on PACKED rows (multiple documents per
+    [B, S] row — ``reader.pack_sequences`` builds them): two extra
+    feeds, ``segment_ids`` [B, S] (0 = padding; equal ids attend) and
+    ``pos_ids`` [B, S] (within-segment positions, driving RoPE or the
+    learned table); attention is block-diagonal-causal, and next-token
+    targets never cross a segment boundary. Padding-free long-context
+    training — no FLOPs spent on pad rows.
     """
     if use_fused_attention is None:
         from ..ops.attention import fused_attention_enabled
@@ -73,11 +81,26 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
     cfg = cfg or base_config()
     _check_cfg(cfg)
     ids = layers.data("ids", [seq_len], dtype="int64")
-    pad_bias = _pad_bias(ids)
-    if use_fused_attention:
-        self_bias, self_causal = pad_bias, True
+    seg = pos_feed = None
+    if packed:
+        seg = layers.data("segment_ids", [seq_len], dtype="int64")
+        pos_feed = layers.data("pos_ids", [seq_len], dtype="int64")
+        # same-segment visibility (and key must be real): [B, 1, S, S]
+        a = layers.reshape(seg, [-1, 1, seq_len, 1])
+        b = layers.reshape(seg, [-1, 1, 1, seq_len])
+        same = layers.cast(layers.equal(a, b), "float32")
+        realk = layers.cast(layers.greater_than(
+            b, layers.fill_constant([1], "int64", 0)), "float32")
+        keep = layers.elementwise_mul(same, realk)
+        pack_bias = layers.scale(layers.elementwise_sub(
+            layers.fill_constant([1], "float32", 1.0), keep), scale=-1e9)
     else:
-        self_bias = layers.elementwise_add(pad_bias, _causal_bias(seq_len))
+        pack_bias = _pad_bias(ids)
+    if use_fused_attention:
+        self_bias, self_causal = pack_bias, True
+    else:
+        self_bias = layers.elementwise_add(pack_bias,
+                                           _causal_bias(seq_len))
         self_causal = False
 
     use_rope = cfg.get("pos_emb", "learned") == "rope"
@@ -86,12 +109,15 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
     rope_pos = None
     if use_rope:
         # positions enter through the per-layer q/k rotation instead of
-        # an additive learned table
+        # an additive learned table; packed rows reset per segment
         x = word
-        rope_pos = layers.range(0, seq_len, 1, "int64")
+        rope_pos = (pos_feed if packed
+                    else layers.range(0, seq_len, 1, "int64"))
     else:
-        pos_ids = layers.reshape(layers.range(0, seq_len, 1, "int64"),
-                                 [1, seq_len])
+        pos_ids = (pos_feed if packed
+                   else layers.reshape(
+                       layers.range(0, seq_len, 1, "int64"),
+                       [1, seq_len]))
         pos = layers.embedding(pos_ids,
                                [cfg["max_length"], cfg["d_model"]],
                                param_attr=ParamAttr(name="gpt_pos_emb"))
@@ -121,23 +147,34 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
                        bias_attr=False,
                        param_attr=ParamAttr(name="gpt_out_proj.w_0"))
 
+    def shift_left(t):
+        # t[:, 1:] with a 0 (pad) in the vacated last column
+        return layers.concat([
+            layers.slice(t, axes=[1], starts=[1], ends=[seq_len]),
+            layers.fill_constant_batch_size_like(t, [-1, 1], "int64", 0),
+        ], axis=1)
+
     # next-token targets: ids shifted left; the last position has no
     # target, and pad positions (id 0) are masked out of the loss
-    labels = layers.concat([
-        layers.slice(ids, axes=[1], starts=[1], ends=[seq_len]),
-        layers.fill_constant_batch_size_like(ids, [-1, 1], "int64", 0),
-    ], axis=1)
+    labels = shift_left(ids)
     cost = layers.softmax_with_cross_entropy(
         logits, layers.reshape(labels, [-1, seq_len, 1]))
     valid = layers.cast(
         layers.greater_than(
             labels, layers.fill_constant([1], "int64", 0)), "float32")
+    if packed:
+        # a target in a DIFFERENT segment (the next document's first
+        # token) must not train this position
+        same_seg = layers.cast(layers.equal(shift_left(seg), seg),
+                               "float32")
+        valid = layers.elementwise_mul(valid, same_seg)
     valid = layers.reshape(valid, [-1, seq_len, 1])
     total = layers.reduce_sum(layers.elementwise_mul(cost, valid))
     count = layers.elementwise_max(
         layers.reduce_sum(valid), layers.fill_constant([1], "float32", 1.0))
     avg = layers.elementwise_div(total, count)
-    return avg, ["ids"]
+    return avg, (["ids", "segment_ids", "pos_ids"] if packed
+                 else ["ids"])
 
 
 

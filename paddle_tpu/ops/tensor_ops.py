@@ -316,9 +316,22 @@ def _rope(ctx, ins, attrs):
     d = x.shape[-1]
     half = d // 2
     inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = pos.reshape(-1).astype(jnp.float32)[:, None] * inv[None, :]
-    sin = jnp.sin(ang).astype(x.dtype)      # [S, half]
-    cos = jnp.cos(ang).astype(x.dtype)
+    if pos.ndim == 2:
+        # per-row positions [B, S] (packed sequences: positions reset
+        # at segment starts): angles [B, 1, S, half] broadcast over
+        # the head axis of x [B, H, S, Dh] — 4-D x only (a 3-D x
+        # would broadcast into a wrong [B, B, ...] result silently)
+        if x.ndim != 4:
+            raise ValueError(
+                "rope with [B, S] positions needs a [B, H, S, D] "
+                "head tensor; got x rank %d" % x.ndim)
+        ang = pos.astype(jnp.float32)[..., None] * inv
+        sin = jnp.sin(ang).astype(x.dtype)[:, None]
+        cos = jnp.cos(ang).astype(x.dtype)[:, None]
+    else:
+        ang = pos.reshape(-1).astype(jnp.float32)[:, None] * inv[None, :]
+        sin = jnp.sin(ang).astype(x.dtype)  # [S, half]
+        cos = jnp.cos(ang).astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin,
                            x1 * sin + x2 * cos], axis=-1)

@@ -12,7 +12,70 @@ import threading
 __all__ = ["Fake", "PipeReader",
            "batch", "shuffle", "buffered", "cache", "map_readers",
            "xmap_readers", "chain", "compose", "firstn",
-           "multiprocess_reader", "stack_feed_window"]
+           "multiprocess_reader", "stack_feed_window", "pack_sequences"]
+
+
+def pack_sequences(seqs, seq_len, n_rows=None):
+    """Pack variable-length token sequences into fixed [B, seq_len]
+    rows for ``models.gpt.build(packed=True)`` — multiple documents
+    per row, no FLOPs on padding. Greedy first-fit in arrival order:
+    a document goes WHOLE into the current row if it fits, else a new
+    row starts; only documents longer than seq_len are ever split
+    (each split tail becomes a new segment in the next row — rows
+    cannot attend across). Returns a feed dict with ``ids``,
+    ``segment_ids`` (1-based per row, 0 = padding; the gpt packed loss
+    hard-masks id 0, so 0 is THE pad token) and ``pos_ids``
+    (within-segment positions, for RoPE resets or the learned table).
+
+    ``n_rows`` pins the batch dimension (pad with empty rows / raise
+    on overflow): the executor compiles per feed SHAPE, so steady-
+    state training should hold B constant rather than recompile on
+    every differently-sized pack."""
+    import numpy as np
+
+    rows, segs, poss = [], [], []
+
+    def new_row():
+        rows.append([])
+        segs.append([])
+        poss.append([])
+
+    new_row()
+    n_seqs = 0
+    for seq in seqs:
+        n_seqs += 1
+        seq = list(seq)
+        while seq:
+            space = seq_len - len(rows[-1])
+            # a doc that would be NEEDLESSLY split moves whole to a
+            # fresh row; docs longer than seq_len must split anyway,
+            # so they fill the remaining space first
+            if not space or (space < len(seq) <= seq_len):
+                new_row()
+                space = seq_len
+            chunk, seq = seq[:space], seq[space:]
+            seg_id = (segs[-1][-1] if segs[-1] else 0) + 1
+            rows[-1].extend(chunk)
+            segs[-1].extend([seg_id] * len(chunk))
+            poss[-1].extend(range(len(chunk)))
+
+    B = len(rows)
+    if n_rows is not None:
+        if B > n_rows:
+            raise ValueError(
+                "pack_sequences: %d sequences need %d rows of length "
+                "%d but n_rows=%d — feed fewer documents per pack or "
+                "raise n_rows" % (n_seqs, B, seq_len, n_rows))
+        B = n_rows
+    ids = np.zeros((B, seq_len), dtype="int64")
+    seg = np.zeros((B, seq_len), dtype="int64")
+    pos = np.zeros((B, seq_len), dtype="int64")
+    for i in range(len(rows)):
+        n = len(rows[i])
+        ids[i, :n] = rows[i]
+        seg[i, :n] = segs[i]
+        pos[i, :n] = poss[i]
+    return {"ids": ids, "segment_ids": seg, "pos_ids": pos}
 
 
 def stack_feed_window(feed_dicts):

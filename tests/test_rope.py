@@ -120,3 +120,31 @@ def test_gpt_rope_decode_matches_full_forward():
     import test_gpt_decode as tgd
 
     tgd._assert_decode_matches_full(GQA_ROPE_CFG)
+
+
+def test_rope_per_row_positions():
+    """[B, S] positions (packed rows): each row rotates by ITS
+    positions — row b equals a separate call with pos[b]."""
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 2, 6, 16).astype("float32")
+    pos = np.stack([np.arange(6), np.array([0, 1, 2, 0, 1, 2])]
+                   ).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            xv = layers.data("x", list(x.shape), dtype="float32",
+                             append_batch_size=False)
+            pv = layers.data("p", list(pos.shape), dtype="int64",
+                             append_batch_size=False)
+            out = layers.rope(xv, pv)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        (o,) = exe.run(main, feed={"x": x, "p": pos}, fetch_list=[out],
+                       scope=scope)
+    o = np.asarray(o)
+    for b in range(2):
+        np.testing.assert_allclose(
+            o[b], _ref_rope(x[b], pos[b]), atol=1e-5, rtol=1e-5,
+            err_msg="row %d" % b)
