@@ -145,3 +145,57 @@ def test_pack_sequences_fixed_rows_and_empty_row_safe():
 
     with pytest.raises(ValueError, match="n_rows"):
         reader.pack_sequences([[1] * 8, [2] * 8], seq_len=8, n_rows=1)
+
+
+def test_packed_windows_scan_composition():
+    """The full steady-state packed loop: pack_sequences (fixed n_rows)
+    -> stack_feed_window -> run_repeated(feed_stacked=True). K packed
+    minibatches per device dispatch must train identically to the
+    per-batch loop over the same packs."""
+    rs = np.random.RandomState(7)
+    S, R = 16, 3
+
+    def packs(k):
+        out = []
+        for _ in range(k):
+            docs = [rs.randint(1, 64, rs.randint(4, 10)).tolist()
+                    for _ in range(4)]
+            out.append(reader.pack_sequences(docs, seq_len=S, n_rows=R))
+        return out
+
+    batches = packs(4)
+
+    def final_params(mode):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 23
+        startup.random_seed = 23
+        scope = Scope()
+        with scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                loss, _ = gpt.build(CFG, seq_len=S, packed=True,
+                                    use_fused_attention=False)
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup, scope=scope)
+            if mode == "window":
+                window = reader.stack_feed_window(batches)
+                exe.run_repeated(main, feed=window, fetch_list=[loss],
+                                 scope=scope, steps=len(batches),
+                                 feed_stacked=True)
+            else:
+                for b in batches:
+                    exe.run(main, feed=b, fetch_list=[loss], scope=scope)
+            # every explicitly-named gpt param (both layers, embeds,
+            # norms, out_proj); auto-named fc biases ('fc_N.b_0')
+            # carry a process-global counter that differs between
+            # builds and are excluded
+            return {p.name: np.asarray(scope.find_var(p.name))
+                    for p in main.global_block().all_parameters()
+                    if p.name.startswith("gpt")}
+
+    p_seq = final_params("seq")
+    p_win = final_params("window")
+    assert p_seq and p_seq.keys() == p_win.keys()
+    for n in p_seq:
+        np.testing.assert_allclose(p_seq[n], p_win[n], atol=1e-5,
+                                   err_msg=n)
