@@ -431,3 +431,30 @@ def test_run_repeated_composes_with_recompute():
     for n in p_seq:
         np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-6,
                                    err_msg=n)
+
+
+def test_warmup_cosine_composition_in_scan():
+    """linear_lr_warmup(cosine_decay(...)) — the standard modern
+    schedule — composes, and advances correctly inside run_repeated
+    (both schedules share the step counter carried by the scan)."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 29
+        startup.random_seed = 29
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [8], dtype="float32")
+            y = layers.data("y", [1], dtype="float32")
+            pred = layers.fc(layers.fc(x, 16, act="relu"), 1)
+            loss = layers.mean(layers.square(pred - y))
+            lr = layers.linear_lr_warmup(
+                layers.cosine_decay(0.1, step_each_epoch=8, epochs=1),
+                warmup_steps=3, start_lr=0.0, end_lr=0.1)
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        return main, startup, loss
+
+    l_seq, p_seq = _run("sequential", 6, build=build)
+    l_rep, p_rep = _run("repeated", 6, build=build)
+    assert abs(l_seq - l_rep) < 1e-6, (l_seq, l_rep)
+    for n in p_seq:
+        np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-6,
+                                   err_msg=n)
