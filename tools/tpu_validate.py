@@ -6,7 +6,8 @@ Stages (each prints a PASS/FAIL line; exits nonzero on any FAIL):
                   composed path (the round-2 regression class: kernels
                   that only ever ran in interpret mode)
   3. step       — one fused-attention transformer train step (tiny)
-  4. bench      — optional: full bench sweep (--bench)
+  4. modern     — llama-style stack (rms+swiglu+rope+GQA) + scanned steps
+  5. bench      — optional: full bench sweep (--bench)
 
 Usage:  python tools/tpu_validate.py [--bench] [--quick]
 Single TPU client rule: run alone, foreground (see .claude verify skill).
@@ -153,6 +154,37 @@ def step():
         print("  fused-attention AMP train step loss %.4f" % val, flush=True)
 
 
+def modern():
+    """The llama-style stack (RMSNorm + SwiGLU + RoPE + GQA + causal
+    flash + AMP Adam) — one tiny train step plus a scanned 3-step
+    run_repeated: the round-4 additions' first hardware contact."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.models import gpt
+
+    cfg = dict(d_model=128, d_ff=256, n_head=4, n_kv_head=2, n_layer=2,
+               vocab=512, max_length=128, dropout=0.1, pos_emb="rope",
+               norm="rms", ffn_act="swiglu")
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope), fluid.program_guard(main, startup):
+        loss, _ = gpt.build(cfg, seq_len=128, use_fused_attention=True)
+        fluid.optimizer.AdamW(learning_rate=1e-4).minimize(loss)
+        main.set_amp(True)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        feed = {"ids": rs.randint(1, 512, (8, 128)).astype("int64")}
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        (lv,) = exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                 scope=scope, steps=3)
+        val = float(np.asarray(lv).reshape(-1)[0])
+        assert np.isfinite(val), "loss is not finite: %r" % val
+        print("  llama-style scanned step loss %.4f" % val, flush=True)
+
+
 def pjrt_serving():
     """Python-free serving e2e: export the AOT artifact, then drive the
     ctypes test for libpjrt_serving.so against the axon PJRT plugin —
@@ -199,6 +231,7 @@ def main():
     ok = _stage("probe", probe)
     ok = ok and _stage("flash", flash)
     ok = ok and _stage("step", step)
+    ok = ok and _stage("modern", modern)
     if ok:
         print("[tpu_validate] next: run `python tools/tpu_validate.py "
               "--serving` (alone) for the Python-free serving e2e",
