@@ -27,15 +27,27 @@ def base_config():
     smaller k/v projections and an H/Hkv-times smaller KV cache;
     ``pos_emb='rope'`` — rotary positions instead of the learned
     table; ``norm='rms'`` — RMSNorm (scale-only, f32 rsqrt);
-    ``ffn_act='swiglu'`` — the gated FFN."""
+    ``ffn_act='swiglu'`` — the gated FFN; ``tie_embeddings=True`` — one table serves lookup and LM head."""
     return dict(d_model=768, d_ff=3072, n_head=12, n_layer=12,
                 vocab=50304, max_length=1024, dropout=0.1)
 
 
+_CFG_KEYS = frozenset([
+    "d_model", "d_ff", "n_head", "n_layer", "vocab", "max_length",
+    "dropout", "n_kv_head", "pos_emb", "norm", "ffn_act",
+    "tie_embeddings",
+])
+
+
 def _check_cfg(cfg):
     """Knob typos must fail at build time, not silently fall back to
-    the default architecture (the n_kv_head contract, applied to the
-    string-valued knobs too)."""
+    the default architecture — covers both bad VALUES for the string
+    knobs and unknown KEYS (e.g. 'tied_embeddings') that would
+    otherwise be ignored."""
+    unknown = set(cfg) - _CFG_KEYS
+    if unknown:
+        raise ValueError("unknown gpt cfg key(s) %s — known keys: %s"
+                         % (sorted(unknown), sorted(_CFG_KEYS)))
     for key, allowed in (("pos_emb", ("learned", "rope")),
                          ("norm", ("layer", "rms")),
                          ("ffn_act", ("relu", "gelu", "swish",
@@ -44,6 +56,21 @@ def _check_cfg(cfg):
         if val is not None and val not in allowed:
             raise ValueError("cfg[%r] must be one of %s; got %r"
                              % (key, allowed, val))
+
+
+def _lm_head(cfg, x):
+    """Final projection to vocab logits. ``tie_embeddings=True`` reuses
+    the input embedding (logits = x @ word_emb^T — no gpt_out_proj
+    parameter; gradients accumulate into the one table from both the
+    lookup and the head), the standard LM weight-tying."""
+    if cfg.get("tie_embeddings"):
+        from ..core.program import default_main_program
+
+        emb = default_main_program().global_block().var("gpt_word_emb")
+        return layers.matmul(x, emb, transpose_y=True)
+    return layers.fc(x, cfg["vocab"], num_flatten_dims=2,
+                     bias_attr=False,
+                     param_attr=ParamAttr(name="gpt_out_proj.w_0"))
 
 
 def _final_norm(cfg, x):
@@ -143,9 +170,7 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
             checkpoints.append(x)
     x = _final_norm(cfg, x)
 
-    logits = layers.fc(x, cfg["vocab"], num_flatten_dims=2,
-                       bias_attr=False,
-                       param_attr=ParamAttr(name="gpt_out_proj.w_0"))
+    logits = _lm_head(cfg, x)
 
     def shift_left(t):
         # t[:, 1:] with a 0 (pad) in the vacated last column
@@ -309,9 +334,7 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
         x = layers.elementwise_add(x, f)
 
     x = _final_norm(cfg, x)
-    logits = layers.fc(x, cfg["vocab"], num_flatten_dims=2,
-                       bias_attr=False,
-                       param_attr=ParamAttr(name="gpt_out_proj.w_0"))
+    logits = _lm_head(cfg, x)
     return logits, cache_names
 
 

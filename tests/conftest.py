@@ -58,6 +58,7 @@ SLOW_TESTS = {
     "test_modern_decoder.py::test_llama_style_stack_fused_matches_composed",
     "test_modern_decoder.py::test_llama_style_decode_matches_full_forward",
     "test_modern_decoder.py::test_swiglu_ffn_has_gate_param_and_trains",
+    "test_modern_decoder.py::test_tied_embeddings_train_and_decode",
     "test_packed_training.py::test_packed_with_rope_resets_positions",
     "test_packed_training.py::test_packed_windows_scan_composition",
     "test_packed_training.py::test_packed_loss_equals_separate_documents",

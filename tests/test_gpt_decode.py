@@ -91,6 +91,10 @@ def _assert_decode_matches_full(cfg):
             if op.type == "mul" and "gpt_out_proj.w_0" in op.inputs.get(
                     "Y", []):
                 logits_name = op.outputs["Out"][0]
+            if op.type == "matmul" and "gpt_word_emb" in op.inputs.get(
+                    "Y", []):
+                # tied head: logits = x @ word_emb^T (last such matmul)
+                logits_name = op.outputs["Out"][0]
         assert logits_name is not None
         ref = np.array(prompt)
         for t in range(NEW):

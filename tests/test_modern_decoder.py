@@ -137,3 +137,58 @@ def test_rope_rejects_odd_head_dim():
                             append_batch_size=False)
             with pytest.raises(ValueError, match="even head dim"):
                 layers.rope(x, p)
+
+
+def test_tied_embeddings_train_and_decode():
+    """tie_embeddings=True: no gpt_out_proj parameter, gradients reach
+    the one table from both the lookup and the head, and KV-cache
+    decode (which shares the table by name) equals the full forward."""
+    from paddle_tpu.models import gpt
+
+    cfg = dict(d_model=32, d_ff=64, n_head=2, n_layer=2, vocab=64,
+               max_length=16, dropout=0.0, tie_embeddings=True)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 31
+    scope = Scope()
+    rs = np.random.RandomState(31)
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss, _ = gpt.build(cfg, seq_len=8,
+                                use_fused_attention=False)
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        assert scope.find_var("gpt_out_proj.w_0") is None
+        # BOTH contributions (lookup grad + head matmul grad) must
+        # accumulate into the one table: the backward program carries a
+        # sum op producing gpt_word_emb@GRAD
+        accum = [op for op in main.global_block().ops
+                 if op.type == "sum"
+                 and "gpt_word_emb@GRAD" in op.outputs.get("Out", [])]
+        assert accum, "no gradient accumulation into the tied table"
+        emb0 = np.asarray(scope.find_var("gpt_word_emb")).copy()
+        feed = {"ids": rs.randint(1, 64, (2, 8)).astype("int64")}
+        first = None
+        for _ in range(6):
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss],
+                           scope=scope)
+            first = first or float(np.asarray(l).reshape(-1)[0])
+        assert float(np.asarray(l).reshape(-1)[0]) < first
+        assert np.abs(np.asarray(scope.find_var("gpt_word_emb"))
+                      - emb0).max() > 0
+
+    import test_gpt_decode as tgd
+
+    tgd._assert_decode_matches_full(cfg)
+
+
+def test_unknown_cfg_key_raises():
+    from paddle_tpu.models import gpt
+
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(Scope()):
+        with fluid.program_guard(main, startup):
+            with pytest.raises(ValueError, match="unknown gpt cfg"):
+                gpt.build(dict(LLAMA_CFG, tied_embeddings=True),
+                          seq_len=8)
