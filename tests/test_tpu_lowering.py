@@ -565,3 +565,53 @@ def test_llama_style_fused_step_lowers_for_tpu():
         finally:
             os.environ.pop("PADDLE_TPU_FLASH_INTERPRET", None)
     assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_packed_fused_step_lowers_for_tpu():
+    """Packed training streams a [B, 1, S, S] block-diagonal bias
+    through the flash kernel (pad-to-block on BOTH score axes) — the
+    Mosaic lowering must accept it before a hardware window does."""
+    import os
+
+    from paddle_tpu.core.executor import analyze_block
+    from paddle_tpu.models import gpt
+    from paddle_tpu.reader import pack_sequences
+
+    cfg = dict(d_model=64, d_ff=128, n_head=4, n_layer=1, vocab=128,
+               max_length=256, dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            loss, _ = gpt.build(cfg, seq_len=256, packed=True,
+                                use_fused_attention=True)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+
+        rs = np.random.RandomState(0)
+        docs = [rs.randint(1, 128, rs.randint(40, 200)).tolist()
+                for _ in range(4)]
+        feed = pack_sequences(docs, seq_len=256, n_rows=4)
+        feed = {k: v.astype("int32") for k, v in feed.items()}
+        (feed_names, fetch_names, const_state, mut_state, pure_written,
+         needs_rng, step) = analyze_block(
+            main, sorted(feed), [loss.name], scope)
+        params = {n: np.asarray(scope.find_var(n))
+                  for n in const_state + mut_state}
+        rng = jax.random.PRNGKey(0)
+
+        def fn(feeds, const_vals, mut_vals):
+            fetches, new_mut, _, _ = step(feeds, const_vals, mut_vals,
+                                          rng)
+            return fetches[0], new_mut
+
+        os.environ["PADDLE_TPU_FLASH_INTERPRET"] = "0"
+        try:
+            exp = _tpu_export(
+                fn, [feed[n] for n in feed_names],
+                [params[n] for n in const_state],
+                [params[n] for n in mut_state])
+        finally:
+            os.environ.pop("PADDLE_TPU_FLASH_INTERPRET", None)
+    assert "tpu_custom_call" in exp.mlir_module()
