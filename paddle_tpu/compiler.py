@@ -96,14 +96,16 @@ class CompiledProgram:
         return self._ensure_engine().run(feed, fetch_list, scope, return_numpy)
 
     def _run_repeated(self, executor, feed, fetch_list, scope, steps,
-                      return_numpy, feed_stacked):
+                      return_numpy, feed_stacked, reduce_fetches="last"):
         if not self._is_data_parallel:
             return executor.run_repeated(
                 self._program, feed, fetch_list, scope, steps=steps,
-                return_numpy=return_numpy, feed_stacked=feed_stacked)
+                return_numpy=return_numpy, feed_stacked=feed_stacked,
+                reduce_fetches=reduce_fetches)
         return self._ensure_engine().run_repeated(
             feed, fetch_list, scope, steps=steps,
-            return_numpy=return_numpy, feed_stacked=feed_stacked)
+            return_numpy=return_numpy, feed_stacked=feed_stacked,
+            reduce_fetches=reduce_fetches)
 
 
 class ParallelExecutor:

@@ -144,6 +144,7 @@ class Executor:
         steps: int = 1,
         return_numpy: bool = True,
         feed_stacked: bool = False,
+        reduce_fetches: str = "last",
     ):
         """Run ``steps`` train iterations as ONE device-side executable
         (a ``lax.scan`` over the whole-block step, donated state carry):
@@ -165,7 +166,11 @@ class Executor:
         ``steps`` axis and the scan consumes one slice per iteration —
         K *different* minibatches per dispatch, the shape a PyReader /
         DataLoader hands over when it batches K microbatches ahead
-        (``paddle_tpu.reader.stack_feed_window`` builds it)."""
+        (``paddle_tpu.reader.stack_feed_window`` builds it).
+        ``reduce_fetches="mean"|"sum"`` aggregates float fetches across
+        the K steps (window-mean loss, summed eval metrics) instead of
+        returning the last step's values."""
+        _check_reduce(reduce_fetches)
         if steps <= 1:
             if feed_stacked:
                 feed = unstack_singleton_feed(feed)
@@ -176,18 +181,21 @@ class Executor:
         if isinstance(program, CompiledProgram):
             # data-parallel: the engine owns the sharded K-step scan
             return program._run_repeated(self, feed, fetch_list, scope,
-                                         steps, return_numpy, feed_stacked)
+                                         steps, return_numpy, feed_stacked,
+                                         reduce_fetches)
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         plan, feeds, const_state, mut_state, rng = self._gather(
             program, feed, fetch_list, scope)
         if feed_stacked:
             validate_stacked_feeds(plan.feed_names, feeds, steps)
-        fn = plan.multi.get((steps, feed_stacked))
+        key = (steps, feed_stacked, reduce_fetches)
+        fn = plan.multi.get(key)
         if fn is None:
-            fn = jax.jit(make_scan_fn(plan.step, steps, feed_stacked),
+            fn = jax.jit(make_scan_fn(plan.step, steps, feed_stacked,
+                                      reduce_fetches),
                          donate_argnums=(2,))
-            plan.multi[(steps, feed_stacked)] = fn
+            plan.multi[key] = fn
 
         from ..profiler import RecordEvent, is_profiler_enabled
 
@@ -370,20 +378,38 @@ def unstack_singleton_feed(feed):
             for k, v in (feed or {}).items()}
 
 
-def make_scan_fn(raw_step, steps, feed_stacked):
+def _check_reduce(reduce_fetches):
+    if reduce_fetches not in ("last", "mean", "sum"):
+        raise ValueError("reduce_fetches must be last|mean|sum; got %r"
+                         % (reduce_fetches,))
+
+
+def make_scan_fn(raw_step, steps, feed_stacked, reduce_fetches="last"):
     """The (unjitted) K-step ``lax.scan`` wrapper over a whole-block step
     — ONE set of scan semantics shared by ``Executor.run_repeated`` and
     ``ParallelEngine`` (which adds mesh shardings when jitting it):
     donated state + RNG chain ride the carry exactly as the unrolled
     sequence would thread them; with ``feed_stacked`` the feeds are the
     scanned xs (one real minibatch per iteration), else they close over
-    the body as constants."""
+    the body as constants.
+
+    ``reduce_fetches``: "last" (default) returns the final iteration's
+    fetch values; "mean"/"sum" accumulate float fetches ACROSS the K
+    steps in the carry (window-mean loss for logging, aggregated eval
+    metrics) — non-float fetches always report the last step's value."""
+    _check_reduce(reduce_fetches)
+
+    def _acc(old, new):
+        if reduce_fetches == "last" or not jnp.issubdtype(
+                jnp.asarray(new).dtype, jnp.floating):
+            return new
+        return old + new
 
     def multi(feeds, const_vals, mut_vals, rng_key):
         # fetches/pure ride the CARRY (init zeros of the step's output
         # shapes), not stacked scan ys: only the last step's values are
-        # wanted, and a [K, ...] stacked buffer per fetch would shrink
-        # the usable batch size
+        # wanted (or a running reduction), and a [K, ...] stacked
+        # buffer per fetch would shrink the usable batch size
         step_feeds = [f[0] for f in feeds] if feed_stacked else feeds
         out_sh = jax.eval_shape(raw_step, step_feeds, const_vals,
                                 mut_vals, rng_key)
@@ -391,15 +417,19 @@ def make_scan_fn(raw_step, steps, feed_stacked):
             lambda s: jnp.zeros(s.shape, s.dtype), tree)
 
         def body(carry, xs):
-            mut, key, _f, _p = carry
+            mut, key, facc, _p = carry
             fetches, new_mut, new_pure, new_key = raw_step(
                 xs if feed_stacked else feeds, const_vals, mut, key)
-            return (new_mut, new_key, fetches, new_pure), None
+            facc = [_acc(o, n) for o, n in zip(facc, fetches)]
+            return (new_mut, new_key, facc, new_pure), None
 
         (mut, key, fetches, pures), _ = jax.lax.scan(
             body, (mut_vals, rng_key, zeros(out_sh[0]),
                    zeros(out_sh[2])),
             feeds if feed_stacked else None, length=steps)
+        if reduce_fetches == "mean":
+            fetches = [f / steps if jnp.issubdtype(f.dtype, jnp.floating)
+                       else f for f in fetches]
         return fetches, mut, pures, key
 
     return multi

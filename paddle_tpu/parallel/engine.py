@@ -97,17 +97,22 @@ class ParallelEngine:
 
     def run_repeated(self, feed, fetch_list, scope: Optional[Scope] = None,
                      steps: int = 1, return_numpy: bool = True,
-                     feed_stacked: bool = False):
+                     feed_stacked: bool = False,
+                     reduce_fetches: str = "last"):
         """K sharded train steps as ONE SPMD executable (`lax.scan` over
         the partitioned whole-block step, donated state carry) — one
         host dispatch per K steps, composed with the engine's mesh
         sharding. Semantics match K sequential ``run`` calls exactly
-        (state, RNG chain, last step's fetches) — see
+        (state, RNG chain; fetches are the last step's, or the window
+        mean/sum with ``reduce_fetches``) — see
         ``Executor.run_repeated``. With ``feed_stacked=True`` every feed
         carries a leading ``steps`` axis (one REAL minibatch per
         iteration, ``reader.stack_feed_window`` builds it); the stacked
         axis is unsharded and each per-step slice keeps the feed's data-
         axis sharding."""
+        from ..core.executor import _check_reduce
+
+        _check_reduce(reduce_fetches)
         scope = scope if scope is not None else global_scope()
         if steps <= 1:
             if feed_stacked:
@@ -117,18 +122,21 @@ class ParallelEngine:
             feed, fetch_list, scope)
         if feed_stacked:
             validate_stacked_feeds(plan.feed_names, feeds, steps)
-        fn, feed_in = self._multi_fn(plan, steps, feed_stacked)
+        fn, feed_in = self._multi_fn(plan, steps, feed_stacked,
+                                     reduce_fetches)
         return self._execute(plan, fn, feed_in, feeds, const_state,
                              mut_state, rng, scope, return_numpy,
                              " after %d scanned steps" % steps,
                              "engine_run_repeated[%d]" % steps)
 
-    def _multi_fn(self, plan, steps, feed_stacked):
+    def _multi_fn(self, plan, steps, feed_stacked,
+                  reduce_fetches="last"):
         """The jitted sharded K-step scan for a plan plus the feed
         shardings its inputs expect — the (fn, feed_in) pair is cached
-        per (steps, feed_stacked) so the steady-state dispatch is a dict
-        lookup, not a per-call respec of the feed shardings."""
-        cached = plan.multi.get((steps, feed_stacked))
+        per (steps, feed_stacked, reduce) so the steady-state dispatch
+        is a dict lookup, not a per-call respec of the feed
+        shardings."""
+        cached = plan.multi.get((steps, feed_stacked, reduce_fetches))
         if cached is not None:
             return cached
         mesh, repl = self.mesh, NamedSharding(self.mesh, P())
@@ -158,11 +166,12 @@ class ParallelEngine:
             repl,
         )
         with mesh:
-            fn = jax.jit(make_scan_fn(plan.step, steps, feed_stacked),
+            fn = jax.jit(make_scan_fn(plan.step, steps, feed_stacked,
+                                      reduce_fetches),
                          in_shardings=in_shardings,
                          out_shardings=out_shardings,
                          donate_argnums=(2,))
-        plan.multi[(steps, feed_stacked)] = (fn, feed_in)
+        plan.multi[(steps, feed_stacked, reduce_fetches)] = (fn, feed_in)
         return fn, feed_in
 
     def _execute(self, plan, fn, feed_shardings, feeds, const_state,

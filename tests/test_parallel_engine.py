@@ -289,7 +289,7 @@ def test_run_repeated_stacked_feeds_shard_and_match():
         # axis (dim 1) split over 'data' — a regression that replicates
         # the window (the sharding-from-stacked-shape bug) fails HERE
         plan = next(iter(engine._cache.values()))
-        _, feed_in = plan.multi[(4, True)]
+        _, feed_in = plan.multi[(4, True, "last")]
         x_idx = plan.feed_names.index("x")
         assert feed_in[x_idx].spec == P(None, "data"), feed_in[x_idx].spec
 

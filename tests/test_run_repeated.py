@@ -458,3 +458,70 @@ def test_warmup_cosine_composition_in_scan():
     for n in p_seq:
         np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-6,
                                    err_msg=n)
+
+
+def test_reduce_fetches_mean_and_sum():
+    """reduce_fetches aggregates float fetches across the scanned
+    steps: 'mean' equals the average of the sequential per-step losses,
+    'sum' their total; state advance is unchanged."""
+    feeds = _feeds_k(3)
+    from paddle_tpu import reader as rd
+
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        seq_losses = []
+        for f in feeds:
+            (l,) = exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+            seq_losses.append(float(np.asarray(l).reshape(-1)[0]))
+        p_seq = {norm: np.asarray(scope.find_var(n))
+                 for n, norm in _param_names(scope).items()}
+
+    for mode, expect in (("mean", np.mean(seq_losses)),
+                         ("sum", np.sum(seq_losses))):
+        main, startup, loss = _build()
+        scope = Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(scope):
+            exe.run(startup, scope=scope)
+            window = rd.stack_feed_window(feeds)
+            (l,) = exe.run_repeated(main, feed=window, fetch_list=[loss],
+                                    scope=scope, steps=3,
+                                    feed_stacked=True,
+                                    reduce_fetches=mode)
+            np.testing.assert_allclose(
+                float(np.asarray(l).reshape(-1)[0]), expect, rtol=1e-5,
+                err_msg=mode)
+            p_rep = {norm: np.asarray(scope.find_var(n))
+                     for n, norm in _param_names(scope).items()}
+        for n in p_seq:
+            np.testing.assert_allclose(p_seq[n], p_rep[n], atol=1e-5,
+                                       err_msg="%s/%s" % (mode, n))
+
+
+def test_reduce_fetches_rejects_unknown():
+    import pytest
+
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        with pytest.raises(ValueError, match="last|mean|sum"):
+            exe.run_repeated(main, feed=_feed(), fetch_list=[loss],
+                             scope=scope, steps=2, reduce_fetches="avg")
+
+
+def test_reduce_fetches_validated_even_at_steps_one():
+    import pytest
+
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        with pytest.raises(ValueError, match="last|mean|sum"):
+            exe.run_repeated(main, feed=_feed(), fetch_list=[loss],
+                             scope=scope, steps=1, reduce_fetches="avg")
