@@ -355,3 +355,32 @@ def test_engine_lowered_hlo_validates_stacked_window():
         with pytest.raises(ValueError, match="leading steps axis of 3"):
             engine.lowered_hlo(window, [loss], scope, steps=3,
                                feed_stacked=True)
+
+
+def test_engine_reduce_fetches_mean_on_mesh():
+    """reduce_fetches='mean' through the SHARDED scan: window mean of
+    the global-batch losses equals the sequential per-batch mean."""
+    from paddle_tpu import reader as rd
+
+    batches = [{"x": x, "y": y} for x, y in _batches(3, seed=9)]
+
+    main, startup, loss = _build_mlp_program()
+    scope = fluid.core.scope.Scope()
+    with fluid.core.scope.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        engine = ParallelEngine(main, loss_name=loss.name)
+        per = [float(np.asarray(engine.run(b, [loss], scope)[0])
+                     .reshape(-1)[0]) for b in batches]
+
+    main, startup, loss = _build_mlp_program()
+    scope = fluid.core.scope.Scope()
+    with fluid.core.scope.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        engine = ParallelEngine(main, loss_name=loss.name)
+        (m,) = engine.run_repeated(rd.stack_feed_window(batches), [loss],
+                                   scope, steps=3, feed_stacked=True,
+                                   reduce_fetches="mean")
+    np.testing.assert_allclose(float(np.asarray(m).reshape(-1)[0]),
+                               np.mean(per), rtol=1e-5)
