@@ -84,6 +84,28 @@ def _final_norm(cfg, x):
                              bias_attr=ParamAttr(name="gpt_ln_f_b"))
 
 
+def _norm_of(cfg, t, prefix):
+    """Per-layer norm for the inference graphs (decode + prefill),
+    matching the training build's _prenorm parameter names."""
+    if cfg.get("norm", "layer") == "rms":
+        return layers.rms_norm(t, begin_norm_axis=2,
+                               param_attr=ParamAttr(name=prefix + "_ln_s"))
+    return layers.layer_norm(t, begin_norm_axis=2,
+                             param_attr=ParamAttr(name=prefix + "_ln_s"),
+                             bias_attr=ParamAttr(name=prefix + "_ln_b"))
+
+
+def _kv_heads_of(cfg):
+    """(n_kv, group size) with the divisibility contract enforced —
+    one check shared by every build path."""
+    n_head = cfg["n_head"]
+    n_kv = cfg.get("n_kv_head") or n_head
+    if n_head % n_kv:
+        raise ValueError("n_head %d must divide by n_kv_head %d"
+                         % (n_head, n_kv))
+    return n_kv, n_head // n_kv
+
+
 def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
           checkpoints=None, packed=False):
     """Causal LM training graph; returns (avg_loss, feed_names).
@@ -203,6 +225,99 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
 
 
 
+def build_prefill_step(cfg=None, batch=1, prompt_len=8, max_len=None):
+    """Prompt prefill as ONE dispatch: forward over the whole [B, P]
+    prompt with causal attention, writing every layer's K/V slab into
+    the caches at positions 0..P-1 (dynamic_update_slice of the full
+    slab — one in-place write per layer, not P), and returning logits
+    [B, P, vocab]. Pair with ``build_decode_step`` over the SAME scope
+    (shared cache/weight names) and drive both via ``generate(...,
+    prefill_prog=...)`` — prompt latency drops from P dispatches to 1.
+
+    Returns (logits_var, cache_names)."""
+    cfg = cfg or base_config()
+    _check_cfg(cfg)
+    if max_len is None:
+        max_len = cfg["max_length"]
+    P = int(prompt_len)
+    assert 0 < P <= max_len, (P, max_len)
+    d_model, n_head = cfg["d_model"], cfg["n_head"]
+    d_head = d_model // n_head
+    n_kv, _g = _kv_heads_of(cfg)
+    from ..layer_helper import LayerHelper
+    from .transformer import repeat_kv_heads
+
+    helper = LayerHelper("gpt_prefill")
+    tokens = layers.data("tokens", [P], dtype="int64")
+    zero = layers.fill_constant([1], "int64", 0)
+
+    use_rope = cfg.get("pos_emb", "learned") == "rope"
+    word = layers.embedding(tokens, [cfg["vocab"], d_model],
+                            param_attr=ParamAttr(name="gpt_word_emb"))
+    pos_range = layers.range(0, P, 1, "int64")
+    if use_rope:
+        x = word
+    else:
+        pos = layers.embedding(layers.reshape(pos_range, [1, P]),
+                               [cfg["max_length"], d_model],
+                               param_attr=ParamAttr(name="gpt_pos_emb"))
+        x = layers.elementwise_add(word, pos)
+
+    bias = _causal_bias(P)
+    cache_names = []
+    for i in range(cfg["n_layer"]):
+        nm = "gpt_%d" % i
+        ck = helper.create_global_variable(
+            name=nm + "_cache_k", shape=(batch, n_kv, max_len, d_head))
+        cv = helper.create_global_variable(
+            name=nm + "_cache_v", shape=(batch, n_kv, max_len, d_head))
+        cache_names += [ck.name, cv.name]
+
+        h = _norm_of(cfg, x, nm + "_pre1")
+        q = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=ParamAttr(name=nm + "_att_q.w_0"))
+        k = layers.fc(h, n_kv * d_head, num_flatten_dims=2,
+                      bias_attr=False,
+                      param_attr=ParamAttr(name=nm + "_att_k.w_0"))
+        v = layers.fc(h, n_kv * d_head, num_flatten_dims=2,
+                      bias_attr=False,
+                      param_attr=ParamAttr(name=nm + "_att_v.w_0"))
+
+        def heads(t, n):
+            t = layers.reshape(t, [-1, P, n, d_head])
+            return layers.transpose(t, perm=[0, 2, 1, 3])  # [B,n,P,Dh]
+
+        q, k, v = heads(q, n_head), heads(k, n_kv), heads(v, n_kv)
+        if use_rope:
+            q = layers.rope(q, pos_range)
+            k = layers.rope(k, pos_range)
+        # one slab write per layer: the cache holds rotated keys
+        layers.kv_cache_write(ck, k, zero)
+        layers.kv_cache_write(cv, v, zero)
+        kr = repeat_kv_heads(k, n_kv, n_head, P, d_head)
+        vr = repeat_kv_heads(v, n_kv, n_head, P, d_head)
+        scores = layers.matmul(q, kr, transpose_y=True,
+                               alpha=d_head ** -0.5)   # [B,H,P,P]
+        scores = layers.elementwise_add(scores, bias)
+        w = layers.softmax(scores)
+        ctxv = layers.matmul(w, vr)                    # [B,H,P,Dh]
+        ctxv = layers.transpose(ctxv, perm=[0, 2, 1, 3])
+        ctxv = layers.reshape(ctxv, [-1, P, d_model])
+        att = layers.fc(ctxv, d_model, num_flatten_dims=2,
+                        bias_attr=False,
+                        param_attr=ParamAttr(name=nm + "_att_o.w_0"))
+        x = layers.elementwise_add(x, att)
+
+        h2 = _norm_of(cfg, x, nm + "_pre2")
+        f = _ffn(h2, d_model, cfg["d_ff"], nm,
+                 act=cfg.get("ffn_act", "relu"))
+        x = layers.elementwise_add(x, f)
+
+    x = _final_norm(cfg, x)
+    logits = _lm_head(cfg, x)
+    return logits, cache_names
+
+
 def build_decode_step(cfg=None, batch=1, max_len=None):
     """Incremental decoding step graph with donated KV caches.
 
@@ -256,24 +371,7 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
         layers.fill_constant([1], "float32", 1.0), vis), scale=-1e9)
     bias = layers.reshape(bias, [1, 1, 1, max_len])
 
-    n_kv = cfg.get("n_kv_head") or n_head
-    if n_head % n_kv:
-        raise ValueError("n_head %d must divide by n_kv_head %d"
-                         % (n_head, n_kv))
-    g = n_head // n_kv
-
-    def _norm(t, prefix):
-        # matches the training build's _prenorm norm choice by name
-        if cfg.get("norm", "layer") == "rms":
-            return layers.rms_norm(t, begin_norm_axis=2,
-                                   param_attr=ParamAttr(
-                                       name=prefix + "_ln_s"))
-        return layers.layer_norm(t, begin_norm_axis=2,
-                                 param_attr=ParamAttr(
-                                     name=prefix + "_ln_s"),
-                                 bias_attr=ParamAttr(
-                                     name=prefix + "_ln_b"))
-
+    n_kv, g = _kv_heads_of(cfg)
     cache_names = []
     for i in range(cfg["n_layer"]):
         nm = "gpt_%d" % i
@@ -285,7 +383,7 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
             name=nm + "_cache_v", shape=(batch, n_kv, max_len, d_head))
         cache_names += [ck.name, cv.name]
 
-        h = _norm(x, nm + "_pre1")
+        h = _norm_of(cfg, x, nm + "_pre1")
         q = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
                       param_attr=ParamAttr(name=nm + "_att_q.w_0"))
         k = layers.fc(h, n_kv * d_head, num_flatten_dims=2,
@@ -328,7 +426,7 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
                         param_attr=ParamAttr(name=nm + "_att_o.w_0"))
         x = layers.elementwise_add(x, att)
 
-        h2 = _norm(x, nm + "_pre2")
+        h2 = _norm_of(cfg, x, nm + "_pre2")
         f = _ffn(h2, d_model, cfg["d_ff"], nm,
                  act=cfg.get("ffn_act", "relu"))
         x = layers.elementwise_add(x, f)
@@ -339,12 +437,15 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
 
 
 def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope,
-             temperature=0.0, top_k=0, seed=0):
+             temperature=0.0, top_k=0, seed=0, prefill_prog=None,
+             prefill_logits=None):
     """Autoregressive generation with the KV-cache decode step.
 
-    prompt_ids: [B, P] int array. Runs P prefill steps (one token at a
-    time through the same compiled step — ONE executable for the whole
-    session) then n_new sampling steps. Returns [B, P + n_new] ids.
+    prompt_ids: [B, P] int array. Prefills the caches (P one-token
+    steps through the decode executable — or ONE dispatch when a
+    ``build_prefill_step`` program for this prompt length is passed as
+    ``prefill_prog``/``prefill_logits``), then runs n_new sampling
+    steps. Returns [B, P + n_new] ids.
 
     temperature=0 (default) is greedy argmax; temperature>0 samples from
     softmax(logits / temperature), optionally truncated to the top_k
@@ -369,16 +470,9 @@ def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope,
         raise ValueError("temperature must be >= 0 (0 = greedy); got %r"
                          % (temperature,))
     rng = np.random.RandomState(seed)
-    out = [ids[:, i] for i in range(P)]
-    for t in range(P + n_new - 1):
-        tok = out[t][:, None]
-        (logits,) = exe.run(
-            decode_prog,
-            feed={"token": tok, "pos": np.array([t], dtype="int64")},
-            fetch_list=[logits_var], scope=scope)
-        if t + 1 < P:
-            continue  # prefill: only the cache write matters
-        lg = logits[:, 0].astype("float64")
+
+    def sample(lg):
+        lg = lg.astype("float64")
         if temperature > 0:
             lg = lg / float(temperature)
             if top_k and top_k > 0:
@@ -387,10 +481,30 @@ def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope,
                 lg = np.where(lg < kth, -np.inf, lg)
             p = np.exp(lg - lg.max(axis=-1, keepdims=True))
             p = p / p.sum(axis=-1, keepdims=True)
-            next_tok = np.array(
+            return np.array(
                 [rng.choice(p.shape[1], p=p[b]) for b in range(B)],
                 dtype="int64")
-        else:
-            next_tok = np.argmax(lg, axis=-1).astype("int64")
-        out.append(next_tok)
+        return np.argmax(lg, axis=-1).astype("int64")
+
+    out = [ids[:, i] for i in range(P)]
+    start = 0
+    if prefill_prog is not None and n_new > 0:
+        # one dispatch fills positions 0..P-1 and yields the first
+        # sampled token from the last prompt position's logits
+        (full,) = exe.run(prefill_prog, feed={"tokens": ids},
+                          fetch_list=[prefill_logits], scope=scope)
+        assert full.shape[1] == P, (
+            "prefill program was built for prompt_len=%d, got P=%d"
+            % (full.shape[1], P))
+        out.append(sample(full[:, P - 1]))
+        start = P
+    for t in range(start, P + n_new - 1):
+        tok = out[t][:, None]
+        (logits,) = exe.run(
+            decode_prog,
+            feed={"token": tok, "pos": np.array([t], dtype="int64")},
+            fetch_list=[logits_var], scope=scope)
+        if t + 1 < P:
+            continue  # prefill: only the cache write matters
+        out.append(sample(logits[:, 0]))
     return np.stack(out, axis=1)

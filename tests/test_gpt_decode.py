@@ -225,3 +225,43 @@ def test_gqa_training_fused_matches_composed():
     fused = run(True)
     np.testing.assert_allclose(composed, fused, rtol=1e-4, atol=1e-5)
     assert composed[-1] < composed[0]
+
+
+def test_prefill_one_dispatch_matches_stepwise_generate():
+    """build_prefill_step: one dispatch fills the caches and yields the
+    first sampled token — generation must EQUAL the token-by-token
+    path, greedy and sampled, on the modern stack (rope+GQA+rms+swiglu
+    + tied table)."""
+    cfg = dict(CFG, n_kv_head=1, pos_emb="rope", norm="rms",
+               ffn_act="swiglu", tie_embeddings=True)
+    params = _trained_scope(cfg)
+    B, P, NEW, S = 2, 5, 4, 12
+    rs = np.random.RandomState(3)
+    prompt = rs.randint(1, 64, (B, P)).astype("int64")
+
+    def run(use_prefill, temperature=0.0, top_k=0):
+        dec_prog, dec_start = fluid.Program(), fluid.Program()
+        pre_prog, pre_start = fluid.Program(), fluid.Program()
+        scope = Scope()
+        with scope_guard(scope):
+            with fluid.program_guard(dec_prog, dec_start):
+                logits, _ = gpt.build_decode_step(cfg, batch=B,
+                                                  max_len=S)
+            with fluid.program_guard(pre_prog, pre_start):
+                pl, _ = gpt.build_prefill_step(cfg, batch=B,
+                                               prompt_len=P, max_len=S)
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(dec_start, scope=scope)
+            exe.run(pre_start, scope=scope)
+            for n, v in params.items():
+                if scope.find_var(n) is not None:
+                    scope.set_var(n, v)
+            kw = dict(prefill_prog=pre_prog, prefill_logits=pl) \
+                if use_prefill else {}
+            return gpt.generate(exe, dec_prog, logits, prompt, NEW,
+                                scope, temperature=temperature,
+                                top_k=top_k, seed=11, **kw)
+
+    np.testing.assert_array_equal(run(False), run(True))
+    np.testing.assert_array_equal(run(False, 0.8, 10),
+                                  run(True, 0.8, 10))
