@@ -80,6 +80,12 @@ BASELINES = {
 # real regressions of the same size). Rows whose spc differs from the
 # baseline's mode anchor at 1.0 until re-pinned. pin_baselines
 # rewrites this dict alongside BASELINES.
+#
+# KNOWN GAP (round 5): only resnet50 has been re-pinned at the new
+# spc=10 default — the 03:21 wedge killed the full re-bench, so
+# regression tracking for the other six workloads is SUSPENDED (they
+# anchor at 1.0) until the next window's full bench + pin_baselines
+# lands (window_playbook step 4 does this automatically).
 BASELINE_SPC = {
     "bert_base_mlm_train_tokens_per_sec_per_chip": 1,
     "deepfm_train_examples_per_sec_per_chip": 1,

@@ -60,6 +60,22 @@ def run_pserver_loop(attrs: Dict, scope: Scope, executor=None):
     grad_to_param = {s["grad_block"]: s["param_block"] for s in specs}
     n_dense = sum(1 for s in specs if not s.get("sparse"))
 
+    # crash recovery: a restarted pserver reloads its shard snapshot
+    # (written by a prior checkpoint-notify) before serving, so
+    # trainers that survived the crash resume from the checkpointed
+    # state instead of re-initialized params (reference: the
+    # load-persistables-on-pserver restart path,
+    # lookup_table_utils.load_persistables_for_increment analog)
+    recover = (os.environ.get("PADDLE_TPU_PS_RECOVER_DIR")
+               or attrs.get("recover_dir"))
+    if recover:
+        shard = os.path.join(recover, endpoint.replace(":", "_"),
+                             "shard.npz")
+        if os.path.exists(shard):
+            with np.load(shard) as data:
+                for n in data.files:
+                    scope.set_var(n, data[n])
+
     # publish startup state (zeros until the trainer-0 init push lands)
     for name in param_blocks:
         v = scope.find_var(name)
@@ -174,4 +190,10 @@ def _save_shards(dirname: str, endpoint: str, scope: Scope, param_blocks,
             v = scope.find_var(n)
             if v is not None:
                 arrays[n] = np.asarray(v)
-    np.savez(os.path.join(sub, "shard.npz"), **arrays)
+    # atomic: a crash mid-write (the exact moment recovery exists for)
+    # must never leave a torn shard.npz for the restarted pserver
+    final = os.path.join(sub, "shard.npz")
+    # tmp MUST end in .npz: np.savez silently appends the suffix
+    tmp = os.path.join(sub, "shard.tmp.%d.npz" % os.getpid())
+    np.savez(tmp, **arrays)
+    os.replace(tmp, final)

@@ -20,7 +20,25 @@ from ..native import load
 from ..native.dtypes import CODE_OF_DTYPE as _DTYPES
 from ..native.dtypes import DTYPE_OF_CODE as _NP_OF_CODE
 
-__all__ = ["RPCClient", "RPCServer", "SelectedRows", "parse_endpoint"]
+__all__ = ["RPCClient", "RPCServer", "RPCError", "SelectedRows",
+           "parse_endpoint"]
+
+
+class RPCError(RuntimeError):
+    """A trainer→pserver RPC failed after the transport exhausted its
+    reconnect deadline (PADDLE_TPU_RPC_DEADLINE_MS, default 60s — the
+    FLAGS_rpc_deadline analog of the reference's grpc_client.cc). The
+    pserver died, was partitioned, or never came up; the current
+    barrier cycle's grads were NOT applied."""
+
+    def __init__(self, op: str, endpoint: str, detail: str = ""):
+        self.op, self.endpoint = op, endpoint
+        msg = ("%s to pserver %s failed: peer unreachable after the RPC "
+               "deadline (died / partitioned / never started)"
+               % (op, endpoint))
+        if detail:
+            msg += " — " + detail
+        super().__init__(msg)
 
 
 def parse_endpoint(ep: str) -> Tuple[str, int]:
@@ -249,7 +267,7 @@ class RPCClient:
     def connect(self, required: bool = True) -> bool:
         ok = bool(self._lib.ps_client_connect(self._h))
         if required and not ok:
-            raise RuntimeError("cannot reach pserver %s" % self.endpoint)
+            raise RPCError("connect", self.endpoint)
         return ok
 
     def send_var(self, name: str, value) -> None:
@@ -267,18 +285,33 @@ class RPCClient:
             _dims_ptr(dims), nrows, rows_ptr,
             vals.ctypes.data_as(ctypes.c_void_p), vals.nbytes)
         if not ok:
-            raise RuntimeError("send_var(%s) to %s failed" % (name, self.endpoint))
+            raise RPCError("send_var(%s)" % name, self.endpoint)
 
     def get_var(self, name: str, retries: int = 50) -> np.ndarray:
-        # retry: in async mode a GET can race the trainer-0 init push
+        # retry: in async mode a GET can race the trainer-0 init push.
+        # The loop is bounded by BOTH a count and the RPC deadline —
+        # against a DEAD peer each native call already burns the full
+        # reconnect deadline, and 50 of those would stack to minutes.
+        import os as _os
         import time
 
+        # parse exactly like the native DeadlineMs(): junk or <=0
+        # falls back to 60s, so the two transports never disagree
+        try:
+            ms = int(_os.environ.get("PADDLE_TPU_RPC_DEADLINE_MS", "60000"))
+        except ValueError:
+            ms = 60000
+        deadline_s = (ms if ms > 0 else 60000) / 1000.0
+        t0 = time.monotonic()
         for attempt in range(max(retries, 1)):
             b = self._lib.ps_client_get_var(self._h, name.encode())
             if b:
                 return _batch_read(self._lib, b)[0][1]
+            if time.monotonic() - t0 > deadline_s:
+                break
             time.sleep(0.1)
-        raise RuntimeError("get_var(%s) from %s failed" % (name, self.endpoint))
+        raise RPCError("get_var(%s)" % name, self.endpoint,
+                       "or the variable was never pushed (init race)")
 
     def prefetch(self, table: str, ids: np.ndarray) -> np.ndarray:
         ids = np.ascontiguousarray(ids, dtype=np.int64).ravel()
@@ -286,14 +319,20 @@ class RPCClient:
             self._h, table.encode(),
             ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(ids))
         if not b:
-            raise RuntimeError("prefetch(%s) from %s failed" % (table, self.endpoint))
+            raise RPCError("prefetch(%s)" % table, self.endpoint)
         return _batch_read(self._lib, b)[0][1]
 
     def send_barrier(self):
-        self._lib.ps_client_send_barrier(self._h)
+        # a failed barrier means the sync cycle is torn (this trainer's
+        # grads were not applied) — silent continuation would train on
+        # stale params, so it raises (reference: grpc_client.cc barrier
+        # RPCs surface through FLAGS_rpc_deadline the same way)
+        if not self._lib.ps_client_send_barrier(self._h):
+            raise RPCError("send_barrier", self.endpoint)
 
     def fetch_barrier(self):
-        self._lib.ps_client_fetch_barrier(self._h)
+        if not self._lib.ps_client_fetch_barrier(self._h):
+            raise RPCError("fetch_barrier", self.endpoint)
 
     def send_complete(self):
         self._lib.ps_client_complete(self._h)

@@ -384,36 +384,61 @@ class DistributeTranspiler:
                 barrier=self.sync_mode,
             )
         else:
-            blk = prog.global_block()
-            if self.sync_mode:
-                d = blk.create_var(name="@SEND_BARRIER@", shape=(), dtype="int32",
-                                   stop_gradient=True)
-                blk.append_op("send_barrier", {}, {"Out": [d]},
-                              {"endpoints": self.pserver_endpoints,
+            self._append_param_pull(prog.global_block(),
+                                    create_params=False)
+        prog._bump()
+        return prog
+
+    def _append_param_pull(self, blk, create_params: bool):
+        """Barriered no-push param pull: send_barrier (an EMPTY grad
+        cycle — the sync server only serves GETs after a cycle) →
+        recv every param block (+concat) → fetch_barrier."""
+        if self.sync_mode:
+            d = blk.create_var(name="@SEND_BARRIER@", shape=(), dtype="int32",
+                               stop_gradient=True)
+            blk.append_op("send_barrier", {}, {"Out": [d]},
+                          {"endpoints": self.pserver_endpoints,
+                           "__op_role__": "dist"})
+        for pname, info in self.param_infos.items():
+            blocks = info["blocks"]
+            if create_params:
+                blk.create_var(name=pname, shape=info["var"].shape,
+                               dtype=info["var"].dtype, persistable=True,
+                               stop_gradient=True)
+            outs = ([pname] if len(blocks) == 1 else
+                    ["%s@RECV.%d" % (pname, vb.idx) for vb in blocks])
+            for vb, n in zip(blocks, outs):
+                if n != pname:
+                    blk.create_var(name=n, shape=vb.shape,
+                                   dtype=info["var"].dtype, stop_gradient=True)
+                blk.append_op("recv", {}, {"Out": [n]},
+                              {"endpoint": vb.endpoint,
+                               "var_name": vb.block_name,
+                               "shape": list(vb.shape),
+                               "dtype": info["var"].dtype,
                                "__op_role__": "dist"})
-            for pname, info in self.param_infos.items():
-                blocks = info["blocks"]
-                outs = ([pname] if len(blocks) == 1 else
-                        ["%s@RECV.%d" % (pname, vb.idx) for vb in blocks])
-                for vb, n in zip(blocks, outs):
-                    if n != pname:
-                        blk.create_var(name=n, shape=vb.shape,
-                                       dtype=info["var"].dtype, stop_gradient=True)
-                    blk.append_op("recv", {}, {"Out": [n]},
-                                  {"endpoint": vb.endpoint,
-                                   "var_name": vb.block_name,
-                                   "shape": list(vb.shape),
-                                   "dtype": info["var"].dtype,
-                                   "__op_role__": "dist"})
-                if len(blocks) > 1:
-                    blk.append_op("concat", {"X": outs}, {"Out": [pname]},
-                                  {"axis": 0, "__op_role__": "dist"})
-            if self.sync_mode:
-                d = blk.create_var(name="@FETCH_BARRIER@", shape=(), dtype="int32",
-                                   stop_gradient=True)
-                blk.append_op("fetch_barrier", {}, {"Out": [d]},
-                              {"endpoints": self.pserver_endpoints,
-                               "__op_role__": "dist"})
+            if len(blocks) > 1:
+                blk.append_op("concat", {"X": outs}, {"Out": [pname]},
+                              {"axis": 0, "__op_role__": "dist"})
+        if self.sync_mode:
+            d = blk.create_var(name="@FETCH_BARRIER@", shape=(), dtype="int32",
+                               stop_gradient=True)
+            blk.append_op("fetch_barrier", {}, {"Out": [d]},
+                          {"endpoints": self.pserver_endpoints,
+                           "__op_role__": "dist"})
+
+    def get_trainer_recovery_program(self) -> Program:
+        """Crash-recovery pull: re-fetch every param block from the
+        pservers into the local scope WITHOUT pushing local state —
+        run after an RPCError when the failed step's donated buffers
+        are gone and the (possibly restarted) pservers hold the
+        authoritative params. In sync mode EVERY surviving trainer
+        must run it together (the empty send-barrier cycle needs all
+        active trainers). Reference analog: the trainer-restart fetch
+        in the fault-tolerant PS flow (grpc_client.cc reconnect +
+        recv)."""
+        prog = Program()
+        self._append_param_pull(prog.global_block(), create_params=True)
         prog._bump()
         return prog
 

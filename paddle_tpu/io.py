@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import List, Optional
 
 import numpy as np
@@ -64,6 +65,24 @@ def _persistable_names(program: Program, predicate) -> List[str]:
     return sorted(set(names))
 
 
+class _EventThread:
+    """Thread-shaped wrapper over an Event so an inline (sync) writer can
+    occupy a slot in the _PENDING chain: async writers only ever call
+    ``join()``/``is_alive()`` on the previous entry's ``_thread``."""
+
+    def __init__(self):
+        self._done = threading.Event()
+
+    def finish(self):
+        self._done.set()
+
+    def join(self, timeout=None):
+        self._done.wait(timeout)
+
+    def is_alive(self):
+        return not self._done.is_set()
+
+
 def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
               filename=None, scope=None):
     program = main_program or default_main_program()
@@ -82,15 +101,24 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     from .native.tensor_store import save_tensors
 
     path = os.path.join(dirname, filename or _COMBINED)
-    # a sync save racing an in-flight async write to the same path:
-    # staging files are unique (tensor_store), but the caller of the
-    # SYNC save expects ITS snapshot to be the final file — drain the
-    # background write first so ordering is deterministic
-    with _pending_lock():
+    # a sync save racing in-flight async writes to the same path: the
+    # SYNC caller expects ITS snapshot to be the final file, so the
+    # sync write rides the same serialize-on-prev chain the async
+    # writers use — it registers in _PENDING (later async saves chain
+    # behind it), joins every earlier writer, then writes inline.
+    handle = AsyncCheckpoint(_EventThread(), path)
+    with _PENDING_LOCK:
         prev = _PENDING.get(path)
-    if prev is not None:
-        prev._thread.join()
-    save_tensors(path, arrays)
+        _PENDING[path] = handle
+    try:
+        if prev is not None:
+            prev._thread.join()
+        save_tensors(path, arrays)
+    finally:
+        handle._thread.finish()
+        with _PENDING_LOCK:
+            if _PENDING.get(path) is handle:
+                del _PENDING[path]
 
 
 def save_params(executor, dirname, main_program=None, filename=None,
@@ -131,20 +159,13 @@ class AsyncCheckpoint:
     result = wait
 
 
-# in-flight background writes keyed by target path: a second async save
-# to the same path must wait for the first (both would stage the same
-# '<path>.tmp' file), and interpreter exit must not truncate a write
-_PENDING_LOCK = None
+# in-flight writes keyed by target path: a second save to the same path
+# must wait for the first (both would stage the same '<path>.tmp' file),
+# and interpreter exit must not truncate a write. The lock is created
+# eagerly — a lazy check-then-set could mint two distinct locks under
+# first-call contention, unguarding _PENDING.
+_PENDING_LOCK = threading.Lock()
 _PENDING = {}
-
-
-def _pending_lock():
-    global _PENDING_LOCK
-    import threading
-
-    if _PENDING_LOCK is None:
-        _PENDING_LOCK = threading.Lock()
-    return _PENDING_LOCK
 
 
 def save_persistables_async(executor, dirname, main_program=None,
@@ -193,11 +214,11 @@ def save_persistables_async(executor, dirname, main_program=None,
         except BaseException as e:  # surfaced by wait()
             handle._err.append(e)
         finally:
-            with _pending_lock():
+            with _PENDING_LOCK:
                 if _PENDING.get(path) is handle:
                     del _PENDING[path]
 
-    with _pending_lock():
+    with _PENDING_LOCK:
         prev = _PENDING.get(path)
         handle = AsyncCheckpoint(None, path)
         handle._thread = threading.Thread(

@@ -489,13 +489,20 @@ def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope,
     out = [ids[:, i] for i in range(P)]
     start = 0
     if prefill_prog is not None and n_new > 0:
+        # the prefill program is compiled for ONE prompt length (its
+        # 'tokens' feed: [-1, P]); check before dispatch so a mismatch
+        # raises this message, not an opaque executor feed-shape error
+        tok_var = prefill_prog.global_block().vars.get("tokens")
+        if tok_var is not None and int(tok_var.shape[-1]) != P:
+            raise ValueError(
+                "generate: prefill_prog was built for prompt_len=%d but "
+                "prompt_ids has P=%d — rebuild with "
+                "build_prefill_step(prompt_len=%d) or pad the prompt"
+                % (int(tok_var.shape[-1]), P, P))
         # one dispatch fills positions 0..P-1 and yields the first
         # sampled token from the last prompt position's logits
         (full,) = exe.run(prefill_prog, feed={"tokens": ids},
                           fetch_list=[prefill_logits], scope=scope)
-        assert full.shape[1] == P, (
-            "prefill program was built for prompt_len=%d, got P=%d"
-            % (full.shape[1], P))
         out.append(sample(full[:, P - 1]))
         start = P
     for t in range(start, P + n_new - 1):

@@ -30,6 +30,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -515,11 +516,25 @@ class PSClient {
   }
 
  private:
+  static int DeadlineMs() {
+    // FLAGS_rpc_deadline analog (grpc_client.cc retry logic): how long
+    // a trainer keeps re-trying to reach a pserver before the RPC
+    // fails. Default 60s covers pserver-after-trainer startup; fault
+    // tests shrink it so a killed pserver surfaces fast.
+    static int ms = [] {
+      const char* env = ::getenv("PADDLE_TPU_RPC_DEADLINE_MS");
+      int v = env ? ::atoi(env) : 60000;
+      return v > 0 ? v : 60000;
+    }();
+    return ms;
+  }
+
   bool ConnectLocked() {
     if (fd_ >= 0) return true;
-    // the pserver process may come up after the trainer: retry ~60s
-    // (FLAGS_rpc_deadline analog, grpc_client.cc retry logic)
-    for (int attempt = 0; attempt < 600; ++attempt) {
+    // the pserver process may come up after the trainer: retry until
+    // the deadline (100 ms per attempt)
+    const int max_attempts = DeadlineMs() / 100 + 1;
+    for (int attempt = 0; attempt < max_attempts; ++attempt) {
       int fd = ::socket(AF_INET, SOCK_STREAM, 0);
       sockaddr_in addr{};
       addr.sin_family = AF_INET;

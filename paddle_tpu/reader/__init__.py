@@ -59,6 +59,11 @@ def pack_sequences(seqs, seq_len, n_rows=None):
             segs[-1].extend([seg_id] * len(chunk))
             poss[-1].extend(range(len(chunk)))
 
+    if rows and not rows[-1]:
+        # drop the trailing empty row (always present when the last doc
+        # exactly filled its row — and the ONLY row when seqs was empty:
+        # an all-padding [1, S] batch would silently train on pure pad)
+        rows.pop(), segs.pop(), poss.pop()
     B = len(rows)
     if n_rows is not None:
         if B > n_rows:

@@ -71,12 +71,85 @@ def main():
     prog = t.get_trainer_program()
     exe.run(t.get_trainer_startup_program())
     losses = []
-    for step in range(STEPS):
+    # fault-injection knobs (test_dist_ps.py kill/restart cases):
+    #   DIST_STEPS     override step count
+    #   PROGRESS_OUT   file appended with one line per finished step
+    #   CKPT_DIR       checkpoint_notify every step (pserver snapshots)
+    #   RETRY_ON_RPC_ERROR  catch a failed step and retry it (resume
+    #                       path: a restarted pserver picks the
+    #                       reconnect up transparently)
+    steps = int(os.environ.get("DIST_STEPS", STEPS))
+    progress = os.environ.get("PROGRESS_OUT")
+    ckpt_dir = os.environ.get("CKPT_DIR")
+    retry = os.environ.get("RETRY_ON_RPC_ERROR") == "1"
+    # STEP_SLEEP slows the loop so a fault-injection kill lands
+    # mid-run deterministically instead of racing a fast trainer
+    step_sleep = float(os.environ.get("STEP_SLEEP", "0"))
+    recovery_prog = None
+    eps = pservers.split(",")
+    step = 0
+    consecutive_failures = 0
+    while step < steps:
         X, Y = data(step)
         # shard the global batch across trainers
         Xs, Ys = X[trainer_id::trainers], Y[trainer_id::trainers]
-        lv, = exe.run(prog, feed={"x": Xs, "y": Ys}, fetch_list=[loss.name])
+        try:
+            lv, = exe.run(prog, feed={"x": Xs, "y": Ys},
+                          fetch_list=[loss.name])
+        except Exception as exc:
+            # RPC failures surface from inside the compiled step's
+            # io_callbacks wrapped in XLA runtime errors, so match on
+            # the named RPCError text rather than the exception type;
+            # anything else (feed shape, NaN guard, a genuine bug) is
+            # NOT retryable and must propagate as the real traceback
+            if not retry or "RPCError" not in repr(exc):
+                raise
+            consecutive_failures += 1
+            if consecutive_failures > 20:
+                raise RuntimeError(
+                    "giving up after %d consecutive RPC failures at "
+                    "step %d" % (consecutive_failures, step)) from exc
+            import time as _time
+
+            from paddle_tpu.ops.distributed_ops import reset_clients
+
+            reset_clients()  # drop dead fds; next call reconnects
+            _time.sleep(0.5)
+            # the failed step's donated buffers are gone — the main
+            # step CANNOT be retried until a recovery pull restores
+            # params, so keep pulling until the (restarted) pserver
+            # answers, then retry the step
+            if recovery_prog is None:  # reuse: compile cache is per id
+                recovery_prog = t.get_trainer_recovery_program()
+            while True:
+                try:
+                    exe.run(recovery_prog)
+                    break
+                except Exception:
+                    consecutive_failures += 1
+                    if consecutive_failures > 20:
+                        raise
+                    reset_clients()
+                    _time.sleep(0.5)
+            if progress:
+                with open(progress, "a") as f:
+                    f.write("R\n")  # recovery marker for the harness
+            continue  # re-run the same step against the restarted peer
+        consecutive_failures = 0
         losses.append(float(lv))
+        if ckpt_dir:
+            from paddle_tpu.ops.distributed_ops import client_for
+
+            for ep in eps:
+                client_for(ep).checkpoint_notify(ckpt_dir)
+        if progress:
+            with open(progress, "a") as f:
+                f.write("%d\n" % step)
+        if step_sleep:
+            import time as _time
+
+            _time.sleep(step_sleep)
+        step += 1
     exe.close()
     out = os.environ.get("LOSS_OUT")
     if out:

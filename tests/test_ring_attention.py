@@ -290,3 +290,24 @@ def test_plain_auto_causal_routes_zigzag_and_odd_shard_falls_back():
     ref = _attention_reference(q, k, v, causal_bias, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_sp_path_emits_no_paddle_deprecation_warnings():
+    """Jax API drift guard (round-4 finding: lax.pvary deprecated in
+    jax 0.8+). The zigzag causal path must not trip ANY
+    DeprecationWarning attributed to paddle_tpu code — the next jax
+    bump turns those warnings into hard removals."""
+    import warnings
+
+    rs = np.random.RandomState(21)
+    B, H, S, D = 1, 2, 16, 8
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D).astype("float32"))
+               for _ in range(3))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        _run_ring(q, k, v, D ** -0.5, causal=True)
+    ours = [w for w in caught
+            if issubclass(w.category, DeprecationWarning)
+            and "paddle_tpu" in str(w.filename)]
+    assert not ours, ["%s:%d %s" % (w.filename, w.lineno, w.message)
+                      for w in ours]
