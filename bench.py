@@ -154,6 +154,35 @@ def _batch(default, quick, quick_default):
     return default * _bscale()
 
 
+class _beacon:
+    """Compile-watchdog heartbeat: while a long phase (compile/warmup)
+    runs, log every 60s that it is still alive — a window post-mortem
+    can then tell a slow-but-progressing compile from a wedged tunnel
+    (round-4 lesson: two 'hangs' were indistinguishable from slowness)."""
+
+    def __init__(self, name, phase, period=60):
+        import threading
+
+        self._stop = threading.Event()
+        self._t = threading.Thread(
+            target=self._loop, args=(name, phase, period), daemon=True)
+
+    def _loop(self, name, phase, period):
+        import time as _time
+
+        t0 = _time.time()
+        while not self._stop.wait(period):
+            _log("%s: still in %s (%.0fs)" % (name, phase,
+                                              _time.time() - t0))
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+
+
 def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
                   steps=10, warmup=3, quick=False, recompute=False,
                   uses_flash=False, attention=False):
@@ -199,8 +228,9 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             steps = spc
             _log("%s: compiling K-step scan + warmup (%d steps/call)"
                  % (name, spc))
-            exe.run_repeated(main, feed=feed, fetch_list=[loss],
-                             scope=scope, steps=spc)
+            with _beacon(name, "compile/warmup"):
+                exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                 scope=scope, steps=spc)
             _log("%s: timing one %d-step call" % (name, spc))
             t0 = time.perf_counter()
             vals = exe.run_repeated(main, feed=feed, fetch_list=[loss],
@@ -209,8 +239,10 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             dt = time.perf_counter() - t0
         else:
             _log("%s: compiling + %d warmup steps" % (name, warmup))
-            for _ in range(warmup):
-                exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            with _beacon(name, "compile/warmup"):
+                for _ in range(warmup):
+                    exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)
 
             _log("%s: timing %d steps" % (name, steps))
             t0 = time.perf_counter()
@@ -557,6 +589,17 @@ def _probe_backend(timeout_s=None):
         os._exit(1)
 
 
+def _enable_compile_cache():
+    """Persistent XLA compile cache anchored at the repo root (see
+    paddle_tpu.flags.enable_compile_cache): BERT-base compiles in
+    minutes; with the cache, the second-ever window replays it in
+    seconds. Off with PADDLE_TPU_COMPILE_CACHE_DIR=0."""
+    from paddle_tpu.flags import enable_compile_cache
+
+    enable_compile_cache(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+
+
 def _run_worker(name, amp, quick):
     """In-process single-workload run (the ``--worker`` entry)."""
     if os.environ.get("JAX_PLATFORMS"):
@@ -566,6 +609,7 @@ def _run_worker(name, amp, quick):
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    _enable_compile_cache()
     _probe_backend()
     try:
         # single source of truth for "this row exercises the flash

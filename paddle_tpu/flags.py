@@ -73,3 +73,27 @@ DEFINE_flag("memory_fraction_of_eager_deletion", 1.0, _SUBSUMED)
 DEFINE_flag("use_pinned_memory", True, _SUBSUMED)
 DEFINE_flag("init_allocated_mem", False, _SUBSUMED)
 DEFINE_flag("limit_of_tmp_allocation", -1, _SUBSUMED)
+
+
+def enable_compile_cache(default_dir: str = None) -> None:
+    """Persistent XLA compilation cache: a process (or TPU-tunnel
+    window) never re-pays a compile an earlier one already paid for
+    the same program+backend. Dir resolution:
+    PADDLE_TPU_COMPILE_CACHE_DIR env ("0" disables) > default_dir >
+    <cwd>/.jax_cache. Safe to call before or after backend init; a
+    jax too old for the options is a no-op."""
+    import os
+
+    cache = os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR",
+                           default_dir or os.path.join(os.getcwd(),
+                                                       ".jax_cache"))
+    if cache == "0":
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        # cache anything that took >2s to compile (training graphs do)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass  # older jax: compile just stays uncached

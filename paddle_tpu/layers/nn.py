@@ -1287,13 +1287,20 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None
 
 
 def fused_attention(q, k, v, bias=None, scale=1.0, dropout=0.0,
-                    causal=False, name=None):
+                    causal=False, segment_ids=None, name=None):
     """Single-kernel scaled-dot-product attention over [B,H,S,D] tensors
     (Pallas flash kernel; see ops/attention.py). The reference composes
     this from matmul+softmax layer calls — SURVEY §5. ``causal=True``
     applies the lower-triangular mask in-kernel and SKIPS above-diagonal
     key blocks (~2x decoder-self-attention FLOPs at long S) — pass it
-    instead of materializing a [S,S] causal bias."""
+    instead of materializing a [S,S] causal bias.
+
+    ``segment_ids`` ([B,S] int, 0 = padding — reader.pack_sequences
+    layout) restricts attention to same-segment real keys for PACKED
+    training WITHOUT materializing the [S,S] pack bias: single-device it
+    folds to a mask once; under a sequence-parallel mesh the ids ride
+    the ring and each pair builds its block mask from two [B,S/n] id
+    vectors."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     mask = helper.create_variable_for_type_inference(q.dtype)
@@ -1301,6 +1308,8 @@ def fused_attention(q, k, v, bias=None, scale=1.0, dropout=0.0,
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias is not None:
         inputs["Bias"] = [bias]
+    if segment_ids is not None:
+        inputs["SegmentIds"] = [segment_ids]
     helper.append_op(type="fused_attention", inputs=inputs,
                      outputs={"Out": [out], "Mask": [mask]},
                      attrs={"scale": float(scale), "dropout": float(dropout),

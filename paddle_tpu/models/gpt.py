@@ -131,23 +131,34 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
     _check_cfg(cfg)
     ids = layers.data("ids", [seq_len], dtype="int64")
     seg = pos_feed = None
+    self_seg = None
     if packed:
         seg = layers.data("segment_ids", [seq_len], dtype="int64")
         pos_feed = layers.data("pos_ids", [seq_len], dtype="int64")
-        # same-segment visibility (and key must be real): [B, 1, S, S]
-        a = layers.reshape(seg, [-1, 1, seq_len, 1])
-        b = layers.reshape(seg, [-1, 1, 1, seq_len])
-        same = layers.cast(layers.equal(a, b), "float32")
-        realk = layers.cast(layers.greater_than(
-            b, layers.fill_constant([1], "int64", 0)), "float32")
-        keep = layers.elementwise_mul(same, realk)
-        pack_bias = layers.scale(layers.elementwise_sub(
-            layers.fill_constant([1], "float32", 1.0), keep), scale=-1e9)
-    else:
-        pack_bias = _pad_bias(ids)
     if use_fused_attention:
-        self_bias, self_causal = pack_bias, True
+        if packed:
+            # the fused op takes the segment ids DIRECTLY — no [S,S]
+            # pack bias is ever materialized; single-device it folds to
+            # a mask once, under an sp mesh the ids ride the ring
+            # (ops/attention.py SegmentIds, ring_attention seg=)
+            self_bias, self_causal, self_seg = None, True, seg
+        else:
+            self_bias, self_causal = _pad_bias(ids), True
     else:
+        if packed:
+            # composed fallback: materialized same-segment visibility
+            # (and key must be real): [B, 1, S, S]
+            a = layers.reshape(seg, [-1, 1, seq_len, 1])
+            b = layers.reshape(seg, [-1, 1, 1, seq_len])
+            same = layers.cast(layers.equal(a, b), "float32")
+            realk = layers.cast(layers.greater_than(
+                b, layers.fill_constant([1], "int64", 0)), "float32")
+            keep = layers.elementwise_mul(same, realk)
+            pack_bias = layers.scale(layers.elementwise_sub(
+                layers.fill_constant([1], "float32", 1.0), keep),
+                scale=-1e9)
+        else:
+            pack_bias = _pad_bias(ids)
         self_bias = layers.elementwise_add(pack_bias,
                                            _causal_bias(seq_len))
         self_causal = False
@@ -182,7 +193,7 @@ def build(cfg=None, seq_len=256, is_test=False, use_fused_attention=None,
             h, h, self_bias, cfg["d_model"], cfg["n_head"], cfg["dropout"],
             is_test, nm + "_att", use_fused_attention,
             causal=self_causal, n_kv_head=cfg.get("n_kv_head"),
-            rope_pos=rope_pos),
+            rope_pos=rope_pos, segment_ids=self_seg),
             cfg["dropout"], is_test, nm + "_pre1", norm=norm)
         x = _prenorm(x, lambda h, nm=nm: _ffn(h, cfg["d_model"],
                                               cfg["d_ff"], nm,

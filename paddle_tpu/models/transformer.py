@@ -75,7 +75,8 @@ def repeat_kv_heads(x, n_kv_head, n_head, seq_len, d_head):
 
 def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
                          is_test, name, use_fused_attention=False,
-                         causal=False, n_kv_head=None, rope_pos=None):
+                         causal=False, n_kv_head=None, rope_pos=None,
+                         segment_ids=None):
     """causal=True only affects the fused path (in-kernel triangular
     mask + above-diagonal block skipping); the composed path expects the
     causal mask folded into `bias` as before. ``n_kv_head < n_head``
@@ -89,6 +90,13 @@ def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
     if n_head % n_kv_head:
         raise ValueError("n_head %d must divide by n_kv_head %d"
                          % (n_head, n_kv_head))
+    if segment_ids is not None and not use_fused_attention:
+        # the composed path has no id-aware masking — silently dropping
+        # the pack mask would train on cross-document attention
+        raise ValueError(
+            "segment_ids requires use_fused_attention=True; the "
+            "composed path needs the pack mask folded into `bias` "
+            "(models/gpt.py builds it that way)")
     d_head = d_model // n_head
     seq_q = q_in.shape[1]
     seq_kv = kv_in.shape[1]
@@ -113,7 +121,8 @@ def multi_head_attention(q_in, kv_in, bias, d_model, n_head, dropout,
     if use_fused_attention:
         ctxv = layers.fused_attention(q, k, v, bias, scale=d_head ** -0.5,
                                       dropout=dropout if not is_test else 0.0,
-                                      causal=causal)
+                                      causal=causal,
+                                      segment_ids=segment_ids)
     else:
         scores = layers.matmul(q, k, transpose_y=True, alpha=d_head ** -0.5)
         if bias is not None:

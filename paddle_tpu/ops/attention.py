@@ -792,7 +792,18 @@ def flash_attention(q, k, v, bias=None, scale=1.0, bias_grad=False,
                         causal)
 
 
-def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale, causal=False):
+def _seg_mask_full(seg):
+    """[B,S] packed segment ids -> [B,1,S,S] additive block-diagonal
+    mask (same-segment AND key-is-real; 0 = padding). The single-device
+    fallback for SegmentIds — the sp ring path never materializes it
+    (it applies the same rule per ring pair)."""
+    from ..parallel.ring_attention import _seg_mask
+
+    return _seg_mask(seg, seg)
+
+
+def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale, causal=False,
+                              seg=None):
     """Mosaic kernels cannot be auto-partitioned by the SPMD partitioner
     (jax raises at multi-device lowering), so under a ParallelEngine mesh
     the op-level flash call wraps itself in shard_map: batch shards over
@@ -814,6 +825,9 @@ def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale, causal=False):
         # _in_manual_mesh: already inside a shard_map region (pipeline
         # stage bodies, ring steps) — Mosaic-in-manual-mesh is the
         # supported pattern; nesting shard_map is a trace error
+        if seg is not None:
+            sm = _seg_mask_full(seg)
+            bias = sm if bias is None else bias + sm
         return flash_attention(q, k, v, bias, scale, causal=causal)
 
     from jax.sharding import PartitionSpec as P
@@ -840,19 +854,32 @@ def _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale, causal=False):
         qs = P(b_ax, h_ax, s_ax, None)
         bspec = None if bias is None else P(
             b_ax if bias.shape[0] != 1 else None, None, None, s_ax)
+        # packed segment ids shard exactly like the sequence: the local
+        # shard is the query side, a travelling copy is the key side
+        sspec = None if seg is None else P(b_ax, s_ax)
 
-        def ring(a, b, c, d=None):
+        def ring(a, b, c, d=None, s=None):
             return ring_attention(a, b, c, scale, s_ax, causal=causal,
-                                  kv_bias=d, use_flash=use_flash)
+                                  kv_bias=d, use_flash=use_flash, seg=s)
 
-        if bias is None:
-            fn = jax.shard_map(ring, mesh=mesh, in_specs=(qs,) * 3,
-                               out_specs=qs, check_vma=False)
-            return fn(q, k, v)
-        fn = jax.shard_map(ring, mesh=mesh, in_specs=(qs,) * 3 + (bspec,),
+        in_specs, args = (qs,) * 3, (q, k, v)
+        ring_fn = ring
+        if bias is not None and seg is not None:
+            in_specs, args = in_specs + (bspec, sspec), args + (bias, seg)
+        elif bias is not None:
+            in_specs, args = in_specs + (bspec,), args + (bias,)
+        elif seg is not None:
+            in_specs, args = in_specs + (sspec,), args + (seg,)
+            ring_fn = lambda a, b, c, s: ring(a, b, c, None, s)  # noqa: E731
+        fn = jax.shard_map(ring_fn, mesh=mesh, in_specs=in_specs,
                            out_specs=qs, check_vma=False)
-        return fn(q, k, v, bias)
+        return fn(*args)
 
+    if seg is not None:
+        # sharded but no seq axis (dp/tp only): fold the pack mask into
+        # the bias and take the plain sharded-batch path below
+        sm = _seg_mask_full(seg)
+        bias = sm if bias is None else bias + sm
     if _use_interpret():
         return flash_attention(q, k, v, bias, scale, causal=causal)
 
@@ -889,12 +916,14 @@ def _fused_attention(ctx, ins, attrs):
     k = ins["K"][0]
     v = ins["V"][0]
     bias = (ins.get("Bias") or [None])[0]
+    seg = (ins.get("SegmentIds") or [None])[0]
     scale = attrs.get("scale", 1.0)
     dropout = attrs.get("dropout", 0.0)
     causal = bool(attrs.get("causal", False))
     if bias is not None:
         bias = bias.astype(jnp.float32)  # mask bias adds in f32 in-kernel
-    out = _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale, causal)
+    out = _maybe_shard_mapped_flash(ctx, q, k, v, bias, scale, causal,
+                                    seg=seg)
     if dropout and not ctx.is_test:
         # dropout on the *output* (weights-dropout does not commute with the
         # fused kernel; divergence from the layer-composed path documented).
@@ -912,6 +941,7 @@ def _fused_attention(ctx, ins, attrs):
 def _fused_attention_grad(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias = (ins.get("Bias") or [None])[0]
+    seg = (ins.get("SegmentIds") or [None])[0]
     mask = (ins.get("Mask") or [None])[0]
     g = ins["Out@GRAD"][0]
     if mask is not None:
@@ -922,6 +952,7 @@ def _fused_attention_grad(ctx, ins, attrs):
     causal = bool(attrs.get("causal", False))
     _, vjp = jax.vjp(
         lambda a, b, c: _maybe_shard_mapped_flash(ctx, a, b, c, bias,
-                                                  scale, causal), q, k, v)
+                                                  scale, causal,
+                                                  seg=seg), q, k, v)
     dq, dk, dv = vjp(g.astype(q.dtype))
     return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}

@@ -35,6 +35,18 @@ from jax import lax
 __all__ = ["ring_attention"]
 
 
+def _seg_mask(q_seg, k_seg):
+    """Additive block-diagonal mask from packed segment ids.
+    q_seg:[B,Sq] k_seg:[B,Sk] -> [B,1,Sq,Sk]; a key is visible iff it
+    shares the query's segment id AND is a real token (seg id > 0 —
+    pack_sequences reserves 0 for padding). Computed per ring pair from
+    two [B,Sl] id vectors, so the full [S,S] pack bias is NEVER
+    materialized anywhere on the sp path."""
+    keep = ((q_seg[:, :, None] == k_seg[:, None, :])
+            & (k_seg[:, None, :] > 0))
+    return jnp.where(keep, 0.0, -1e9)[:, None].astype(jnp.float32)
+
+
 def _block_partials(q, k, v, scale, mask):
     """Unnormalised flash partials for one K/V block.
     q:[B,H,Sq,D] k,v:[B,H,Sk,D] mask:[...,Sq,Sk] additive or None.
@@ -53,12 +65,21 @@ def ring_attention(q, k, v, scale: float, axis_name: str,
                    causal: bool = False,
                    kv_bias: Optional[jax.Array] = None,
                    use_flash: bool = False,
-                   schedule: str = "auto"):
+                   schedule: str = "auto",
+                   seg: Optional[jax.Array] = None):
     """Attention over a sequence sharded on `axis_name`.
 
     q,k,v: [B,H,Sl,D] local shards. kv_bias: [B,1,1,Sl] additive bias that
     travels with the K/V blocks (e.g. padding mask). causal=True applies
     the global lower-triangular mask using ring positions.
+
+    seg: [B,Sl] packed segment ids sharded like the sequence (local
+    shard; 0 = padding) — enables PACKED training (multiple documents
+    per row, reader.pack_sequences layout) under sp: the local ids are
+    the query side, a travelling copy rides the ring as the key side,
+    and each pair applies the block-diagonal same-segment mask from the
+    two id vectors (see _seg_mask). O(Sl^2) per pair instead of an
+    [S,S] pack bias.
 
     use_flash=True runs each ring step through the Pallas flash kernel
     (ops/attention.py flash_attention_with_lse) instead of a
@@ -83,14 +104,14 @@ def ring_attention(q, k, v, scale: float, axis_name: str,
                    or (schedule == "auto" and causal))
     if want_zigzag and causal and n_static > 1 and q.shape[2] % 2 == 0:
         return _ring_attention_zigzag(q, k, v, scale, axis_name,
-                                      kv_bias, use_flash)
+                                      kv_bias, use_flash, seg=seg)
     if schedule == "zigzag":
         raise ValueError(
             "zigzag schedule requires causal=True, >1 ring devices "
             "and an even local shard length")
     if use_flash:
         return _ring_attention_flash(q, k, v, scale, axis_name, causal,
-                                     kv_bias)
+                                     kv_bias, seg=seg)
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, H, Sl, D = q.shape
@@ -100,7 +121,7 @@ def ring_attention(q, k, v, scale: float, axis_name: str,
     neg = jnp.float32(-1e9)
 
     def step(i, carry):
-        o_acc, m_acc, l_acc, k_cur, v_cur, b_cur = carry
+        o_acc, m_acc, l_acc, k_cur, v_cur, b_cur, s_cur = carry
         src = (idx - i) % n                        # origin block of k_cur
         mask = None
         if causal:
@@ -108,6 +129,9 @@ def ring_attention(q, k, v, scale: float, axis_name: str,
             k_pos = src * Sl + jnp.arange(Sl)
             mask = jnp.where(k_pos[None, :] > q_pos[:, None], neg, 0.0)
             mask = mask[None, None]
+        if s_cur is not None:
+            sm = _seg_mask(seg, s_cur)
+            mask = sm if mask is None else mask + sm
         if b_cur is not None:
             bm = b_cur.astype(jnp.float32)
             mask = bm if mask is None else mask + bm
@@ -117,18 +141,19 @@ def ring_attention(q, k, v, scale: float, axis_name: str,
         b = jnp.exp(m - new_m)
         o_acc = o_acc * a[..., None] + o * b[..., None]
         l_acc = l_acc * a + l * b
-        k_cur, v_cur, b_cur = _rotate(axis_name, perm, k_cur, v_cur, b_cur)
-        return o_acc, new_m, l_acc, k_cur, v_cur, b_cur
+        k_cur, v_cur, b_cur, s_cur = _rotate(axis_name, perm,
+                                             k_cur, v_cur, b_cur, s_cur)
+        return o_acc, new_m, l_acc, k_cur, v_cur, b_cur, s_cur
 
     o0 = jnp.zeros((B, H, Sl, D), jnp.float32)
     m0 = jnp.full((B, H, Sl), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, Sl), jnp.float32)
-    carry = (o0, m0, l0, k, v, kv_bias)
+    carry = (o0, m0, l0, k, v, kv_bias, seg)
     # the ring length is static (mesh-axis size), so the loop unrolls and
     # XLA pipelines each ppermute against the next block's matmuls
     for i in range(int(n)):
         carry = step(i, carry)
-    o_acc, _, l_acc, _, _, _ = carry
+    o_acc, _, l_acc = carry[0], carry[1], carry[2]
     return (o_acc / l_acc[..., None]).astype(q.dtype)
 
 
@@ -138,7 +163,8 @@ def _rotate(axis_name, perm, *vals):
             for v in vals]
 
 
-def _ring_attention_flash(q, k, v, scale, axis_name, causal, kv_bias):
+def _ring_attention_flash(q, k, v, scale, axis_name, causal, kv_bias,
+                          seg=None):
     """Flash-kernel ring: each step yields a NORMALIZED partial (out, lse)
     from the Pallas kernel; partials over key shards merge with
     logaddexp weights (out = sum_i out_i * softmax_i(lse_i)).
@@ -158,8 +184,13 @@ def _ring_attention_flash(q, k, v, scale, axis_name, causal, kv_bias):
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def step(i, carry):
-        o_acc, lse_acc, k_cur, v_cur, b_cur = carry
+        o_acc, lse_acc, k_cur, v_cur, b_cur, s_cur = carry
         bias = None if b_cur is None else b_cur.astype(jnp.float32)
+        if s_cur is not None:
+            # packed rows: per-pair [B,1,Sl,Sl] same-segment mask from
+            # the two id vectors (O(Sl^2) per step, never [S,S])
+            sm = _seg_mask(seg, s_cur)
+            bias = sm if bias is None else bias + sm
         # diagonal block (ring step 0, src == idx): the kernel's causal
         # path masks in-VMEM and skips above-diagonal key blocks — no
         # materialized [Sl, Sl] diagonal bias
@@ -175,12 +206,13 @@ def _ring_attention_flash(q, k, v, scale, axis_name, causal, kv_bias):
             visible = idx >= i
             o_new = jnp.where(visible, o_new, o_acc)
             new_lse = jnp.where(visible, new_lse, lse_acc)
-        k_cur, v_cur, b_cur = _rotate(axis_name, perm, k_cur, v_cur, b_cur)
-        return o_new, new_lse, k_cur, v_cur, b_cur
+        k_cur, v_cur, b_cur, s_cur = _rotate(axis_name, perm,
+                                             k_cur, v_cur, b_cur, s_cur)
+        return o_new, new_lse, k_cur, v_cur, b_cur, s_cur
 
     o0 = jnp.zeros((B, H, Sl, D), jnp.float32)
     lse0 = jnp.full((B, H, Sl), -jnp.inf, jnp.float32)
-    carry = (o0, lse0, k, v, kv_bias)
+    carry = (o0, lse0, k, v, kv_bias, seg)
     for i in range(int(n)):
         carry = step(i, carry)
     return carry[0].astype(q.dtype)
@@ -203,7 +235,7 @@ def _zigzag_permutes(n):
 
 
 def _ring_attention_zigzag(q, k, v, scale, axis_name, kv_bias,
-                           use_flash):
+                           use_flash, seg=None):
     """Causal ring on the ZIGZAG (striped) chunk assignment:
     device d owns global chunks {d, 2n-1-d} (each Sl/2 rows), so the
     causal visible-work per (device, step) is a CONSTANT two of the four
@@ -249,11 +281,20 @@ def _ring_attention_zigzag(q, k, v, scale, axis_name, kv_bias,
     b0 = b1 = None
     if kv_bias is not None:
         b0, b1 = to_zigzag(kv_bias.astype(jnp.float32), 3)
+    qs0 = qs1 = s0 = s1 = None
+    if seg is not None:
+        # segment ids chunk-split exactly like the sequence: one static
+        # copy per q chunk, one travelling copy per kv chunk
+        qs0, qs1 = to_zigzag(seg, 1)
+        s0, s1 = qs0, qs1
 
     perm = [(j, (j + 1) % n) for j in range(n)]
     qg0, qg1 = idx, 2 * n - 1 - idx
 
-    def pair(qc, kc, vc, bc, causal_pair):
+    def pair(qc, kc, vc, bc, causal_pair, qsc=None, ksc=None):
+        if ksc is not None:
+            sm = _seg_mask(qsc, ksc)
+            bc = sm if bc is None else bc + sm
         if use_flash:
             o, lse = flash_attention_with_lse(qc, kc, vc, bc, scale,
                                               causal=causal_pair)
@@ -293,7 +334,7 @@ def _ring_attention_zigzag(q, k, v, scale, axis_name, kv_bias,
         w_i = jnp.where(jnp.isneginf(new), 0.0, jnp.exp(l_i - new))
         return o_a * w_a[..., None] + o_i * w_i[..., None], new
 
-    def visible_pair(acc, pred, qc, kc, vc, bc):
+    def visible_pair(acc, pred, qc, kc, vc, bc, qsc=None, ksc=None):
         # bc closes over the branches — lax.cond supports captured
         # tracers including ones that carry cotangents (the flash
         # kernel stop_gradients its bias; the plain pair's bias grad
@@ -301,7 +342,7 @@ def _ring_attention_zigzag(q, k, v, scale, axis_name, kv_bias,
         # test_zigzag_plain_causal_with_bias_and_grads)
         part = lax.cond(
             pred,
-            lambda qq, kk, vv: pair(qq, kk, vv, bc, False),
+            lambda qq, kk, vv: pair(qq, kk, vv, bc, False, qsc, ksc),
             lambda qq, kk, vv: neutral(qq),
             qc, kc, vc)
         return merge(acc, part)
@@ -309,22 +350,23 @@ def _ring_attention_zigzag(q, k, v, scale, axis_name, kv_bias,
     acc0 = neutral(q0)
     acc1 = neutral(q1)
     kc0, kc1, vc0, vc1, bc0, bc1 = k0, k1, v0, v1, b0, b1
+    sc0, sc1 = s0, s1
     for j in range(n):
         if j == 0:
             # self step (static): both diagonals causal; (q1, k0) is the
             # always-visible full pair; (q0, k1) is never visible
-            acc0 = merge(acc0, pair(q0, kc0, vc0, bc0, True))
-            acc1 = merge(acc1, pair(q1, kc1, vc1, bc1, True))
-            acc1 = merge(acc1, pair(q1, kc0, vc0, bc0, False))
+            acc0 = merge(acc0, pair(q0, kc0, vc0, bc0, True, qs0, sc0))
+            acc1 = merge(acc1, pair(q1, kc1, vc1, bc1, True, qs1, sc1))
+            acc1 = merge(acc1, pair(q1, kc0, vc0, bc0, False, qs1, sc0))
         else:
             p = (idx - j) % n
             kg0, kg1 = p, 2 * n - 1 - p
-            acc0 = visible_pair(acc0, qg0 > kg0, q0, kc0, vc0, bc0)
-            acc0 = visible_pair(acc0, qg0 > kg1, q0, kc1, vc1, bc1)
-            acc1 = visible_pair(acc1, qg1 > kg0, q1, kc0, vc0, bc0)
-            acc1 = visible_pair(acc1, qg1 > kg1, q1, kc1, vc1, bc1)
-        kc0, vc0, bc0 = _rotate(axis_name, perm, kc0, vc0, bc0)
-        kc1, vc1, bc1 = _rotate(axis_name, perm, kc1, vc1, bc1)
+            acc0 = visible_pair(acc0, qg0 > kg0, q0, kc0, vc0, bc0, qs0, sc0)
+            acc0 = visible_pair(acc0, qg0 > kg1, q0, kc1, vc1, bc1, qs0, sc1)
+            acc1 = visible_pair(acc1, qg1 > kg0, q1, kc0, vc0, bc0, qs1, sc0)
+            acc1 = visible_pair(acc1, qg1 > kg1, q1, kc1, vc1, bc1, qs1, sc1)
+        kc0, vc0, bc0, sc0 = _rotate(axis_name, perm, kc0, vc0, bc0, sc0)
+        kc1, vc1, bc1, sc1 = _rotate(axis_name, perm, kc1, vc1, bc1, sc1)
 
     out = from_zigzag(acc0[0], acc1[0], 2)
     return out.astype(q.dtype)

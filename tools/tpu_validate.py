@@ -213,6 +213,12 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # share the bench's persistent XLA compile cache: a validator run
+    # early in a window pre-warms the bench compiles (and vice versa)
+    from paddle_tpu.flags import enable_compile_cache
+
+    enable_compile_cache(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache"))
     ap = argparse.ArgumentParser()
     ap.add_argument("--bench", action="store_true",
                     help="also run the full bench sweep")
