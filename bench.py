@@ -266,6 +266,8 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # for a hardware number (pin_baselines refuses platform
             # "cpu"; the judge can see it either way)
             "platform": _jax.devices()[0].platform.lower(),
+            # smoke rows (tiny batches) must never pin as baselines
+            **({"quick": True} if quick else {}),
             "precision": "bf16_amp" if amp else "f32",
             # recompute trades FLOPs for memory: mark the row so it is
             # never mistaken for (or regression-compared against) a
@@ -535,6 +537,153 @@ def bench_deepfm(amp, quick, uses_flash=False):
                          "examples/sec", batch, build, feed, amp, quick=quick)
 
 
+def _deepfm_dist_build(distributed):
+    """ONE graph for the distributed-CTR trainer AND its pservers (the
+    transpiler requires both sides to transpile the identical program)."""
+    import paddle_tpu as fluid
+    import paddle_tpu.models.ctr as ctr
+
+    n_fields, n_dense, vocab = 26, 13, 1000001
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss, _acc, _ = ctr.build("deepfm", n_fields, n_dense, vocab,
+                                  distributed=distributed)
+        fluid.optimizer.SGD(learning_rate=1e-3).minimize(loss)
+    return main, startup, loss, (n_fields, n_dense, vocab)
+
+
+def _deepfm_dist_transpile(main, startup, trainer_id=0):
+    import paddle_tpu as fluid
+
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=trainer_id, program=main,
+                pservers=os.environ["PADDLE_PSERVER_ENDPOINTS"],
+                trainers=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                sync_mode=True, startup_program=startup)
+    return t
+
+
+def _run_dist_ctr_pserver():
+    """Hidden entry: one CPU pserver for bench_deepfm_dist (MUST NOT
+    claim the single-client TPU tunnel)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid
+
+    main, startup, _loss, _dims = _deepfm_dist_build(distributed=True)
+    t = _deepfm_dist_transpile(main, startup)
+    ep = os.environ["PADDLE_CURRENT_ENDPOINT"]
+    exe = fluid.Executor()
+    exe.run(t.get_startup_program(ep))
+    exe.run(t.get_pserver_program(ep))
+    return 0
+
+
+def bench_deepfm_dist(amp, quick, uses_flash=False):
+    """The reference's CTR benchmark is DISTRIBUTED (fluid_benchmark.py
+    pserver mode + models/): sparse tables live only on pservers
+    (prefetch + SelectedRows grads over the RPC stack), the dense half
+    trains on this chip. Two localhost CPU pservers are spawned for the
+    duration of the row; loss parity vs single-process is pinned CPU-side
+    by tests/test_dist_ps.py::test_dist_ctr_sparse_table_cluster_*."""
+    import socket
+
+    batch = _batch(8192, quick, 256)
+    socks, ports = [], []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    endpoints = ",".join("127.0.0.1:%d" % p for p in ports)
+    os.environ["PADDLE_PSERVER_ENDPOINTS"] = endpoints
+    os.environ["PADDLE_TRAINERS_NUM"] = "1"
+    os.environ["PADDLE_TRAINER_ID"] = "0"
+    pservers = []
+    try:
+        for ep in endpoints.split(","):
+            env = dict(os.environ)
+            env.update({"JAX_PLATFORMS": "cpu",
+                        "PADDLE_CURRENT_ENDPOINT": ep})
+            # SAME process group as this worker (no start_new_session):
+            # if the orchestrator deadline-kills a wedged worker via
+            # killpg, the pservers die with it instead of leaking as
+            # orphans blocked in their serve loop
+            pservers.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--dist-ctr-pserver"],
+                env=env, stderr=sys.stderr))
+
+        import paddle_tpu as fluid
+        from paddle_tpu.core.scope import Scope, scope_guard
+
+        main, startup, loss, (n_fields, n_dense, vocab) = \
+            _deepfm_dist_build(distributed=True)
+        t = _deepfm_dist_transpile(main, startup)
+        prog = t.get_trainer_program()
+        scope = Scope()
+        with scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            if amp:
+                prog.set_amp(True)
+            exe.run(t.get_trainer_startup_program(), scope=scope)
+            rs = np.random.RandomState(0)
+            feed = {
+                "sparse_ids": rs.randint(
+                    0, vocab, (batch, n_fields)).astype("int64"),
+                "dense": rs.rand(batch, n_dense).astype("float32"),
+                "label": rs.randint(0, 2, (batch, 1)).astype("int64"),
+            }
+            # device-resident feeds, same as _run_workload: the timed
+            # loop measures the train step + RPC, not repeated H2D of
+            # the same host arrays
+            import jax.numpy as jnp
+
+            feed = {k: jnp.asarray(v) for k, v in feed.items()}
+            steps, warmup = (2, 1) if quick else (10, 3)
+            _log("deepfm_dist: compiling + %d warmup steps" % warmup)
+            with _beacon("deepfm_dist", "compile/warmup"):
+                for _ in range(warmup):
+                    exe.run(prog, feed=feed, fetch_list=[loss], scope=scope)
+            _log("deepfm_dist: timing %d steps" % steps)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                vals = exe.run(prog, feed=feed, fetch_list=[loss],
+                               scope=scope)
+            float(np.asarray(vals[0]).reshape(-1)[0])
+            dt = time.perf_counter() - t0
+            exe.close()  # Complete -> pservers drain and exit
+        import jax as _jax
+
+        rec = {
+            "metric": "deepfm_dist_train_examples_per_sec_per_chip",
+            "platform": _jax.devices()[0].platform.lower(),
+            **({"quick": True} if quick else {}),
+            "precision": "bf16_amp" if amp else "f32",
+            "distributed": True,
+            "pservers": 2,
+            "value": round(batch * steps / dt, 1),
+            "unit": "examples/sec",
+            "vs_baseline": round(
+                batch * steps / dt / BASELINES[
+                    "deepfm_dist_train_examples_per_sec_per_chip"], 3)
+            if "deepfm_dist_train_examples_per_sec_per_chip" in BASELINES
+            else 1.0,
+            "tflops_per_sec": None,  # RPC-bound; MFU is not the story
+            "mfu": None,
+        }
+        print(json.dumps(rec), flush=True)
+        return rec
+    finally:
+        for p in pservers:  # direct kill: children share our process group
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
 WORKLOADS = {
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
@@ -542,13 +691,16 @@ WORKLOADS = {
     "vgg16": bench_vgg16,
     "bert": bench_bert,
     "deepfm": bench_deepfm,
+    "deepfm_dist": bench_deepfm_dist,
     "gpt_causal": bench_gpt_causal,
 }
 
 # Safe (no custom-kernel) workloads first: if the tunnel wedges or a
 # Pallas compile hangs partway through, the rows already printed stand.
+# deepfm_dist LAST: it spawns localhost pserver subprocesses, so a
+# half-cleaned failure can't disturb the single-process rows.
 ORDER = ["resnet50", "vgg16", "deepfm", "transformer", "bert",
-         "transformer_long", "gpt_causal"]
+         "transformer_long", "gpt_causal", "deepfm_dist"]
 
 # Workloads with fused_attention ops in the graph, with their sequence
 # length; eligible for one retry with PADDLE_TPU_FUSED_ATTENTION=0.
@@ -728,7 +880,12 @@ def main():
                     help=argparse.SUPPRESS)  # internal: backend-init check
     ap.add_argument("--in-process", action="store_true",
                     help="no subprocess isolation (debugging)")
+    ap.add_argument("--dist-ctr-pserver", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: CPU pserver child
     args = ap.parse_args()
+
+    if args.dist_ctr_pserver:
+        return _run_dist_ctr_pserver()
 
     if args.probe:
         if os.environ.get("JAX_PLATFORMS"):

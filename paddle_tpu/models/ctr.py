@@ -18,24 +18,32 @@ from ..param_attr import ParamAttr
 __all__ = ["wide_deep", "deepfm", "build"]
 
 
-def _field_embed(ids, vocab, dim, name):
-    """[B,F] ids -> [B,F,dim] via one shared table (hash-bucketed slots)."""
+def _field_embed(ids, vocab, dim, name, distributed=False):
+    """[B,F] ids -> [B,F,dim] via one shared table (hash-bucketed slots).
+    distributed=True marks the lookup for the PS sparse-table path: the
+    transpiler rewrites it to prefetch (remote row fetch) + send_sparse
+    (SelectedRows grads), and the table lives ONLY on its pserver —
+    reference dist_ctr.py / distribute_lookup_table flow."""
     return layers.embedding(ids, size=[vocab, dim],
+                            is_sparse=distributed,
+                            is_distributed=distributed,
                             param_attr=ParamAttr(name=name))
 
 
 def wide_deep(sparse_ids, dense, vocab=1000001, emb_dim=16,
-              hidden=(400, 400, 400)):
+              hidden=(400, 400, 400), distributed=False):
     n_fields = sparse_ids.shape[1]
     # deep: field embeddings concat + MLP
-    emb = _field_embed(sparse_ids, vocab, emb_dim, "deep_emb")
+    emb = _field_embed(sparse_ids, vocab, emb_dim, "deep_emb",
+                       distributed=distributed)
     deep = layers.reshape(emb, [-1, n_fields * emb_dim])
     deep = layers.concat([deep, dense], axis=1)
     for i, h in enumerate(hidden):
         deep = layers.fc(deep, h, act="relu",
                          param_attr=ParamAttr(name="deep_fc%d.w_0" % i))
     # wide: linear over sparse (dim-1 embedding = per-id weight) + dense
-    wide_emb = _field_embed(sparse_ids, vocab, 1, "wide_emb")
+    wide_emb = _field_embed(sparse_ids, vocab, 1, "wide_emb",
+                            distributed=distributed)
     wide = layers.reshape(wide_emb, [-1, n_fields])
     wide = layers.concat([wide, dense], axis=1)
     both = layers.concat([deep, wide], axis=1)
@@ -44,14 +52,16 @@ def wide_deep(sparse_ids, dense, vocab=1000001, emb_dim=16,
 
 
 def deepfm(sparse_ids, dense, vocab=1000001, emb_dim=16,
-           hidden=(400, 400)):
+           hidden=(400, 400), distributed=False):
     n_fields = sparse_ids.shape[1]
     # first order
-    w1 = _field_embed(sparse_ids, vocab, 1, "fm_w1")          # [B,F,1]
+    w1 = _field_embed(sparse_ids, vocab, 1, "fm_w1",
+                      distributed=distributed)           # [B,F,1]
     first = layers.reduce_sum(layers.reshape(w1, [-1, n_fields]), dim=1,
                               keep_dim=True)                   # [B,1]
     # second order: 0.5 * ((sum_f v)^2 - sum_f v^2)
-    v = _field_embed(sparse_ids, vocab, emb_dim, "fm_v")       # [B,F,k]
+    v = _field_embed(sparse_ids, vocab, emb_dim, "fm_v",
+                     distributed=distributed)             # [B,F,k]
     sum_v = layers.reduce_sum(v, dim=1)                        # [B,k]
     sum_sq = layers.elementwise_mul(sum_v, sum_v)
     sq_sum = layers.reduce_sum(layers.elementwise_mul(v, v), dim=1)
@@ -74,12 +84,13 @@ def deepfm(sparse_ids, dense, vocab=1000001, emb_dim=16,
 
 
 def build(model="deepfm", n_fields=26, n_dense=13, vocab=1000001,
-          emb_dim=16):
+          emb_dim=16, distributed=False):
     sparse_ids = layers.data("sparse_ids", [n_fields], dtype="int64")
     dense = layers.data("dense", [n_dense])
     label = layers.data("label", [1], dtype="int64")
     fn = deepfm if model == "deepfm" else wide_deep
-    probs = fn(sparse_ids, dense, vocab=vocab, emb_dim=emb_dim)
+    probs = fn(sparse_ids, dense, vocab=vocab, emb_dim=emb_dim,
+               distributed=distributed)
     loss = layers.mean(layers.cross_entropy(probs, label))
     acc = layers.accuracy(probs, label)
     return loss, acc, [sparse_ids, dense, label]

@@ -1,0 +1,82 @@
+"""Cluster-test worker for the distributed sparse CTR path (reference
+dist_ctr.py analog): DeepFM with a distributed lookup table, role and
+topology from PADDLE_* env vars, losses written as JSON. The sparse
+tables ride prefetch/send_sparse over the PS RPC stack; the dense half
+trains through the regular send/recv blocks."""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+
+STEPS = 6
+VOCAB, N_FIELDS, N_DENSE = 64, 4, 3
+BATCH = 16
+
+
+def build(distributed):
+    import paddle_tpu.models.ctr as ctr
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss, _acc, _ = ctr.build("deepfm", N_FIELDS, N_DENSE, VOCAB,
+                                  emb_dim=8, distributed=distributed)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def data(step):
+    rs = np.random.RandomState(200 + step)
+    ids = rs.randint(0, VOCAB, (BATCH, N_FIELDS)).astype("int64")
+    dense = rs.rand(BATCH, N_DENSE).astype("float32")
+    label = rs.randint(0, 2, (BATCH, 1)).astype("int64")
+    return ids, dense, label
+
+
+def main():
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER")
+    pservers = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    main_prog, startup, loss = build(distributed=True)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.min_block_size = int(os.environ.get("MIN_BLOCK_SIZE", "64"))
+    t = fluid.DistributeTranspiler(cfg)
+    t.transpile(trainer_id=trainer_id, program=main_prog, pservers=pservers,
+                trainers=trainers, sync_mode=True, startup_program=startup)
+
+    exe = fluid.Executor()
+    if role == "PSERVER":
+        ep = os.environ["PADDLE_CURRENT_ENDPOINT"]
+        exe.run(t.get_startup_program(ep))
+        exe.run(t.get_pserver_program(ep))
+        return
+
+    prog = t.get_trainer_program()
+    exe.run(t.get_trainer_startup_program())
+    losses = []
+    for step in range(STEPS):
+        ids, dense, label = data(step)
+        sl = slice(trainer_id, None, trainers)  # half batch per trainer
+        lv, = exe.run(prog, feed={"sparse_ids": ids[sl], "dense": dense[sl],
+                                  "label": label[sl]},
+                      fetch_list=[loss.name])
+        losses.append(float(lv))
+    exe.close()
+    out = os.environ.get("LOSS_OUT")
+    if out:
+        with open(out, "w") as f:
+            json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

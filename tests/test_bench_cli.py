@@ -12,6 +12,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
 
@@ -95,3 +97,22 @@ def test_bench_orchestrator_kills_hung_workload():
     assert len(rows) == 1
     assert "error" in rows[0]
     assert "deadline" in rows[0]["error"]
+
+
+@pytest.mark.slow
+def test_bench_deepfm_dist_row(tmp_path):
+    """The distributed-CTR row: trainer + 2 spawned localhost pservers,
+    sparse tables riding prefetch/SelectedRows over the RPC stack; the
+    row must be tagged distributed and leave no orphan pservers."""
+    rc, rows = _run(["--worker", "deepfm_dist", "--quick"], {}, 600)
+    assert rc == 0, rows
+    row = [r for r in rows if "value" in r][0]
+    assert row["distributed"] is True and row["pservers"] == 2
+    assert row["metric"] == "deepfm_dist_train_examples_per_sec_per_chip"
+    assert row["value"] > 0
+    assert row.get("quick") is True  # smoke rows must carry the marker
+    # the docstring's "no orphan pservers" is enforced, not aspirational
+    ps = subprocess.run(["ps", "ax"], stdout=subprocess.PIPE, text=True)
+    leaked = [l for l in ps.stdout.splitlines()
+              if "--dist-ctr-pserver" in l]
+    assert not leaked, leaked

@@ -330,3 +330,73 @@ def test_pserver_restart_resumes_from_checkpoint(tmp_path):
             if p is not None and p.poll() is None:
                 p.kill()
                 p.communicate()
+
+
+@pytest.mark.slow
+def test_dist_ctr_sparse_table_cluster_matches_single(tmp_path):
+    """The reference's dist_ctr contract (dist_ctr.py via
+    test_dist_base.py): DeepFM with DISTRIBUTED sparse tables — 2
+    trainers x half batch against 2 pservers, tables living only on
+    their pservers (prefetch + SelectedRows grads over the RPC stack) —
+    must track the single-process full-batch run."""
+    script = os.path.join(HERE, "dist_ctr_script.py")
+    ports = _free_ports(2)
+    pservers = ",".join("127.0.0.1:%d" % p for p in ports)
+    repo_root = os.path.dirname(HERE)
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = repo_root + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    base_env.update({
+        "PADDLE_PSERVER_ENDPOINTS": pservers,
+        "PADDLE_TRAINERS_NUM": "2",
+        "JAX_PLATFORMS": "cpu",
+    })
+    procs, loss_files = [], []
+    for ep in pservers.split(","):
+        env = dict(base_env)
+        env.update({"PADDLE_TRAINING_ROLE": "PSERVER",
+                    "PADDLE_CURRENT_ENDPOINT": ep})
+        procs.append(subprocess.Popen([sys.executable, script], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    for i in range(2):
+        f = str(tmp_path / ("ctr_loss_%d.json" % i))
+        loss_files.append(f)
+        env = dict(base_env)
+        env.update({"PADDLE_TRAINING_ROLE": "TRAINER",
+                    "PADDLE_TRAINER_ID": str(i), "LOSS_OUT": f})
+        procs.append(subprocess.Popen([sys.executable, script], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out.decode(errors="replace"))
+            assert p.returncode == 0, "worker failed:\n%s" % outs[-1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    dist_avg = np.mean([json.load(open(f)) for f in loss_files], axis=0)
+
+    # single-process full batch, same feeds
+    sys.path.insert(0, HERE)
+    import dist_ctr_script as m
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    main, startup, loss = m.build(distributed=False)
+    sc = Scope()
+    with scope_guard(sc):
+        exe = fluid.Executor()
+        exe.run(startup, scope=sc)
+        single = []
+        for step in range(m.STEPS):
+            ids, dense, label = m.data(step)
+            lv, = exe.run(main, feed={"sparse_ids": ids, "dense": dense,
+                                      "label": label},
+                          fetch_list=[loss.name], scope=sc)
+            single.append(float(lv))
+    np.testing.assert_allclose(dist_avg, single, rtol=2e-3, atol=2e-4)
+    assert single[-1] < single[0]  # genuinely training

@@ -93,13 +93,21 @@ def main():
             print("SKIP %s: recompute/scaled-batch/dispatch-override "
                   "rows never pin over the plain-config baseline" % name)
             continue
+        if row.get("quick"):
+            print("SKIP %s: --quick smoke row (tiny batch) never pins "
+                  "as a baseline" % name)
+            continue
         if row.get("platform") == "cpu" and not args.force:
             print("SKIP %s: measured on the CPU backend — baselines "
                   "hold HARDWARE numbers (--force to pin anyway)" % name)
             continue
         spc = int(row.get("steps_per_call", 1))
         old, old_spc = current.get(name), cur_spc.get(name, 1)
-        if spc != default_spc and not args.force:
+        if row.get("distributed"):
+            # distributed rows (deepfm_dist) drive per-step RPC
+            # callbacks — spc=1 IS their default mode, not a sweep
+            pass
+        elif spc != default_spc and not args.force:
             print("SKIP %s: steps_per_call=%d row is an A/B sweep, not "
                   "bench's default mode (%d) — baselines track the "
                   "default config (--force to pin anyway)"
