@@ -21,6 +21,57 @@ from .param_attr import ParamAttr
 
 __all__ = ["LayerHelper"]
 
+# Active parameter-stacking guards (innermost last): while a
+# layers.scan_layers body builds, every create_parameter call is
+# intercepted to create ONE stacked [n_layers, *shape] parameter and
+# hand the body a per-iteration slice view — ordinary layer code
+# (fc, layer_norm, fused_attention, ...) runs unchanged inside the
+# scanned body. See layers/scan_ext.py.
+_PARAM_STACKERS = []
+
+
+class _ParamStacker:
+    """Collects stacked params + per-iteration slice vars for one
+    scan_layers body (the StageBuilder pattern of layers/parallel_ext
+    .py, applied transparently through LayerHelper)."""
+
+    def __init__(self, n: int, sub_block):
+        self.n = int(n)
+        self.sub = sub_block
+        self.stacked = []            # [n, *shape] Parameters
+        self.slice_names = []        # body-visible per-iter views
+        self._by_name = {}           # user name -> slice Variable (reuse)
+
+    def create(self, helper: "LayerHelper", attr, shape, dtype, is_bias,
+               default_initializer):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        suffix = "b" if is_bias else "w"
+        name = attr.name or unique_name.generate(
+            "%s.%s" % (helper.name, suffix))
+        if name in self._by_name:  # sharing-by-name inside the body
+            return self._by_name[name]
+        inner = _PARAM_STACKERS.pop()  # create the stacked param OUTSIDE
+        try:
+            stacked = helper.create_parameter(
+                ParamAttr(name=name, initializer=attr.initializer,
+                          trainable=attr.trainable,
+                          regularizer=attr.regularizer,
+                          gradient_clip=attr.gradient_clip,
+                          learning_rate=attr.learning_rate),
+                [self.n] + [int(s) for s in shape], dtype, is_bias=is_bias,
+                default_initializer=default_initializer)
+        finally:
+            _PARAM_STACKERS.append(inner)
+        slice_var = self.sub.create_var(
+            name=unique_name.generate(name + ".layer"),
+            shape=tuple(int(s) for s in shape), dtype=dtype)
+        self.stacked.append(stacked)
+        self.slice_names.append(slice_var.name)
+        self._by_name[name] = slice_var
+        return slice_var
+
 
 class LayerHelper:
     def __init__(self, layer_type: str, **kwargs):
@@ -48,6 +99,11 @@ class LayerHelper:
         is_bias: bool = False,
         default_initializer=None,
     ) -> Optional[Parameter]:
+        if _PARAM_STACKERS:
+            # inside a scan_layers body: create the stacked parameter
+            # and return the per-iteration slice view instead
+            return _PARAM_STACKERS[-1].create(
+                self, attr, shape, dtype, is_bias, default_initializer)
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None

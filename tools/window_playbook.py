@@ -19,8 +19,9 @@ Steps (artifacts):
   5. resnet50 batch-256    -> appended A/B row (MFU ladder step 3)
   6. transformer S=128 forced-kernel A/B (flash_min_seq=0) — quantifies
      the kernel-vs-composed gap at short S
-  7. dump_step_hlo resnet50 -> docs/perf/resnet50_* (op mix, aliasing)
-  8. flash_tune transformer_long (longest; only if still healthy)
+  7. tpu_validate --serving -> Python-free PJRT serving e2e proof
+  8. dump_step_hlo resnet50 -> docs/perf/resnet50_* (op mix, aliasing)
+  9. flash_tune transformer_long (longest; only if still healthy)
 
 Never run this concurrently with any other TPU-touching process: the
 tunnel is single-client and a SIGKILLed claim wedges the machine.
@@ -189,10 +190,21 @@ def main():
         log("tunnel wedged after A/Bs — stopping")
         return 1
 
-    # 7. step-HLO artifacts for the bottleneck analysis
+    # 7. Python-free serving e2e: compile+execute a StableHLO bucket
+    #    through the PJRT C API against the real plugin, output parity
+    #    vs the Python predictor (the serving execute-path proof; its
+    #    own invocation — the tunnel is single-client and the loader
+    #    must own the claim)
+    run([PY, "tools/tpu_validate.py", "--serving"], 600)
+
+    if not probe():
+        log("tunnel wedged after serving — stopping")
+        return 1
+
+    # 8. step-HLO artifacts for the bottleneck analysis
     run([PY, "tools/dump_step_hlo.py", "resnet50"], 900)
 
-    # 8. block-size sweep (longest; last)
+    # 9. block-size sweep (longest; last)
     run([PY, "tools/flash_tune.py", "transformer_long"], 1800)
 
     log("queue complete in %.0fs" % (time.time() - t0))
