@@ -41,6 +41,29 @@ def _log(msg):
     print("[bench %s] %s" % (time.strftime("%H:%M:%S"), msg),
           file=sys.stderr, flush=True)
 
+
+def _telemetry_dir():
+    return os.environ.get("PADDLE_TPU_TELEMETRY_DIR") or os.getcwd()
+
+
+def _dump_telemetry(tag):
+    """Write this process's metrics snapshot as a sidecar
+    (BENCH_<tag>.telemetry.json). Called from the worker after every row
+    — INCLUDING failed ones, and from the probe on a wedged backend — so
+    a dead round still records how far init got (probe timing, RPC
+    attempts, executor cache state) instead of a bare error string."""
+    try:
+        from paddle_tpu import observe
+
+        path = os.path.join(_telemetry_dir(),
+                            "BENCH_%s.telemetry.json" % tag)
+        observe.dump(path)
+        _log("telemetry sidecar: %s" % path)
+        return path
+    except Exception as exc:  # noqa: BLE001 — telemetry must never sink a row
+        _log("telemetry dump failed: %s: %s" % (type(exc).__name__, exc))
+        return None
+
 # chip peak bf16 FLOP/s by device_kind substring (lowercase); override with
 # PADDLE_TPU_PEAK_TFLOPS for unlisted hardware
 PEAKS = {
@@ -158,7 +181,10 @@ class _beacon:
     """Compile-watchdog heartbeat: while a long phase (compile/warmup)
     runs, log every 60s that it is still alive — a window post-mortem
     can then tell a slow-but-progressing compile from a wedged tunnel
-    (round-4 lesson: two 'hangs' were indistinguishable from slowness)."""
+    (round-4 lesson: two 'hangs' were indistinguishable from slowness).
+    Each beat also checkpoints the telemetry sidecar: when the
+    orchestrator SIGKILLs a wedged worker (no finally runs), the last
+    checkpoint still records how far the phase got."""
 
     def __init__(self, name, phase, period=60):
         import threading
@@ -174,6 +200,7 @@ class _beacon:
         while not self._stop.wait(period):
             _log("%s: still in %s (%.0fs)" % (name, phase,
                                               _time.time() - t0))
+            _dump_telemetry(name)
 
     def __enter__(self):
         self._t.start()
@@ -181,6 +208,9 @@ class _beacon:
 
     def __exit__(self, *exc):
         self._stop.set()
+        # join: a beat mid-_dump_telemetry must not race the caller's own
+        # final sidecar dump for the same tag (same tmp path)
+        self._t.join(timeout=30)
 
 
 def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
@@ -565,11 +595,41 @@ def _deepfm_dist_transpile(main, startup, trainer_id=0):
 
 def _run_dist_ctr_pserver():
     """Hidden entry: one CPU pserver for bench_deepfm_dist (MUST NOT
-    claim the single-client TPU tunnel)."""
+    claim the single-client TPU tunnel).
+
+    Port assignment (no TOCTOU): this process binds port 0 ITSELF via a
+    prebound RPCServer — the kernel assigns a free port that stays held
+    from bind to serve — writes the real endpoint to
+    PADDLE_TPU_PS_PORT_FILE, then waits for the launcher to publish the
+    full cluster endpoint list (PADDLE_TPU_PS_ENDPOINTS_FILE) before
+    transpiling. The old scheme (launcher binds/closes/reuses a port)
+    could lose the port to another process and stall the trainer for the
+    full RPC deadline."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as fluid
+    from paddle_tpu.distributed import ps as ps_runtime
+    from paddle_tpu.distributed.rpc import RPCServer
+
+    port_file = os.environ.get("PADDLE_TPU_PS_PORT_FILE")
+    if port_file:
+        server = RPCServer(
+            port=0,
+            num_trainers=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            sync=True)
+        ep = "127.0.0.1:%d" % server.port
+        tmp = port_file + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as f:
+            f.write(ep)
+        os.replace(tmp, port_file)  # atomic: launcher never reads a torn file
+        endpoints = _wait_for_file(
+            os.environ["PADDLE_TPU_PS_ENDPOINTS_FILE"],
+            timeout_s=int(os.environ.get("PADDLE_TPU_PS_RENDEZVOUS_TIMEOUT",
+                                         "120")))
+        os.environ["PADDLE_PSERVER_ENDPOINTS"] = endpoints
+        os.environ["PADDLE_CURRENT_ENDPOINT"] = ep
+        ps_runtime.register_prebound_server(ep, server)
 
     main, startup, _loss, _dims = _deepfm_dist_build(distributed=True)
     t = _deepfm_dist_transpile(main, startup)
@@ -580,6 +640,29 @@ def _run_dist_ctr_pserver():
     return 0
 
 
+def _wait_for_file(path, timeout_s=120, poll_s=0.05, procs=()):
+    """Poll until `path` exists and is non-empty; return its contents.
+    Raises if the deadline passes or any process in `procs` died."""
+    t0 = time.monotonic()
+    while True:
+        try:
+            with open(path) as f:
+                data = f.read().strip()
+            if data:
+                return data
+        except OSError:
+            pass
+        for p in procs:
+            if p.poll() is not None:
+                raise RuntimeError(
+                    "pserver child exited rc=%s before rendezvous"
+                    % p.returncode)
+        if time.monotonic() - t0 > timeout_s:
+            raise RuntimeError("timed out after %ds waiting for %s"
+                               % (timeout_s, path))
+        time.sleep(poll_s)
+
+
 def bench_deepfm_dist(amp, quick, uses_flash=False):
     """The reference's CTR benchmark is DISTRIBUTED (fluid_benchmark.py
     pserver mode + models/): sparse tables live only on pservers
@@ -587,27 +670,23 @@ def bench_deepfm_dist(amp, quick, uses_flash=False):
     trains on this chip. Two localhost CPU pservers are spawned for the
     duration of the row; loss parity vs single-process is pinned CPU-side
     by tests/test_dist_ps.py::test_dist_ctr_sparse_table_cluster_*."""
-    import socket
+    import tempfile
 
     batch = _batch(8192, quick, 256)
-    socks, ports = [], []
-    for _ in range(2):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    endpoints = ",".join("127.0.0.1:%d" % p for p in ports)
-    os.environ["PADDLE_PSERVER_ENDPOINTS"] = endpoints
+    n_ps = 2
     os.environ["PADDLE_TRAINERS_NUM"] = "1"
     os.environ["PADDLE_TRAINER_ID"] = "0"
+    rdv = tempfile.mkdtemp(prefix="bench_ps_rdv_")
+    port_files = [os.path.join(rdv, "ps%d.endpoint" % i)
+                  for i in range(n_ps)]
+    eps_file = os.path.join(rdv, "endpoints")
     pservers = []
     try:
-        for ep in endpoints.split(","):
+        for pf in port_files:
             env = dict(os.environ)
             env.update({"JAX_PLATFORMS": "cpu",
-                        "PADDLE_CURRENT_ENDPOINT": ep})
+                        "PADDLE_TPU_PS_PORT_FILE": pf,
+                        "PADDLE_TPU_PS_ENDPOINTS_FILE": eps_file})
             # SAME process group as this worker (no start_new_session):
             # if the orchestrator deadline-kills a wedged worker via
             # killpg, the pservers die with it instead of leaking as
@@ -616,6 +695,18 @@ def bench_deepfm_dist(amp, quick, uses_flash=False):
                 [sys.executable, os.path.abspath(__file__),
                  "--dist-ctr-pserver"],
                 env=env, stderr=sys.stderr))
+
+        # each pserver binds port 0 itself and reports the REAL endpoint
+        # back through its port file (no bind/close/reuse TOCTOU); the
+        # assembled list is published to every child atomically
+        endpoints = ",".join(
+            _wait_for_file(pf, timeout_s=120, procs=pservers)
+            for pf in port_files)
+        tmp = eps_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(endpoints)
+        os.replace(tmp, eps_file)
+        os.environ["PADDLE_PSERVER_ENDPOINTS"] = endpoints
 
         import paddle_tpu as fluid
         from paddle_tpu.core.scope import Scope, scope_guard
@@ -664,7 +755,7 @@ def bench_deepfm_dist(amp, quick, uses_flash=False):
             **({"quick": True} if quick else {}),
             "precision": "bf16_amp" if amp else "f32",
             "distributed": True,
-            "pservers": 2,
+            "pservers": n_ps,
             "value": round(batch * steps / dt, 1),
             "unit": "examples/sec",
             "vs_baseline": round(
@@ -682,6 +773,9 @@ def bench_deepfm_dist(amp, quick, uses_flash=False):
             if p.poll() is None:
                 p.kill()
                 p.wait()
+        import shutil
+
+        shutil.rmtree(rdv, ignore_errors=True)
 
 
 WORKLOADS = {
@@ -715,30 +809,50 @@ assert set(ORDER) == set(WORKLOADS), "ORDER out of sync with WORKLOADS"
 
 
 def _probe_backend(timeout_s=None):
-    """Fail fast (with a diagnosable JSON row) if jax backend init hangs —
-    a wedged TPU tunnel blocks inside a C call that no KeyboardInterrupt
-    reaches, so a watchdog thread + os._exit is the only way out."""
+    """Fail fast (with a diagnosable JSON row AND a telemetry sidecar) if
+    jax backend init hangs — a wedged TPU tunnel blocks inside a C call
+    that no KeyboardInterrupt reaches, so a watchdog thread + os._exit is
+    the only way out. The sidecar records the probe wall time + outcome,
+    so a post-mortem can distinguish "wedged for the full timeout" from
+    "failed instantly with a config error"."""
     import threading
+
+    from paddle_tpu.observe.families import (BACKEND_PROBE_OK,
+                                             BACKEND_PROBE_SECONDS)
 
     timeout_s = timeout_s or int(
         os.environ.get("PADDLE_TPU_BENCH_INIT_TIMEOUT", "300"))
-    ok = []
+    ok, err = [], []
 
     def probe():
-        import jax
+        try:
+            import jax
 
-        ok.append(str(jax.devices()))
+            ok.append(str(jax.devices()))
+        except BaseException as exc:  # noqa: BLE001 — report, don't hang
+            err.append("%s: %s" % (type(exc).__name__, exc))
 
+    t0 = time.perf_counter()
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    t.join(timeout_s)
+    # poll instead of one long join: an instant failure (bad platform
+    # string) must not burn the full wedge timeout
+    deadline = t0 + timeout_s
+    while t.is_alive() and time.perf_counter() < deadline:
+        t.join(0.25)
+    BACKEND_PROBE_SECONDS.set(time.perf_counter() - t0)
     if not ok:
+        BACKEND_PROBE_OK.set(0)
+        detail = err[0][:300] if err else (
+            "did not complete within %ds" % timeout_s)
         print(json.dumps({
             "metric": "backend_init",
-            "error": "jax backend init did not complete within %ds "
-                     "(TPU tunnel unreachable/wedged)" % timeout_s,
+            "error": "jax backend init failed: %s "
+                     "(TPU tunnel unreachable/wedged)" % detail,
         }), flush=True)
+        _dump_telemetry("probe")
         os._exit(1)
+    BACKEND_PROBE_OK.set(1)
 
 
 def _enable_compile_cache():
@@ -763,6 +877,8 @@ def _run_worker(name, amp, quick):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     _enable_compile_cache()
     _probe_backend()
+    from paddle_tpu.observe.families import BENCH_ROWS
+
     try:
         # single source of truth for "this row exercises the flash
         # kernel": the ATTENTION_WORKLOADS set + the fused-attention
@@ -784,10 +900,12 @@ def _run_worker(name, amp, quick):
                      "to the composed XLA path"
                      % (name, ATTENTION_SEQ[name]))
         WORKLOADS[name](amp, quick, uses_flash=uses_flash)
+        BENCH_ROWS.labels(status="ok").inc()
         return 0
     except Exception as exc:  # noqa: BLE001
         import traceback
 
+        BENCH_ROWS.labels(status="error").inc()
         tb = traceback.format_exc().strip().splitlines()
         print(json.dumps({
             "metric": name,
@@ -795,6 +913,11 @@ def _run_worker(name, amp, quick):
             "traceback_tail": " | ".join(tb[-3:])[:400],
         }), flush=True)
         return 1
+    finally:
+        # the sidecar rides along even when the row failed: it holds the
+        # executor cache state, RPC attempt counters and probe timings a
+        # post-mortem needs (the round-5 "tunnel wedged" gap)
+        _dump_telemetry(name)
 
 
 def _spawn_workload(name, args, timeout_s, extra_env=None):
@@ -896,6 +1019,7 @@ def main():
         import jax
 
         _log("probe ok: %s" % jax.devices())
+        _dump_telemetry("probe")
         return 0
 
     if args.worker:

@@ -37,6 +37,7 @@ from . import (  # noqa: F401
     layers,
     metrics,
     nets,
+    observe,
     optimizer,
     profiler,
     regularizer,

@@ -16,6 +16,7 @@ per-op dispatch, implicit data transform, and the eager-deletion GC.
 
 from __future__ import annotations
 
+import time
 import warnings
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -53,6 +54,9 @@ class _Plan:
         self.cost = None  # cost_analysis() result, filled on first request
         self.hlo_text = {}  # stage -> lowered_hlo() text (AOT compiles
         #                     can't reuse the jit cache; amortize them)
+        self.compiled_sigs = set()  # dispatch signatures already compiled:
+        #                    the first dispatch of each lands in the
+        #                    compile-time histogram, not the run histogram
 
 
 class Executor:
@@ -91,8 +95,11 @@ class Executor:
 
         plan, feeds, const_state, mut_state, rng = self._gather(
             program, feed, fetch_list, scope)
+        from ..observe import observe_feed_gap
         from ..profiler import RecordEvent, is_profiler_enabled
 
+        observe_feed_gap()
+        t0 = time.perf_counter()
         if is_profiler_enabled():
             # whole-step annotation: the analog of the per-op RecordEvent in
             # the reference's interpreter loop (operator.cc:180) — ops fuse
@@ -105,6 +112,8 @@ class Executor:
         else:
             fetches, new_mut, new_pure, new_rng = plan.fn(
                 feeds, const_state, mut_state, rng)
+        _record_dispatch(plan, "run", "run", 1,
+                         time.perf_counter() - t0)
 
         return self._finish(plan, scope, fetches, new_mut, new_pure,
                             new_rng, return_numpy, "")
@@ -197,8 +206,11 @@ class Executor:
                          donate_argnums=(2,))
             plan.multi[key] = fn
 
+        from ..observe import observe_feed_gap
         from ..profiler import RecordEvent, is_profiler_enabled
 
+        observe_feed_gap()
+        t0 = time.perf_counter()
         if is_profiler_enabled():
             with RecordEvent("executor_run_repeated[%d]" % steps):
                 fetches, new_mut, new_pure, new_rng = fn(
@@ -209,6 +221,8 @@ class Executor:
         else:
             fetches, new_mut, new_pure, new_rng = fn(
                 feeds, const_state, mut_state, rng)
+        _record_dispatch(plan, ("run_repeated",) + key, "run_repeated",
+                         steps, time.perf_counter() - t0)
         return self._finish(plan, scope, fetches, new_mut, new_pure,
                             new_rng, return_numpy,
                             " after %d scanned steps" % steps)
@@ -318,8 +332,18 @@ class Executor:
         key = self._cache_key(program, feed_vals, fetch_names)
         plan = self._cache.get(key)
         if plan is None:
+            from ..observe.families import (EXECUTOR_CACHE_MISSES,
+                                            EXECUTOR_PREPARE_SECONDS)
+
+            EXECUTOR_CACHE_MISSES.inc()
+            t0 = time.perf_counter()
             plan = self._prepare(program, feed_vals, fetch_names, scope)
+            EXECUTOR_PREPARE_SECONDS.observe(time.perf_counter() - t0)
             self._cache[key] = plan
+        else:
+            from ..observe.families import EXECUTOR_CACHE_HITS
+
+            EXECUTOR_CACHE_HITS.inc()
         const_state = [_require(scope, n) for n in plan.const_state]
         mut_state = [_require(scope, n) for n in plan.mut_state]
         rng = scope.find_var(RNG_VAR)
@@ -350,6 +374,24 @@ class Executor:
         fn = jax.jit(step, donate_argnums=(2,))
         return _Plan(feed_names, fetch_names, const_state, mut_state,
                      pure_written, needs_rng, fn, step=step)
+
+
+def _record_dispatch(plan, sig, site, steps, dt):
+    """Telemetry epilogue shared by run()/run_repeated(): count the steps
+    and route the wall time — a plan's FIRST dispatch per signature is
+    dominated by jax trace + XLA compile and lands in the compile
+    histogram; steady-state dispatches land in the run histogram (so a
+    recompile storm is visible as compile-histogram growth, not as a
+    mysteriously fat run tail)."""
+    from ..observe.families import (EXECUTOR_COMPILE_SECONDS,
+                                    EXECUTOR_RUN_SECONDS, EXECUTOR_STEPS)
+
+    EXECUTOR_STEPS.inc(steps)
+    if sig not in plan.compiled_sigs:
+        plan.compiled_sigs.add(sig)
+        EXECUTOR_COMPILE_SECONDS.observe(dt)
+    else:
+        EXECUTOR_RUN_SECONDS.labels(site=site).observe(dt)
 
 
 def validate_stacked_feeds(feed_names, feeds, steps):

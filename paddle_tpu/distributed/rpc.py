@@ -12,6 +12,7 @@ selected_rows.h:32).
 from __future__ import annotations
 
 import ctypes
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -19,9 +20,60 @@ import numpy as np
 from ..native import load
 from ..native.dtypes import CODE_OF_DTYPE as _DTYPES
 from ..native.dtypes import DTYPE_OF_CODE as _NP_OF_CODE
+from ..observe.families import (RPC_BYTES_RECV, RPC_BYTES_SENT, RPC_CALLS,
+                                RPC_DEADLINE_EXPIRATIONS, RPC_ERRORS,
+                                RPC_RETRIES, RPC_SECONDS,
+                                RPC_SERVER_REQUESTS)
 
 __all__ = ["RPCClient", "RPCServer", "RPCError", "SelectedRows",
            "parse_endpoint"]
+
+
+def _deadline_seconds() -> float:
+    """PADDLE_TPU_RPC_DEADLINE_MS, parsed exactly like the native
+    DeadlineMs(): junk or <=0 falls back to 60s."""
+    import os as _os
+
+    try:
+        ms = int(_os.environ.get("PADDLE_TPU_RPC_DEADLINE_MS", "60000"))
+    except ValueError:
+        ms = 60000
+    return (ms if ms > 0 else 60000) / 1000.0
+
+
+class _rpc_call:
+    """Per-method telemetry for one client call: call count on entry,
+    latency histogram on exit, error counter when the call raises
+    RPCError — plus the deadline-expiration counter when the failing
+    call actually burned the reconnect deadline (a fast failure, e.g.
+    get_var exhausting its retry COUNT against a live server, is an
+    error but not an expiration — the distinction a wedged-tunnel
+    post-mortem needs)."""
+
+    __slots__ = ("method", "_t0")
+
+    def __init__(self, method: str):
+        self.method = method
+
+    def __enter__(self):
+        RPC_CALLS.labels(method=self.method).inc()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        RPC_SECONDS.labels(method=self.method).observe(dt)
+        if exc_type is not None and issubclass(exc_type, RPCError):
+            RPC_ERRORS.labels(method=self.method).inc()
+            if dt >= _deadline_seconds():
+                RPC_DEADLINE_EXPIRATIONS.labels(method=self.method).inc()
+        return False
+
+
+def _payload_nbytes(value) -> int:
+    if isinstance(value, SelectedRows):
+        return int(value.values.nbytes + value.rows.nbytes)
+    return int(np.asarray(value).nbytes)
 
 
 class RPCError(RuntimeError):
@@ -196,6 +248,7 @@ class RPCServer:
         self._lib.ps_server_start(self._h)
 
     def set_var(self, name: str, value: np.ndarray):
+        RPC_SERVER_REQUESTS.labels(method="set_var").inc()
         value = _contig(value)
         code = _DTYPES[value.dtype]
         self._lib.ps_server_set_var(
@@ -219,11 +272,13 @@ class RPCServer:
     def wait_grads(self) -> List[Tuple[str, object, int]]:
         """Block until every active trainer send-barriered; return the
         cycle's received vars (dense ndarray or SelectedRows)."""
+        RPC_SERVER_REQUESTS.labels(method="wait_grads").inc()
         b = self._lib.ps_server_wait_grads(self._h)
         return _batch_read(self._lib, b)
 
     def serve(self):
         """Publish the store and open the GET window for this cycle."""
+        RPC_SERVER_REQUESTS.labels(method="serve").inc()
         self._lib.ps_server_serve(self._h)
 
     def pop_async(self, timeout_ms: int = 100):
@@ -265,77 +320,84 @@ class RPCClient:
         self._h = self._lib.ps_client_create(host.encode(), port, trainer_id)
 
     def connect(self, required: bool = True) -> bool:
-        ok = bool(self._lib.ps_client_connect(self._h))
-        if required and not ok:
-            raise RPCError("connect", self.endpoint)
-        return ok
+        with _rpc_call("connect"):
+            ok = bool(self._lib.ps_client_connect(self._h))
+            if required and not ok:
+                raise RPCError("connect", self.endpoint)
+            return ok
 
     def send_var(self, name: str, value) -> None:
-        if isinstance(value, SelectedRows):
-            rows, vals, height = value.rows, value.values, value.height
-            dims = (height if height >= 0 else len(rows),) + vals.shape[1:]
-            nrows = len(rows)
-            rows_ptr = rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
-        else:
-            vals = _contig(value)
-            dims, nrows, rows_ptr = vals.shape, -1, None
-        vals = _contig(vals)
-        ok = self._lib.ps_client_send_var(
-            self._h, name.encode(), _DTYPES[vals.dtype], len(dims),
-            _dims_ptr(dims), nrows, rows_ptr,
-            vals.ctypes.data_as(ctypes.c_void_p), vals.nbytes)
-        if not ok:
-            raise RPCError("send_var(%s)" % name, self.endpoint)
+        with _rpc_call("send_var"):
+            if isinstance(value, SelectedRows):
+                rows, vals, height = value.rows, value.values, value.height
+                dims = (height if height >= 0 else len(rows),) + vals.shape[1:]
+                nrows = len(rows)
+                rows_ptr = rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+            else:
+                vals = _contig(value)
+                dims, nrows, rows_ptr = vals.shape, -1, None
+            vals = _contig(vals)
+            ok = self._lib.ps_client_send_var(
+                self._h, name.encode(), _DTYPES[vals.dtype], len(dims),
+                _dims_ptr(dims), nrows, rows_ptr,
+                vals.ctypes.data_as(ctypes.c_void_p), vals.nbytes)
+            if not ok:
+                raise RPCError("send_var(%s)" % name, self.endpoint)
+            RPC_BYTES_SENT.inc(_payload_nbytes(value))
 
     def get_var(self, name: str, retries: int = 50) -> np.ndarray:
         # retry: in async mode a GET can race the trainer-0 init push.
         # The loop is bounded by BOTH a count and the RPC deadline —
         # against a DEAD peer each native call already burns the full
         # reconnect deadline, and 50 of those would stack to minutes.
-        import os as _os
-        import time
-
-        # parse exactly like the native DeadlineMs(): junk or <=0
-        # falls back to 60s, so the two transports never disagree
-        try:
-            ms = int(_os.environ.get("PADDLE_TPU_RPC_DEADLINE_MS", "60000"))
-        except ValueError:
-            ms = 60000
-        deadline_s = (ms if ms > 0 else 60000) / 1000.0
-        t0 = time.monotonic()
-        for attempt in range(max(retries, 1)):
-            b = self._lib.ps_client_get_var(self._h, name.encode())
-            if b:
-                return _batch_read(self._lib, b)[0][1]
-            if time.monotonic() - t0 > deadline_s:
-                break
-            time.sleep(0.1)
-        raise RPCError("get_var(%s)" % name, self.endpoint,
-                       "or the variable was never pushed (init race)")
+        # deadline parsed exactly like the native transport's, so the
+        # two never disagree (_deadline_seconds)
+        deadline_s = _deadline_seconds()
+        with _rpc_call("get_var"):
+            t0 = time.monotonic()
+            for attempt in range(max(retries, 1)):
+                if attempt:
+                    RPC_RETRIES.labels(method="get_var").inc()
+                b = self._lib.ps_client_get_var(self._h, name.encode())
+                if b:
+                    out = _batch_read(self._lib, b)[0][1]
+                    RPC_BYTES_RECV.inc(_payload_nbytes(out))
+                    return out
+                if time.monotonic() - t0 > deadline_s:
+                    break
+                time.sleep(0.1)
+            raise RPCError("get_var(%s)" % name, self.endpoint,
+                           "or the variable was never pushed (init race)")
 
     def prefetch(self, table: str, ids: np.ndarray) -> np.ndarray:
-        ids = np.ascontiguousarray(ids, dtype=np.int64).ravel()
-        b = self._lib.ps_client_prefetch(
-            self._h, table.encode(),
-            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(ids))
-        if not b:
-            raise RPCError("prefetch(%s)" % table, self.endpoint)
-        return _batch_read(self._lib, b)[0][1]
+        with _rpc_call("prefetch"):
+            ids = np.ascontiguousarray(ids, dtype=np.int64).ravel()
+            b = self._lib.ps_client_prefetch(
+                self._h, table.encode(),
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(ids))
+            if not b:
+                raise RPCError("prefetch(%s)" % table, self.endpoint)
+            out = _batch_read(self._lib, b)[0][1]
+            RPC_BYTES_RECV.inc(_payload_nbytes(out))
+            return out
 
     def send_barrier(self):
         # a failed barrier means the sync cycle is torn (this trainer's
         # grads were not applied) — silent continuation would train on
         # stale params, so it raises (reference: grpc_client.cc barrier
         # RPCs surface through FLAGS_rpc_deadline the same way)
-        if not self._lib.ps_client_send_barrier(self._h):
-            raise RPCError("send_barrier", self.endpoint)
+        with _rpc_call("send_barrier"):
+            if not self._lib.ps_client_send_barrier(self._h):
+                raise RPCError("send_barrier", self.endpoint)
 
     def fetch_barrier(self):
-        if not self._lib.ps_client_fetch_barrier(self._h):
-            raise RPCError("fetch_barrier", self.endpoint)
+        with _rpc_call("fetch_barrier"):
+            if not self._lib.ps_client_fetch_barrier(self._h):
+                raise RPCError("fetch_barrier", self.endpoint)
 
     def send_complete(self):
-        self._lib.ps_client_complete(self._h)
+        with _rpc_call("send_complete"):
+            self._lib.ps_client_complete(self._h)
 
     def checkpoint_notify(self, dirname: str):
         self._lib.ps_client_checkpoint(self._h, dirname.encode())

@@ -1,8 +1,8 @@
 """layers: user-facing op-builder API (reference: python/paddle/fluid/layers)."""
 
 from . import (control_flow, decode, detection, io, learning_rate_scheduler,
-               loss, metric_op, nn, ops, parallel_ext, rnn_blocks, sequence,
-               tensor)
+               loss, metric_op, nn, ops, parallel_ext, rnn_blocks,
+               scan_ext, sequence, tensor)
 from .control_flow import *  # noqa: F401,F403
 from .rnn_blocks import *  # noqa: F401,F403
 from .decode import *  # noqa: F401,F403
@@ -16,3 +16,4 @@ from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .parallel_ext import *  # noqa: F401,F403
+from .scan_ext import *  # noqa: F401,F403

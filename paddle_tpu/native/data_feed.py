@@ -66,6 +66,10 @@ class MultiSlotDataFeed:
             self._started = True
 
     def __iter__(self) -> Iterator[List[np.ndarray]]:
+        from ..observe import mark_batch_produced
+        from ..observe.families import DATA_BATCHES
+
+        batches = DATA_BATCHES.labels(source="datafeed")
         self.start()
         while True:
             b = self._lib.mdf_next_batch(self._h)
@@ -82,6 +86,8 @@ class MultiSlotDataFeed:
                 arr = np.ctypeslib.as_array(buf).reshape(rows, s.width).copy()
                 out.append(arr)
             self._lib.mdf_batch_free(b)
+            batches.inc()
+            mark_batch_produced()
             yield out
 
     def feed_dict(self) -> Iterator[dict]:

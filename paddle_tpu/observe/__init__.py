@@ -1,0 +1,58 @@
+"""observe: process-wide runtime telemetry (metrics registry + spans).
+
+The observability layer SURVEY §5's host-profiler only half covers:
+``profiler.py`` answers "where did the time go" during an explicitly
+started profiling session; this package answers "what has the process
+done so far" at ANY moment — counters/gauges/histograms every hot
+subsystem updates unconditionally, plus span tracing that composes
+with ``profiler.RecordEvent`` so spans land in the same chrome-trace
+timeline when a session IS active.
+
+    from paddle_tpu import observe
+
+    observe.snapshot()            # JSON-able dict of every metric
+    observe.render_prometheus()   # text exposition format
+    observe.dump(path)            # atomic JSON snapshot to disk
+
+    C = observe.counter("my_events_total", "what it counts")
+    C.inc()
+    with observe.span("my_phase"):
+        ...                       # timed + chrome-traced
+
+`tools/stats_dump.py` pretty-prints a live or saved snapshot; bench.py
+drops a ``BENCH_<workload>.telemetry.json`` sidecar per row (including
+failed ones) built from these snapshots. See docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+from . import families  # noqa: F401  (declares the well-known families)
+from .families import REGISTRY
+from .metrics import (Counter, DEFAULT_BUCKETS, Family, Gauge,  # noqa: F401
+                      Histogram, Registry)
+from .spans import (Span, mark_batch_produced,  # noqa: F401
+                    observe_feed_gap, span)
+
+__all__ = ["REGISTRY", "counter", "gauge", "histogram", "get_metric",
+           "snapshot", "render_prometheus", "dump", "reset",
+           "span", "Span", "mark_batch_produced", "observe_feed_gap",
+           "Counter", "Gauge", "Histogram", "Family", "Registry",
+           "DEFAULT_BUCKETS"]
+
+# module-level facade over the process-wide registry
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+get_metric = REGISTRY.get
+snapshot = REGISTRY.snapshot
+render_prometheus = REGISTRY.render_prometheus
+dump = REGISTRY.dump
+
+
+def reset():
+    """Zero every metric AND the cross-subsystem span state (the pending
+    feed-to-run stamp) — full test isolation, not a runtime operation."""
+    from . import spans as _spans
+
+    REGISTRY.reset()
+    _spans._last_batch_ts = None

@@ -1,0 +1,115 @@
+"""Well-known metric families for the runtime's hot subsystems.
+
+Declared HERE (not in the subsystems) so that importing
+``paddle_tpu.observe`` alone materializes every family with zeroed
+default children: a telemetry sidecar written by a process that died
+before reaching the executor (e.g. the bench backend probe wedging on
+the TPU tunnel) still carries the full executor/RPC schema — the
+diagnosis is "0 cache misses, 0 RPC calls, probe took 300s", not an
+absent file. Subsystems import their families from here and only ever
+increment/observe.
+"""
+
+from __future__ import annotations
+
+from .metrics import Registry
+
+__all__ = ["REGISTRY"]
+
+REGISTRY = Registry()
+
+# ------------------------------------------------------------- executor
+EXECUTOR_CACHE_HITS = REGISTRY.counter(
+    "paddle_executor_cache_hits_total",
+    "Plan-cache hits in Executor._gather (program+feed-signature key)")
+EXECUTOR_CACHE_MISSES = REGISTRY.counter(
+    "paddle_executor_cache_misses_total",
+    "Plan-cache misses (each one costs an analyze_block + jit wrap)")
+EXECUTOR_STEPS = REGISTRY.counter(
+    "paddle_executor_steps_total",
+    "Train/eval steps executed (run_repeated counts all K scanned steps)")
+EXECUTOR_PREPARE_SECONDS = REGISTRY.histogram(
+    "paddle_executor_prepare_seconds",
+    "Wall time of Executor._prepare (block analysis + step trace wrap)")
+EXECUTOR_COMPILE_SECONDS = REGISTRY.histogram(
+    "paddle_executor_compile_seconds",
+    "Wall time of the FIRST dispatch of a plan (jax trace + XLA compile "
+    "+ one step); later dispatches land in paddle_executor_run_seconds")
+EXECUTOR_RUN_SECONDS = REGISTRY.histogram(
+    "paddle_executor_run_seconds",
+    "Wall time of one compiled-step dispatch (host-observed; includes "
+    "device sync only when the caller blocks)", labels=("site",))
+FEED_TO_RUN_GAP_SECONDS = REGISTRY.histogram(
+    "paddle_feed_to_run_gap_seconds",
+    "Gap between the input pipeline producing a batch and the next "
+    "executor dispatch starting — input-bound vs compute-bound signal")
+
+# ------------------------------------------------------------------ rpc
+RPC_CALLS = REGISTRY.counter(
+    "paddle_rpc_client_calls_total",
+    "RPCClient calls by method", labels=("method",))
+RPC_ERRORS = REGISTRY.counter(
+    "paddle_rpc_client_errors_total",
+    "RPCClient calls that raised RPCError", labels=("method",))
+RPC_RETRIES = REGISTRY.counter(
+    "paddle_rpc_client_retries_total",
+    "Extra attempts beyond the first (get_var init-race polling)",
+    labels=("method",))
+RPC_DEADLINE_EXPIRATIONS = REGISTRY.counter(
+    "paddle_rpc_client_deadline_expirations_total",
+    "Calls that exhausted PADDLE_TPU_RPC_DEADLINE_MS", labels=("method",))
+RPC_BYTES_SENT = REGISTRY.counter(
+    "paddle_rpc_client_bytes_sent_total",
+    "Payload bytes pushed through ps_client_send_var")
+RPC_BYTES_RECV = REGISTRY.counter(
+    "paddle_rpc_client_bytes_recv_total",
+    "Payload bytes decoded from get_var/prefetch responses")
+RPC_SECONDS = REGISTRY.histogram(
+    "paddle_rpc_client_seconds",
+    "RPCClient call latency by method", labels=("method",))
+RPC_SERVER_REQUESTS = REGISTRY.counter(
+    "paddle_rpc_server_requests_total",
+    "RPCServer-side operations", labels=("method",))
+
+_RPC_METHODS = ("connect", "send_var", "get_var", "prefetch",
+                "send_barrier", "fetch_barrier", "send_complete")
+for _m in _RPC_METHODS:
+    # pre-materialize the per-method series: a snapshot taken before any
+    # RPC ran still shows every method at 0 (the schema IS the signal)
+    RPC_CALLS.labels(method=_m)
+    RPC_SECONDS.labels(method=_m)
+    RPC_ERRORS.labels(method=_m)
+
+# --------------------------------------------------------------- engine
+ENGINE_DISPATCHES = REGISTRY.counter(
+    "paddle_engine_dispatches_total",
+    "ParallelEngine compiled-step dispatches", labels=("site",))
+ENGINE_RUN_SECONDS = REGISTRY.histogram(
+    "paddle_engine_run_seconds",
+    "ParallelEngine dispatch wall time (placement + compiled step)",
+    labels=("site",))
+ENGINE_COLLECTIVES = REGISTRY.counter(
+    "paddle_engine_collectives_total",
+    "Explicit collectives EMITTED AT TRACE TIME by op lowerings "
+    "(ppermute/all_to_all/...); per compile, not per step",
+    labels=("kind",))
+ENGINE_DEVICES = REGISTRY.gauge(
+    "paddle_engine_device_count", "Mesh size of the last-built engine")
+
+# ----------------------------------------------------------------- data
+DATA_BATCHES = REGISTRY.counter(
+    "paddle_data_batches_total",
+    "Batches produced by the input pipelines", labels=("source",))
+for _s in ("reader.batch", "datafeed"):
+    DATA_BATCHES.labels(source=_s)
+
+# -------------------------------------------------------- backend/bench
+BACKEND_PROBE_SECONDS = REGISTRY.gauge(
+    "paddle_backend_probe_seconds",
+    "Wall time of the last jax backend-init probe (bench.py)")
+BACKEND_PROBE_OK = REGISTRY.gauge(
+    "paddle_backend_probe_ok",
+    "1 if the last backend probe completed, 0 if it timed out")
+BENCH_ROWS = REGISTRY.counter(
+    "paddle_bench_rows_total",
+    "Bench rows emitted by outcome", labels=("status",))

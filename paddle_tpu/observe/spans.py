@@ -1,0 +1,89 @@
+"""Step spans: timed scopes that feed BOTH the metrics registry and the
+profiler's chrome-trace timeline.
+
+A ``span`` is the composition the ISSUE prescribes: entering one starts
+a ``profiler.RecordEvent`` (so when the profiler is on, the span lands
+in the same aggregated event table and chrome://tracing JSON as every
+other host annotation) and, on exit, ALWAYS records the elapsed time
+into a histogram — metrics accumulate whether or not a profiling
+session is active. Instrumented call sites therefore never need two
+wrappers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .families import REGISTRY
+
+__all__ = ["Span", "span", "mark_batch_produced", "observe_feed_gap"]
+
+SPAN_SECONDS = REGISTRY.histogram(
+    "paddle_span_seconds",
+    "Generic named-span latency (spans without a dedicated histogram)",
+    labels=("span",))
+
+
+class Span:
+    """Context manager: chrome-trace annotation + latency histogram.
+
+    ``histogram``: a Histogram child/family to record into (defaults to
+    the generic ``paddle_span_seconds{span=<name>}`` series).
+    ``counter``: optional Counter child/family inc'd once per exit.
+    """
+
+    __slots__ = ("name", "_hist", "_counter", "_t0", "_rec")
+
+    def __init__(self, name: str, histogram=None, counter=None):
+        self.name = name
+        self._hist = histogram
+        self._counter = counter
+        self._t0 = None
+        self._rec = None
+
+    def __enter__(self):
+        from ..profiler import RecordEvent
+
+        self._rec = RecordEvent(self.name)
+        self._rec.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self._rec.__exit__(*exc)
+        self._rec = None
+        hist = self._hist if self._hist is not None \
+            else SPAN_SECONDS.labels(span=self.name)
+        hist.observe(dt)
+        if self._counter is not None:
+            self._counter.inc()
+        return False
+
+
+def span(name: str, histogram=None, counter=None) -> Span:
+    return Span(name, histogram=histogram, counter=counter)
+
+
+# ------------------------------------------------------- feed-to-run gap
+# The input pipeline stamps "a batch is ready" (mark_batch_produced, from
+# reader.batch / MultiSlotDataFeed); the executor reads-and-clears the
+# stamp at dispatch entry (observe_feed_gap). The observed gap separates
+# input-bound from compute-bound steady states without a profiler run.
+_last_batch_ts: Optional[float] = None
+
+from .families import FEED_TO_RUN_GAP_SECONDS  # noqa: E402
+
+
+def mark_batch_produced() -> None:
+    global _last_batch_ts
+    _last_batch_ts = time.perf_counter()
+
+
+def observe_feed_gap() -> None:
+    global _last_batch_ts
+    ts = _last_batch_ts
+    if ts is not None:
+        _last_batch_ts = None
+        FEED_TO_RUN_GAP_SECONDS.observe(time.perf_counter() - ts)

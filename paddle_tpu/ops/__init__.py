@@ -24,6 +24,7 @@ from . import (  # noqa: F401
     rnn,
     optimizer_ops,
     pipeline_ops,
+    scan_ops,
     sequence,
     tensor_ops,
 )

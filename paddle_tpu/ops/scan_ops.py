@@ -1,0 +1,125 @@
+"""scan_layers op: N identical layers compiled as ONE lax.scan body.
+
+Lowering for the graph op `layers/scan_ext.py` builds (the layer stacks
+its per-layer parameters as [n_layers, *shape]; see that module for the
+compile-time rationale). Mirrors ops/pipeline_ops.py's shape:
+
+* forward — ``lax.scan`` over the stacked parameter slices, carrying the
+  activation; captured outer tensors (attention bias, positions, ...)
+  close over the body and broadcast into every iteration.
+* stochastic bodies — draw ONE base key in the forward, fold in the
+  layer index per iteration, and export the base key through the
+  ``RngKey`` output; the custom grad replays it so the backward re-trace
+  reproduces every dropout mask bit-for-bit (the recompute_ops pattern).
+* ``remat=True`` — the per-layer body runs under ``jax.checkpoint``:
+  scan+remat, the standard O(1)-layers activation profile.
+* gradients — jax transposes the scan into the reverse-order backward
+  scan; the custom grad exists to replay the key and to route cotangents
+  back to X / StackedParams / float Captured inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_grad_lowering, register_op
+
+__all__: List[str] = []
+
+
+def _apply_scan(ctx, x, stacked, captured, attrs, base_key):
+    """Forward computation shared by the op lowering and its grad replay.
+    ``base_key`` is None for deterministic bodies; otherwise the drawn
+    (forward) or replayed (backward) base key."""
+    from ..core.lowering import LowerContext, lower_ops
+
+    n = int(attrs["n_layers"])
+    sub = ctx.block.program.block(attrs["sub_block"])
+    slice_names = list(attrs["slice_names"])
+    captured_names = list(attrs["captured_names"])
+    in_name, out_name = attrs["in_name"], attrs["out_name"]
+
+    def layer(x_c, slices, key):
+        env: Dict[str, Any] = dict(zip(slice_names, slices))
+        env.update(zip(captured_names, captured))
+        env[in_name] = x_c
+        sctx = LowerContext(sub, key, ctx.is_test, ctx.amp, ctx.mesh,
+                            ctx.data_axis, ctx.model_axis, ctx.seq_axis)
+        lower_ops(sctx, sub.ops, env)
+        return env[out_name]
+
+    if attrs.get("remat"):
+        layer = jax.checkpoint(layer)
+
+    def body(carry, xs):
+        i, slices = xs
+        key = jax.random.fold_in(base_key, i) if base_key is not None \
+            else None
+        return layer(carry, list(slices), key), None
+
+    out, _ = jax.lax.scan(body, x, (jnp.arange(n), tuple(stacked)))
+    return out
+
+
+@register_op("scan_layers", diff_inputs=["X", "StackedParams", "Captured"],
+             needs_env=False, uses_rng=True)
+def _scan_layers(ctx, ins, attrs):
+    x = ins["X"][0]
+    stacked = list(ins["StackedParams"])
+    captured = list(ins.get("Captured") or [])
+    if attrs.get("uses_rng"):
+        if ctx.is_test or attrs.get("is_test", False):
+            base_key = jax.random.PRNGKey(0)  # dropout is identity in test
+        else:
+            # next_rng() raises in pure contexts BY DESIGN: a generic-vjp
+            # re-trace must never silently draw different masks than the
+            # forward — this op's own grad replays the RngKey output
+            base_key = ctx.next_rng()
+    else:
+        base_key = None
+    out = _apply_scan(ctx, x, stacked, captured, attrs, base_key)
+    res = {"Out": [out]}
+    if attrs.get("uses_rng"):
+        res["RngKey"] = [jax.random.key_data(base_key)]
+    return res
+
+
+@register_grad_lowering("scan_layers")
+def _scan_layers_grad(ctx, ins, attrs):
+    """vjp over the forward with the SAME base key (replayed from the
+    RngKey output), exactly as pipeline/recompute grads replay theirs."""
+    x = ins["X"][0]
+    stacked = list(ins["StackedParams"])
+    captured = list(ins.get("Captured") or [])
+    base_key = None
+    if attrs.get("uses_rng"):
+        base_key = jax.random.wrap_key_data(ins["RngKey"][0])
+
+    # only float captured tensors can carry cotangents (segment ids,
+    # position ids etc. are ints): vjp over the float subset, None for
+    # the rest (append_backward already skipped them via diff_inputs)
+    fidx = [i for i, v in enumerate(captured)
+            if v is not None
+            and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)]
+
+    def f(xi, ps, fcs):
+        cs = list(captured)
+        for j, i in enumerate(fidx):
+            cs[i] = fcs[j]
+        return _apply_scan(ctx, xi, ps, cs, attrs, base_key)
+
+    primal, vjp = jax.vjp(f, x, stacked, [captured[i] for i in fidx])
+    g = (ins.get("Out@GRAD") or [None])[0]
+    if g is None:
+        g = jnp.zeros_like(primal)
+    elif g.dtype != primal.dtype or g.shape != primal.shape:
+        g = jnp.broadcast_to(g.astype(primal.dtype), primal.shape)
+    dx, dps, dfcs = vjp(g)
+    cgrads: List[Any] = [None] * len(captured)
+    for j, i in enumerate(fidx):
+        cgrads[i] = dfcs[j]
+    return {"X@GRAD": [dx], "StackedParams@GRAD": list(dps),
+            "Captured@GRAD": cgrads}

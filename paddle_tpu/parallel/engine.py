@@ -16,6 +16,7 @@ annotations and the XLA SPMD partitioner + runtime replace all of it:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -78,6 +79,9 @@ class ParallelEngine:
         self.mesh = mesh
         self.rules = rules or ShardingRules()
         self._cache: Dict[Tuple, _ParallelPlan] = {}
+        from ..observe.families import ENGINE_DEVICES
+
+        ENGINE_DEVICES.set(self.device_count)
 
     @property
     def device_count(self) -> int:
@@ -93,7 +97,7 @@ class ParallelEngine:
                              [plan.feed_shardings[n]
                               for n in plan.feed_names],
                              feeds, const_state, mut_state, rng, scope,
-                             return_numpy, "", "engine_run")
+                             return_numpy, "", "engine_run", steps=1)
 
     def run_repeated(self, feed, fetch_list, scope: Optional[Scope] = None,
                      steps: int = 1, return_numpy: bool = True,
@@ -127,7 +131,8 @@ class ParallelEngine:
         return self._execute(plan, fn, feed_in, feeds, const_state,
                              mut_state, rng, scope, return_numpy,
                              " after %d scanned steps" % steps,
-                             "engine_run_repeated[%d]" % steps)
+                             "engine_run_repeated[%d]" % steps,
+                             steps=steps)
 
     def _multi_fn(self, plan, steps, feed_stacked,
                   reduce_fetches="last"):
@@ -175,12 +180,22 @@ class ParallelEngine:
         return fn, feed_in
 
     def _execute(self, plan, fn, feed_shardings, feeds, const_state,
-                 mut_state, rng, scope, return_numpy, nan_suffix, event):
+                 mut_state, rng, scope, return_numpy, nan_suffix, event,
+                 steps=1):
         """Place inputs per their shardings (feeds split over the data
         axis, state per its spec), run one compiled dispatch, write the
         new state back to the scope. The epilogue (state write-back,
         numpy conversion, FLAGS_check_nan_inf) is the Executor's — the
         mesh path must not lose the NaN tripwire the plain path has."""
+        from ..observe import observe_feed_gap
+        from ..observe.families import (ENGINE_DISPATCHES,
+                                        ENGINE_RUN_SECONDS, EXECUTOR_STEPS)
+
+        observe_feed_gap()
+        site = "run_repeated" if steps > 1 else "run"
+        ENGINE_DISPATCHES.labels(site=site).inc()
+        EXECUTOR_STEPS.inc(steps)
+        t_dispatch = time.perf_counter()
         feeds = [jax.device_put(v, s)
                  for v, s in zip(feeds, feed_shardings)]
         const_state = [
@@ -205,6 +220,8 @@ class ParallelEngine:
         else:
             fetches, new_mut, new_pure, new_rng = fn(
                 feeds, const_state, mut_state, rng)
+        ENGINE_RUN_SECONDS.labels(site=site).observe(
+            time.perf_counter() - t_dispatch)
         return Executor._finish(plan, scope, fetches, new_mut, new_pure,
                                 new_rng, return_numpy, nan_suffix)
 

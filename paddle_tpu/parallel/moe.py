@@ -92,6 +92,9 @@ def moe_apply(expert_params, gate_w, x, axis_name, capacity=None,
 
     Returns ([T, D] outputs, aux_loss scalar).
     """
+    from ..observe.families import ENGINE_COLLECTIVES
+
+    ENGINE_COLLECTIVES.labels(kind="all_to_all").inc()  # per trace
     E = int(lax.psum(1, axis_name))
     T, D = x.shape
     capacity = int(capacity or -(-2 * T * top_k // E))

@@ -55,6 +55,9 @@ def pipeline_apply(stage_fn, stage_params, x_mb, axis_name, mb_arg=False):
     Returns [M, mb, ...] outputs, broadcast to every device on the axis
     (so the caller can compute the loss anywhere).
     """
+    from ..observe.families import ENGINE_COLLECTIVES
+
+    ENGINE_COLLECTIVES.labels(kind="ppermute").inc()  # per trace, not step
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     local_params = jax.tree.map(lambda p: p[0], stage_params)

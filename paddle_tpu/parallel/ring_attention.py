@@ -73,6 +73,8 @@ def ring_attention(q, k, v, scale: float, axis_name: str,
     travels with the K/V blocks (e.g. padding mask). causal=True applies
     the global lower-triangular mask using ring positions.
 
+    (Telemetry: counts one `ring_ppermute` collective per trace.)
+
     seg: [B,Sl] packed segment ids sharded like the sequence (local
     shard; 0 = padding) — enables PACKED training (multiple documents
     per row, reader.pack_sequences layout) under sp: the local ids are
@@ -99,6 +101,9 @@ def ring_attention(q, k, v, scale: float, axis_name: str,
     """
     if schedule not in ("auto", "contiguous", "zigzag"):
         raise ValueError("schedule must be auto|contiguous|zigzag")
+    from ..observe.families import ENGINE_COLLECTIVES
+
+    ENGINE_COLLECTIVES.labels(kind="ring_ppermute").inc()  # per trace
     n_static = int(lax.psum(1, axis_name))
     want_zigzag = (schedule == "zigzag"
                    or (schedule == "auto" and causal))

@@ -61,9 +61,18 @@ def pack_sequences(seqs, seq_len, n_rows=None):
 
     if rows and not rows[-1]:
         # drop the trailing empty row (always present when the last doc
-        # exactly filled its row — and the ONLY row when seqs was empty:
-        # an all-padding [1, S] batch would silently train on pure pad)
+        # exactly filled its row)
         rows.pop(), segs.pop(), poss.pop()
+    if not rows:
+        # empty input (no documents, or all documents empty) must be an
+        # explicit error: silently returning a 0-row batch — or, with
+        # n_rows set, an ALL-PADDING batch padded back up to n_rows —
+        # would train on pure pad (segment id 0 everywhere)
+        raise ValueError(
+            "pack_sequences: no tokens to pack (%s) — an empty pack "
+            "cannot form a training batch"
+            % ("empty sequence iterable" if n_seqs == 0
+               else "all %d documents are empty" % n_seqs))
     B = len(rows)
     if n_rows is not None:
         if B > n_rows:
@@ -115,13 +124,21 @@ def stack_feed_window(feed_dicts):
 
 def batch(reader, batch_size, drop_last=False):
     def batch_reader():
+        from ..observe import mark_batch_produced
+        from ..observe.families import DATA_BATCHES
+
+        batches = DATA_BATCHES.labels(source="reader.batch")
         buf = []
         for sample in reader():
             buf.append(sample)
             if len(buf) == batch_size:
+                batches.inc()
+                mark_batch_produced()
                 yield buf
                 buf = []
         if buf and not drop_last:
+            batches.inc()
+            mark_batch_produced()
             yield buf
 
     return batch_reader

@@ -7,19 +7,28 @@ subprocess per workload and relays its JSON rows. On the CPU backend the
 worker re-asserts JAX_PLATFORMS over the axon sitecustomize.
 """
 
+import atexit
 import json
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 
 import pytest
 
 BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
+# one scratch dir for the module's telemetry sidecars (not the repo
+# root), reclaimed at interpreter exit
+_TEL_DIR = tempfile.mkdtemp(prefix="bench_tel_")
+atexit.register(shutil.rmtree, _TEL_DIR, ignore_errors=True)
+
 
 def _run(args, env_extra, timeout):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PADDLE_TPU_TELEMETRY_DIR", _TEL_DIR)
     env.pop("XLA_FLAGS", None)  # 1-device CPU is fine and compiles faster
     # a developer shell's flash/bench knobs must not leak into the
     # subprocess and flip the pallas_mode/fused-path assertions
@@ -111,8 +120,15 @@ def test_bench_deepfm_dist_row(tmp_path):
     assert row["metric"] == "deepfm_dist_train_examples_per_sec_per_chip"
     assert row["value"] > 0
     assert row.get("quick") is True  # smoke rows must carry the marker
-    # the docstring's "no orphan pservers" is enforced, not aspirational
-    ps = subprocess.run(["ps", "ax"], stdout=subprocess.PIPE, text=True)
+    # the docstring's "no orphan pservers" is enforced, not aspirational —
+    # scoped to THIS test's process tree: the worker is spawned without
+    # start_new_session, so it and its pserver children share our process
+    # group, while a concurrent CI run's pservers do not (a system-wide
+    # `ps ax | grep` false-positived under parallel runs)
+    pgid = str(os.getpgid(0))
+    ps = subprocess.run(["ps", "-eo", "pgid,args"],
+                        stdout=subprocess.PIPE, text=True)
     leaked = [l for l in ps.stdout.splitlines()
-              if "--dist-ctr-pserver" in l]
+              if "--dist-ctr-pserver" in l
+              and l.split(None, 1)[0] == pgid]
     assert not leaked, leaked

@@ -1,0 +1,397 @@
+"""Telemetry-layer tests (observe/): registry semantics under threads,
+label families, snapshot/prometheus round-trip, executor cache metrics,
+RPC retry/deadline counters via the in-process RPC harness, span/profiler
+composition, and the bench telemetry sidecar + stats_dump CLI."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observe
+
+ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+BENCH = os.path.join(ROOT, "bench.py")
+STATS_DUMP = os.path.join(ROOT, "tools", "stats_dump.py")
+
+
+# --------------------------------------------------------------- registry
+def test_counter_gauge_histogram_under_threads():
+    reg = observe.Registry()
+    c = reg.counter("t_c_total", "threaded counter")
+    g = reg.gauge("t_g", "threaded gauge")
+    h = reg.histogram("t_h_seconds", "threaded histogram")
+    N, T = 1000, 8
+
+    def work():
+        for i in range(N):
+            c.inc()
+            g.inc()
+            h.observe(i * 1e-3)
+
+    ts = [threading.Thread(target=work) for _ in range(T)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # exact totals: increments are lock-protected, no lost updates
+    assert c.value == N * T
+    assert g.value == N * T
+    assert h.labels().count == N * T
+    assert abs(h.labels().sum - T * sum(i * 1e-3 for i in range(N))) < 1e-6
+
+    with pytest.raises(ValueError):
+        c.labels().inc(-1)  # counters only go up
+
+
+def test_label_families():
+    reg = observe.Registry()
+    f = reg.counter("t_reqs_total", "labeled", labels=("method", "code"))
+    f.labels(method="get", code="200").inc()
+    f.labels("get", "500").inc(2)
+    f.labels(method="get", code="200").inc()  # same child again
+    with pytest.raises(ValueError):
+        f.labels(method="get")  # missing label
+    with pytest.raises(ValueError):
+        f.labels(method="get", code="1", extra="x")  # unknown label
+    with pytest.raises(ValueError):
+        reg.counter("t_reqs_total", "", labels=("other",))  # schema clash
+    with pytest.raises(ValueError):
+        reg.gauge("t_reqs_total")  # kind clash
+    # idempotent re-declaration returns the same family
+    assert reg.counter("t_reqs_total", labels=("method", "code")) is f
+
+    got = {tuple(sorted(s["labels"].items())): s["value"]
+           for s in reg.snapshot()["metrics"]["t_reqs_total"]["samples"]}
+    assert got == {
+        (("code", "200"), ("method", "get")): 2.0,
+        (("code", "500"), ("method", "get")): 2.0,
+    }
+
+
+def test_histogram_fixed_buckets_cumulative():
+    reg = observe.Registry()
+    h = reg.histogram("t_lat", "", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    b = dict(h.labels().cumulative_buckets())
+    assert b == {"0.1": 1, "1": 2, "10": 3, "+Inf": 4}
+    assert h.labels().count == 4
+    # default buckets are the fixed 1-2-5 log-scale ladder
+    assert observe.DEFAULT_BUCKETS[0] == 1e-6
+    assert len(observe.DEFAULT_BUCKETS) == 30
+
+
+def test_histogram_bucket_redeclare_mismatch_raises():
+    reg = observe.Registry()
+    reg.histogram("t_b", "", buckets=(0.1, 1.0))
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("t_b", "", buckets=(10.0, 100.0))
+    # same (or unspecified) buckets re-declare fine
+    reg.histogram("t_b", "", buckets=(1.0, 0.1))
+    reg.histogram("t_b")
+
+
+def test_registry_reset_zeroes_but_keeps_schema():
+    reg = observe.Registry()
+    f = reg.counter("t_r_total", labels=("k",))
+    f.labels(k="a").inc(5)
+    reg.reset()
+    samples = reg.snapshot()["metrics"]["t_r_total"]["samples"]
+    assert samples == [{"labels": {"k": "a"}, "value": 0.0}]
+
+
+# ---------------------------------------------------- exposition format
+_EXPO_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                    # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'    # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})?'  # more labels
+    r' (?P<value>\S+)$')
+
+
+def _assert_valid_exposition(text):
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        m = _EXPO_LINE.match(line)
+        assert m, "invalid exposition line: %r" % line
+        v = m.group("value")
+        if v not in ("+Inf", "-Inf", "NaN"):
+            float(v)  # raises on junk
+
+
+def test_prometheus_exposition_parses_line_by_line():
+    # exercise every metric kind, labels, and escaping in one registry
+    reg = observe.Registry()
+    reg.counter("t_e_total", "with \"quotes\" and \\slash",
+                labels=("k",)).labels(k='va"l\\ue').inc()
+    reg.gauge("t_e_g", "gauge").set(-2.5)
+    reg.histogram("t_e_h", "hist").observe(0.5)
+    _assert_valid_exposition(reg.render_prometheus())
+    # the process-wide registry (executor/RPC instrumentation included)
+    _assert_valid_exposition(observe.render_prometheus())
+
+
+def test_snapshot_prometheus_round_trip(tmp_path):
+    path = str(tmp_path / "snap.json")
+    live = observe.dump(path)
+    with open(path) as f:
+        saved = json.load(f)
+    # a saved snapshot renders exactly like the live registry it captured
+    assert observe.render_prometheus(saved) == \
+        observe.render_prometheus(live)
+    _assert_valid_exposition(observe.render_prometheus(saved))
+    # the well-known executor + RPC families are always present and
+    # non-empty, even in a process that never ran a step (the sidecar-
+    # on-probe-failure contract)
+    for fam in ("paddle_executor_cache_misses_total",
+                "paddle_executor_steps_total",
+                "paddle_rpc_client_calls_total",
+                "paddle_rpc_client_seconds"):
+        assert saved["metrics"][fam]["samples"], fam
+
+
+# ------------------------------------------------- executor integration
+def _value(name, **labels):
+    for s in observe.snapshot()["metrics"][name]["samples"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s.get("value", s.get("count"))
+    return 0.0
+
+
+def test_executor_cache_and_step_metrics(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+
+    h0 = _value("paddle_executor_cache_hits_total")
+    m0 = _value("paddle_executor_cache_misses_total")
+    s0 = _value("paddle_executor_steps_total")
+    X = np.ones((3, 4), np.float32)
+    for _ in range(3):
+        exe.run(main, feed={"x": X}, fetch_list=[y.name], scope=scope)
+    assert _value("paddle_executor_cache_misses_total") == m0 + 1
+    assert _value("paddle_executor_cache_hits_total") == h0 + 2
+    assert _value("paddle_executor_steps_total") == s0 + 3
+    # first dispatch lands in the compile histogram, the rest in run
+    assert _value("paddle_executor_run_seconds", site="run") >= 2
+
+
+def test_run_repeated_counts_all_scanned_steps(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    s0 = _value("paddle_executor_steps_total")
+    exe.run_repeated(main, feed={"x": np.ones((3, 2), np.float32)},
+                     fetch_list=[y.name], scope=scope, steps=4)
+    assert _value("paddle_executor_steps_total") == s0 + 4
+
+
+# ------------------------------------------------------ RPC integration
+def test_rpc_call_and_bytes_metrics():
+    from paddle_tpu.distributed.rpc import RPCClient, RPCServer
+
+    srv = RPCServer(port=0, num_trainers=1, sync=False)
+    srv.start()
+    c0 = _value("paddle_rpc_client_calls_total", method="send_var")
+    b0 = _value("paddle_rpc_client_bytes_sent_total")
+    r0 = _value("paddle_rpc_client_bytes_recv_total")
+    cli = RPCClient("127.0.0.1:%d" % srv.port, trainer_id=0)
+    cli.connect()
+    payload = np.arange(12, dtype=np.float32).reshape(3, 4)
+    cli.send_var("g", payload)
+    srv.set_var("w", payload)
+    got = cli.get_var("w")
+    assert np.array_equal(got, payload)
+    cli.close()
+    srv.close()
+    assert _value("paddle_rpc_client_calls_total",
+                  method="send_var") == c0 + 1
+    assert _value("paddle_rpc_client_bytes_sent_total") == \
+        b0 + payload.nbytes
+    assert _value("paddle_rpc_client_bytes_recv_total") == \
+        r0 + payload.nbytes
+    assert _value("paddle_rpc_client_seconds", method="get_var") >= 1
+    assert _value("paddle_rpc_server_requests_total", method="set_var") >= 1
+
+
+def test_rpc_retry_and_deadline_counters(monkeypatch):
+    from paddle_tpu.distributed.rpc import RPCClient, RPCError, RPCServer
+
+    # short deadline so the missing-var poll loop expires in ~0.4s
+    monkeypatch.setenv("PADDLE_TPU_RPC_DEADLINE_MS", "400")
+    srv = RPCServer(port=0, num_trainers=1, sync=False)
+    srv.start()
+    cli = RPCClient("127.0.0.1:%d" % srv.port, trainer_id=0)
+    cli.connect()
+    e0 = _value("paddle_rpc_client_errors_total", method="get_var")
+    d0 = _value("paddle_rpc_client_deadline_expirations_total",
+                method="get_var")
+    r0 = _value("paddle_rpc_client_retries_total", method="get_var")
+    with pytest.raises(RPCError):
+        cli.get_var("never_pushed")
+    cli.close()
+    srv.close()
+    assert _value("paddle_rpc_client_errors_total",
+                  method="get_var") == e0 + 1
+    assert _value("paddle_rpc_client_deadline_expirations_total",
+                  method="get_var") == d0 + 1
+    # the init-race poll loop retried at least twice before expiring
+    assert _value("paddle_rpc_client_retries_total",
+                  method="get_var") >= r0 + 2
+
+
+def test_rpc_fast_failure_is_error_but_not_deadline_expiration():
+    """get_var exhausting its retry COUNT against a live server (default
+    60s deadline nowhere near burned) is an error, NOT a deadline
+    expiration — the sidecar distinction between init-race and wedge."""
+    from paddle_tpu.distributed.rpc import RPCClient, RPCError, RPCServer
+
+    srv = RPCServer(port=0, num_trainers=1, sync=False)
+    srv.start()
+    cli = RPCClient("127.0.0.1:%d" % srv.port, trainer_id=0)
+    cli.connect()
+    e0 = _value("paddle_rpc_client_errors_total", method="get_var")
+    d0 = _value("paddle_rpc_client_deadline_expirations_total",
+                method="get_var")
+    with pytest.raises(RPCError):
+        cli.get_var("never_pushed", retries=2)  # fails in ~0.2s
+    cli.close()
+    srv.close()
+    assert _value("paddle_rpc_client_errors_total",
+                  method="get_var") == e0 + 1
+    assert _value("paddle_rpc_client_deadline_expirations_total",
+                  method="get_var") == d0
+
+
+def test_reset_clears_pending_feed_gap_stamp(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    observe.mark_batch_produced()  # stale stamp from "another test"
+    observe.reset()
+    exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+            fetch_list=[y.name], scope=scope)
+    # the stale stamp must not leak a bogus gap into the zeroed histogram
+    assert _value("paddle_feed_to_run_gap_seconds") == 0
+
+
+# ------------------------------------------------- span/profiler compose
+def test_span_lands_in_profiler_timeline(tmp_path, capsys):
+    from paddle_tpu import profiler
+
+    n0 = _value("paddle_span_seconds", span="obs_test_span")
+    path = str(tmp_path / "trace.json")
+    profiler.start_profiler(state="CPU")
+    with observe.span("obs_test_span"):
+        np.dot(np.ones((16, 16)), np.ones((16, 16)))
+    profiler.stop_profiler(profile_path=path)
+    out = capsys.readouterr().out
+    # same aggregated event table as any RecordEvent...
+    assert "obs_test_span" in out
+    # ...same chrome trace...
+    trace = json.load(open(path))
+    assert any(e["name"] == "obs_test_span" for e in trace["traceEvents"])
+    # ...AND the histogram, without needing the profiler at all
+    assert _value("paddle_span_seconds", span="obs_test_span") == n0 + 1
+    with observe.span("obs_test_span"):
+        pass
+    assert _value("paddle_span_seconds", span="obs_test_span") == n0 + 2
+
+
+def test_feed_to_run_gap(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    g0 = _value("paddle_feed_to_run_gap_seconds")
+    observe.mark_batch_produced()
+    exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+            fetch_list=[y.name], scope=scope)
+    assert _value("paddle_feed_to_run_gap_seconds") == g0 + 1
+    # read-and-clear: a second run without a new batch records nothing
+    exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+            fetch_list=[y.name], scope=scope)
+    assert _value("paddle_feed_to_run_gap_seconds") == g0 + 1
+
+
+def test_reader_batch_counts():
+    from paddle_tpu import reader
+
+    b0 = _value("paddle_data_batches_total", source="reader.batch")
+    r = reader.batch(lambda: iter(range(10)), batch_size=4)
+    assert len(list(r())) == 3  # 4 + 4 + 2 (no drop_last)
+    assert _value("paddle_data_batches_total",
+                  source="reader.batch") == b0 + 3
+
+
+# ------------------------------------------- bench sidecar + stats_dump
+def _run_bench_probe(tmp_path, platform):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": platform,
+                "PADDLE_TPU_TELEMETRY_DIR": str(tmp_path),
+                "PADDLE_TPU_BENCH_INIT_TIMEOUT": "60"})
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, BENCH, "--probe"], env=env, timeout=240,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+
+
+def test_bench_probe_writes_sidecar_and_stats_dump_renders(tmp_path):
+    proc = _run_bench_probe(tmp_path, "cpu")
+    assert proc.returncode == 0
+    sidecar = tmp_path / "BENCH_probe.telemetry.json"
+    assert sidecar.exists()
+    snap = json.loads(sidecar.read_text())
+    # executor + RPC metric families are non-empty even though this
+    # process never ran a step (acceptance criterion)
+    assert snap["metrics"]["paddle_executor_cache_misses_total"]["samples"]
+    assert snap["metrics"]["paddle_rpc_client_calls_total"]["samples"]
+    assert snap["metrics"]["paddle_backend_probe_ok"]["samples"][0][
+        "value"] == 1.0
+
+    out = subprocess.run(
+        [sys.executable, STATS_DUMP, str(sidecar)], timeout=120,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    assert out.returncode == 0, out.stdout
+    assert "paddle_backend_probe_seconds" in out.stdout
+
+    promo = subprocess.run(
+        [sys.executable, STATS_DUMP, str(sidecar), "--prometheus"],
+        timeout=120, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    assert promo.returncode == 0
+    _assert_valid_exposition(promo.stdout)
+
+
+def test_bench_probe_failure_still_writes_sidecar(tmp_path):
+    # the round-5 scenario: backend init fails -> the run must still
+    # leave a diagnosable sidecar, not just an error row
+    proc = _run_bench_probe(tmp_path, "bogus_backend")
+    assert proc.returncode == 1
+    rows = [json.loads(l) for l in proc.stdout.splitlines() if l]
+    assert any(r.get("metric") == "backend_init" and "error" in r
+               for r in rows)
+    snap = json.loads(
+        (tmp_path / "BENCH_probe.telemetry.json").read_text())
+    assert snap["metrics"]["paddle_backend_probe_ok"]["samples"][0][
+        "value"] == 0.0
+    assert snap["metrics"]["paddle_rpc_client_calls_total"]["samples"]

@@ -147,6 +147,18 @@ def test_pack_sequences_fixed_rows_and_empty_row_safe():
         reader.pack_sequences([[1] * 8, [2] * 8], seq_len=8, n_rows=1)
 
 
+def test_pack_sequences_empty_input_raises():
+    """An empty pack must be an explicit error: with n_rows set it would
+    otherwise be padded back up to an ALL-padding batch (the exact
+    silent-pure-pad batch the trailing-empty-row guard exists to
+    prevent)."""
+    for seqs in ([], [[]], [[], []]):
+        with pytest.raises(ValueError, match="no tokens to pack"):
+            reader.pack_sequences(seqs, seq_len=8, n_rows=2)
+        with pytest.raises(ValueError, match="no tokens to pack"):
+            reader.pack_sequences(seqs, seq_len=8)
+
+
 def test_packed_windows_scan_composition():
     """The full steady-state packed loop: pack_sequences (fixed n_rows)
     -> stack_feed_window -> run_repeated(feed_stacked=True). K packed

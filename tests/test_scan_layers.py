@@ -1,0 +1,227 @@
+"""scan_layers (layers/scan_ext.py + ops/scan_ops.py): forward parity
+with the unrolled layer stack, gradient flow into the stacked params,
+captured outer tensors, remat, and the dropout RngKey replay path."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.program import grad_var_name
+
+
+def _build_scan(n_layers, width, remat=False, dropout=0.0, use_captured=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[width], dtype="float32")
+        cap = None
+        if use_captured:
+            # computed OUTSIDE the body; must broadcast into every
+            # iteration as an explicit captured input
+            cap = fluid.layers.scale(x, scale=0.5)
+
+        def body(h):
+            h = fluid.layers.fc(h, size=width, act="tanh")
+            if use_captured:
+                h = fluid.layers.elementwise_add(h, cap)
+            if dropout:
+                h = fluid.layers.dropout(h, dropout_prob=dropout)
+            return h
+
+        y = fluid.layers.scan_layers(x, n_layers, body, remat=remat)
+        loss = fluid.layers.reduce_mean(y)
+        fluid.backward.append_backward(loss)
+    return main, startup, loss
+
+
+def _stacked_params(main, n_layers):
+    ps = [p for p in main.global_block().all_parameters()
+          if p.shape and p.shape[0] == n_layers]
+    assert ps, "no stacked parameters found"
+    return ps
+
+
+def test_scan_layers_forward_matches_numpy_unroll():
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    n, width = 3, 4
+    main, startup, loss = _build_scan(n, width)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        W, b = _stacked_params(main, n)
+        assert tuple(W.shape) == (n, width, width)
+        assert tuple(b.shape) == (n, width)
+        Wv = np.asarray(scope.find_var(W.name))
+        bv = np.asarray(scope.find_var(b.name))
+        X = np.random.RandomState(0).rand(5, width).astype("float32")
+        got = exe.run(main, feed={"x": X}, fetch_list=[loss.name],
+                      scope=scope)[0]
+        h = X
+        for i in range(n):
+            h = np.tanh(h @ Wv[i] + bv[i])
+        np.testing.assert_allclose(got, h.mean(), rtol=1e-5, atol=1e-6)
+
+
+def test_scan_layers_backward_matches_unrolled_stack():
+    """Gradient parity: the scanned stack's stacked-param grads must equal
+    the per-layer grads of an unrolled program holding the same weights."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    n, width = 3, 4
+    main, startup, loss = _build_scan(n, width)
+    scope = Scope()
+    rs = np.random.RandomState(1)
+    X = rs.rand(6, width).astype("float32")
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        W, b = _stacked_params(main, n)
+        Wv = np.asarray(scope.find_var(W.name)).copy()
+        bv = np.asarray(scope.find_var(b.name)).copy()
+        gW, gb, l_scan = exe.run(
+            main, feed={"x": X},
+            fetch_list=[grad_var_name(W.name), grad_var_name(b.name),
+                        loss.name],
+            scope=scope)
+
+    # unrolled twin: n separate fc layers seeded with the same weights
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = fluid.layers.data(name="x", shape=[width], dtype="float32")
+        h = x2
+        names = []
+        for i in range(n):
+            h = fluid.layers.fc(
+                h, size=width, act="tanh",
+                param_attr=fluid.ParamAttr(name="uw%d" % i),
+                bias_attr=fluid.ParamAttr(name="ub%d" % i))
+            names.append(("uw%d" % i, "ub%d" % i))
+        loss2 = fluid.layers.reduce_mean(h)
+        fluid.backward.append_backward(loss2)
+    scope2 = Scope()
+    with scope_guard(scope2):
+        exe2 = fluid.Executor()
+        exe2.run(startup2, scope=scope2)
+        for i, (wn, bn) in enumerate(names):
+            scope2.set_var(wn, Wv[i])
+            scope2.set_var(bn, bv[i])
+        fetch = [grad_var_name(wn) for wn, _ in names] + \
+            [grad_var_name(bn) for _, bn in names] + [loss2.name]
+        out = exe2.run(main2, feed={"x": X}, fetch_list=fetch,
+                       scope=scope2)
+        uW, ub, l_unroll = out[:n], out[n:2 * n], out[-1]
+
+    np.testing.assert_allclose(l_scan, l_unroll, rtol=1e-5, atol=1e-6)
+    for i in range(n):
+        np.testing.assert_allclose(gW[i], uW[i], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(gb[i], ub[i], rtol=1e-4, atol=1e-5)
+
+
+def test_scan_layers_trains_and_decreases_loss():
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    n, width = 4, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[width], dtype="float32")
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="float32")
+        y = fluid.layers.scan_layers(
+            x, n, lambda h: fluid.layers.fc(h, size=width, act="tanh"))
+        pred = fluid.layers.fc(y, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pred, lbl)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rs = np.random.RandomState(0)
+    X = rs.rand(16, width).astype("float32")
+    L = rs.rand(16, 1).astype("float32")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        losses = [float(exe.run(main, feed={"x": X, "lbl": L},
+                                fetch_list=[loss.name], scope=scope)[0])
+                  for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_scan_layers_captured_tensor_broadcasts():
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    n, width = 2, 4
+    main, startup, loss = _build_scan(n, width, use_captured=True)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        W, b = _stacked_params(main, n)
+        Wv = np.asarray(scope.find_var(W.name))
+        bv = np.asarray(scope.find_var(b.name))
+        X = np.random.RandomState(2).rand(3, width).astype("float32")
+        got = exe.run(main, feed={"x": X}, fetch_list=[loss.name],
+                      scope=scope)[0]
+        h, cap = X, 0.5 * X
+        for i in range(n):
+            h = np.tanh(h @ Wv[i] + bv[i]) + cap
+        np.testing.assert_allclose(got, h.mean(), rtol=1e-5, atol=1e-6)
+
+
+def test_scan_layers_remat_matches_plain():
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    n, width = 3, 4
+    X = np.random.RandomState(3).rand(4, width).astype("float32")
+    outs = {}
+    for remat in (False, True):
+        main, startup, loss = _build_scan(n, width, remat=remat)
+        scope = Scope()
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            W, b = _stacked_params(main, n)
+            # pin identical weights across the two programs
+            rs = np.random.RandomState(4)
+            scope.set_var(W.name, rs.rand(n, width, width)
+                          .astype("float32") * 0.3)
+            scope.set_var(b.name, np.zeros((n, width), "float32"))
+            outs[remat] = exe.run(
+                main, feed={"x": X},
+                fetch_list=[loss.name, grad_var_name(W.name)],
+                scope=scope)
+    np.testing.assert_allclose(outs[False][0], outs[True][0],
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(outs[False][1], outs[True][1],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_scan_layers_dropout_fwd_bwd_runs():
+    """Stochastic body: forward draws per-layer folded keys, the custom
+    grad replays the RngKey output — backward must run (the generic vjp
+    would raise 'RNG in pure context') and produce finite grads."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    n, width = 3, 4
+    main, startup, loss = _build_scan(n, width, dropout=0.5)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        W, _b = _stacked_params(main, n)
+        X = np.random.RandomState(5).rand(8, width).astype("float32")
+        l1, g1 = exe.run(main, feed={"x": X},
+                         fetch_list=[loss.name, grad_var_name(W.name)],
+                         scope=scope)
+        assert np.isfinite(l1).all() and np.isfinite(g1).all()
+        # the RNG chain advances: a second run draws different masks
+        l2 = exe.run(main, feed={"x": X}, fetch_list=[loss.name],
+                     scope=scope)[0]
+        assert not np.allclose(l1, l2)
+
+
+def test_scan_layers_shape_contract():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        with pytest.raises(ValueError, match="carry shape"):
+            fluid.layers.scan_layers(
+                x, 2, lambda h: fluid.layers.fc(h, size=8))

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""stats_dump: pretty-print a telemetry snapshot (live or saved sidecar).
+
+Usage:
+    python tools/stats_dump.py BENCH_resnet50.telemetry.json
+    python tools/stats_dump.py BENCH_probe.telemetry.json --all
+    python tools/stats_dump.py snapshot.json --prometheus
+    python tools/stats_dump.py --live            # this process (near-empty;
+                                                 # useful from a REPL/pdb)
+
+Reads the JSON written by `paddle_tpu.observe.dump()` (bench.py drops one
+per workload row, including failed rows) and renders counters/gauges as a
+table and histograms with count/sum/mean and estimated p50/p90/p99.
+`--prometheus` re-renders the snapshot in text exposition format instead.
+
+Diagnosing a wedged TPU tunnel from a sidecar: see docs/OBSERVABILITY.md
+("Reading a sidecar post-mortem") — the short version is to look at
+paddle_backend_probe_ok/_seconds first, then the executor cache + step
+counters to see how far init got, then the per-method RPC counters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable from any cwd: the repo root (parent of tools/) owns paddle_tpu
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _percentile(buckets, count, q):
+    """Estimate a quantile from cumulative {le: count} buckets (linear
+    interpolation within the winning bucket, prometheus-style)."""
+    if not count:
+        return None
+    target = q * count
+    prev_le, prev_c = 0.0, 0
+    items = sorted(((float("inf") if le == "+Inf" else float(le)), c)
+                   for le, c in buckets.items())
+    for le, c in items:
+        if c >= target:
+            if le == float("inf"):
+                return prev_le  # open-ended bucket: report its lower edge
+            span = c - prev_c
+            frac = (target - prev_c) / span if span else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_c = le, c
+    return prev_le
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e12:
+            return str(int(v))
+        return "%.6g" % v
+    return str(v)
+
+
+def _label_str(labels):
+    return ",".join("%s=%s" % kv for kv in sorted(labels.items()))
+
+
+def render_table(snap, show_all=False, out=sys.stdout):
+    meta = "snapshot pid=%s unix_time=%s" % (snap.get("pid"),
+                                             _fmt(snap.get("unix_time")))
+    print(meta, file=out)
+    print("-" * max(len(meta), 72), file=out)
+    scalar_rows, hist_rows = [], []
+    for name in sorted(snap["metrics"]):
+        m = snap["metrics"][name]
+        for s in m["samples"]:
+            key = name + ("{%s}" % _label_str(s["labels"])
+                          if s["labels"] else "")
+            if m["type"] == "histogram":
+                if not show_all and not s["count"]:
+                    continue
+                cnt, tot = s["count"], s["sum"]
+                hist_rows.append((
+                    key, cnt, _fmt(tot), _fmt(tot / cnt if cnt else None),
+                    _fmt(_percentile(s["buckets"], cnt, 0.5)),
+                    _fmt(_percentile(s["buckets"], cnt, 0.9)),
+                    _fmt(_percentile(s["buckets"], cnt, 0.99)),
+                ))
+            else:
+                # gauges always render: a gauge at 0 is a signal
+                # (paddle_backend_probe_ok=0 IS the wedged-tunnel
+                # diagnosis), only zero counters are noise
+                if not show_all and m["type"] == "counter" \
+                        and not s["value"]:
+                    continue
+                scalar_rows.append((key, m["type"], _fmt(s["value"])))
+    if scalar_rows:
+        w = max(len(r[0]) for r in scalar_rows)
+        print("%-*s %-8s %s" % (w, "metric", "type", "value"), file=out)
+        for key, kind, val in scalar_rows:
+            print("%-*s %-8s %s" % (w, key, kind, val), file=out)
+    if hist_rows:
+        print(file=out)
+        w = max(len(r[0]) for r in hist_rows)
+        print("%-*s %8s %10s %10s %10s %10s %10s"
+              % (w, "histogram", "count", "sum", "mean", "p50", "p90",
+                 "p99"), file=out)
+        for key, cnt, tot, mean, p50, p90, p99 in hist_rows:
+            print("%-*s %8d %10s %10s %10s %10s %10s"
+                  % (w, key, cnt, tot, mean, p50, p90, p99), file=out)
+    if not scalar_rows and not hist_rows:
+        print("(all metrics zero — rerun with --all to list the schema)",
+              file=out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="pretty-print a paddle_tpu telemetry snapshot")
+    ap.add_argument("snapshot", nargs="?", default=None,
+                    help="path to a saved snapshot/sidecar JSON")
+    ap.add_argument("--live", action="store_true",
+                    help="snapshot THIS process's registry instead of a file")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="render text exposition format instead of a table")
+    ap.add_argument("--all", action="store_true",
+                    help="include zero-valued series (show the full schema)")
+    args = ap.parse_args(argv)
+
+    if args.live == (args.snapshot is not None):
+        ap.error("pass exactly one of: a snapshot path, or --live")
+
+    if args.live:
+        from paddle_tpu import observe
+
+        snap = observe.snapshot()
+    else:
+        with open(args.snapshot) as f:
+            snap = json.load(f)
+        if "metrics" not in snap:
+            ap.error("%s is not a telemetry snapshot (no 'metrics' key)"
+                     % args.snapshot)
+
+    if args.prometheus:
+        # Registry.render_prometheus renders from any saved snapshot dict
+        from paddle_tpu.observe.metrics import Registry
+
+        sys.stdout.write(Registry().render_prometheus(snap))
+    else:
+        render_table(snap, show_all=args.all)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
