@@ -234,12 +234,20 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
         exe.run(startup, scope=scope)
 
         feed = feed_fn()
-        # place feeds on device once: the timed loop measures the train
-        # step, not a repeated H2D of the same host arrays (a real input
-        # pipeline overlaps transfer via PyReader's prefetch thread)
+        # PADDLE_TPU_BENCH_PIPELINE=1: drive the timed loop through the
+        # pipelined engine (DevicePrefetcher H2D thread + run_pipelined's
+        # async in-flight window) instead of pre-placed feeds + blocking
+        # run() — the end-to-end input-pipeline configuration, feeds
+        # starting HOST-side each step. Rows record "pipelined" so
+        # pin_baselines never mixes the modes.
+        pipelined = os.environ.get("PADDLE_TPU_BENCH_PIPELINE", "0") != "0"
         import jax.numpy as jnp
 
-        feed = {k: jnp.asarray(v) for k, v in feed.items()}
+        if not pipelined:
+            # place feeds on device once: the timed loop measures the
+            # train step, not a repeated H2D of the same host arrays (a
+            # real input pipeline overlaps transfer via the prefetcher)
+            feed = {k: jnp.asarray(v) for k, v in feed.items()}
         # device-side K-step loop: one host dispatch per K steps
         # (run_repeated's lax.scan) instead of K round-trips — isolates
         # per-step host/tunnel dispatch latency from the device step
@@ -254,7 +262,31 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
         spc = int(os.environ.get(
             "PADDLE_TPU_BENCH_STEPS_PER_CALL",
             "1" if quick else str(DEFAULT_STEPS_PER_CALL)))
-        if spc > 1:
+        if pipelined:
+            spc = 1  # per-step dispatch IS the pipelined mode's shape
+            in_flight = int(os.environ.get("PADDLE_TPU_BENCH_IN_FLIGHT", "2"))
+            depth = int(os.environ.get("PADDLE_TPU_BENCH_PREFETCH_DEPTH", "2"))
+            # fresh array copies per step: the const-feed dedup cache must
+            # not short-circuit the H2D this mode exists to measure; lazy
+            # so peak host RSS holds only the prefetch window, not steps x
+            # batch bytes
+            host_batches = (
+                {k: np.array(v, copy=True) for k, v in feed.items()}
+                for _ in range(steps))
+            _log("%s: compiling + %d warmup steps (pipelined)"
+                 % (name, warmup))
+            with _beacon(name, "compile/warmup"):
+                for _ in range(warmup):
+                    exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            _log("%s: timing %d pipelined steps (in_flight=%d, depth=%d)"
+                 % (name, steps, in_flight, depth))
+            t0 = time.perf_counter()
+            _n, vals = exe.train_loop(
+                main, iter(host_batches), fetch_list=[loss], scope=scope,
+                max_in_flight=in_flight, prefetch_depth=depth)
+            float(np.asarray(vals[0]).reshape(-1)[0])  # block on the result
+            dt = time.perf_counter() - t0
+        elif spc > 1:
             steps = spc
             _log("%s: compiling K-step scan + warmup (%d steps/call)"
                  % (name, spc))
@@ -322,6 +354,13 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # K steps per host dispatch (run_repeated lax.scan); absent
             # means the classic one-dispatch-per-step loop
             **({"steps_per_call": spc} if spc > 1 else {}),
+            # pipelined-engine rows (DevicePrefetcher + async in-flight
+            # dispatch, host-side feeds each step) are their own mode:
+            # never regression-compared against pre-placed-feed
+            # baselines; the window/depth knobs shape the measurement,
+            # so rows record them like every other non-default knob
+            **({"pipelined": True, "in_flight": in_flight,
+                "prefetch_depth": depth} if pipelined else {}),
             # batch multiplier (PADDLE_TPU_BENCH_BATCH_SCALE): scaled
             # rows never regression-compare against the default-batch
             # baseline silently
@@ -334,6 +373,7 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # — they anchor at 1.0 until a matching baseline exists
             "vs_baseline": round(throughput / BASELINES[name], 3)
             if (name in BASELINES and not recompute and _bscale() == 1
+                and not pipelined
                 and spc == BASELINE_SPC.get(name, 1)
                 and not (attention
                          and "PADDLE_TPU_FLASH_MIN_SEQ" in os.environ))

@@ -16,8 +16,10 @@ per-op dispatch, implicit data transform, and the eager-deletion GC.
 
 from __future__ import annotations
 
+import os
 import time
 import warnings
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -60,11 +62,25 @@ class _Plan:
 
 
 class Executor:
-    """User-facing executor (python/paddle/fluid/executor.py:262 analog)."""
+    """User-facing executor (python/paddle/fluid/executor.py:262 analog).
 
-    def __init__(self, place=None):
+    ``cache_size`` caps the plan cache (LRU): each cached plan pins a
+    jitted executable (and, via ``plan.multi``, its K-step scan
+    variants), so a shape-churning workload must not hold every stale
+    executable alive. Default from ``PADDLE_TPU_EXECUTOR_CACHE_SIZE``
+    (32); evictions count into
+    ``paddle_executor_plan_cache_evictions_total``.
+    """
+
+    def __init__(self, place=None, cache_size: Optional[int] = None):
         self.place = place
-        self._cache: Dict[Tuple, _Plan] = {}
+        if cache_size is None:
+            cache_size = int(os.environ.get(
+                "PADDLE_TPU_EXECUTOR_CACHE_SIZE", "32"))
+        if cache_size < 1:
+            raise ValueError("cache_size must be >= 1, got %d" % cache_size)
+        self._cache_size = cache_size
+        self._cache: "OrderedDict[Tuple, _Plan]" = OrderedDict()
 
     # ------------------------------------------------------------------ run
     def run(
@@ -107,40 +123,46 @@ class Executor:
             with RecordEvent("executor_run"):
                 fetches, new_mut, new_pure, new_rng = plan.fn(
                     feeds, const_state, mut_state, rng)
+                steady = _record_dispatch(plan, "run", "run", 1,
+                                          time.perf_counter() - t0)
                 fetches = [f.block_until_ready() if hasattr(f, "block_until_ready")
                            else f for f in fetches]
+                if fetches:  # an empty fetch_list never blocks
+                    _record_completion(steady, "run",
+                                       time.perf_counter() - t0)
+                t0 = None  # completion observed here; _finish must not re-record
         else:
             fetches, new_mut, new_pure, new_rng = plan.fn(
                 feeds, const_state, mut_state, rng)
-        _record_dispatch(plan, "run", "run", 1,
-                         time.perf_counter() - t0)
+            steady = _record_dispatch(plan, "run", "run", 1,
+                                      time.perf_counter() - t0)
 
         return self._finish(plan, scope, fetches, new_mut, new_pure,
-                            new_rng, return_numpy, "")
+                            new_rng, return_numpy, "",
+                            completion=(steady, "run", t0))
 
     @staticmethod
     def _finish(plan, scope, fetches, new_mut, new_pure, new_rng,
-                return_numpy, nan_suffix):
+                return_numpy, nan_suffix, completion=None):
         """Shared run()/run_repeated() epilogue: state write-back, RNG
-        store, numpy conversion, FLAGS_check_nan_inf."""
-        for n, v in zip(plan.mut_state, new_mut):
-            scope.set_var(n, v)
-        for n, v in zip(plan.pure_written, new_pure):
-            scope.set_var(n, v)
-        if plan.needs_rng:
-            scope.set_var(RNG_VAR, new_rng)
+        store, numpy conversion, FLAGS_check_nan_inf. ``completion`` is
+        ``(steady, site, t0)``: when the numpy conversion blocks on the
+        result, the dispatch-to-ready latency is observed as the
+        ``complete`` phase (t0=None when the caller already recorded it
+        or never blocks). ``run_pipelined`` reuses the same two helpers
+        from its loop and ``FetchHandle.result()`` so the paths cannot
+        drift."""
+        _write_back_state(plan, scope, new_mut, new_pure, new_rng)
 
         if return_numpy:
             out = [np.asarray(v) for v in fetches]
-            from ..flags import get_flag
-
-            if get_flag("check_nan_inf"):
-                for name, v in zip(plan.fetch_names, out):
-                    if np.issubdtype(v.dtype, np.floating) and \
-                            not np.isfinite(v).all():
-                        raise FloatingPointError(
-                            "NaN/Inf in fetched var %r%s "
-                            "(FLAGS_check_nan_inf)" % (name, nan_suffix))
+            # `complete` only when the conversion actually blocked on a
+            # result: an empty fetch_list never waits, and recording it
+            # would fill the histogram with dispatch-only samples
+            if out and completion is not None and completion[2] is not None:
+                _record_completion(completion[0], completion[1],
+                                   time.perf_counter() - completion[2])
+            _check_fetches_finite(plan.fetch_names, out, nan_suffix)
             return out
         return list(fetches)
 
@@ -210,22 +232,250 @@ class Executor:
         from ..profiler import RecordEvent, is_profiler_enabled
 
         observe_feed_gap()
+        sig = ("run_repeated",) + key
         t0 = time.perf_counter()
         if is_profiler_enabled():
             with RecordEvent("executor_run_repeated[%d]" % steps):
                 fetches, new_mut, new_pure, new_rng = fn(
                     feeds, const_state, mut_state, rng)
+                steady = _record_dispatch(plan, sig, "run_repeated",
+                                          steps, time.perf_counter() - t0)
                 fetches = [f.block_until_ready()
                            if hasattr(f, "block_until_ready") else f
                            for f in fetches]
+                if fetches:  # an empty fetch_list never blocks
+                    _record_completion(steady, "run_repeated",
+                                       time.perf_counter() - t0)
+                t0 = None
         else:
             fetches, new_mut, new_pure, new_rng = fn(
                 feeds, const_state, mut_state, rng)
-        _record_dispatch(plan, ("run_repeated",) + key, "run_repeated",
-                         steps, time.perf_counter() - t0)
+            steady = _record_dispatch(plan, sig, "run_repeated",
+                                      steps, time.perf_counter() - t0)
         return self._finish(plan, scope, fetches, new_mut, new_pure,
                             new_rng, return_numpy,
-                            " after %d scanned steps" % steps)
+                            " after %d scanned steps" % steps,
+                            completion=(steady, "run_repeated", t0))
+
+    # -------------------------------------------------------- pipelined
+    def run_pipelined(
+        self,
+        program: Optional[Program] = None,
+        reader=None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        max_in_flight: int = 2,
+        prefetch_depth: Optional[int] = None,
+        return_numpy: bool = True,
+        const_feed_names: Sequence[str] = (),
+        const_dedup: Optional[bool] = None,
+    ):
+        """Fully overlapped step loop: generator of ``FetchHandle``s.
+
+        ``reader`` yields feed dicts (a zero-arg callable returning an
+        iterable, an iterable, or an already-constructed
+        ``DevicePrefetcher``). A background thread converts each batch
+        and ``device_put``s it committed to this executor's place
+        (``prefetch_depth`` batches ahead), so the step loop receives
+        device-resident feeds; each step is DISPATCHED without blocking
+        on its results — JAX async dispatch then overlaps step N's
+        compute with step N+1's H2D and step N-1's D2H. The in-flight
+        window (``max_in_flight``) bounds dispatched-but-unresolved
+        steps: before dispatching past the cap, the OLDEST handle is
+        waited on, capping live device buffers at
+        ``max_in_flight * (feeds + fetches)`` plus the prefetch queue.
+
+        Semantics are identical to calling ``run`` once per batch —
+        state/RNG advance the same way; fetch values are numerically
+        identical (``tests/test_device_pipeline.py`` pins parity).
+        Feeds repeated across steps (same ndarray object, or names in
+        ``const_feed_names``) skip re-transfer via the const-feed dedup
+        cache — see ``ConstFeedCache`` for the in-place-mutation
+        invalidation rule. Pass ``const_dedup=False`` when the reader
+        refills ONE preallocated ndarray in place each step (constant
+        object identity, changing data): identity dedup would serve
+        stale batches there; ``const_feed_names`` still cache by name.
+
+        Abandoning the generator (break / close) stops the prefetch
+        thread and drains in-flight work. The analog of the reference's
+        async_executor.cc multi-threaded trainer loop, recast for ONE
+        XLA executable with async dispatch instead of per-op threads.
+        """
+        from .pipeline import DevicePrefetcher
+
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        if reader is None:
+            raise ValueError("run_pipelined needs a reader of feed dicts")
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1, got %d"
+                             % max_in_flight)
+        if isinstance(reader, DevicePrefetcher):
+            prefetcher = reader
+            if prefetcher._closed:
+                # iter() would raise this too, but only at first next();
+                # a caller-supplied spent prefetcher must fail HERE
+                raise RuntimeError(
+                    "DevicePrefetcher is single-use: it was already closed "
+                    "or fully consumed; construct a new one per epoch")
+            if prefetch_depth is not None \
+                    and prefetch_depth != prefetcher._depth:
+                # silently running at the prefetcher's depth would make
+                # the tuning knob a no-op; surface the conflict eagerly
+                raise ValueError(
+                    "prefetch_depth=%d conflicts with the already-"
+                    "constructed DevicePrefetcher(depth=%d); set depth "
+                    "when constructing it" % (prefetch_depth,
+                                              prefetcher._depth))
+            exe_dev = self._jax_device()
+            if prefetcher._device is not None and exe_dev is not None \
+                    and prefetcher._device != exe_dev:
+                # feeds committed to the wrong device would only fail at
+                # the first dispatch (or silently misplace) mid-training
+                raise ValueError(
+                    "DevicePrefetcher commits feeds to %s but this "
+                    "executor's place is %s; construct the prefetcher "
+                    "with place=executor.place" % (prefetcher._device,
+                                                   exe_dev))
+            if const_dedup is not None \
+                    and const_dedup != prefetcher._dedup_unmarked:
+                raise ValueError(
+                    "const_dedup=%r conflicts with the already-"
+                    "constructed DevicePrefetcher(const_dedup=%r); set it "
+                    "when constructing it" % (const_dedup,
+                                              prefetcher._dedup_unmarked))
+            if const_feed_names:
+                prefetcher.const_cache.mark_constant(*const_feed_names)
+        else:
+            prefetcher = DevicePrefetcher(
+                reader, place=self.place, program=program,
+                depth=2 if prefetch_depth is None else prefetch_depth,
+                const_feed_names=const_feed_names,
+                const_dedup=True if const_dedup is None else const_dedup)
+        # validation + prefetcher setup are eager; only the loop itself is
+        # a generator (a never-iterated result must not defer ValueErrors).
+        # iter() stays lazy — it starts the fill thread, which must not
+        # run for a generator that is never iterated
+        return self._pipelined_loop(program, prefetcher, fetch_list, scope,
+                                    max_in_flight, return_numpy)
+
+    def _pipelined_loop(self, program, prefetcher, fetch_list, scope,
+                        max_in_flight, return_numpy):
+        from .pipeline import FetchHandle
+        from ..observe import observe_feed_gap
+        from ..observe.families import (PIPELINE_IN_FLIGHT,
+                                        PIPELINE_OVERLAP_RATIO,
+                                        PIPELINE_WAIT_SECONDS)
+
+        window: deque = deque()
+        blocked = 0.0
+        step_i = 0
+        t_loop = time.perf_counter()
+        feed_iter = iter(prefetcher)
+        try:
+            while True:
+                # drain the window BEFORE pulling the next feed: the wait
+                # must not sit between the prefetcher's hand-off stamp and
+                # the dispatch (it would pollute the feed->run gap), and
+                # the prefetch thread keeps filling during it either way
+                if len(window) >= max_in_flight:
+                    tw = time.perf_counter()
+                    window.popleft().wait()
+                    dt = time.perf_counter() - tw
+                    blocked += dt
+                    PIPELINE_WAIT_SECONDS.observe(dt)
+                    PIPELINE_IN_FLIGHT.set(len(window))
+                feeds = next(feed_iter, None)
+                if feeds is None:
+                    break
+                # observe the hand-off gap IMMEDIATELY: the batch is
+                # already device-resident, so unlike run() there is no
+                # conversion left between hand-off and dispatch worth
+                # including (and on oversubscribed hosts every extra
+                # bytecode in this window collects scheduler noise)
+                observe_feed_gap()
+                plan, feed_list, const_state, mut_state, rng = self._gather(
+                    program, feeds, fetch_list, scope)
+                t0 = time.perf_counter()
+                fetches, new_mut, new_pure, new_rng = plan.fn(
+                    feed_list, const_state, mut_state, rng)
+                # sig "run": same executable as run(), so a run() warmup
+                # already paid this signature's compile
+                steady = _record_dispatch(plan, "run", "run_pipelined", 1,
+                                          time.perf_counter() - t0)
+                # state write-back WITHOUT blocking: the new arrays are
+                # futures; the next dispatch chains on them device-side
+                _write_back_state(plan, scope, new_mut, new_pure, new_rng)
+                # the handle records the `complete` phase when it first
+                # blocks (wait()/result()) — dispatch-start to ready
+                handle = FetchHandle(step_i, plan.fetch_names, fetches,
+                                     return_numpy,
+                                     completion=(steady, "run_pipelined",
+                                                 t0),
+                                     block_on=() if fetches else
+                                     _completion_probe(plan, new_mut,
+                                                       new_pure, new_rng))
+                window.append(handle)
+                PIPELINE_IN_FLIGHT.set(len(window))
+                step_i += 1
+                yield handle
+        finally:
+            prefetcher.close()
+            # the drain waits are window waits too: a loop with
+            # steps <= max_in_flight never stalls IN the loop, so
+            # excluding these would report ~1.0 overlap for a run that
+            # was fully serialized on its fetch waits
+            while window:
+                tw = time.perf_counter()
+                window.popleft().wait()
+                dt = time.perf_counter() - tw
+                blocked += dt
+                PIPELINE_WAIT_SECONDS.observe(dt)
+            PIPELINE_IN_FLIGHT.set(0)
+            wall = time.perf_counter() - t_loop
+            if step_i and wall > 0:
+                PIPELINE_OVERLAP_RATIO.set(max(0.0, 1.0 - blocked / wall))
+
+    def train_loop(
+        self,
+        program: Optional[Program] = None,
+        reader=None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        max_in_flight: int = 2,
+        prefetch_depth: Optional[int] = None,
+        return_numpy: bool = True,
+        const_feed_names: Sequence[str] = (),
+        const_dedup: Optional[bool] = None,
+        on_step=None,
+    ):
+        """Drive ``run_pipelined`` over the whole reader; returns
+        ``(n_steps, last_fetch_values)``. ``on_step(step_i, values)`` is
+        called per resolved step (in order) — resolution trails dispatch
+        by the in-flight window, so the callback never serializes the
+        pipeline."""
+        pending: deque = deque()
+        last = None
+        n = 0
+
+        def _resolve(h):
+            vals = h.result()
+            if on_step is not None:
+                on_step(h.step, vals)
+            return vals
+
+        for h in self.run_pipelined(
+                program, reader, fetch_list, scope,
+                max_in_flight=max_in_flight, prefetch_depth=prefetch_depth,
+                return_numpy=return_numpy,
+                const_feed_names=const_feed_names, const_dedup=const_dedup):
+            n += 1
+            pending.append(h)
+            if len(pending) > max_in_flight:
+                last = _resolve(pending.popleft())
+        while pending:
+            last = _resolve(pending.popleft())
+        return n, last
 
     def cost_analysis(
         self,
@@ -326,13 +576,13 @@ class Executor:
             v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
         ]
         block = program.global_block()
-        feed_vals = {
-            n: _feed_to_device(n, v, block.vars.get(n)) for n, v in feed.items()
-        }
+        feed_vals, _ = feeds_to_device(feed, block.vars.get,
+                                       self._jax_device())
         key = self._cache_key(program, feed_vals, fetch_names)
         plan = self._cache.get(key)
         if plan is None:
-            from ..observe.families import (EXECUTOR_CACHE_MISSES,
+            from ..observe.families import (EXECUTOR_CACHE_EVICTIONS,
+                                            EXECUTOR_CACHE_MISSES,
                                             EXECUTOR_PREPARE_SECONDS)
 
             EXECUTOR_CACHE_MISSES.inc()
@@ -340,10 +590,14 @@ class Executor:
             plan = self._prepare(program, feed_vals, fetch_names, scope)
             EXECUTOR_PREPARE_SECONDS.observe(time.perf_counter() - t0)
             self._cache[key] = plan
+            while len(self._cache) > self._cache_size:
+                self._cache.popitem(last=False)
+                EXECUTOR_CACHE_EVICTIONS.inc()
         else:
             from ..observe.families import EXECUTOR_CACHE_HITS
 
             EXECUTOR_CACHE_HITS.inc()
+            self._cache.move_to_end(key)
         const_state = [_require(scope, n) for n in plan.const_state]
         mut_state = [_require(scope, n) for n in plan.mut_state]
         rng = scope.find_var(RNG_VAR)
@@ -362,6 +616,10 @@ class Executor:
 
         complete_and_reset()
 
+    def _jax_device(self):
+        """Concrete jax.Device for this executor's place (None = default)."""
+        return self.place.jax_device() if self.place is not None else None
+
     # -------------------------------------------------------------- prepare
     def _cache_key(self, program, feed_vals, fetch_names):
         sig = tuple(sorted((n, v.shape, str(v.dtype)) for n, v in feed_vals.items()))
@@ -377,12 +635,15 @@ class Executor:
 
 
 def _record_dispatch(plan, sig, site, steps, dt):
-    """Telemetry epilogue shared by run()/run_repeated(): count the steps
-    and route the wall time — a plan's FIRST dispatch per signature is
-    dominated by jax trace + XLA compile and lands in the compile
-    histogram; steady-state dispatches land in the run histogram (so a
-    recompile storm is visible as compile-histogram growth, not as a
-    mysteriously fat run tail)."""
+    """Telemetry shared by run()/run_repeated()/run_pipelined(): count the
+    steps and route the wall time — a plan's FIRST dispatch per signature
+    is dominated by jax trace + XLA compile and lands in the compile
+    histogram; steady-state dispatches land in the run histogram's
+    ``dispatch`` phase (the async hand-off the host actually pays per
+    step). Returns True for a steady-state dispatch so the caller knows
+    whether a matching ``complete`` observation belongs in the run
+    histogram (a compile event's completion would fatten the run tail
+    with compile time)."""
     from ..observe.families import (EXECUTOR_COMPILE_SECONDS,
                                     EXECUTOR_RUN_SECONDS, EXECUTOR_STEPS)
 
@@ -390,8 +651,67 @@ def _record_dispatch(plan, sig, site, steps, dt):
     if sig not in plan.compiled_sigs:
         plan.compiled_sigs.add(sig)
         EXECUTOR_COMPILE_SECONDS.observe(dt)
-    else:
-        EXECUTOR_RUN_SECONDS.labels(site=site).observe(dt)
+        return False
+    EXECUTOR_RUN_SECONDS.labels(site=site, phase="dispatch").observe(dt)
+    return True
+
+
+def _completion_probe(plan, new_mut, new_pure, new_rng):
+    """Something safe for an empty-fetch FetchHandle to block on. The
+    mut-state outputs are DONATED to the NEXT dispatch (argnum 2 of the
+    jitted step), so holding them would block_until_ready deleted
+    buffers on donation-honoring backends (TPU/GPU; CPU ignores
+    donation, which is why tests alone can't catch this). new_rng and
+    new_pure are never donated — prefer the smallest of those; when the
+    step writes ONLY mut state, a tiny device-side copy completes with
+    the step (data dependency) and belongs to nobody's donation."""
+    nbytes = lambda a: getattr(a, "nbytes", 0)  # noqa: E731
+    safe = ([new_rng] if plan.needs_rng else []) + list(new_pure)
+    if safe:
+        return (min(safe, key=nbytes),)
+    if new_mut:
+        return (jnp.copy(min(new_mut, key=nbytes)),)
+    return ()  # a no-output step has no device work to bound
+
+
+def _write_back_state(plan, scope, new_mut, new_pure, new_rng):
+    """Post-dispatch scope write-back shared by run()'s _finish and
+    _pipelined_loop — the arrays may still be futures; the next dispatch
+    chains on them device-side."""
+    for n, v in zip(plan.mut_state, new_mut):
+        scope.set_var(n, v)
+    for n, v in zip(plan.pure_written, new_pure):
+        scope.set_var(n, v)
+    if plan.needs_rng:
+        scope.set_var(RNG_VAR, new_rng)
+
+
+def _check_fetches_finite(fetch_names, values, suffix=""):
+    """FLAGS_check_nan_inf guard shared by _finish and
+    FetchHandle.result(); no-op when the flag is off."""
+    from ..flags import get_flag
+
+    if not get_flag("check_nan_inf"):
+        return
+    for name, v in zip(fetch_names, values):
+        if np.issubdtype(v.dtype, np.floating) and \
+                not np.isfinite(v).all():
+            raise FloatingPointError(
+                "NaN/Inf in fetched var %r%s "
+                "(FLAGS_check_nan_inf)" % (name, suffix))
+
+
+def _record_completion(steady, site, dt):
+    """The ``complete`` phase: dispatch-start to results-ready, observed
+    only when the host actually blocked (profiled runs, numpy fetch
+    conversion). Both phases recorded in BOTH profiled and unprofiled
+    paths — PR 1 recorded async-dispatch time unprofiled but blocked
+    completion profiled, silently under-reporting run latency."""
+    if not steady:
+        return
+    from ..observe.families import EXECUTOR_RUN_SECONDS
+
+    EXECUTOR_RUN_SECONDS.labels(site=site, phase="complete").observe(dt)
 
 
 def validate_stacked_feeds(feed_names, feeds, steps):
@@ -709,27 +1029,74 @@ def _accum_step(program, block, feed_names, fetch_names, const_state,
     return step
 
 
-def _feed_to_device(name: str, val, var):
-    """Convert one feed to its on-device dtype. int64 ids narrow to int32
-    (x64 stays off — see as_jax_dtype) with an explicit range check instead
-    of jnp's silent truncation warning."""
+def _feed_host_array(name: str, val, var) -> np.ndarray:
+    """Host-side half of feed conversion: dtype coercion to the on-device
+    dtype with the explicit int64 range check (instead of jnp's silent
+    truncation warning). The result is ready for a batched
+    ``jax.device_put``."""
     want = as_jax_dtype(var.dtype) if var is not None else None
-    if isinstance(val, jax.Array) and (want is None or val.dtype == want):
-        return val  # already on device at the right dtype: no host round-trip
-    if var is not None and var.dtype in ("int64", "uint64"):
-        arr = np.asarray(val)
-        if arr.dtype.itemsize == 8 and arr.size:
+    arr = np.asarray(val)
+    if arr.size and arr.dtype.itemsize == 8:
+        if var is not None and var.dtype in ("int64", "uint64"):
             dev_dt = "int32" if var.dtype == "int64" else "uint32"
+        elif var is None and arr.dtype.kind in "iu":
+            # no var info (e.g. DevicePrefetcher without `program`): x64
+            # is disabled so device_put will narrow int64->int32 anyway;
+            # range-check here too instead of silent wraparound
+            dev_dt = "int32" if arr.dtype.kind == "i" else "uint32"
+        else:
+            dev_dt = None
+        if dev_dt is not None:
             info = np.iinfo(dev_dt)
             lo, hi = arr.min(), arr.max()
             if lo < info.min or hi > info.max:
                 raise OverflowError(
-                    "feed %r has values in [%d, %d], outside the device %s "
-                    "range [%d, %d]; ids this large need the distributed "
-                    "sparse table path (distributed/transpiler.py)"
+                    "feed %r has values in [%d, %d], outside the device "
+                    "%s range [%d, %d]; ids this large need the "
+                    "distributed sparse table path "
+                    "(distributed/transpiler.py)"
                     % (name, lo, hi, dev_dt, info.min, info.max))
-        return jnp.asarray(arr, dtype=want)
-    return jnp.asarray(val, dtype=want)
+    if want is not None and arr.dtype != want:
+        arr = np.asarray(arr, dtype=want)
+    return arr
+
+
+def _feed_to_device(name: str, val, var):
+    """Convert ONE feed to a device array at its on-device dtype (kept for
+    per-array callers, e.g. the ParallelEngine's sharded placement; the
+    executor's own hot path batches via feeds_to_device)."""
+    want = as_jax_dtype(var.dtype) if var is not None else None
+    if isinstance(val, jax.Array):
+        # right dtype passes through; wrong dtype casts DEVICE-side —
+        # never a host round-trip (matching feeds_to_device)
+        return val if (want is None or val.dtype == want) \
+            else jnp.asarray(val, dtype=want)
+    return jnp.asarray(_feed_host_array(name, val, var), dtype=want)
+
+
+def feeds_to_device(feed: Dict[str, Any], var_lookup, device=None):
+    """Convert a whole feed dict with ONE ``jax.device_put`` pytree call
+    (one transfer program instead of a blocking ``jnp.asarray`` per
+    array), committed to ``device`` when given. Values already on device
+    at the right dtype pass through untouched; device arrays at the
+    wrong dtype cast device-side. Returns ``(dict, h2d_bytes)`` — bytes
+    actually staged for transfer (pass-throughs cost nothing). Shared by
+    ``Executor._gather`` and ``core.pipeline.DevicePrefetcher``."""
+    out: Dict[str, Any] = {}
+    host: Dict[str, np.ndarray] = {}
+    for n, v in feed.items():
+        var = var_lookup(n)
+        want = as_jax_dtype(var.dtype) if var is not None else None
+        if isinstance(v, jax.Array):
+            # device-side cast when needed; never a host round-trip
+            out[n] = v if (want is None or v.dtype == want) \
+                else jnp.asarray(v, dtype=want)
+        else:
+            host[n] = _feed_host_array(n, v, var)
+    nbytes = sum(a.nbytes for a in host.values())
+    if host:
+        out.update(jax.device_put(host, device))
+    return out, nbytes
 
 
 def _require(scope: Scope, name: str):

@@ -1,0 +1,440 @@
+"""Pipelined execution: device-side input prefetch + async fetch futures.
+
+Closes the feed->run gap PR 1 only measured: ``Executor.run()`` converts
+every feed on the caller thread (a blocking host->device copy) and
+``np.asarray``s every fetch eagerly, so step N+1's input transfer never
+overlaps step N's device compute. This module supplies the three pieces
+``Executor.run_pipelined`` composes into a fully overlapped loop — the
+TPU-idiomatic analog of the reference's ``async_executor.cc`` +
+``double_buffer`` reader op (whose ``reader.buffered()`` port only
+prefetches to *host* numpy, leaving the device copy on the critical
+path):
+
+* ``DevicePrefetcher`` — wraps any reader of feed dicts and runs ONE
+  bounded background thread that converts each batch (dtype coercion,
+  int64 range-checked narrowing) and ``jax.device_put``s the whole feed
+  pytree committed to the executor's place, blocking until resident.
+  The step loop receives already-on-device ``jax.Array`` feeds; H2D
+  rides the prefetch thread, overlapped with device compute.
+* ``ConstFeedCache`` — feeds whose ndarray is identical across steps
+  (same object, or a user-marked constant name) skip re-transfer
+  entirely. Invalidation rule: the cache keys unmarked arrays by object
+  identity and HOLDS a reference (so an id can never be reused by a new
+  array while cached) — mutating a cached array IN PLACE yields
+  unspecified results (stale on copying backends, aliased under CPU
+  zero-copy); call ``invalidate(arr)`` after an in-place update, or
+  pass a fresh array. Names listed in ``const_feed_names`` are cached
+  by NAME and transfer exactly once, value changes ignored until
+  ``invalidate(name=...)``.
+* ``FetchHandle`` — a future over one dispatched step's fetches. JAX
+  dispatch is async: the handle holds device arrays still being
+  computed; ``result()`` materializes (numpy conversion + the
+  FLAGS_check_nan_inf check) on demand, so compute, next-batch H2D and
+  previous-fetch D2H all overlap while the in-flight window caps device
+  memory.
+
+See docs/PERFORMANCE.md for the architecture and tuning guide.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Any, Dict, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["DevicePrefetcher", "ConstFeedCache", "FetchHandle"]
+
+_END = object()
+
+
+def _tree_nbytes(tree) -> int:
+    return sum(getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(tree))
+
+
+class ConstFeedCache:
+    """Device-resident dedup cache for feeds that repeat across steps.
+
+    Two tiers:
+    * unmarked arrays key on ``(feed name, id(arr))`` — the name matters
+      because the SAME host array fed under two names converts to two
+      different device arrays (per-var dtype coercion); a hit requires
+      the cached host object to BE the fed object (the cache holds a
+      strong reference, so a live entry's id can never be recycled by a
+      different array).
+      Bounded LRU — and the prefetcher only stores an unmarked array on
+      its SECOND sighting, so ordinary fresh-per-step batches never pin
+      host or device memory here (dedup then kicks in from the third
+      repeat onward).
+    * ``mark_constant(name)`` names key on the feed NAME: the first
+      value transfers, every later value is ignored (the user's promise
+      of constancy) until ``invalidate(name=...)``.
+
+    Mutating a cached ndarray in place is UNSPECIFIED until the caller
+    invalidates: the cache keeps serving its device value, which is
+    stale on copying backends (TPU) and may alias the mutated host
+    buffer on CPU (``device_put`` zero-copy) — two different wrong
+    answers. Call ``invalidate(arr)`` after any in-place update. This is
+    the documented invalidation rule — the same discipline the prefetch
+    thread already requires (an array handed to the pipeline is borrowed
+    until its step consumed it).
+    """
+
+    def __init__(self, capacity: int = 8):
+        if capacity < 1:
+            raise ValueError("ConstFeedCache capacity must be >= 1")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        # (feed name, id(arr)) -> (host_ref, device_arr); ordered for LRU
+        self._by_id: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._by_name: Dict[str, Any] = {}
+        self._const_names: set = set()
+
+    def mark_constant(self, *names: str) -> None:
+        with self._lock:
+            self._const_names.update(names)
+
+    def is_const(self, name: str) -> bool:
+        with self._lock:
+            return name in self._const_names
+
+    def lookup(self, name: str, val, device=None) -> Optional[Any]:
+        """Device array for (name, val) if cached, else None. ``device``
+        (when given) guards a cache shared across prefetchers committed
+        to different devices: an entry resident elsewhere is a MISS (and
+        the re-transfer overwrites it), never a mixed-device feed."""
+        from ..observe.families import (PIPELINE_CONST_BYTES_SAVED,
+                                        PIPELINE_CONST_HITS)
+
+        with self._lock:
+            if name in self._const_names:
+                dev = self._by_name.get(name)
+            else:
+                key = (name, id(val))
+                entry = self._by_id.get(key)
+                if entry is None or entry[0] is not val:
+                    return None
+                self._by_id.move_to_end(key)
+                dev = entry[1]
+        if dev is not None and device is not None \
+                and getattr(dev, "device", device) != device:
+            return None
+        if dev is not None:
+            PIPELINE_CONST_HITS.inc()
+            PIPELINE_CONST_BYTES_SAVED.inc(_tree_nbytes(dev))
+        return dev
+
+    def store(self, name: str, val, dev) -> None:
+        with self._lock:
+            if name in self._const_names:
+                self._by_name[name] = dev
+                return
+            if not isinstance(val, np.ndarray):
+                return  # lists/scalars have no stable identity worth caching
+            key = (name, id(val))
+            self._by_id[key] = (val, dev)
+            self._by_id.move_to_end(key)
+            while len(self._by_id) > self.capacity:
+                self._by_id.popitem(last=False)
+
+    def invalidate(self, val=None, name: Optional[str] = None) -> None:
+        """Drop one entry (by array or name) or, with no args, everything."""
+        with self._lock:
+            if val is None and name is None:
+                self._by_id.clear()
+                self._by_name.clear()
+                return
+            if val is not None:
+                for key in [k for k in self._by_id if k[1] == id(val)]:
+                    del self._by_id[key]
+            if name is not None:
+                self._by_name.pop(name, None)
+
+
+class DevicePrefetcher:
+    """Background-thread H2D prefetch: wraps a reader of feed dicts and
+    yields feed dicts of already-device-resident ``jax.Array``s.
+
+    ``reader``: a zero-arg callable returning an iterable of feed dicts
+    (the repo's reader convention), or an iterable of feed dicts.
+    ``place``: the executor's Place; transfers commit to its device.
+    ``program``: optional — its global block supplies var dtypes so the
+    conversion matches ``Executor.run``'s (int64 ids narrow with a range
+    check, AMP-independent on-device dtypes).
+    ``depth``: max batches resident ahead of the consumer (bounds device
+    memory: depth * batch bytes).
+    ``const_feed_names``: names cached by NAME in the dedup cache (see
+    ``ConstFeedCache``); unmarked repeat arrays dedup automatically by
+    object identity unless ``const_dedup=False`` — pass that when the
+    reader refills ONE preallocated ndarray in place each step (constant
+    id, changing data), where identity dedup would serve stale batches.
+
+    The fill thread stops promptly when the consumer abandons iteration
+    (``close()``, ``with`` exit, or generator GC) — the put is
+    stop-aware, never a forever-block against the bounded queue. A
+    reader exception is re-raised in the consumer at the point of
+    iteration. A prefetcher is SINGLE-USE: once closed or fully
+    consumed, iterating again raises — construct one per epoch.
+    """
+
+    def __init__(self, reader, place=None, program=None, depth: int = 2,
+                 const_feed_names: Sequence[str] = (),
+                 const_cache: Optional[ConstFeedCache] = None,
+                 const_dedup: bool = True):
+        if depth < 1:
+            raise ValueError("DevicePrefetcher depth must be >= 1")
+        self._reader = reader
+        self._depth = depth
+        # const_dedup=False turns OFF the implicit identity tier — for
+        # readers that refill ONE preallocated ndarray in place each step
+        # (id stays constant while the data changes, so identity dedup
+        # would silently serve stale batches). Marked const_feed_names
+        # still cache: that tier is an explicit opt-in by name.
+        self._dedup_unmarked = const_dedup
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self.const_cache = const_cache or ConstFeedCache()
+        if const_feed_names:
+            self.const_cache.mark_constant(*const_feed_names)
+        self._var_lookup = (program.global_block().vars.get
+                            if program is not None else lambda _n: None)
+        self._device = place.jax_device() if place is not None else None
+        self._thread = threading.Thread(
+            target=self._fill, name="DevicePrefetcher", daemon=True)
+        self._started = False
+        self._closed = False
+        # (name, id) seen once (weakrefs: pins nothing) — an unmarked
+        # array only enters the const cache on its SECOND sighting, so
+        # ordinary fresh-per-step batches never pin cache memory
+        self._seen: "OrderedDict[tuple, weakref.ref]" = OrderedDict()
+
+    # ------------------------------------------------------------ thread
+    def _convert(self, feed: Dict[str, Any]) -> tuple:
+        """One batch -> device-resident pytree; returns (dict, h2d_bytes)."""
+        from .executor import feeds_to_device
+
+        cached, rest = {}, {}
+        for n, v in feed.items():
+            dev = self.const_cache.lookup(n, v, device=self._device) \
+                if (self._dedup_unmarked or self.const_cache.is_const(n)) \
+                else None
+            if dev is not None:
+                cached[n] = dev
+            else:
+                rest[n] = v
+        out, nbytes = feeds_to_device(rest, self._var_lookup, self._device)
+        for n, dev in out.items():
+            if self.const_cache.is_const(n) or \
+                    (self._dedup_unmarked and self._repeat(n, feed[n])):
+                self.const_cache.store(n, feed[n], dev)
+        out.update(cached)
+        return out, nbytes
+
+    def _repeat(self, name, v) -> bool:
+        """True iff this exact array object was fed under this name
+        before (fill thread only, so no lock). Tracks candidates by
+        weakref: a fresh batch costs one dict slot, never a pinned
+        array. Name-qualified like the cache: the same array under two
+        names converts to two different device arrays."""
+        if not isinstance(v, np.ndarray):
+            return False
+        k = (name, id(v))
+        ref = self._seen.get(k)
+        if ref is not None and ref() is v:
+            self._seen.move_to_end(k)
+            return True
+        try:
+            self._seen[k] = weakref.ref(v)
+        except TypeError:
+            return False
+        self._seen.move_to_end(k)
+        while len(self._seen) > max(32, 4 * self._depth):
+            self._seen.popitem(last=False)
+        return False
+
+    def _put(self, item) -> bool:
+        """Stop-aware bounded put; False if the consumer went away."""
+        from ..observe.families import PIPELINE_PREFETCH_DEPTH
+        from ..reader import _stop_aware_put
+
+        if not _stop_aware_put(self._q, item, self._stop):
+            return False
+        PIPELINE_PREFETCH_DEPTH.set(self._q.qsize())
+        return True
+
+    def _fill(self):
+        from ..observe.families import (DATA_BATCHES, PIPELINE_H2D_BYTES,
+                                        PIPELINE_H2D_SECONDS)
+
+        batches = DATA_BATCHES.labels(source="device_prefetcher")
+        try:
+            it = self._reader() if callable(self._reader) \
+                else iter(self._reader)
+            for feed in it:
+                if self._stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                dev, nbytes = self._convert(feed)
+                # block in THIS thread: the consumer must receive feeds
+                # that are truly resident, and the histogram must record
+                # real transfer latency, not an async hand-off
+                jax.block_until_ready(dev)
+                PIPELINE_H2D_SECONDS.observe(time.perf_counter() - t0)
+                PIPELINE_H2D_BYTES.inc(nbytes)
+                batches.inc()
+                if not self._put(dev):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._error = e
+        finally:
+            self._put(_END)
+
+    # ---------------------------------------------------------- consumer
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        # NOT a generator: the single-use check must fire at iter() time
+        # (run_pipelined's eager-validation contract), not at first next()
+        if self._closed:
+            # the _END sentinel is consumed by the first pass, so a second
+            # one would block in q.get() forever — fail fast instead
+            raise RuntimeError(
+                "DevicePrefetcher is single-use: it was already closed or "
+                "fully consumed; construct a new one per epoch")
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self._consume()
+
+    def _consume(self) -> Iterator[Dict[str, Any]]:
+        from ..observe import mark_batch_produced
+        from ..observe.families import PIPELINE_PREFETCH_DEPTH
+
+        try:
+            while True:
+                try:
+                    item = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return  # close()d from another thread mid-iteration
+                    continue
+                PIPELINE_PREFETCH_DEPTH.set(self._q.qsize())
+                if item is _END:
+                    if self._error is not None:
+                        raise self._error
+                    return
+                # stamp at device-resident HAND-OFF (not host production):
+                # the executor's feed->run gap then measures exactly the
+                # latency left on the critical path — ~µs when the
+                # pipeline keeps up, vs the full blocking convert+H2D in
+                # an unpipelined loop
+                mark_batch_produced()
+                yield item
+        finally:
+            self.close()
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the fill thread and release queued batches. Idempotent;
+        called automatically when iteration ends or is abandoned."""
+        self._closed = True
+        self._stop.set()
+        if self._started:
+            from ..reader import _drain
+
+            # drain so a put-blocked producer wakes, sees stop, exits
+            _drain(self._q)
+            self._thread.join(timeout=timeout)
+        from ..observe.families import PIPELINE_PREFETCH_DEPTH
+
+        PIPELINE_PREFETCH_DEPTH.set(0)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class FetchHandle:
+    """Future over one dispatched step's fetches.
+
+    The executor hands these out WITHOUT blocking: JAX async dispatch
+    means the wrapped arrays are still being computed when the handle is
+    yielded. ``result()`` materializes (and caches) the values —
+    numpy-converted when the dispatching call asked for it, with the
+    FLAGS_check_nan_inf check applied at that point; ``wait()`` blocks
+    until the device values are ready without converting; ``done()``
+    polls.
+    """
+
+    __slots__ = ("step", "fetch_names", "_fetches", "_return_numpy",
+                 "_values", "_materialized", "_completion", "_block_on")
+
+    def __init__(self, step: int, fetch_names: Sequence[str], fetches,
+                 return_numpy: bool = True, completion=None, block_on=()):
+        self.step = step
+        self.fetch_names = tuple(fetch_names)
+        self._fetches = list(fetches)
+        self._return_numpy = return_numpy
+        self._values = None
+        self._materialized = False
+        # (steady, site, t0) from _record_dispatch: the `complete` phase
+        # is observed once, when the host first blocks on this step
+        self._completion = completion
+        # with an empty fetch_list there is nothing to block on, so the
+        # in-flight window would stop bounding dispatch: `block_on`
+        # carries the step's state futures so wait() still means "this
+        # step's device work finished" (released after the first wait)
+        self._block_on = block_on
+
+    def done(self) -> bool:
+        targets = self._fetches if self._fetches \
+            else jax.tree.leaves(self._block_on)
+        return all(f.is_ready() if hasattr(f, "is_ready") else True
+                   for f in targets)
+
+    def _record_complete(self) -> None:
+        # no fetches -> the host never learns when the step finished;
+        # recording here would pollute `complete` with dispatch-only dt
+        if self._completion is None or not self._fetches:
+            return
+        steady, site, t0 = self._completion
+        self._completion = None
+        from .executor import _record_completion
+
+        _record_completion(steady, site, time.perf_counter() - t0)
+
+    def wait(self) -> "FetchHandle":
+        jax.block_until_ready(self._fetches if self._fetches
+                              else self._block_on)
+        self._block_on = ()  # release the state futures once ready
+        self._record_complete()
+        return self
+
+    def result(self):
+        """Block until ready and return the fetch values (numpy when the
+        dispatching call used return_numpy=True). Idempotent."""
+        if self._materialized:
+            return self._values
+        if self._return_numpy and self._fetches:
+            out = [np.asarray(v) for v in self._fetches]
+            self._record_complete()
+            from .executor import _check_fetches_finite
+
+            _check_fetches_finite(self.fetch_names, out,
+                                  " at pipelined step %d" % self.step)
+        else:
+            self.wait()
+            out = list(self._fetches)
+        self._values = out
+        self._materialized = True
+        self._fetches = out  # drop the extra list, keep slots consistent
+        return out

@@ -55,4 +55,4 @@ def reset():
     from . import spans as _spans
 
     REGISTRY.reset()
-    _spans._last_batch_ts = None
+    _spans._clear_batch_stamp()

@@ -37,12 +37,69 @@ EXECUTOR_COMPILE_SECONDS = REGISTRY.histogram(
     "+ one step); later dispatches land in paddle_executor_run_seconds")
 EXECUTOR_RUN_SECONDS = REGISTRY.histogram(
     "paddle_executor_run_seconds",
-    "Wall time of one compiled-step dispatch (host-observed; includes "
-    "device sync only when the caller blocks)", labels=("site",))
+    "Steady-state step latency, split by phase: 'dispatch' is the async "
+    "hand-off (host time until the XLA launch returns), 'complete' is "
+    "dispatch-to-results-ready (only observed when the host actually "
+    "blocks, e.g. return_numpy or an explicit wait). For "
+    "site=run_pipelined, 'complete' measures dispatch to FIRST host "
+    "block on the step's FetchHandle — by design ~max_in_flight steps "
+    "late, so it reads higher than site=run without the step being "
+    "slower; compare 'dispatch' across sites, not 'complete'",
+    labels=("site", "phase"))
+for _site in ("run", "run_repeated", "run_pipelined"):
+    for _phase in ("dispatch", "complete"):
+        # pre-materialize the per-site/phase series (schema-is-the-signal,
+        # same as the RPC methods below)
+        EXECUTOR_RUN_SECONDS.labels(site=_site, phase=_phase)
+EXECUTOR_CACHE_EVICTIONS = REGISTRY.counter(
+    "paddle_executor_plan_cache_evictions_total",
+    "Plans evicted from the size-capped executor LRU "
+    "(PADDLE_TPU_EXECUTOR_CACHE_SIZE); sustained growth = shape churn")
 FEED_TO_RUN_GAP_SECONDS = REGISTRY.histogram(
     "paddle_feed_to_run_gap_seconds",
-    "Gap between the input pipeline producing a batch and the next "
-    "executor dispatch starting — input-bound vs compute-bound signal")
+    "Gap between the input pipeline handing over a batch and the next "
+    "executor dispatch starting — input-bound vs compute-bound signal. "
+    "Unpipelined runs stamp at host-batch production, so the gap "
+    "includes the blocking H2D convert; DevicePrefetcher stamps at "
+    "device-resident hand-off, so a working pipeline shows ~µs gaps")
+
+# ------------------------------------------------------------- pipeline
+PIPELINE_PREFETCH_DEPTH = REGISTRY.gauge(
+    "paddle_pipeline_prefetch_queue_depth",
+    "Device-resident batches currently queued in DevicePrefetcher "
+    "(0 while compute-bound consumers drain faster than the reader). "
+    "Process-global, last-writer-wins: meaningful with ONE live "
+    "pipeline; concurrent prefetchers overwrite each other and close() "
+    "zeroes it")
+PIPELINE_IN_FLIGHT = REGISTRY.gauge(
+    "paddle_pipeline_in_flight_steps",
+    "Dispatched-but-unresolved steps in run_pipelined's window")
+PIPELINE_H2D_BYTES = REGISTRY.counter(
+    "paddle_pipeline_h2d_bytes_total",
+    "Feed bytes transferred host->device by DevicePrefetcher")
+PIPELINE_H2D_SECONDS = REGISTRY.histogram(
+    "paddle_pipeline_h2d_seconds",
+    "Per-batch DevicePrefetcher convert + device_put + ready wall time "
+    "(off the step loop's critical path)")
+PIPELINE_WAIT_SECONDS = REGISTRY.histogram(
+    "paddle_pipeline_wait_seconds",
+    "Time run_pipelined blocked on the OLDEST in-flight step — at the "
+    "window cap before dispatching the next one, or draining the last "
+    "max_in_flight steps after the reader ran dry")
+PIPELINE_OVERLAP_RATIO = REGISTRY.gauge(
+    "paddle_pipeline_overlap_ratio",
+    "1 - fetch-blocked/wall for the last run_pipelined loop: ~1.0 = the "
+    "in-flight window never stalled dispatch, ~0 = the loop serialized "
+    "on waits for the oldest step's results. Measures WINDOW waits only "
+    "— an input-starved loop also reads ~1.0; diagnose starvation via "
+    "prefetch_queue_depth ~0 (the feed->run gap is stamped at queue "
+    "hand-off, so it stays ~µs even while the consumer starves)")
+PIPELINE_CONST_HITS = REGISTRY.counter(
+    "paddle_pipeline_const_feed_hits_total",
+    "Feeds served from the const-feed dedup cache (H2D skipped)")
+PIPELINE_CONST_BYTES_SAVED = REGISTRY.counter(
+    "paddle_pipeline_const_feed_bytes_saved_total",
+    "H2D bytes avoided by const-feed dedup hits")
 
 # ------------------------------------------------------------------ rpc
 RPC_CALLS = REGISTRY.counter(
@@ -100,7 +157,7 @@ ENGINE_DEVICES = REGISTRY.gauge(
 DATA_BATCHES = REGISTRY.counter(
     "paddle_data_batches_total",
     "Batches produced by the input pipelines", labels=("source",))
-for _s in ("reader.batch", "datafeed"):
+for _s in ("reader.batch", "datafeed", "device_prefetcher"):
     DATA_BATCHES.labels(source=_s)
 
 # -------------------------------------------------------- backend/bench

@@ -330,9 +330,16 @@ class Registry:
                 lines.append("# HELP %s %s" % (
                     name, m["help"].replace("\\", r"\\").replace("\n", r"\n")))
             lines.append("# TYPE %s %s" % (name, m["type"]))
+            # label order follows the declared schema, not the sample
+            # dict: a JSON round-trip (dump writes sort_keys=True) must
+            # render byte-identically to the live registry
+            lnames = m.get("labelnames") or []
             for s in m["samples"]:
-                lbl = ",".join('%s="%s"' % (k, _escape_label_value(str(v)))
-                               for k, v in s["labels"].items())
+                order = [k for k in lnames if k in s["labels"]] + \
+                    [k for k in s["labels"] if k not in lnames]
+                lbl = ",".join('%s="%s"'
+                               % (k, _escape_label_value(str(s["labels"][k])))
+                               for k in order)
                 if m["type"] == "histogram":
                     for le, c in _bucket_items(s["buckets"]):
                         blbl = (lbl + "," if lbl else "") + 'le="%s"' % le

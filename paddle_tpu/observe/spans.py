@@ -12,8 +12,8 @@ wrappers.
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import Optional
 
 from .families import REGISTRY
 
@@ -67,23 +67,31 @@ def span(name: str, histogram=None, counter=None) -> Span:
 
 
 # ------------------------------------------------------- feed-to-run gap
-# The input pipeline stamps "a batch is ready" (mark_batch_produced, from
-# reader.batch / MultiSlotDataFeed); the executor reads-and-clears the
-# stamp at dispatch entry (observe_feed_gap). The observed gap separates
+# The input pipeline stamps "a batch was handed to this thread"
+# (mark_batch_produced, from reader.batch / MultiSlotDataFeed /
+# DevicePrefetcher hand-off); the executor reads-and-clears the stamp at
+# dispatch entry (observe_feed_gap). The observed gap separates
 # input-bound from compute-bound steady states without a profiler run.
-_last_batch_ts: Optional[float] = None
+# THREAD-LOCAL: a background fill thread (buffered(), DevicePrefetcher)
+# runs the wrapped reader concurrently with the consumer's step loop —
+# a shared stamp would let batch N+1's production overwrite batch N's
+# hand-off between stamp and observe, recording a gap against the wrong
+# batch. Thread-wrapping readers re-stamp at hand-off in the consumer.
+_batch_stamp = threading.local()
 
 from .families import FEED_TO_RUN_GAP_SECONDS  # noqa: E402
 
 
 def mark_batch_produced() -> None:
-    global _last_batch_ts
-    _last_batch_ts = time.perf_counter()
+    _batch_stamp.ts = time.perf_counter()
 
 
 def observe_feed_gap() -> None:
-    global _last_batch_ts
-    ts = _last_batch_ts
+    ts = getattr(_batch_stamp, "ts", None)
     if ts is not None:
-        _last_batch_ts = None
+        _batch_stamp.ts = None
         FEED_TO_RUN_GAP_SECONDS.observe(time.perf_counter() - ts)
+
+
+def _clear_batch_stamp() -> None:
+    _batch_stamp.ts = None

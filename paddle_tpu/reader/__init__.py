@@ -159,33 +159,75 @@ def shuffle(reader, buf_size):
     return shuffle_reader
 
 
+def _stop_aware_put(q, item, stop, poll=0.1):
+    """Bounded put that gives up when `stop` is set — a producer thread
+    must never block forever against a full queue after its consumer
+    abandoned the generator. Returns False when stopped."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=poll)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _drain(q):
+    """Empty a queue so a producer blocked in `_stop_aware_put` wakes,
+    sees the stop flag, and exits. The other half of the stop-aware
+    contract; shared by buffered/multiprocess_reader/DevicePrefetcher."""
+    while True:
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            break
+
+
 def buffered(reader, size):
     """Background-thread prefetch (the py_reader/double-buffer analog for
-    plain python pipelines)."""
+    plain python pipelines). A consumer that abandons the generator
+    early (break, GC, .close()) signals the fill thread to stop — the
+    put is stop-aware, so the thread exits instead of blocking forever
+    on the bounded queue."""
     end = object()
 
     def buffered_reader():
+        from ..observe import mark_batch_produced
+
         q: queue.Queue = queue.Queue(maxsize=size)
+        stop = threading.Event()
         error = []
 
         def fill():
             try:
                 for sample in reader():
-                    q.put(sample)
+                    if not _stop_aware_put(q, sample, stop):
+                        return
             except BaseException as e:  # re-raised in the consumer
                 error.append(e)
             finally:
-                q.put(end)
+                _stop_aware_put(q, end, stop)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
-        while True:
-            s = q.get()
-            if s is end:
-                if error:
-                    raise error[0]
-                break
-            yield s
+        try:
+            while True:
+                s = q.get()
+                if s is end:
+                    if error:
+                        raise error[0]
+                    break
+                # the wrapped reader's gap stamp landed in the FILL
+                # thread (stamps are thread-local); re-stamp at hand-off
+                # so the consumer's feed->run gap still observes
+                mark_batch_produced()
+                yield s
+        finally:
+            # GeneratorExit / normal exhaustion / consumer exception all
+            # land here: release the producer, then drain so a put
+            # blocked on a full queue wakes and sees the stop flag
+            stop.set()
+            _drain(q)
 
     return buffered_reader
 
@@ -275,31 +317,49 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     processes (GIL-bound cv2 decoding); here readers drive jax/numpy
     which release the GIL, so worker THREADS give the same overlap
     without fork-vs-PJRT hazards (documented divergence)."""
-    import queue as _queue
-    import threading
-
     def reader():
-        q = _queue.Queue(maxsize=queue_size)
+        q = queue.Queue(maxsize=queue_size)
+        stop = threading.Event()
         sentinel = object()
+        errors = []
 
         def work(r):
             try:
                 for sample in r():
-                    q.put(sample)
-            finally:
-                q.put(sentinel)
+                    if not _stop_aware_put(q, sample, stop):
+                        return
+            except BaseException as e:  # re-raised in the consumer: a
+                errors.append(e)       # dead worker must not read as a
+            finally:                   # normally-exhausted epoch
+                _stop_aware_put(q, sentinel, stop)
 
         threads = [threading.Thread(target=work, args=(r,), daemon=True)
                    for r in readers]
         for t in threads:
             t.start()
-        done = 0
-        while done < len(readers):
-            item = q.get()
-            if item is sentinel:
-                done += 1
-            else:
-                yield item
+        try:
+            from ..observe import mark_batch_produced
+
+            done = 0
+            while done < len(readers):
+                item = q.get()
+                if item is sentinel:
+                    done += 1
+                    # a worker appends its error BEFORE its sentinel, so
+                    # checking here raises at the point of death instead
+                    # of after every healthy worker drains its epoch
+                    if errors:
+                        raise errors[0]
+                else:
+                    # worker-thread stamps are thread-local: re-stamp at
+                    # hand-off so the consumer's feed->run gap observes
+                    mark_batch_produced()
+                    yield item
+        finally:
+            # same guard as buffered(): an abandoned consumer must not
+            # leave len(readers) drain threads blocked on q.put forever
+            stop.set()
+            _drain(q)
 
     return reader
 

@@ -109,6 +109,90 @@ def test_bench_orchestrator_kills_hung_workload():
 
 
 @pytest.mark.slow
+def test_bench_pipelined_row(tmp_path):
+    """PADDLE_TPU_BENCH_PIPELINE=1 drives the timed loop through
+    DevicePrefetcher + run_pipelined: the row must carry the
+    "pipelined" marker (so it never pins over a pre-placed-feed
+    baseline) and the sidecar must hold the pipeline families."""
+    # composed attention: the assertion is about pipelined wiring, not
+    # the flash kernel, and conftest's PADDLE_TPU_FLASH_MIN_SEQ=0 would
+    # otherwise leak in and flip the dispatch under pytest
+    rc, rows = _run(["--worker", "transformer", "--quick"],
+                    {"PADDLE_TPU_BENCH_PIPELINE": "1",
+                     "PADDLE_TPU_FUSED_ATTENTION": "0",
+                     "PADDLE_TPU_TELEMETRY_DIR": str(tmp_path),
+                     "PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT": "560"}, 590)
+    assert rc == 0, rows
+    row = [r for r in rows if "value" in r][0]
+    assert row["pipelined"] is True
+    assert row["value"] > 0
+    assert row["vs_baseline"] == 1.0  # mode-mismatched rows never compare
+    side = json.load(open(tmp_path / "BENCH_transformer.telemetry.json"))
+    m = side["metrics"]
+    assert m["paddle_pipeline_h2d_bytes_total"]["samples"][0]["value"] > 0
+    assert m["paddle_pipeline_h2d_seconds"]["samples"][0]["count"] > 0
+    assert m["paddle_pipeline_overlap_ratio"]["samples"][0]["value"] > 0
+
+
+def _mini_snap(steps, gap_bucket_counts):
+    """Minimal valid telemetry snapshot for stats_dump --diff tests."""
+    total = sum(gap_bucket_counts.values())
+    acc, buckets = 0, {}
+    for le in sorted(gap_bucket_counts, key=float):
+        acc += gap_bucket_counts[le]
+        buckets[le] = acc
+    buckets["+Inf"] = total
+    return {
+        "version": 1, "pid": 1, "unix_time": 0.0,
+        "metrics": {
+            "paddle_executor_steps_total": {
+                "type": "counter", "help": "", "labelnames": [],
+                "samples": [{"labels": {}, "value": steps}]},
+            "paddle_feed_to_run_gap_seconds": {
+                "type": "histogram", "help": "", "labelnames": [],
+                "samples": [{"labels": {}, "sum": 0.1 * total,
+                             "count": total, "buckets": buckets}]},
+            "paddle_backend_probe_ok": {
+                "type": "gauge", "help": "", "labelnames": [],
+                "samples": [{"labels": {}, "value": 0}]},
+        }}
+
+
+def test_stats_dump_diff_prints_per_family_deltas(tmp_path):
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_mini_snap(10, {"0.01": 10})))
+    b.write_text(json.dumps(_mini_snap(25, {"0.001": 15})))
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(BENCH), "tools", "stats_dump.py"),
+         "--diff", str(a), str(b)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    # counter delta and side-by-side histogram stats both render
+    assert "paddle_executor_steps_total" in out.stdout
+    assert "+15" in out.stdout
+    assert "paddle_feed_to_run_gap_seconds" in out.stdout
+    line = [l for l in out.stdout.splitlines()
+            if l.startswith("paddle_feed_to_run_gap_seconds")][0]
+    cols = line.split()
+    assert cols[1] == "10" and cols[2] == "15"  # cnt A, cnt B
+    # a gauge at 0 in BOTH snapshots still renders (probe_ok=0 IS the
+    # wedged-tunnel diagnosis; zero-suppression only drops counters)
+    assert "paddle_backend_probe_ok" in out.stdout
+
+    # a non-snapshot file is a usage error, not a traceback
+    junk = tmp_path / "junk.json"
+    junk.write_text("{}")
+    bad = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(BENCH), "tools", "stats_dump.py"),
+         "--diff", str(a), str(junk)],
+        capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 2
+    assert "not a telemetry snapshot" in bad.stderr
+
+
+@pytest.mark.slow
 def test_bench_deepfm_dist_row(tmp_path):
     """The distributed-CTR row: trainer + 2 spawned localhost pservers,
     sparse tables riding prefetch/SelectedRows over the RPC stack; the

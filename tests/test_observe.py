@@ -183,8 +183,12 @@ def test_executor_cache_and_step_metrics(fresh_programs):
     assert _value("paddle_executor_cache_misses_total") == m0 + 1
     assert _value("paddle_executor_cache_hits_total") == h0 + 2
     assert _value("paddle_executor_steps_total") == s0 + 3
-    # first dispatch lands in the compile histogram, the rest in run
-    assert _value("paddle_executor_run_seconds", site="run") >= 2
+    # first dispatch lands in the compile histogram; the steady steps
+    # record BOTH phases: the async hand-off and the blocked completion
+    assert _value("paddle_executor_run_seconds", site="run",
+                  phase="dispatch") >= 2
+    assert _value("paddle_executor_run_seconds", site="run",
+                  phase="complete") >= 2
 
 
 def test_run_repeated_counts_all_scanned_steps(fresh_programs):

@@ -7,6 +7,8 @@ Usage:
     python tools/stats_dump.py snapshot.json --prometheus
     python tools/stats_dump.py --live            # this process (near-empty;
                                                  # useful from a REPL/pdb)
+    python tools/stats_dump.py --diff A.telemetry.json B.telemetry.json
+                                                 # per-family deltas B vs A
 
 Reads the JSON written by `paddle_tpu.observe.dump()` (bench.py drops one
 per workload row, including failed rows) and renders counters/gauges as a
@@ -66,6 +68,13 @@ def _label_str(labels):
     return ",".join("%s=%s" % kv for kv in sorted(labels.items()))
 
 
+def _series_key(name, sample):
+    """Canonical per-series key ('name{l=v,...}') — shared by the table
+    and --diff renderers so their keys can never drift apart."""
+    labels = sample["labels"]
+    return name + ("{%s}" % _label_str(labels) if labels else "")
+
+
 def render_table(snap, show_all=False, out=sys.stdout):
     meta = "snapshot pid=%s unix_time=%s" % (snap.get("pid"),
                                              _fmt(snap.get("unix_time")))
@@ -75,8 +84,7 @@ def render_table(snap, show_all=False, out=sys.stdout):
     for name in sorted(snap["metrics"]):
         m = snap["metrics"][name]
         for s in m["samples"]:
-            key = name + ("{%s}" % _label_str(s["labels"])
-                          if s["labels"] else "")
+            key = _series_key(name, s)
             if m["type"] == "histogram":
                 if not show_all and not s["count"]:
                     continue
@@ -114,6 +122,82 @@ def render_table(snap, show_all=False, out=sys.stdout):
               file=out)
 
 
+def render_diff(snap_a, snap_b, name_a="A", name_b="B", show_all=False,
+                out=sys.stdout):
+    """Per-series comparison of two snapshots: counters/gauges print
+    value A, value B and the delta; histograms print count/mean/p50/p99
+    side by side. Built for comparing bench telemetry sidecars — e.g. a
+    pipelined vs unpipelined row — at a glance. Series present in only
+    one snapshot render with '-' on the missing side."""
+    print("diff: A=%s  B=%s" % (name_a, name_b), file=out)
+
+    def _series(snap):
+        table = {}
+        for name, m in snap["metrics"].items():
+            for s in m["samples"]:
+                table[_series_key(name, s)] = (m["type"], s)
+        return table
+
+    sa, sb = _series(snap_a), _series(snap_b)
+    scalar_rows, hist_rows = [], []
+    for key in sorted(set(sa) | set(sb)):
+        kind = (sa.get(key) or sb.get(key))[0]
+        a = sa.get(key, (None, None))[1]
+        b = sb.get(key, (None, None))[1]
+        if kind == "histogram":
+            def stats(s):
+                if s is None or not s["count"]:
+                    return (0, None, None, None)
+                cnt = s["count"]
+                return (cnt, s["sum"] / cnt,
+                        _percentile(s["buckets"], cnt, 0.5),
+                        _percentile(s["buckets"], cnt, 0.99))
+            ca, ma, p50a, p99a = stats(a)
+            cb, mb, p50b, p99b = stats(b)
+            if not show_all and not ca and not cb:
+                continue
+            hist_rows.append((key, ca, cb, _fmt(ma), _fmt(mb),
+                              _fmt(p50a), _fmt(p50b), _fmt(p99a),
+                              _fmt(p99b)))
+        else:
+            va = a["value"] if a is not None else None
+            vb = b["value"] if b is not None else None
+            # gauges always render, as in render_table: a gauge at 0 in
+            # both snapshots (backend_probe_ok) IS the diagnosis
+            if not show_all and kind != "gauge" and not va and not vb:
+                continue
+            delta = (vb or 0) - (va or 0)
+            scalar_rows.append((key, kind, _fmt(va), _fmt(vb),
+                                "%+g" % delta if delta else "0"))
+    if scalar_rows:
+        w = max(len(r[0]) for r in scalar_rows)
+        print("%-*s %-8s %12s %12s %12s"
+              % (w, "metric", "type", "A", "B", "delta"), file=out)
+        for key, kind, va, vb, d in scalar_rows:
+            print("%-*s %-8s %12s %12s %12s" % (w, key, kind, va, vb, d),
+                  file=out)
+    if hist_rows:
+        print(file=out)
+        w = max(len(r[0]) for r in hist_rows)
+        print("%-*s %8s %8s %10s %10s %10s %10s %10s %10s"
+              % (w, "histogram", "cnt A", "cnt B", "mean A", "mean B",
+                 "p50 A", "p50 B", "p99 A", "p99 B"), file=out)
+        for row in hist_rows:
+            print("%-*s %8d %8d %10s %10s %10s %10s %10s %10s"
+                  % ((w,) + row), file=out)
+    if not scalar_rows and not hist_rows:
+        print("(no non-zero series in either snapshot — --all lists "
+              "the schema)", file=out)
+
+
+def _load_snapshot(path, ap):
+    with open(path) as f:
+        snap = json.load(f)
+    if "metrics" not in snap:
+        ap.error("%s is not a telemetry snapshot (no 'metrics' key)" % path)
+    return snap
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="pretty-print a paddle_tpu telemetry snapshot")
@@ -125,7 +209,21 @@ def main(argv=None):
                     help="render text exposition format instead of a table")
     ap.add_argument("--all", action="store_true",
                     help="include zero-valued series (show the full schema)")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                    help="compare two snapshots: per-series value deltas "
+                         "and histogram count/mean/p50/p99 side by side")
     args = ap.parse_args(argv)
+
+    if args.diff is not None:
+        if args.live or args.snapshot is not None or args.prometheus:
+            ap.error("--diff takes exactly two snapshot paths and "
+                     "composes only with --all")
+        render_diff(_load_snapshot(args.diff[0], ap),
+                    _load_snapshot(args.diff[1], ap),
+                    name_a=os.path.basename(args.diff[0]),
+                    name_b=os.path.basename(args.diff[1]),
+                    show_all=args.all)
+        return 0
 
     if args.live == (args.snapshot is not None):
         ap.error("pass exactly one of: a snapshot path, or --live")
@@ -135,11 +233,7 @@ def main(argv=None):
 
         snap = observe.snapshot()
     else:
-        with open(args.snapshot) as f:
-            snap = json.load(f)
-        if "metrics" not in snap:
-            ap.error("%s is not a telemetry snapshot (no 'metrics' key)"
-                     % args.snapshot)
+        snap = _load_snapshot(args.snapshot, ap)
 
     if args.prometheus:
         # Registry.render_prometheus renders from any saved snapshot dict
