@@ -818,6 +818,219 @@ def bench_deepfm_dist(amp, quick, uses_flash=False):
         shutil.rmtree(rdv, ignore_errors=True)
 
 
+def _serving_pctl(sorted_vals, q):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def _serving_row(name, value, unit, lat_s, extra):
+    """One serving bench row: open-loop p50/p99 latency + throughput.
+    Marked "serving": pin_baselines never pins these over training
+    baselines (a scheduler-mode number is not a train-step number)."""
+    import jax as _jax
+
+    lat = sorted(lat_s)
+    rec = {
+        "metric": name,
+        "platform": _jax.devices()[0].platform.lower(),
+        "serving": True,
+        "value": round(value, 1),
+        "unit": unit,
+        "p50_ms": round(1e3 * _serving_pctl(lat, 0.50), 2) if lat else None,
+        "p99_ms": round(1e3 * _serving_pctl(lat, 0.99), 2) if lat else None,
+        "vs_baseline": 1.0,
+        "tflops_per_sec": None,  # scheduler-bound; MFU is not the story
+        "mfu": None,
+    }
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def bench_serving_decode(amp, quick, uses_flash=False):
+    """Continuous-batching GPT decode under a seeded open-loop load:
+    requests arrive on an exponential clock regardless of completion
+    (open loop — queueing delay shows up in latency instead of
+    throttling the generator), the engine packs them into b_max slots.
+    Reports aggregate tokens/sec + per-request p50/p99 latency; the
+    telemetry sidecar carries the occupancy/queue histograms."""
+    import threading
+
+    from paddle_tpu.observe.families import SERVING_TOKENS_PER_SEC
+    from paddle_tpu.serving import DecodeEngine
+
+    cfg = dict(d_model=128, d_ff=512, n_head=4, n_layer=4, vocab=1024,
+               max_length=128, dropout=0.0)
+    b_max = 4 if quick else 8
+    n_req = 8 if quick else 64
+    P, n_new = 8, 8 if quick else 24
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, cfg["vocab"], (P,)).astype("int64")
+               for _ in range(n_req)]
+
+    engine = DecodeEngine(cfg, params=None, b_max=b_max,
+                          max_len=P + n_new,
+                          queue_capacity=max(64, 2 * n_req))
+    engine.start()
+    try:
+        _log("serving_decode: compiling decode+prefill (warmup request)")
+        with _beacon("serving_decode", "compile/warmup"):
+            engine.submit(prompts[0], n_new).result(timeout=600)
+            # calibrate the arrival rate to ~b_max concurrent streams:
+            # per-token step time from a second, timed request
+            t0 = time.perf_counter()
+            engine.submit(prompts[0], n_new).result(timeout=600)
+            per_token = (time.perf_counter() - t0) / n_new
+        mean_gap = max(per_token * n_new / b_max, 1e-4)
+        arrivals = np.cumsum(rs.exponential(mean_gap, size=n_req))
+
+        from paddle_tpu import observe
+
+        def _occ():
+            s = observe.snapshot()["metrics"][
+                "paddle_serving_slot_occupancy_ratio"]["samples"][0]
+            return s["count"], s["sum"]
+
+        # occupancy over the DRIVE interval only: the two solo
+        # warmup/calibration requests decode at 1/b_max and would drag
+        # a lifetime mean well below what the row claims to measure
+        occ0 = _occ()
+        done_at = [None] * n_req
+        reqs = [None] * n_req
+        t_start = time.perf_counter()
+
+        def _drive():
+            for i, (p, at) in enumerate(zip(prompts, arrivals)):
+                dt = t_start + at - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+                reqs[i] = engine.submit(p, n_new)
+
+        _log("serving_decode: open-loop drive (%d requests, mean gap "
+             "%.1fms)" % (n_req, mean_gap * 1e3))
+        driver = threading.Thread(target=_drive, daemon=True)
+        driver.start()
+        driver.join()
+        for i, r in enumerate(reqs):
+            r.result(timeout=600)
+            done_at[i] = time.perf_counter()
+        t_end = max(done_at)
+        # open-loop latency: completion minus SCHEDULED arrival (late
+        # submission counts against the server, as it would in a real
+        # open-loop harness)
+        lat = [d - (t_start + a) for d, a in zip(done_at, arrivals)]
+        tokens = n_req * n_new
+        tps = tokens / (t_end - t_start)
+        SERVING_TOKENS_PER_SEC.set(tps)
+        occ1 = _occ()
+        steps = occ1[0] - occ0[0]
+        return _serving_row(
+            "serving_gpt_decode_tokens_per_sec", tps, "tokens/sec", lat,
+            {"b_max": b_max, "requests": n_req, "n_new": n_new,
+             **({"quick": True} if quick else {}),
+             "mean_occupancy": round((occ1[1] - occ0[1]) / steps, 3)
+             if steps else None})
+    finally:
+        engine.stop()
+
+
+def bench_serving_predictor(amp, quick, uses_flash=False):
+    """Micro-batched Predictor serving under a seeded open-loop load:
+    single-row requests coalesce in the max-wait window, pad to the
+    warmup bucket, and ride one dispatch. Reports examples/sec +
+    p50/p99; the sidecar carries batch-rows/padding-waste families."""
+    import tempfile
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+    from paddle_tpu.serving import MicroBatcher
+
+    n_req = 64 if quick else 512
+    bucket = 8 if quick else 32
+    rs = np.random.RandomState(0)
+
+    model_dir = tempfile.mkdtemp(prefix="bench_serving_pred_")
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [64], dtype="float32")
+            h = fluid.layers.fc(x, 256, act="relu")
+            h = fluid.layers.fc(h, 256, act="relu")
+            pred = fluid.layers.fc(h, 16, act="softmax")
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup, scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe,
+                                      main_program=main)
+
+    config = AnalysisConfig(model_dir=model_dir)
+    config.warmup_batch_sizes = [1, bucket]
+    _log("serving_predictor: warmup compiles (buckets %s)"
+         % config.warmup_batch_sizes)
+    with _beacon("serving_predictor", "compile/warmup"):
+        predictor = create_paddle_predictor(config)
+        # per-request step time at bucket occupancy 1 calibrates the
+        # arrival rate (target: ~bucket/2 rows per window)
+        one = {"x": rs.randn(1, 64).astype("float32")}
+        t0 = time.perf_counter()
+        for _ in range(5):
+            predictor.run(one)
+        per_run = (time.perf_counter() - t0) / 5
+    max_wait = max(2 * per_run, 0.002)
+    mean_gap = max(2 * max_wait / bucket, 1e-5)
+    arrivals = np.cumsum(rs.exponential(mean_gap, size=n_req))
+    feeds = [{"x": rs.randn(1, 64).astype("float32")}
+             for _ in range(n_req)]
+
+    batcher = MicroBatcher(predictor, max_rows=bucket,
+                           max_wait_s=max_wait,
+                           queue_capacity=max(256, 2 * n_req))
+    try:
+        reqs = [None] * n_req
+        t_start = time.perf_counter()
+
+        def _drive():
+            for i, (f, at) in enumerate(zip(feeds, arrivals)):
+                dt = t_start + at - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+                reqs[i] = batcher.submit(f)
+
+        _log("serving_predictor: open-loop drive (%d requests, window "
+             "%.1fms)" % (n_req, max_wait * 1e3))
+        driver = threading.Thread(target=_drive, daemon=True)
+        driver.start()
+        driver.join()
+        done_at = []
+        for r in reqs:
+            r.result(timeout=600)
+            done_at.append(time.perf_counter())
+        t_end = max(done_at)
+        lat = [d - (t_start + a) for d, a in zip(done_at, arrivals)]
+        eps = n_req / (t_end - t_start)
+        from paddle_tpu import observe
+
+        snap = observe.snapshot()["metrics"]
+        rows = snap["paddle_serving_batch_rows"]["samples"][0]
+        return _serving_row(
+            "serving_predictor_examples_per_sec", eps, "examples/sec",
+            lat,
+            {"bucket": bucket, "requests": n_req,
+             **({"quick": True} if quick else {}),
+             "mean_batch_rows": round(rows["sum"] / rows["count"], 2)
+             if rows["count"] else None})
+    finally:
+        batcher.close()
+        import shutil
+
+        shutil.rmtree(model_dir, ignore_errors=True)
+
+
 WORKLOADS = {
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
@@ -828,6 +1041,21 @@ WORKLOADS = {
     "deepfm_dist": bench_deepfm_dist,
     "gpt_causal": bench_gpt_causal,
 }
+
+# PADDLE_TPU_BENCH_SERVING=1 swaps the workload list for the serving
+# schedulers (docs/SERVING.md): open-loop load through the
+# micro-batched Predictor and the continuous-batching decode engine.
+# Rows are marked "serving" and never pin as training baselines.
+SERVING_ORDER = ["serving_predictor", "serving_decode"]
+SERVING_WORKLOADS = {
+    "serving_predictor": bench_serving_predictor,
+    "serving_decode": bench_serving_decode,
+}
+WORKLOADS.update(SERVING_WORKLOADS)
+
+
+def _serving_mode():
+    return os.environ.get("PADDLE_TPU_BENCH_SERVING", "0") != "0"
 
 # Safe (no custom-kernel) workloads first: if the tunnel wedges or a
 # Pallas compile hangs partway through, the rows already printed stand.
@@ -845,7 +1073,8 @@ ATTENTION_SEQ = {"transformer": 128, "transformer_long": 1024,
                  "bert": 128, "gpt_causal": 1024}
 ATTENTION_WORKLOADS = frozenset(ATTENTION_SEQ)
 
-assert set(ORDER) == set(WORKLOADS), "ORDER out of sync with WORKLOADS"
+assert set(ORDER) | set(SERVING_ORDER) == set(WORKLOADS), \
+    "ORDER/SERVING_ORDER out of sync with WORKLOADS"
 
 
 def _probe_backend(timeout_s=None):
@@ -1062,16 +1291,19 @@ def main():
         _dump_telemetry("probe")
         return 0
 
+    # PADDLE_TPU_BENCH_SERVING=1 swaps the default workload list for the
+    # serving schedulers; --only still picks any single workload by name
+    default_order = SERVING_ORDER if _serving_mode() else ORDER
     if args.worker:
         return _run_worker(args.worker, not args.fp32, args.quick)
     if args.in_process:
-        names = [args.only] if args.only else ORDER
+        names = [args.only] if args.only else default_order
         ok_count = sum(
             _run_worker(name, not args.fp32, args.quick) == 0
             for name in names)
         return 0 if ok_count else 1  # same contract as the default path
 
-    names = [args.only] if args.only else ORDER
+    names = [args.only] if args.only else default_order
     per_workload = int(os.environ.get(
         "PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT", "900"))
     budget = int(os.environ.get("PADDLE_TPU_BENCH_TOTAL_BUDGET", "7200"))

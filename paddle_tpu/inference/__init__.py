@@ -27,6 +27,15 @@ __all__ = ["AnalysisConfig", "Predictor", "create_paddle_predictor",
            "PaddleTensor"]
 
 
+def batch_major(var) -> bool:
+    """True when the var's leading dim is dynamic (the batch axis) —
+    THE predicate for "rows of this tensor belong to individual
+    requests", shared by the Predictor's bucket router and the serving
+    micro-batcher's feed/fetch checks."""
+    shape = getattr(var, "shape", None)
+    return bool(shape) and (shape[0] is None or shape[0] < 0)
+
+
 class AnalysisConfig:
     """Predictor configuration (api/paddle_analysis_config.h analog)."""
 
@@ -77,7 +86,9 @@ class Predictor:
         self.feed_names: List[str] = list(feeds)
         self.fetch_vars = fetches
         self.fetch_names = [v.name for v in fetches]
-        for bs in config.warmup_batch_sizes:
+        self._buckets: List[int] = sorted(set(
+            int(b) for b in config.warmup_batch_sizes))
+        for bs in self._buckets:
             self._warmup(bs)
 
     # ------------------------------------------------------------- serving
@@ -89,21 +100,117 @@ class Predictor:
 
     def run(self, inputs) -> List[np.ndarray]:
         """inputs: list of PaddleTensor / list of arrays in feed order /
-        dict name->array. Returns fetch arrays."""
+        dict name->array. Returns fetch arrays.
+
+        Batch sizes route through the ``warmup_batch_sizes`` buckets:
+        an unseen size pads up to the nearest bucket (reusing that
+        warmed executable — steady-state traffic never triggers a fresh
+        XLA compile) and the pad rows are sliced back off the results.
+        A batch larger than every bucket falls back to an exact-shape
+        compile, counted in ``paddle_serving_bucket_miss_total``. The
+        serving micro-batcher and direct callers share this one code
+        path. No buckets configured = the classic compile-per-shape
+        behavior."""
         feed = self._as_feed(inputs)
-        return self._exe.run(self.program, feed=feed,
+        feed, n_rows = self._route_bucket(feed)
+        outs = self._exe.run(self.program, feed=feed,
                              fetch_list=self.fetch_names, scope=self.scope)
+        if n_rows is not None:
+            outs = [o[:n_rows] if self._batch_major(v) else o
+                    for v, o in zip(self.fetch_vars, outs)]
+        return outs
 
     __call__ = run
 
+    def bucket_for(self, batch_size: int) -> Optional[int]:
+        """Smallest warmup bucket >= batch_size, or None when the batch
+        overflows every bucket (or none are configured)."""
+        for b in self._buckets:
+            if b >= batch_size:
+                return b
+        return None
+
+    @staticmethod
+    def _batch_major(var) -> bool:
+        return batch_major(var)
+
+    def _route_bucket(self, feed):
+        """Pad batch-major feeds up to the nearest warmup bucket.
+        Returns (feed, n_rows): n_rows is None when nothing was padded
+        (exact bucket hit, bucket overflow, or no buckets/batch dim)."""
+        if not self._buckets:
+            return feed, None
+        block = self.program.global_block()
+        batch_names = [n for n in feed
+                       if self._batch_major(block.vars.get(n))]
+        if not batch_names:
+            return feed, None
+        sizes = {np.asarray(feed[n]).shape[0] for n in batch_names}
+        if len(sizes) != 1:
+            raise ValueError(
+                "inconsistent batch sizes across feeds: %s"
+                % ({n: np.asarray(feed[n]).shape for n in batch_names},))
+        from ..observe.families import (SERVING_BUCKET_HITS,
+                                        SERVING_BUCKET_MISSES,
+                                        SERVING_PADDED_ROWS,
+                                        SERVING_PADDING_WASTE,
+                                        SERVING_ROWS)
+
+        (b,) = sizes
+        SERVING_ROWS.inc(b)
+        bucket = self.bucket_for(b)
+        if bucket is None:
+            # larger than every warmed shape: exact compile, and say so
+            SERVING_BUCKET_MISSES.inc()
+            SERVING_PADDING_WASTE.set(0.0)
+            return feed, None
+        SERVING_BUCKET_HITS.inc()
+        if bucket == b:
+            SERVING_PADDING_WASTE.set(0.0)
+            return feed, None
+        pad = bucket - b
+        SERVING_PADDED_ROWS.inc(pad)
+        SERVING_PADDING_WASTE.set(pad / float(bucket))
+        out = dict(feed)
+        for n in batch_names:
+            arr = np.asarray(feed[n])
+            out[n] = np.concatenate(
+                [arr, np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)])
+        return out, b
+
     def _as_feed(self, inputs) -> Dict[str, np.ndarray]:
+        known = set(self.feed_names)
         if isinstance(inputs, dict):
-            return inputs
+            unknown = sorted(set(inputs) - known)
+            if unknown:
+                raise ValueError(
+                    "unknown feed name(s) %s — this predictor's inputs "
+                    "are %s" % (unknown, self.feed_names))
+            return dict(inputs)
         if isinstance(inputs, (list, tuple)):
-            vals = [t.data if isinstance(t, PaddleTensor) else t for t in inputs]
-            names = ([t.name for t in inputs]
-                     if all(isinstance(t, PaddleTensor) for t in inputs)
-                     else self.feed_names)
+            vals = [t.data if isinstance(t, PaddleTensor) else t
+                    for t in inputs]
+            if inputs and all(isinstance(t, PaddleTensor)
+                              for t in inputs):
+                names = [t.name for t in inputs]
+                unknown = sorted(set(names) - known)
+                if unknown:
+                    raise ValueError(
+                        "unknown feed name(s) %s — this predictor's "
+                        "inputs are %s" % (unknown, self.feed_names))
+                if len(set(names)) != len(names):
+                    raise ValueError("duplicate feed names in inputs: %s"
+                                     % (names,))
+            else:
+                names = self.feed_names
+                if len(vals) != len(names):
+                    # dict(zip(...)) would silently truncate the longer
+                    # side — a missing/extra positional feed must raise
+                    raise ValueError(
+                        "got %d positional inputs for %d feeds %s — "
+                        "pass one array per feed (or PaddleTensors / a "
+                        "name->array dict)" % (len(vals), len(names),
+                                               self.feed_names))
             return dict(zip(names, vals))
         return {self.feed_names[0]: inputs}
 

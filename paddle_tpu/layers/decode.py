@@ -116,9 +116,13 @@ def rope(x, pos, base=10000.0, name=None):
 
 def kv_cache_write(cache, update, pos, name=None):
     """Write `update` [B, H, 1, D] into persistable `cache` [B, H, S, D]
-    at sequence position `pos` (a [1] int var). Returns the cache var
-    (the op writes the var in place graph-wise; the executor's donation
-    makes it in-place on device). See models/gpt.py build_decode_step."""
+    at sequence position `pos` — a [1] int var (all rows share one
+    position: the lockstep decode step) or a [B]/[B, 1] int var
+    (per-row positions: each cache slot advances independently, the
+    continuous-batching serving step). Returns the cache var (the op
+    writes the var in place graph-wise; the executor's donation makes
+    it in-place on device). See models/gpt.py build_decode_step and
+    build_serving_decode_step."""
     helper = LayerHelper("kv_cache_write", name=name)
     helper.append_op(
         type="kv_cache_write",

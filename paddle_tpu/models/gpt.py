@@ -329,11 +329,16 @@ def build_prefill_step(cfg=None, batch=1, prompt_len=8, max_len=None):
     return logits, cache_names
 
 
-def build_decode_step(cfg=None, batch=1, max_len=None):
+def build_decode_step(cfg=None, batch=1, max_len=None,
+                      per_slot_pos=False):
     """Incremental decoding step graph with donated KV caches.
 
     Feeds: token [B, 1] int64 (the current position's input token) and
-    pos [1] int64 (its position). Per-layer K/V caches live as
+    pos int64 — a [1] scalar shared by every row (the classic lockstep
+    loop, default) or, with ``per_slot_pos=True``, a [B, 1] per-row
+    position so each cache slot advances independently (the serving
+    engine's continuous-batching step — see
+    ``build_serving_decode_step``). Per-layer K/V caches live as
     persistable [B, n_kv_head (default n_head), max_len, Dh] state the
     executor DONATES — the `kv_cache_write` update is in-place on
     device, so a decode step moves O(1) data (GQA shrinks the cache
@@ -348,17 +353,29 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
     _check_cfg(cfg)
     if max_len is None:
         max_len = cfg["max_length"]
+    use_rope = cfg.get("pos_emb", "learned") == "rope"
+    if not use_rope and max_len > cfg["max_length"]:
+        # the learned gpt_pos_emb table has cfg['max_length'] rows;
+        # positions past it would CLAMP in the lookup (XLA gather) and
+        # silently corrupt every token after that point
+        raise ValueError(
+            "max_len=%d exceeds the learned position table "
+            "(cfg['max_length']=%d) — raise max_length or use "
+            "pos_emb='rope'" % (max_len, cfg["max_length"]))
     d_model, n_head = cfg["d_model"], cfg["n_head"]
     d_head = d_model // n_head
     from ..layer_helper import LayerHelper
 
     helper = LayerHelper("gpt_decode")
     token = layers.data("token", [1], dtype="int64")
-    pos = layers.data("pos", [1], dtype="int64", append_batch_size=False)
+    if per_slot_pos:
+        pos = layers.data("pos", [1], dtype="int64")   # batched: [B, 1]
+    else:
+        pos = layers.data("pos", [1], dtype="int64",
+                          append_batch_size=False)     # one shared [1]
 
     # lookup_table squeezes trailing-1 id dims (reference semantics):
     # [B,1] ids -> [B,D]; restore the [B,1,D] step layout explicitly
-    use_rope = cfg.get("pos_emb", "learned") == "rope"
     word = layers.reshape(
         layers.embedding(token, [cfg["vocab"], d_model],
                          param_attr=ParamAttr(name="gpt_word_emb")),
@@ -366,21 +383,25 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
     if use_rope:
         x = word                              # positions rotate q/k below
     else:
+        pos_ids = pos if per_slot_pos else layers.reshape(pos, [1, 1])
         posv = layers.reshape(
-            layers.embedding(layers.reshape(pos, [1, 1]),
-                             [cfg["max_length"], d_model],
+            layers.embedding(pos_ids, [cfg["max_length"], d_model],
                              param_attr=ParamAttr(name="gpt_pos_emb")),
-            [1, 1, d_model])
+            [-1, 1, d_model] if per_slot_pos else [1, 1, d_model])
         x = layers.elementwise_add(word, posv)    # [B, 1, D]
 
     # visibility over cache rows: positions <= pos attend, later rows
-    # (zeros from init) mask out
+    # mask out — zeros from init in the lockstep loop; per-slot, row b
+    # attends to `cache row <= pos[b]`, so a retired neighbor's stale
+    # rows never leak into a live slot's attention
     ar = layers.reshape(layers.range(0, max_len, 1, "int64"), [1, max_len])
     vis = layers.cast(layers.less_equal(
-        ar, layers.reshape(pos, [1, 1])), "float32")
+        ar, pos if per_slot_pos else layers.reshape(pos, [1, 1])),
+        "float32")                      # [B, S] per-slot, else [1, S]
     bias = layers.scale(layers.elementwise_sub(
         layers.fill_constant([1], "float32", 1.0), vis), scale=-1e9)
-    bias = layers.reshape(bias, [1, 1, 1, max_len])
+    bias = layers.reshape(
+        bias, [-1 if per_slot_pos else 1, 1, 1, max_len])
 
     n_kv, g = _kv_heads_of(cfg)
     cache_names = []
@@ -411,10 +432,12 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
         k, v = kv_heads(k), kv_heads(v)
         if use_rope:
             # rotate at THIS position; the cache stores rotated keys,
-            # so dot products against it are relative-position exact
+            # so dot products against it are relative-position exact.
+            # Per-slot [B, 1] positions broadcast per-row angles over
+            # the head axis — each slot rotates at ITS position
             k = layers.rope(k, pos)
-        ck = layers.kv_cache_write(ck, k, pos)
-        cv = layers.kv_cache_write(cv, v, pos)
+        ck = layers.kv_cache_write(ck, k, pos)   # per-row vmapped when
+        cv = layers.kv_cache_write(cv, v, pos)   # pos is [B]/[B, 1]
         # GQA grouped attention: query heads fold as [B, Hkv, g, Dh]
         # (h = kv*g + j, row-major — the same h//g mapping as
         # transformer.repeat_kv_heads) and batch-matmul DIRECTLY
@@ -424,8 +447,9 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
         q = layers.reshape(q, [-1, n_kv, g, d_head])
         if use_rope:
             # a [1] pos yields [1, Dh/2] sin/cos that broadcast over
-            # every leading layout — rotating the folded q directly is
-            # exact (all g query heads sit at the same position)
+            # every leading layout ([B, 1] per-slot pos: [B,1,1,Dh/2])
+            # — rotating the folded q directly is exact: all g query
+            # heads of a row sit at that row's position
             q = layers.rope(q, pos)
         scores = layers.matmul(q, ck, transpose_y=True,
                                alpha=d_head ** -0.5)    # [B,Hkv,g,S]
@@ -445,6 +469,54 @@ def build_decode_step(cfg=None, batch=1, max_len=None):
     x = _final_norm(cfg, x)
     logits = _lm_head(cfg, x)
     return logits, cache_names
+
+
+def build_serving_decode_step(cfg=None, batch=1, max_len=None):
+    """Continuous-batching decode step: ``build_decode_step`` with
+    PER-SLOT positions. Feeds are token [B, 1] int64 (each slot's
+    current input token) and pos [B, 1] int64 (each slot's own sequence
+    position), so the B cache slots advance independently — the serving
+    engine (serving/engine.py) admits a new sequence into a free slot
+    mid-flight while its neighbors keep decoding, and retires finished
+    slots without draining the batch. Every per-slot op is row-local
+    (embedding lookup, fc = per-row dots, rope with [B, 1] positions,
+    per-row visibility bias, vmapped kv_cache_write), so an active
+    slot's logits are bitwise those of the same tokens run through a
+    smaller-batch ``build_decode_step`` — the engine's parity contract
+    with ``generate`` rests on it.
+
+    Cache/parameter names match ``build_decode_step``; caches are
+    [B, n_kv, max_len, Dh] donated state whose batch rows the engine
+    treats as independent slots (a free slot's rows are garbage until
+    the next prefill-then-insert overwrites them; the per-row mask
+    ``cache row <= pos[b]`` keeps garbage out of every live slot's
+    attention). Returns (logits_var, cache_names)."""
+    return build_decode_step(cfg, batch=batch, max_len=max_len,
+                             per_slot_pos=True)
+
+
+def sample_token(logits_row, rng, temperature=0.0, top_k=0):
+    """Sample ONE next token from a single row of logits: float64
+    softmax(logits/temperature), optional top-k truncation, seeded
+    choice; temperature=0 is greedy argmax. The ONE sampling
+    implementation shared by ``generate`` (applied per batch row, in
+    row order, on one RandomState) and the serving engine's per-slot
+    sampler (its own RandomState per request) — sharing it is what
+    makes the engine's outputs bitwise ``generate``'s by construction,
+    not just by test."""
+    import numpy as np
+
+    lg = logits_row.astype("float64")
+    if temperature > 0:
+        lg = lg / float(temperature)
+        if top_k and top_k > 0:
+            k = min(int(top_k), lg.shape[-1])
+            kth = np.partition(lg, -k)[-k]
+            lg = np.where(lg < kth, -np.inf, lg)
+        p = np.exp(lg - lg.max())
+        p = p / p.sum()
+        return int(rng.choice(p.shape[0], p=p))
+    return int(np.argmax(lg))
 
 
 def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope,
@@ -483,19 +555,10 @@ def generate(exe, decode_prog, logits_var, prompt_ids, n_new, scope,
     rng = np.random.RandomState(seed)
 
     def sample(lg):
-        lg = lg.astype("float64")
-        if temperature > 0:
-            lg = lg / float(temperature)
-            if top_k and top_k > 0:
-                k = min(int(top_k), lg.shape[-1])
-                kth = np.partition(lg, -k, axis=-1)[:, -k, None]
-                lg = np.where(lg < kth, -np.inf, lg)
-            p = np.exp(lg - lg.max(axis=-1, keepdims=True))
-            p = p / p.sum(axis=-1, keepdims=True)
-            return np.array(
-                [rng.choice(p.shape[1], p=p[b]) for b in range(B)],
-                dtype="int64")
-        return np.argmax(lg, axis=-1).astype("int64")
+        # one shared sampler applied row by row (draw order = batch
+        # order on the one RandomState) — see sample_token
+        return np.array([sample_token(lg[b], rng, temperature, top_k)
+                         for b in range(B)], dtype="int64")
 
     out = [ids[:, i] for i in range(P)]
     start = 0

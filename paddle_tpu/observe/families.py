@@ -160,6 +160,97 @@ DATA_BATCHES = REGISTRY.counter(
 for _s in ("reader.batch", "datafeed", "device_prefetcher"):
     DATA_BATCHES.labels(source=_s)
 
+# -------------------------------------------------------- serving
+# (serving/queue.py, serving/batcher.py, serving/engine.py and the
+# Predictor bucket router — see docs/SERVING.md)
+SERVING_QUEUE_DEPTH = REGISTRY.gauge(
+    "paddle_serving_queue_depth",
+    "Requests currently waiting in the admission queue (RequestQueue); "
+    "pinned at capacity = sustained overload, submits are being rejected")
+SERVING_QUEUE_WAIT_SECONDS = REGISTRY.histogram(
+    "paddle_serving_queue_wait_seconds",
+    "Time a request spent queued before admission (submit to the "
+    "scheduler popping it); the queue-side half of request latency")
+SERVING_QUEUE_REJECTED = REGISTRY.counter(
+    "paddle_serving_queue_rejected_total",
+    "Submits rejected because the bounded queue was full (backpressure: "
+    "the caller gets QueueFull, never a silent drop)")
+SERVING_DEADLINE_EXPIRATIONS = REGISTRY.counter(
+    "paddle_serving_deadline_expirations_total",
+    "Requests whose deadline passed while still queued — they are "
+    "failed with DeadlineExpired at pop time, never dispatched")
+SERVING_REQUESTS = REGISTRY.counter(
+    "paddle_serving_requests_total",
+    "Serving requests by terminal outcome", labels=("outcome",))
+for _o in ("ok", "rejected", "expired", "cancelled", "error"):
+    # pre-materialize the schema (same pattern as the RPC methods)
+    SERVING_REQUESTS.labels(outcome=_o)
+SERVING_REQUEST_SECONDS = REGISTRY.histogram(
+    "paddle_serving_request_seconds",
+    "End-to-end request latency (submit to completion), observed for "
+    "requests that completed ok")
+SERVING_BATCHES = REGISTRY.counter(
+    "paddle_serving_batches_total",
+    "Micro-batches dispatched by the dynamic batcher (one Predictor "
+    "run each)")
+SERVING_BATCH_ROWS = REGISTRY.histogram(
+    "paddle_serving_batch_rows",
+    "Rows coalesced per micro-batch BEFORE bucket padding — low values "
+    "with a deep queue mean the max-wait window is too short")
+SERVING_BUCKET_HITS = REGISTRY.counter(
+    "paddle_serving_bucket_hits_total",
+    "Predictor runs served by a warmup_batch_sizes bucket executable "
+    "(exact size or padded up) — steady state should be all hits")
+SERVING_BUCKET_MISSES = REGISTRY.counter(
+    "paddle_serving_bucket_miss_total",
+    "Predictor runs whose batch exceeded every warmup bucket and fell "
+    "back to an exact-shape compile — sustained growth = the bucket "
+    "list needs a bigger entry")
+SERVING_PADDED_ROWS = REGISTRY.counter(
+    "paddle_serving_padded_rows_total",
+    "Zero rows added by bucket padding (wasted compute rides these)")
+SERVING_ROWS = REGISTRY.counter(
+    "paddle_serving_rows_total",
+    "Real (caller) rows through the Predictor bucket router; "
+    "padding waste = padded_rows / (rows + padded_rows)")
+SERVING_PADDING_WASTE = REGISTRY.gauge(
+    "paddle_serving_padding_waste_ratio",
+    "Padding fraction of the LAST routed batch (pad rows / bucket "
+    "size); the counters above give the lifetime ratio")
+SERVING_SLOTS_ACTIVE = REGISTRY.gauge(
+    "paddle_serving_slots_active",
+    "Decode slots currently holding a live sequence in the continuous-"
+    "batching engine (of engine b_max)")
+SERVING_OCCUPANCY = REGISTRY.histogram(
+    "paddle_serving_slot_occupancy_ratio",
+    "active_slots / b_max observed at every decode step — the engine's "
+    "effective batch efficiency; admissions raise it mid-run, "
+    "retirements lower it (a lockstep batcher would hold the initial "
+    "ratio until the LONGEST request finished)")
+SERVING_ADMITTED = REGISTRY.counter(
+    "paddle_serving_slots_admitted_total",
+    "Sequences admitted into a free decode slot (prefill-then-insert)")
+SERVING_RETIRED = REGISTRY.counter(
+    "paddle_serving_slots_retired_total",
+    "Sequences retired from their slot (EOS or token budget) — the "
+    "slot frees immediately instead of idling until the batch drains")
+SERVING_DECODE_STEPS = REGISTRY.counter(
+    "paddle_serving_decode_steps_total",
+    "Continuous-batching decode dispatches (each advances every active "
+    "slot by one token)")
+SERVING_TOKENS = REGISTRY.counter(
+    "paddle_serving_tokens_total",
+    "Tokens generated by the continuous-batching engine (prefill-"
+    "sampled first tokens included)")
+SERVING_TOKENS_PER_SEC = REGISTRY.gauge(
+    "paddle_serving_tokens_per_sec",
+    "Aggregate engine throughput over the last completed drive "
+    "interval (set by the serving bench; 0 outside bench runs)")
+SERVING_PREFILL_PROGRAMS = REGISTRY.counter(
+    "paddle_serving_prefill_programs_total",
+    "Distinct prompt lengths the engine compiled a prefill executable "
+    "for — sustained growth = prompt-length churn; bucket prompts")
+
 # -------------------------------------------------------- backend/bench
 BACKEND_PROBE_SECONDS = REGISTRY.gauge(
     "paddle_backend_probe_seconds",

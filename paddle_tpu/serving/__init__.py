@@ -1,0 +1,36 @@
+"""Serving scheduler: request queue, dynamic micro-batching, and
+continuous batching for autoregressive decode.
+
+The layer between callers and compiled executables that the reference
+framework delegates to an external server (SURVEY §1) — a TPU-native
+framework owns it, because batch occupancy is the difference between
+~1/B and full utilisation on a dispatch-latency-bound device. Three
+pieces (docs/SERVING.md has the architecture):
+
+* ``queue``   — bounded admission queue: backpressure (reject-when-
+  full, counted), per-request deadlines, cancellation, per-request
+  futures.
+* ``batcher`` — dynamic micro-batching for ``Predictor`` workloads:
+  coalesce within a max-wait window, ride the Predictor's
+  warmup-bucket router (no steady-state recompiles), slice per-request
+  results back out.
+* ``engine``  — continuous batching for GPT decode: one fixed-b_max
+  decode executable whose per-slot KV caches admit new sequences at
+  step boundaries (prefill-then-insert) and retire finished ones
+  immediately.
+
+All three report through ``paddle_tpu.observe`` (queue depth,
+time-in-queue, occupancy, padding waste, tokens/sec, deadline
+expirations) and are exercised by the ``PADDLE_TPU_BENCH_SERVING=1``
+bench mode.
+"""
+
+from __future__ import annotations
+
+from .batcher import MicroBatcher
+from .engine import DecodeEngine
+from .queue import (Cancelled, DeadlineExpired, QueueFull, RequestQueue,
+                    ServingRequest)
+
+__all__ = ["Cancelled", "DeadlineExpired", "DecodeEngine", "MicroBatcher",
+           "QueueFull", "RequestQueue", "ServingRequest"]

@@ -1,0 +1,365 @@
+"""Continuous batching for autoregressive GPT decode.
+
+``models/gpt.py:generate`` drives one fixed-batch decode loop per
+caller: requests that arrive mid-generation wait for the whole loop,
+and a finished row idles its slot until the LONGEST request in the
+batch completes. On a dispatch-latency-bound device that is the
+difference between ~1/B and full utilisation. This engine owns the
+batch instead:
+
+* ONE decode executable at fixed ``b_max``
+  (``gpt.build_serving_decode_step``): per-slot token/position feeds,
+  per-slot visibility masks, per-slot (vmapped) KV-cache writes. The
+  B cache rows are B independent slots.
+* **Admission** happens at step boundaries, prefill-then-insert: a new
+  prompt prefills through a batch=1 ``build_prefill_step`` executable
+  (one dispatch, its own scope sharing the weight arrays by name),
+  then the slot's cache rows are spliced into the big caches with one
+  ``dynamic_update_slice`` per layer tensor. Prefill executables are
+  cached per prompt length
+  (``paddle_serving_prefill_programs_total`` counts compiles).
+* **Retirement** is immediate: a sequence that hits EOS or its token
+  budget frees its slot at that step boundary
+  (``paddle_serving_slots_retired_total``); the next queued request is
+  admitted into it while the rest of the batch keeps decoding.
+
+Requests enter through a bounded ``RequestQueue`` (backpressure,
+deadlines over queue time, cancellation — serving/queue.py). Sampling
+is host-side and per-request (its own seeded RandomState), so a
+request's output is bitwise what ``generate()`` would produce for it
+alone — tests/test_serving.py pins that parity. Occupancy telemetry:
+``paddle_serving_slot_occupancy_ratio`` per decode step,
+``paddle_serving_slots_active``, tokens/steps counters
+(docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .queue import RequestQueue
+
+__all__ = ["DecodeEngine"]
+
+
+class _Slot:
+    """One live sequence bound to a cache row."""
+
+    __slots__ = ("request", "tokens", "target_len", "eos_id",
+                 "temperature", "top_k", "rng")
+
+    def __init__(self, request, prompt, n_new, eos_id, temperature,
+                 top_k, seed):
+        self.request = request
+        self.tokens = [int(t) for t in prompt]
+        self.target_len = len(prompt) + int(n_new)
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.rng = np.random.RandomState(seed)
+
+    def sample(self, logits_row) -> int:
+        """THE sampler generate() uses, applied to this slot's row with
+        its private RandomState — a slot decodes bitwise like a B=1
+        generate() with the same seed by construction."""
+        from ..models.gpt import sample_token
+
+        return sample_token(logits_row, self.rng, self.temperature,
+                            self.top_k)
+
+    def finished(self, last_token: int) -> bool:
+        return (len(self.tokens) >= self.target_len
+                or (self.eos_id is not None and last_token == self.eos_id))
+
+
+class DecodeEngine:
+    """Continuous-batching scheduler over one ``b_max`` decode
+    executable.
+
+    ``params`` maps parameter name -> array (the training scope's
+    persistables, ``gpt_*`` names); None keeps the startup
+    initialization (bench/synthetic runs). ``submit`` returns a
+    ``ServingRequest`` whose ``result()`` is the full int64 token
+    sequence ``[P + generated]`` (budget ``n_new``, or shorter when
+    ``eos_id`` is sampled — the EOS token is included). Deadlines
+    bound QUEUE time; once a sequence holds a slot it runs to
+    completion. ``start()`` launches the scheduler thread; ``stop()``
+    drains nothing — in-flight and queued requests fail with
+    ``Cancelled``."""
+
+    def __init__(self, cfg, params: Optional[Dict[str, np.ndarray]] = None,
+                 b_max: int = 4, max_len: Optional[int] = None,
+                 queue_capacity: int = 64, eos_id: Optional[int] = None,
+                 place=None):
+        import paddle_tpu as fluid
+        from ..core.scope import Scope, scope_guard
+        from ..models import gpt
+
+        if b_max < 1:
+            raise ValueError("b_max must be >= 1")
+        self.cfg = dict(cfg) if cfg else gpt.base_config()
+        self.b_max = b_max
+        self.max_len = (self.cfg["max_length"] if max_len is None
+                        else int(max_len))
+        self.eos_id = eos_id
+        self._params = dict(params) if params else {}
+        self._gpt = gpt
+        self._fluid = fluid
+        self._scope_guard = scope_guard
+        self._scope = Scope()
+        self._prefill_scope = Scope()
+        self._prefill: Dict[int, tuple] = {}   # P -> (prog, logits_var)
+        self._exe = fluid.Executor(place if place is not None
+                                   else fluid.TPUPlace())
+        self._decode_prog = fluid.Program()
+        dec_start = fluid.Program()
+        with scope_guard(self._scope):
+            with fluid.program_guard(self._decode_prog, dec_start):
+                self._logits, self._cache_names = \
+                    gpt.build_serving_decode_step(
+                        self.cfg, batch=b_max, max_len=self.max_len)
+            self._exe.run(dec_start, scope=self._scope)
+            for n, v in self._params.items():
+                if self._scope.find_var(n) is not None:
+                    self._scope.set_var(n, v)
+        import jax
+
+        def _splice(bigs, smalls, idx):
+            return [jax.lax.dynamic_update_slice(
+                        b, s.astype(b.dtype), (idx, 0, 0, 0))
+                    for b, s in zip(bigs, smalls)]
+
+        # one compiled dispatch splices a prefilled slot into ALL the
+        # big caches; donating them makes the update in-place on device
+        self._splice = jax.jit(_splice, donate_argnums=0)
+        self.queue = RequestQueue(queue_capacity)
+        self._slots: list = [None] * b_max
+        self._n_active = 0
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._loop,
+                                        name="DecodeEngine", daemon=True)
+        self._started = False
+
+    # ------------------------------------------------------------ caller
+    def submit(self, prompt_ids, n_new: int, eos_id: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+               deadline_s: Optional[float] = None):
+        """Enqueue one generation request (thread-safe). ``prompt_ids``
+        is a 1-D (or [1, P]) int array; raises ``QueueFull`` under
+        backpressure, ``ValueError`` on a budget that overruns the
+        cache (the same check as ``generate``)."""
+        if self._error is not None:
+            raise RuntimeError("DecodeEngine failed") from self._error
+        prompt = np.asarray(prompt_ids, dtype="int64").reshape(-1)
+        P = prompt.shape[0]
+        if P < 1:
+            raise ValueError("empty prompt")
+        if n_new < 1:
+            raise ValueError("n_new must be >= 1; got %r" % (n_new,))
+        if P + n_new > self.max_len:
+            raise ValueError(
+                "prompt (%d) + new tokens (%d) exceeds the engine's "
+                "max_len=%d — positions past the cache would clamp and "
+                "corrupt output" % (P, n_new, self.max_len))
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0; got %r"
+                             % (temperature,))
+        payload = dict(prompt=prompt, n_new=int(n_new),
+                       eos_id=self.eos_id if eos_id is None else eos_id,
+                       temperature=float(temperature), top_k=int(top_k),
+                       seed=int(seed))
+        return self.queue.submit(payload, deadline_s=deadline_s)
+
+    def start(self) -> "DecodeEngine":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop the scheduler. Queued requests fail with ``Cancelled``;
+        sequences mid-generation fail with ``Cancelled`` too (their
+        partial output is dropped). Idempotent."""
+        from .queue import Cancelled
+
+        self._stop.set()
+        self.queue.close()
+        if self._started:
+            self._thread.join(timeout=timeout)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                slot.request.set_exception(
+                    Cancelled("engine stopped mid-generation"))
+                self._slots[i] = None
+        self._n_active = 0
+        self._set_active_gauge()
+
+    def __enter__(self) -> "DecodeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # --------------------------------------------------------- scheduler
+    def _loop(self) -> None:
+        from .queue import Cancelled
+
+        try:
+            while not self._stop.is_set():
+                # admit into free slots at the step boundary; block on
+                # the queue only when the whole batch is idle
+                self._admit(block=self._n_active == 0)
+                if self._stop.is_set():
+                    return
+                if self._n_active == 0:
+                    continue
+                self._decode_step()
+        except BaseException as exc:  # noqa: BLE001 — fail every caller loudly
+            self._error = exc
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    slot.request.set_exception(exc)
+                    self._slots[i] = None
+            self._n_active = 0
+            self._set_active_gauge()  # a dead engine holds no live slots
+            self.queue.close()  # pending requests fail as Cancelled
+            if not isinstance(exc, Cancelled):
+                raise
+
+    def _admit(self, block: bool) -> None:
+        while self._n_active < self.b_max and not self._stop.is_set():
+            req = self.queue.get(timeout=0.05 if block else 0)
+            if req is None:
+                return
+            slot_idx = self._slots.index(None)
+            try:
+                self._admit_one(slot_idx, req)
+            except BaseException as exc:  # noqa: BLE001
+                # the pop already admitted req (queue.close can't cancel
+                # it) but it isn't in a slot yet — fail it HERE or its
+                # caller blocks in result() forever, then let the loop's
+                # error path fail everyone else
+                req.set_exception(exc)
+                raise
+            block = False  # drain without blocking once something runs
+
+    def _admit_one(self, slot_idx: int, req) -> None:
+        from ..observe.families import (SERVING_ADMITTED, SERVING_TOKENS)
+
+        p = req.payload
+        slot = _Slot(req, p["prompt"], p["n_new"], p["eos_id"],
+                     p["temperature"], p["top_k"], p["seed"])
+        first = self._prefill_insert(slot_idx, p["prompt"], slot)
+        SERVING_ADMITTED.inc()
+        SERVING_TOKENS.inc()
+        slot.tokens.append(first)
+        if slot.finished(first):
+            self._retire(slot_idx, slot)
+            return
+        self._slots[slot_idx] = slot
+        self._n_active += 1
+        self._set_active_gauge()
+
+    def _prefill_insert(self, slot_idx: int, prompt, slot) -> int:
+        """One prefill dispatch (batch=1, its own scope), then splice
+        the slot's cache rows into the big caches — ONE jitted dispatch
+        for all 2*n_layer tensors, with the big caches donated so the
+        update is in-place on device (per-tensor eager updates cost
+        2*n_layer dispatches plus a full cache copy each, which at
+        high admission rates rivals the decode steps themselves).
+        Returns the first sampled token (from the last prompt
+        position's logits)."""
+        import jax.numpy as jnp
+
+        P = prompt.shape[0]
+        prog, logits_var = self._prefill_program(P)
+        with self._scope_guard(self._prefill_scope):
+            (full,) = self._exe.run(
+                prog, feed={"tokens": prompt[None, :]},
+                fetch_list=[logits_var], scope=self._prefill_scope)
+        bigs = [jnp.asarray(self._scope.find_var(n))
+                for n in self._cache_names]
+        smalls = [jnp.asarray(self._prefill_scope.find_var(n))
+                  for n in self._cache_names]
+        for n, out in zip(self._cache_names,
+                          self._splice(bigs, smalls, slot_idx)):
+            self._scope.set_var(n, out)
+        return slot.sample(full[0, P - 1])
+
+    def _prefill_program(self, P: int):
+        """Batch=1 prefill executable for prompt length P, cached. All
+        P's share ONE prefill scope: the [1, n_kv, max_len, Dh] caches
+        have the same shape for every P, and weights are (re)copied
+        from the engine scope after each new program's startup."""
+        hit = self._prefill.get(P)
+        if hit is not None:
+            return hit
+        from ..observe.families import SERVING_PREFILL_PROGRAMS
+
+        fluid = self._fluid
+        prog, start = fluid.Program(), fluid.Program()
+        with self._scope_guard(self._prefill_scope):
+            with fluid.program_guard(prog, start):
+                logits_var, cache_names = self._gpt.build_prefill_step(
+                    self.cfg, batch=1, prompt_len=P, max_len=self.max_len)
+            self._exe.run(start, scope=self._prefill_scope)
+            # share the engine's weight ARRAYS by name (cheap reference
+            # copies); never the caches — their batch dim differs
+            skip = set(cache_names) | {"tokens"}
+            for n in prog.global_block().vars:
+                if n in skip:
+                    continue
+                v = self._scope.find_var(n)
+                if v is not None:
+                    self._prefill_scope.set_var(n, v)
+        SERVING_PREFILL_PROGRAMS.inc()
+        self._prefill[P] = (prog, logits_var)
+        return self._prefill[P]
+
+    def _decode_step(self) -> None:
+        from ..observe.families import (SERVING_DECODE_STEPS,
+                                        SERVING_OCCUPANCY, SERVING_TOKENS)
+
+        token = np.zeros((self.b_max, 1), dtype="int64")
+        pos = np.zeros((self.b_max, 1), dtype="int64")
+        active = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue  # free slot: token 0 at pos 0 writes garbage
+                #           into a row nobody reads (masked, and the
+                #           next prefill-insert overwrites it)
+            active.append(i)
+            token[i, 0] = slot.tokens[-1]
+            pos[i, 0] = len(slot.tokens) - 1
+        with self._scope_guard(self._scope):
+            (logits,) = self._exe.run(
+                self._decode_prog, feed={"token": token, "pos": pos},
+                fetch_list=[self._logits], scope=self._scope)
+        SERVING_DECODE_STEPS.inc()
+        SERVING_OCCUPANCY.observe(len(active) / float(self.b_max))
+        SERVING_TOKENS.inc(len(active))
+        for i in active:
+            slot = self._slots[i]
+            tok = slot.sample(logits[i, 0])
+            slot.tokens.append(tok)
+            if slot.finished(tok):
+                self._slots[i] = None
+                self._n_active -= 1
+                self._retire(i, slot)
+        self._set_active_gauge()
+
+    def _retire(self, slot_idx: int, slot: _Slot) -> None:
+        from ..observe.families import SERVING_RETIRED
+
+        SERVING_RETIRED.inc()
+        slot.request.set_result(np.asarray(slot.tokens, dtype="int64"))
+
+    def _set_active_gauge(self) -> None:
+        from ..observe.families import SERVING_SLOTS_ACTIVE
+
+        SERVING_SLOTS_ACTIVE.set(self._n_active)

@@ -54,6 +54,7 @@ SLOW_TESTS = {
     "test_gpt_decode.py::test_gqa_training_fused_matches_composed",
     "test_gpt_decode.py::test_generate_sampling_modes",
     "test_gpt_decode.py::test_prefill_one_dispatch_matches_stepwise_generate",
+    "test_gpt_decode.py::test_prefill_with_grouped_query_attention_matches_decode_loop",
     "test_rope.py::test_gpt_rope_trains_and_paths_match",
     "test_rope.py::test_gpt_rope_decode_matches_full_forward",
     "test_modern_decoder.py::test_llama_style_stack_fused_matches_composed",

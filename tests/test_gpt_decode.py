@@ -227,6 +227,48 @@ def test_gqa_training_fused_matches_composed():
     assert composed[-1] < composed[0]
 
 
+def test_prefill_with_grouped_query_attention_matches_decode_loop():
+    """generate(prefill_prog=...) composed with GROUPED-query attention
+    (1 < n_kv_head < n_head, so the g-fold query fold is non-trivial in
+    both builders) on the classic learned-positions stack: the
+    prefill-then-decode path must be BITWISE the pure decode-loop path.
+    Complements test_prefill_one_dispatch_matches_stepwise_generate,
+    which pins the rope+MQA (n_kv_head=1) modern stack."""
+    cfg = dict(CFG, n_head=4, n_kv_head=2)
+    params = _trained_scope(cfg)
+    B, P, NEW, S = 2, 5, 4, 12
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(1, 64, (B, P)).astype("int64")
+
+    def run(use_prefill, temperature=0.0, top_k=0):
+        dec_prog, dec_start = fluid.Program(), fluid.Program()
+        pre_prog, pre_start = fluid.Program(), fluid.Program()
+        scope = Scope()
+        with scope_guard(scope):
+            with fluid.program_guard(dec_prog, dec_start):
+                logits, cache_names = gpt.build_decode_step(
+                    cfg, batch=B, max_len=S)
+            with fluid.program_guard(pre_prog, pre_start):
+                pl, _ = gpt.build_prefill_step(cfg, batch=B,
+                                               prompt_len=P, max_len=S)
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(dec_start, scope=scope)
+            exe.run(pre_start, scope=scope)
+            for n, v in params.items():
+                if scope.find_var(n) is not None:
+                    scope.set_var(n, v)
+            # both builders cache n_kv heads, not n_head
+            assert np.shape(scope.find_var(cache_names[0]))[1] == 2
+            kw = dict(prefill_prog=pre_prog, prefill_logits=pl) \
+                if use_prefill else {}
+            return gpt.generate(exe, dec_prog, logits, prompt, NEW,
+                                scope, temperature=temperature,
+                                top_k=top_k, seed=17, **kw)
+
+    np.testing.assert_array_equal(run(False), run(True))
+    np.testing.assert_array_equal(run(False, 0.7, 6), run(True, 0.7, 6))
+
+
 def test_prefill_one_dispatch_matches_stepwise_generate():
     """build_prefill_step: one dispatch fills the caches and yields the
     first sampled token — generation must EQUAL the token-by-token

@@ -68,6 +68,81 @@ def test_predictor_warmup_and_shapes(tmp_path, fresh_programs):
     assert o1.shape == (1, 1) and o32.shape == (32, 1)
 
 
+def test_predictor_bucket_routing_pads_and_slices(tmp_path,
+                                                  fresh_programs):
+    """An unseen batch size rides the nearest warmup bucket: the feed
+    pads up, the result slices back, and NO new executable compiles —
+    the serving micro-batcher and direct callers share this path."""
+    from paddle_tpu import observe
+
+    def misses():
+        for s in observe.snapshot()["metrics"][
+                "paddle_executor_cache_misses_total"]["samples"]:
+            return s["value"]
+
+    def counter(name):
+        s = observe.snapshot()["metrics"][name]["samples"][0]
+        return s.get("value", s.get("count"))
+
+    main, startup, scope = fresh_programs
+    X, _ = _train_and_save(tmp_path, scope)
+    config = AnalysisConfig(model_dir=str(tmp_path))
+    config.warmup_batch_sizes = [4, 32]
+    predictor = create_paddle_predictor(config)
+    assert predictor.bucket_for(3) == 4
+    assert predictor.bucket_for(4) == 4
+    assert predictor.bucket_for(5) == 32
+    assert predictor.bucket_for(33) is None
+
+    m0 = misses()
+    h0 = counter("paddle_serving_bucket_hits_total")
+    p0 = counter("paddle_serving_padded_rows_total")
+    # batch 3 -> bucket 4: padded rows never leak into the result, and
+    # the rows that do come back are bitwise the bucket-4 computation
+    out3, = predictor.run({"x": X[:3]})
+    assert out3.shape == (3, 1)
+    ref4, = predictor.run({"x": np.concatenate(
+        [X[:3], np.zeros((1, 4), "float32")])})
+    np.testing.assert_array_equal(out3, ref4[:3])
+    assert misses() == m0                     # warmed bucket: no compile
+    assert counter("paddle_serving_bucket_hits_total") == h0 + 2
+    assert counter("paddle_serving_padded_rows_total") == p0 + 1
+
+    # larger than every bucket: exact compile, counted as a miss
+    b0 = counter("paddle_serving_bucket_miss_total")
+    out40, = predictor.run({"x": np.concatenate([X, X[:8]])})
+    assert out40.shape == (40, 1)
+    assert counter("paddle_serving_bucket_miss_total") == b0 + 1
+    assert misses() == m0 + 1                 # the one exact compile
+
+    # no buckets configured = classic compile-per-shape behavior
+    plain = create_paddle_predictor(AnalysisConfig(model_dir=str(tmp_path)))
+    out5, = plain.run({"x": X[:5]})
+    assert out5.shape == (5, 1)
+
+
+def test_predictor_feed_validation(tmp_path, fresh_programs):
+    """_as_feed must reject what it used to accept silently: unknown
+    names (dict AND PaddleTensor paths) and positional lists whose
+    length mismatches the feed list (dict(zip) truncation)."""
+    main, startup, scope = fresh_programs
+    X, _ = _train_and_save(tmp_path, scope)
+    predictor = create_paddle_predictor(
+        AnalysisConfig(model_dir=str(tmp_path)))
+    with pytest.raises(ValueError, match="unknown feed name"):
+        predictor.run({"x": X, "typo": X})
+    with pytest.raises(ValueError, match="unknown feed name"):
+        predictor.run([PaddleTensor("typo", X)])
+    with pytest.raises(ValueError, match="positional inputs"):
+        predictor.run([X, X])       # 2 arrays for 1 feed
+    with pytest.raises(ValueError, match="positional inputs"):
+        predictor.run([])           # 0 arrays for 1 feed
+    # the good paths still work
+    assert predictor.run({"x": X})[0].shape == (32, 1)
+    assert predictor.run([PaddleTensor("x", X)])[0].shape == (32, 1)
+    assert predictor.run([X])[0].shape == (32, 1)
+
+
 def test_predictor_excludes_train_ops(tmp_path, fresh_programs):
     main, startup, scope = fresh_programs
     _train_and_save(tmp_path, scope)

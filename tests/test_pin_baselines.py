@@ -114,6 +114,20 @@ def test_spc1_row_skips_when_default_is_10(tmp_path):
     assert base[ROW] == 509.8 and spc[ROW] == 1
 
 
+def test_serving_rows_never_pin(tmp_path):
+    # PADDLE_TPU_BENCH_SERVING=1 rows measure scheduler throughput, not
+    # train steps — like pipelined rows they must never touch baselines
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": "serving_gpt_decode_tokens_per_sec", "value": 9e9,
+         "serving": True, "steps_per_call": 10},
+        {"metric": ROW, "value": 9999.0, "serving": True,
+         "steps_per_call": 10}])
+    assert proc.stdout.count("SKIP") == 2
+    assert "serving" in proc.stdout
+    assert base[ROW] == 509.8
+    assert "serving_gpt_decode_tokens_per_sec" not in base
+
+
 def test_dispatch_override_rows_never_pin(tmp_path):
     proc, base, spc = _pin(tmp_path, [
         {"metric": ROW, "value": 9999.0, "steps_per_call": 10,

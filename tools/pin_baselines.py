@@ -89,9 +89,10 @@ def main():
     for row in rows:
         name, value = row["metric"], float(row["value"])
         if row.get("recompute") or row.get("batch_scale", 1) != 1 \
-                or "flash_min_seq" in row or row.get("pipelined"):
+                or "flash_min_seq" in row or row.get("pipelined") \
+                or row.get("serving"):
             print("SKIP %s: recompute/scaled-batch/dispatch-override/"
-                  "pipelined rows never pin over the plain-config "
+                  "pipelined/serving rows never pin over the plain-config "
                   "baseline" % name)
             continue
         if row.get("quick"):

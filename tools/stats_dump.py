@@ -9,11 +9,19 @@ Usage:
                                                  # useful from a REPL/pdb)
     python tools/stats_dump.py --diff A.telemetry.json B.telemetry.json
                                                  # per-family deltas B vs A
+    python tools/stats_dump.py BENCH_serving_decode.telemetry.json \
+        --grep paddle_serving                    # just one family group
 
 Reads the JSON written by `paddle_tpu.observe.dump()` (bench.py drops one
 per workload row, including failed rows) and renders counters/gauges as a
 table and histograms with count/sum/mean and estimated p50/p90/p99.
 `--prometheus` re-renders the snapshot in text exposition format instead.
+
+The serving sidecars (PADDLE_TPU_BENCH_SERVING=1 bench rows, one per
+scheduler) carry the paddle_serving_* families — queue depth/wait,
+batch rows, bucket hit/miss + padding waste, slot occupancy, admission/
+retirement counters (docs/SERVING.md "Reading the telemetry") — so
+`--grep paddle_serving` is the one-look serving health view.
 
 Diagnosing a wedged TPU tunnel from a sidecar: see docs/OBSERVABILITY.md
 ("Reading a sidecar post-mortem") — the short version is to look at
@@ -75,13 +83,17 @@ def _series_key(name, sample):
     return name + ("{%s}" % _label_str(labels) if labels else "")
 
 
-def render_table(snap, show_all=False, out=sys.stdout):
+def render_table(snap, show_all=False, grep=None, out=sys.stdout):
     meta = "snapshot pid=%s unix_time=%s" % (snap.get("pid"),
                                              _fmt(snap.get("unix_time")))
+    if grep:
+        meta += "  (grep=%s)" % grep
     print(meta, file=out)
     print("-" * max(len(meta), 72), file=out)
     scalar_rows, hist_rows = [], []
     for name in sorted(snap["metrics"]):
+        if grep and grep not in name:
+            continue
         m = snap["metrics"][name]
         for s in m["samples"]:
             key = _series_key(name, s)
@@ -123,7 +135,7 @@ def render_table(snap, show_all=False, out=sys.stdout):
 
 
 def render_diff(snap_a, snap_b, name_a="A", name_b="B", show_all=False,
-                out=sys.stdout):
+                grep=None, out=sys.stdout):
     """Per-series comparison of two snapshots: counters/gauges print
     value A, value B and the delta; histograms print count/mean/p50/p99
     side by side. Built for comparing bench telemetry sidecars — e.g. a
@@ -141,6 +153,8 @@ def render_diff(snap_a, snap_b, name_a="A", name_b="B", show_all=False,
     sa, sb = _series(snap_a), _series(snap_b)
     scalar_rows, hist_rows = [], []
     for key in sorted(set(sa) | set(sb)):
+        if grep and grep not in key:
+            continue
         kind = (sa.get(key) or sb.get(key))[0]
         a = sa.get(key, (None, None))[1]
         b = sb.get(key, (None, None))[1]
@@ -212,6 +226,9 @@ def main(argv=None):
     ap.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
                     help="compare two snapshots: per-series value deltas "
                          "and histogram count/mean/p50/p99 side by side")
+    ap.add_argument("--grep", default=None, metavar="SUBSTR",
+                    help="only families whose name contains SUBSTR (e.g. "
+                         "paddle_serving for the serving scheduler view)")
     args = ap.parse_args(argv)
 
     if args.diff is not None:
@@ -222,7 +239,7 @@ def main(argv=None):
                     _load_snapshot(args.diff[1], ap),
                     name_a=os.path.basename(args.diff[0]),
                     name_b=os.path.basename(args.diff[1]),
-                    show_all=args.all)
+                    show_all=args.all, grep=args.grep)
         return 0
 
     if args.live == (args.snapshot is not None):
@@ -236,12 +253,15 @@ def main(argv=None):
         snap = _load_snapshot(args.snapshot, ap)
 
     if args.prometheus:
+        if args.grep:
+            ap.error("--grep composes with the table/--diff renderers, "
+                     "not --prometheus (exposition format is all-series)")
         # Registry.render_prometheus renders from any saved snapshot dict
         from paddle_tpu.observe.metrics import Registry
 
         sys.stdout.write(Registry().render_prometheus(snap))
     else:
-        render_table(snap, show_all=args.all)
+        render_table(snap, show_all=args.all, grep=args.grep)
     return 0
 
 
