@@ -1077,51 +1077,85 @@ assert set(ORDER) | set(SERVING_ORDER) == set(WORKLOADS), \
     "ORDER/SERVING_ORDER out of sync with WORKLOADS"
 
 
-def _probe_backend(timeout_s=None):
+def _probe_backend(timeout_s=None, attempts=None, probe_fn=None):
     """Fail fast (with a diagnosable JSON row AND a telemetry sidecar) if
     jax backend init hangs — a wedged TPU tunnel blocks inside a C call
-    that no KeyboardInterrupt reaches, so a watchdog thread + os._exit is
-    the only way out. The sidecar records the probe wall time + outcome,
-    so a post-mortem can distinguish "wedged for the full timeout" from
-    "failed instantly with a config error"."""
-    import threading
+    that no KeyboardInterrupt reaches, so a deadline-bounded daemon
+    thread (resilience.watchdog.run_with_deadline) + os._exit is the
+    only way out.
 
-    from paddle_tpu.observe.families import (BACKEND_PROBE_OK,
-                                             BACKEND_PROBE_SECONDS)
+    The probe RETRIES: a single transient wedge zeroed round r05's
+    entire bench queue ("no workloads attempted"), so up to
+    ``PADDLE_TPU_BENCH_INIT_ATTEMPTS`` (default 3) attempts run with
+    full-jitter backoff between them
+    (``PADDLE_TPU_BENCH_INIT_BACKOFF_MS`` base, doubling, capped 30s)
+    before the round is declared dead. Every attempt's wall time lands
+    in the ``paddle_backend_probe_attempt_seconds`` histogram and its
+    outcome in ``paddle_backend_probe_attempts_total{outcome}``, so a
+    post-mortem distinguishes "wedged 300s, wedged 300s, ok in 4s"
+    from "failed instantly with a config error". Worst-case wall is
+    ``attempts * timeout`` + backoff — the parent's subprocess guard
+    budgets for that."""
+    from paddle_tpu.observe.families import (BACKEND_PROBE_ATTEMPT_SECONDS,
+                                             BACKEND_PROBE_ATTEMPTS,
+                                             BACKEND_PROBE_OK,
+                                             BACKEND_PROBE_SECONDS,
+                                             RESILIENCE_WEDGES)
+    from paddle_tpu.resilience.backoff import backoff_delay, millis_env
+    from paddle_tpu.resilience.watchdog import run_with_deadline
 
     timeout_s = timeout_s or int(
         os.environ.get("PADDLE_TPU_BENCH_INIT_TIMEOUT", "300"))
-    ok, err = [], []
-
-    def probe():
-        try:
+    attempts = max(1, attempts or int(
+        os.environ.get("PADDLE_TPU_BENCH_INIT_ATTEMPTS", "3")))
+    if probe_fn is None:
+        def probe_fn():
             import jax
 
-            ok.append(str(jax.devices()))
-        except BaseException as exc:  # noqa: BLE001 — report, don't hang
-            err.append("%s: %s" % (type(exc).__name__, exc))
+            return str(jax.devices())
 
-    t0 = time.perf_counter()
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    # poll instead of one long join: an instant failure (bad platform
-    # string) must not burn the full wedge timeout
-    deadline = t0 + timeout_s
-    while t.is_alive() and time.perf_counter() < deadline:
-        t.join(0.25)
-    BACKEND_PROBE_SECONDS.set(time.perf_counter() - t0)
-    if not ok:
-        BACKEND_PROBE_OK.set(0)
-        detail = err[0][:300] if err else (
-            "did not complete within %ds" % timeout_s)
-        print(json.dumps({
-            "metric": "backend_init",
-            "error": "jax backend init failed: %s "
-                     "(TPU tunnel unreachable/wedged)" % detail,
-        }), flush=True)
-        _dump_telemetry("probe")
-        os._exit(1)
-    BACKEND_PROBE_OK.set(1)
+    base_s = millis_env("PADDLE_TPU_BENCH_INIT_BACKOFF_MS", 2000)
+    detail = ""
+    for attempt in range(attempts):
+        ok, val, dt = run_with_deadline(probe_fn, timeout_s)
+        BACKEND_PROBE_SECONDS.set(dt)
+        BACKEND_PROBE_ATTEMPT_SECONDS.observe(dt)
+        if ok:
+            BACKEND_PROBE_ATTEMPTS.labels(outcome="ok").inc()
+            BACKEND_PROBE_OK.set(1)
+            return
+        wedged = isinstance(val, TimeoutError)
+        BACKEND_PROBE_ATTEMPTS.labels(
+            outcome="timeout" if wedged else "error").inc()
+        if wedged:
+            RESILIENCE_WEDGES.labels(site="backend.probe").inc()
+            detail = "did not complete within %ds" % timeout_s
+        else:
+            detail = ("%s: %s" % (type(val).__name__, val))[:300]
+        if attempt + 1 < attempts:
+            delay = backoff_delay(attempt, base_s, 30.0)
+            _log("backend probe attempt %d/%d failed (%s); retrying in "
+                 "%.1fs" % (attempt + 1, attempts, detail, delay))
+            time.sleep(delay)
+    BACKEND_PROBE_OK.set(0)
+    print(json.dumps({
+        "metric": "backend_init",
+        "error": "jax backend init failed after %d attempts: %s "
+                 "(TPU tunnel unreachable/wedged)" % (attempts, detail),
+    }), flush=True)
+    _dump_telemetry("probe")
+    os._exit(1)
+
+
+def _fit_probe_attempts(budget_s, timeout_s, attempts):
+    """Probe attempts that FIT inside ``budget_s``: each attempt costs
+    up to ``timeout_s`` plus a capped-30s backoff, and 60s of slack is
+    reserved for the worker's own startup/teardown. A worker whose
+    probe retries outlived its workload deadline would be SIGKILLed
+    mid-probe — losing the diagnosable backend_init row and sidecar
+    the probe exists to write."""
+    fit = max(1, int((budget_s - 60) // (timeout_s + 30)))
+    return max(1, min(attempts, fit))
 
 
 def _enable_compile_cache():
@@ -1145,7 +1179,13 @@ def _run_worker(name, amp, quick):
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     _enable_compile_cache()
-    _probe_backend()
+    # in-worker probe retries must fit the parent's per-workload
+    # deadline (the default 3 x 300s budget would outlive the 900s
+    # workload timeout and get this worker killed mid-probe)
+    _probe_backend(attempts=_fit_probe_attempts(
+        int(os.environ.get("PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT", "900")),
+        int(os.environ.get("PADDLE_TPU_BENCH_INIT_TIMEOUT", "300")),
+        int(os.environ.get("PADDLE_TPU_BENCH_INIT_ATTEMPTS", "3"))))
     from paddle_tpu.observe.families import BENCH_ROWS
 
     try:
@@ -1312,6 +1352,8 @@ def main():
     # fail fast on a dead/wedged backend: one subprocess probe up front
     # instead of 6 workers independently burning the init timeout each
     init_timeout = int(os.environ.get("PADDLE_TPU_BENCH_INIT_TIMEOUT", "300"))
+    init_attempts = max(1, int(os.environ.get(
+        "PADDLE_TPU_BENCH_INIT_ATTEMPTS", "3")))
     import signal as _signal
 
     probe = subprocess.Popen(
@@ -1319,7 +1361,10 @@ def main():
         stdout=subprocess.DEVNULL, stderr=sys.stderr,
         start_new_session=True)
     try:
-        probe_rc = probe.wait(timeout=init_timeout + 60)
+        # budget for the probe's own retries: attempts x per-attempt
+        # timeout, plus its (capped-30s) backoff sleeps and startup slack
+        probe_rc = probe.wait(
+            timeout=init_attempts * (init_timeout + 30) + 60)
     except subprocess.TimeoutExpired:
         probe_rc = -1
         try:

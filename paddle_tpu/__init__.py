@@ -44,7 +44,7 @@ from . import (  # noqa: F401
 )
 from . import (contrib, flags, imperative, inference,  # noqa: F401
                learning_rate_decay, lod_tensor, reader, recordio_writer,
-               transpiler)
+               resilience, transpiler)
 from .lod_tensor import (LoDTensor, LoDTensorArray, Tensor,  # noqa: F401
                          create_lod_tensor, create_random_int_lodtensor)
 from .reader import batch  # noqa: F401  (paddle.batch top-level parity)

@@ -16,6 +16,7 @@ per-op dispatch, implicit data transform, and the eager-deletion GC.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 import warnings
@@ -30,6 +31,11 @@ from .lowering import LowerContext, as_jax_dtype, lower_block
 from .program import Program, Variable, default_main_program
 from .registry import get_op, has_op
 from .scope import Scope, global_scope
+# hoisted out of the per-step guards: resilience's module-level imports
+# never touch core (no cycle), and the dispatch window must carry no
+# avoidable bytecode on the 2-core throttled CI box
+from ..resilience.faults import fault_point
+from ..resilience.watchdog import heartbeat
 
 __all__ = ["Executor"]
 
@@ -121,19 +127,23 @@ class Executor:
             # the reference's interpreter loop (operator.cc:180) — ops fuse
             # into this one launch
             with RecordEvent("executor_run"):
-                fetches, new_mut, new_pure, new_rng = plan.fn(
-                    feeds, const_state, mut_state, rng)
+                with _dispatch_guard(plan, "run"):
+                    fetches, new_mut, new_pure, new_rng = plan.fn(
+                        feeds, const_state, mut_state, rng)
                 steady = _record_dispatch(plan, "run", "run", 1,
                                           time.perf_counter() - t0)
-                fetches = [f.block_until_ready() if hasattr(f, "block_until_ready")
-                           else f for f in fetches]
+                with _wait_guard():
+                    fetches = [f.block_until_ready()
+                               if hasattr(f, "block_until_ready")
+                               else f for f in fetches]
                 if fetches:  # an empty fetch_list never blocks
                     _record_completion(steady, "run",
                                        time.perf_counter() - t0)
                 t0 = None  # completion observed here; _finish must not re-record
         else:
-            fetches, new_mut, new_pure, new_rng = plan.fn(
-                feeds, const_state, mut_state, rng)
+            with _dispatch_guard(plan, "run"):
+                fetches, new_mut, new_pure, new_rng = plan.fn(
+                    feeds, const_state, mut_state, rng)
             steady = _record_dispatch(plan, "run", "run", 1,
                                       time.perf_counter() - t0)
 
@@ -155,7 +165,13 @@ class Executor:
         _write_back_state(plan, scope, new_mut, new_pure, new_rng)
 
         if return_numpy:
-            out = [np.asarray(v) for v in fetches]
+            if fetches:
+                # the conversion is the host block where a wedged device
+                # hangs an unprofiled run — keep it heartbeat-stamped
+                with _wait_guard():
+                    out = [np.asarray(v) for v in fetches]
+            else:
+                out = []
             # `complete` only when the conversion actually blocked on a
             # result: an empty fetch_list never waits, and recording it
             # would fill the histogram with dispatch-only samples
@@ -236,20 +252,23 @@ class Executor:
         t0 = time.perf_counter()
         if is_profiler_enabled():
             with RecordEvent("executor_run_repeated[%d]" % steps):
-                fetches, new_mut, new_pure, new_rng = fn(
-                    feeds, const_state, mut_state, rng)
+                with _dispatch_guard(plan, sig):
+                    fetches, new_mut, new_pure, new_rng = fn(
+                        feeds, const_state, mut_state, rng)
                 steady = _record_dispatch(plan, sig, "run_repeated",
                                           steps, time.perf_counter() - t0)
-                fetches = [f.block_until_ready()
-                           if hasattr(f, "block_until_ready") else f
-                           for f in fetches]
+                with _wait_guard():
+                    fetches = [f.block_until_ready()
+                               if hasattr(f, "block_until_ready") else f
+                               for f in fetches]
                 if fetches:  # an empty fetch_list never blocks
                     _record_completion(steady, "run_repeated",
                                        time.perf_counter() - t0)
                 t0 = None
         else:
-            fetches, new_mut, new_pure, new_rng = fn(
-                feeds, const_state, mut_state, rng)
+            with _dispatch_guard(plan, sig):
+                fetches, new_mut, new_pure, new_rng = fn(
+                    feeds, const_state, mut_state, rng)
             steady = _record_dispatch(plan, sig, "run_repeated",
                                       steps, time.perf_counter() - t0)
         return self._finish(plan, scope, fetches, new_mut, new_pure,
@@ -380,7 +399,8 @@ class Executor:
                 # the prefetch thread keeps filling during it either way
                 if len(window) >= max_in_flight:
                     tw = time.perf_counter()
-                    window.popleft().wait()
+                    with _wait_guard(step_i):
+                        window.popleft().wait()
                     dt = time.perf_counter() - tw
                     blocked += dt
                     PIPELINE_WAIT_SECONDS.observe(dt)
@@ -397,8 +417,9 @@ class Executor:
                 plan, feed_list, const_state, mut_state, rng = self._gather(
                     program, feeds, fetch_list, scope)
                 t0 = time.perf_counter()
-                fetches, new_mut, new_pure, new_rng = plan.fn(
-                    feed_list, const_state, mut_state, rng)
+                with _dispatch_guard(plan, "run"):
+                    fetches, new_mut, new_pure, new_rng = plan.fn(
+                        feed_list, const_state, mut_state, rng)
                 # sig "run": same executable as run(), so a run() warmup
                 # already paid this signature's compile
                 steady = _record_dispatch(plan, "run", "run_pipelined", 1,
@@ -427,7 +448,8 @@ class Executor:
             # was fully serialized on its fetch waits
             while window:
                 tw = time.perf_counter()
-                window.popleft().wait()
+                with _wait_guard(step_i):
+                    window.popleft().wait()
                 dt = time.perf_counter() - tw
                 blocked += dt
                 PIPELINE_WAIT_SECONDS.observe(dt)
@@ -632,6 +654,42 @@ class Executor:
         fn = jax.jit(step, donate_argnums=(2,))
         return _Plan(feed_names, fetch_names, const_state, mut_state,
                      pure_written, needs_rng, fn, step=step)
+
+
+@contextlib.contextmanager
+def _wait_guard(step=None):
+    """Heartbeat around a HOST BLOCK on device results (profiled
+    block_until_ready, the numpy fetch conversion, pipelined window
+    waits). Dispatch is async, so a wedged device manifests exactly
+    here — without this stamp the watchdog would read a dead tunnel as
+    host idleness and never fire."""
+    hb = heartbeat()
+    tok = hb.begin("executor.wait", step=step)
+    try:
+        yield
+    finally:
+        hb.end("executor.wait", tok)
+
+
+@contextlib.contextmanager
+def _dispatch_guard(plan, sig):
+    """Resilience wrapper around ONE XLA dispatch, shared by run()/
+    run_repeated()/run_pipelined(): stamps the process heartbeat (with
+    ``compiling=True`` for a plan's first dispatch per signature, so
+    the watchdog judges it against the compile grace deadline, not the
+    steady-state one) and passes through the ``executor.dispatch``
+    fault-injection site. The fault fires AFTER the begin stamp —
+    an injected wedge must look to the watchdog exactly like a real
+    one — and the end stamp lands even when the fault raises, so the
+    watchdog re-arms once the error has surfaced."""
+    hb = heartbeat()
+    tok = hb.begin("executor.dispatch",
+                   compiling=sig not in plan.compiled_sigs)
+    try:
+        fault_point("executor.dispatch")
+        yield
+    finally:
+        hb.end("executor.dispatch", tok)
 
 
 def _record_dispatch(plan, sig, site, steps, dt):
@@ -1095,6 +1153,7 @@ def feeds_to_device(feed: Dict[str, Any], var_lookup, device=None):
             host[n] = _feed_host_array(n, v, var)
     nbytes = sum(a.nbytes for a in host.values())
     if host:
+        fault_point("device_put")
         out.update(jax.device_put(host, device))
     return out, nbytes
 

@@ -273,12 +273,18 @@ class DevicePrefetcher:
                                         PIPELINE_H2D_SECONDS)
 
         batches = DATA_BATCHES.labels(source="device_prefetcher")
+        from ..resilience.faults import fault_point
+
         try:
             it = self._reader() if callable(self._reader) \
                 else iter(self._reader)
             for feed in it:
                 if self._stop.is_set():
                     return
+                # fault-injection site: fires once per batch pulled; an
+                # injected raise lands in self._error and re-raises in
+                # the consumer, exactly like a real reader failure
+                fault_point("reader.next")
                 t0 = time.perf_counter()
                 dev, nbytes = self._convert(feed)
                 # block in THIS thread: the consumer must receive feeds

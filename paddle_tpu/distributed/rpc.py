@@ -20,6 +20,8 @@ import numpy as np
 from ..native import load
 from ..native.dtypes import CODE_OF_DTYPE as _DTYPES
 from ..native.dtypes import DTYPE_OF_CODE as _NP_OF_CODE
+from ..resilience.backoff import backoff_delay, millis_env
+from ..resilience.faults import fault_point
 from ..observe.families import (RPC_BYTES_RECV, RPC_BYTES_SENT, RPC_CALLS,
                                 RPC_DEADLINE_EXPIRATIONS, RPC_ERRORS,
                                 RPC_RETRIES, RPC_SECONDS,
@@ -39,6 +41,17 @@ def _deadline_seconds() -> float:
     except ValueError:
         ms = 60000
     return (ms if ms > 0 else 60000) / 1000.0
+
+
+def _retry_backoff_seconds() -> Tuple[float, float]:
+    """(base, cap) for the get_var retry backoff, in seconds. Env-tuned:
+    ``PADDLE_TPU_RPC_RETRY_BASE_MS`` (default 50) and
+    ``PADDLE_TPU_RPC_RETRY_CAP_MS`` (default 1000) — full jitter doubles
+    the envelope per attempt up to the cap, so a herd of trainers
+    polling one recovering pserver decorrelates instead of stampeding
+    on a fixed cadence (docs/RESILIENCE.md)."""
+    return (millis_env("PADDLE_TPU_RPC_RETRY_BASE_MS", 50),
+            millis_env("PADDLE_TPU_RPC_RETRY_CAP_MS", 1000))
 
 
 class _rpc_call:
@@ -328,6 +341,7 @@ class RPCClient:
 
     def send_var(self, name: str, value) -> None:
         with _rpc_call("send_var"):
+            fault_point("rpc.send")
             if isinstance(value, SelectedRows):
                 rows, vals, height = value.rows, value.values, value.height
                 dims = (height if height >= 0 else len(rows),) + vals.shape[1:]
@@ -351,8 +365,13 @@ class RPCClient:
         # against a DEAD peer each native call already burns the full
         # reconnect deadline, and 50 of those would stack to minutes.
         # deadline parsed exactly like the native transport's, so the
-        # two never disagree (_deadline_seconds)
+        # two never disagree (_deadline_seconds). Sleeps are FULL-JITTER
+        # exponential (PADDLE_TPU_RPC_RETRY_BASE_MS/_CAP_MS) and clamped
+        # to the REMAINING deadline, checked BEFORE sleeping — a fixed
+        # backoff used to burn the deadline's last slice asleep and then
+        # report expiration without having retried
         deadline_s = _deadline_seconds()
+        base_s, cap_s = _retry_backoff_seconds()
         with _rpc_call("get_var"):
             t0 = time.monotonic()
             for attempt in range(max(retries, 1)):
@@ -363,9 +382,14 @@ class RPCClient:
                     out = _batch_read(self._lib, b)[0][1]
                     RPC_BYTES_RECV.inc(_payload_nbytes(out))
                     return out
-                if time.monotonic() - t0 > deadline_s:
+                if attempt + 1 >= max(retries, 1):
+                    break  # count exhausted: no retry follows, so a
+                    #        sleep here would be pure added latency
+                remaining = deadline_s - (time.monotonic() - t0)
+                if remaining <= 0:
                     break
-                time.sleep(0.1)
+                time.sleep(min(backoff_delay(attempt, base_s, cap_s),
+                               remaining))
             raise RPCError("get_var(%s)" % name, self.endpoint,
                            "or the variable was never pushed (init race)")
 

@@ -169,7 +169,8 @@ _PENDING = {}
 
 
 def save_persistables_async(executor, dirname, main_program=None,
-                            filename=None, scope=None) -> AsyncCheckpoint:
+                            filename=None, scope=None,
+                            extra_vars=()) -> AsyncCheckpoint:
     """Non-blocking ``save_persistables``: the device→host transfer is
     SYNCHRONOUS (overlapped across arrays via ``copy_to_host_async``,
     and required for correctness — the next train step donates the
@@ -182,12 +183,21 @@ def save_persistables_async(executor, dirname, main_program=None,
     TPU-native analog of the reference's trainer-thread saves (io.py:441
     save_persistables + the PS checkpoint_notify path): there the RPC
     layer hides the write latency; here the train loop keeps the chip
-    busy while the host writes."""
+    busy while the host writes.
+
+    ``extra_vars``: additional SCOPE var names snapshotted alongside the
+    program's persistables when present (names absent from the scope
+    are skipped, not errors). The resilience supervisor passes the
+    executor's RNG-chain var here so a resumed run replays dropout
+    masks bitwise — see docs/RESILIENCE.md."""
     import threading
 
     program = main_program or default_main_program()
     scope = scope or global_scope()
     names = _persistable_names(program, lambda v: v.persistable)
+    for n in extra_vars:
+        if n not in names and scope.find_var(n) is not None:
+            names.append(n)
     vals = []
     for n in names:
         v = scope.find_var(n)

@@ -7,6 +7,7 @@ existing good checkpoint."""
 from __future__ import annotations
 
 import ctypes
+import glob as _glob
 import itertools as _itertools
 import os
 from typing import Dict
@@ -56,8 +57,44 @@ def _lib():
     return lib
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        # EPERM etc: the pid exists but isn't ours — treat as alive
+        return True
+    return True
+
+
+def _clean_orphan_tmps(path: str) -> None:
+    """Remove staging files for THIS target left by DEAD writer pids —
+    a SIGKILLed/power-lost writer dies between the tmp write and the
+    rename, and nothing else ever collects its litter. Live pids (a
+    concurrent writer in another process) are never touched; neither is
+    this process's own staging (same-path writes serialize in io.py, so
+    any same-pid tmp seen here belongs to an in-flight writer)."""
+    for tmp in _glob.glob(_glob.escape(path) + ".tmp.*"):
+        parts = tmp[len(path):].split(".")  # ['', 'tmp', '<pid>', '<seq>']
+        try:
+            pid = int(parts[2])
+        except (IndexError, ValueError):
+            continue
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        try:
+            os.remove(tmp)
+        except OSError:
+            continue
+        from ..observe.families import RESILIENCE_ORPHANS_CLEANED
+
+        RESILIENCE_ORPHANS_CLEANED.inc()
+
+
 def save_tensors(path: str, tensors: Dict[str, np.ndarray]) -> None:
     lib = _lib()
+    _clean_orphan_tmps(path)
     # normalize + dtype-check everything BEFORE touching the filesystem
     prepared = []
     for name, arr in tensors.items():
@@ -85,6 +122,15 @@ def save_tensors(path: str, tensors: Dict[str, np.ndarray]) -> None:
         ended = True
         if not lib.ts_write_end(h):
             raise IOError("finalize failed for %s" % tmp)
+        # fault-injection site, placed EXACTLY in the crash window that
+        # matters: the staged tmp is complete, the rename has not
+        # happened — a 'crash' here leaves the litter a real power loss
+        # leaves (previous checkpoint intact, orphaned tmp on disk); a
+        # 'raise' here surfaces like any transient write error (the
+        # finally below removes the staging file)
+        from ..resilience.faults import fault_point
+
+        fault_point("checkpoint.write")
         os.replace(tmp, path)
         finished = True
     finally:

@@ -251,13 +251,94 @@ SERVING_PREFILL_PROGRAMS = REGISTRY.counter(
     "Distinct prompt lengths the engine compiled a prefill executable "
     "for — sustained growth = prompt-length churn; bucket prompts")
 
+# ----------------------------------------------------------- resilience
+# (paddle_tpu/resilience/: fault injection, wedge watchdog, checkpoint-
+# resume supervisor — see docs/RESILIENCE.md)
+RESILIENCE_FAULTS_INJECTED = REGISTRY.counter(
+    "paddle_resilience_faults_injected_total",
+    "Faults injected by the armed FaultPlan (resilience/faults.py), by "
+    "site and mode — chaos tests assert on these instead of trusting "
+    "the injection happened", labels=("site", "mode"))
+FAULT_SITES = ("executor.dispatch", "device_put", "rpc.send",
+               "reader.next", "checkpoint.write")
+for _site in FAULT_SITES:
+    for _mode in ("raise", "delay", "wedge", "crash"):
+        # pre-materialize the full site x mode schema (schema-is-the-
+        # signal: a sidecar from a crashed chaos run still shows every
+        # site at 0 except the one that fired)
+        RESILIENCE_FAULTS_INJECTED.labels(site=_site, mode=_mode)
+RESILIENCE_FAULT_SITES_ARMED = REGISTRY.gauge(
+    "paddle_resilience_fault_sites_armed",
+    "Fault specs armed in the currently installed FaultPlan "
+    "(0 = injection plane inactive)")
+RESILIENCE_WEDGES = REGISTRY.counter(
+    "paddle_resilience_wedges_detected_total",
+    "Watchdog wedge detections: a heartbeat-stamped operation ran past "
+    "its deadline with no progress stamp (one count per stalled "
+    "operation, not per poll)", labels=("site",))
+for _site in ("executor.dispatch", "executor.wait", "backend.probe"):
+    RESILIENCE_WEDGES.labels(site=_site)
+RESILIENCE_HEARTBEAT_AGE = REGISTRY.gauge(
+    "paddle_resilience_heartbeat_age_seconds",
+    "Age of the OLDEST still-open heartbeat operation at the "
+    "watchdog's last poll; 0 while the process is idle (only written "
+    "while paddle_resilience_watchdog_armed is 1)")
+RESILIENCE_WATCHDOG_ARMED = REGISTRY.gauge(
+    "paddle_resilience_watchdog_armed",
+    "1 while a Watchdog thread is polling heartbeats")
+RESILIENCE_RECOVERIES = REGISTRY.counter(
+    "paddle_resilience_recoveries_total",
+    "resilient_train_loop recoveries by kind: 'resume' reloaded the "
+    "latest manifest checkpoint and fast-forwarded the reader, "
+    "'restart' re-ran the startup program (no durable checkpoint yet)",
+    labels=("kind",))
+for _k in ("resume", "restart"):
+    RESILIENCE_RECOVERIES.labels(kind=_k)
+RESILIENCE_CHECKPOINTS = REGISTRY.counter(
+    "paddle_resilience_checkpoints_total",
+    "Supervisor checkpoints by status: 'written' = durable + manifest "
+    "updated, 'pruned' = retired by retain-last-K, 'failed' = the "
+    "async write raised (previous checkpoint stays latest)",
+    labels=("status",))
+for _s in ("written", "pruned", "failed"):
+    RESILIENCE_CHECKPOINTS.labels(status=_s)
+RESILIENCE_CHECKPOINT_SECONDS = REGISTRY.histogram(
+    "paddle_resilience_checkpoint_seconds",
+    "Train-loop wall time spent launching one periodic checkpoint "
+    "(device->host snapshot + finalizing the previous write; the disk "
+    "write itself runs on the background thread)")
+RESILIENCE_BACKOFF_SECONDS = REGISTRY.histogram(
+    "paddle_resilience_retry_backoff_seconds",
+    "Full-jitter backoff sleeps taken before a supervisor recovery "
+    "attempt")
+RESILIENCE_FF_BATCHES = REGISTRY.counter(
+    "paddle_resilience_fast_forward_batches_total",
+    "Reader batches consumed and discarded while fast-forwarding to "
+    "the resumed step after a checkpoint reload")
+RESILIENCE_ORPHANS_CLEANED = REGISTRY.counter(
+    "paddle_resilience_checkpoint_orphans_cleaned_total",
+    "Stale checkpoint staging (.tmp) files left by DEAD writer "
+    "processes, removed by a later save to the same path")
+
 # -------------------------------------------------------- backend/bench
 BACKEND_PROBE_SECONDS = REGISTRY.gauge(
     "paddle_backend_probe_seconds",
-    "Wall time of the last jax backend-init probe (bench.py)")
+    "Wall time of the last jax backend-init probe attempt (bench.py)")
 BACKEND_PROBE_OK = REGISTRY.gauge(
     "paddle_backend_probe_ok",
     "1 if the last backend probe completed, 0 if it timed out")
+BACKEND_PROBE_ATTEMPTS = REGISTRY.counter(
+    "paddle_backend_probe_attempts_total",
+    "Backend init probe attempts by outcome — the bench retries "
+    "transient wedges (PADDLE_TPU_BENCH_INIT_ATTEMPTS) instead of "
+    "zeroing the round on the first one", labels=("outcome",))
+for _o in ("ok", "timeout", "error"):
+    BACKEND_PROBE_ATTEMPTS.labels(outcome=_o)
+BACKEND_PROBE_ATTEMPT_SECONDS = REGISTRY.histogram(
+    "paddle_backend_probe_attempt_seconds",
+    "Per-attempt backend init probe wall time (the gauge keeps only "
+    "the last attempt; the histogram keeps every retry, so a "
+    "post-mortem sees 'wedged 300s, wedged 300s, ok in 4s')")
 BENCH_ROWS = REGISTRY.counter(
     "paddle_bench_rows_total",
     "Bench rows emitted by outcome", labels=("status",))

@@ -41,6 +41,7 @@ MODULES = [
     "paddle_tpu.imperative.nn",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.resilience",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.transpiler",
     "paddle_tpu.transpiler",

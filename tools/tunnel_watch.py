@@ -11,6 +11,8 @@ exits so the operator (or driver) sees the artifacts.
     python tools/tunnel_watch.py --quick         # quick queue on capture
     python tools/tunnel_watch.py --interval 120  # probe cadence (s)
     python tools/tunnel_watch.py --max-hours 10  # give up after N hours
+    python tools/tunnel_watch.py --rearm 2       # re-arm after a capture,
+                                                 # up to 2 more windows
 
 Every probe and the capture outcome are appended to
 docs/tunnel_watch.log (timestamped), so even an empty round leaves
@@ -53,6 +55,13 @@ def main():
                     help="exit 2 after this long without a window")
     ap.add_argument("--quick", action="store_true",
                     help="pass --quick to the playbook on capture")
+    ap.add_argument("--rearm", type=int, default=0, metavar="N",
+                    help="after a captured window, re-arm and keep "
+                         "probing for up to N MORE windows instead of "
+                         "exiting (round-4 saw two usable hardware "
+                         "windows; a one-shot watchdog forfeits the "
+                         "second). Default 0: exit after the first "
+                         "capture")
     args = ap.parse_args()
 
     if os.environ.get("PADDLE_TPU_PLATFORM"):
@@ -63,24 +72,41 @@ def main():
 
     deadline = time.time() + args.max_hours * 3600
     n = 0
-    wlog("armed: interval=%ds max_hours=%.1f queue=%s"
+    captures = 0
+    failed = 0
+    wlog("armed: interval=%ds max_hours=%.1f queue=%s rearm=%d"
          % (args.interval, args.max_hours,
-            "quick" if args.quick else "full"))
+            "quick" if args.quick else "full", args.rearm))
     while time.time() < deadline:
         n += 1
         if probe():
-            wlog("probe #%d OK — TUNNEL ALIVE, firing playbook" % n)
+            wlog("probe #%d OK — TUNNEL ALIVE, firing playbook "
+                 "(capture #%d)" % (n, captures + 1))
             cmd = [PY, "tools/window_playbook.py"]
             if args.quick:
                 cmd.append("--quick")
             # Window contents are bounded by the playbook's own
             # per-step deadlines; 2h hard cap here is a backstop.
             rc = run(cmd, 7200)
-            wlog("playbook done rc=%s — exiting for operator commit" % rc)
-            return 0 if rc == 0 else 1
+            captures += 1
+            failed += int(rc != 0)
+            if captures > args.rearm:
+                wlog("playbook done rc=%s — exiting for operator commit"
+                     % rc)
+                return 0 if failed == 0 else 1
+            wlog("playbook done rc=%s — RE-ARMED (%d/%d re-arms left); "
+                 "next probe in %ds"
+                 % (rc, args.rearm - captures + 1, args.rearm,
+                    args.interval))
+            time.sleep(args.interval)
+            continue
         wlog("probe #%d dead (timeout/err); sleeping %ds"
              % (n, args.interval))
         time.sleep(args.interval)
+    if captures:
+        wlog("max_hours reached after %d capture(s); exiting for "
+             "operator commit" % captures)
+        return 0 if failed == 0 else 1
     wlog("max_hours reached with no window; %d probes, all dead" % n)
     return 2
 
