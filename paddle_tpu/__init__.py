@@ -29,6 +29,7 @@ if _os.environ.get("PADDLE_TPU_PLATFORM"):
     _jax.config.update("jax_platforms", _os.environ["PADDLE_TPU_PLATFORM"])
 
 from . import ops as _ops  # registers all op lowerings  # noqa: F401
+from . import analysis  # attaches shape rules + exposes the verifier  # noqa: F401
 from . import (  # noqa: F401
     backward,
     clip,

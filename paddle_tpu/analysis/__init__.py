@@ -1,0 +1,36 @@
+"""Static program verifier: shape/dtype inference + IR lint passes.
+
+The compile-time checking layer the reference got from per-op
+``InferShape`` + OpDesc validation (framework/shape_inference.h), rebuilt
+for whole-block XLA lowering: importing this package attaches shape rules
+for the core op vocabulary to the registry's ``infer_shape`` hook, and
+
+* ``Program.validate()`` / ``verify_program`` run inference + the lint
+  suite, fill inferred shapes back onto Variables, and raise
+  ``ProgramVerifyError`` (op type, name-scope, definition site) on
+  errors;
+* the Executor runs the same check at prepare time when
+  ``PADDLE_TPU_VALIDATE=1`` (tests/conftest.py turns it on suite-wide);
+* ``tools/lint_program.py`` is the CLI; ``paddle_analysis_*`` observe
+  families count programs checked, findings by rule, and verify time.
+
+See docs/ANALYSIS.md for the rule catalog and how to write a rule.
+"""
+
+from . import shape_rules  # noqa: F401  (attaches the core rule set)
+from .infer import (Finding, InferContext, InferError,  # noqa: F401
+                    ProgramVerifyError, infer_program_shapes,
+                    validation_enabled, verify_program)
+from .lint import LINT_RULES, lint_program  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "InferContext",
+    "InferError",
+    "LINT_RULES",
+    "ProgramVerifyError",
+    "infer_program_shapes",
+    "lint_program",
+    "validation_enabled",
+    "verify_program",
+]

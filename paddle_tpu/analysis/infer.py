@@ -1,0 +1,361 @@
+"""Whole-program shape/dtype inference over Program blocks.
+
+The compile-time half of the reference's per-op ``InferShape``
+(framework/shape_inference.h, op_desc.cc InferShape calls): every op with
+a rule on its OpDef ``infer_shape`` hook (core/registry.py:39) propagates
+symbolic shapes — ``-1`` dims ride through untouched, so a ``[-1, 784]``
+data var stays batch-polymorphic — and inferred shapes are written back
+onto ``Variable``s that were created without one. A mismatch (e.g. a
+matmul whose contraction dims disagree) becomes an error **Finding**
+carrying the op's type, name-scope, and definition site, and
+``Program.validate()`` / prepare-time checking raise it as
+``ProgramVerifyError`` — instead of the cryptic JAX trace error the same
+program would produce deep inside core/lowering.py.
+
+TVM (arXiv:1802.04799) treats static shape/type inference over the graph
+IR as the substrate every later pass stands on; this module is that
+substrate for the quantize/distribute transpilers and the lint suite
+(analysis/lint.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core.program import Block, Program, Variable
+from ..core.registry import OPS
+
+__all__ = [
+    "Finding",
+    "InferContext",
+    "InferError",
+    "ProgramVerifyError",
+    "infer_program_shapes",
+    "validation_enabled",
+    "verify_program",
+]
+
+SEVERITIES = ("error", "warning", "info")
+
+# every rule name a Finding can carry — observe/families.py pre-materializes
+# the paddle_analysis_findings_total{rule=...} series from this list
+RULES = (
+    "shape-infer",        # a shape rule reported a hard mismatch (error)
+    "shape-annotation",   # declared var shape disagrees with inference
+    "dtype-annotation",   # declared var dtype disagrees with inference
+    "unregistered-op",    # op type has no registered lowering
+    "def-before-use",     # var read before the op that defines it
+    "undefined-input",    # read with no producer and no declared source
+    "fetch-undefined",    # fetch target nothing defines
+    "dead-var",           # var no op reads or writes
+    "dead-op",            # op contributing to no fetch/persistable write
+    "double-write",       # persistable written twice, no read between
+    "int64-feed",         # int64 feed var (narrowed to int32 on device)
+    "int64-narrowing",    # op materializes an int64 intermediate
+    "grad-pairing",       # X@GRAD without X in the program
+    "sub-block",          # control-flow sub-block wiring broken
+)
+
+
+class Finding:
+    """One verifier result, with op provenance when anchored to an op."""
+
+    __slots__ = ("rule", "severity", "message", "op_type", "block_idx",
+                 "op_idx", "name_scope", "def_site", "var")
+
+    def __init__(self, rule: str, severity: str, message: str,
+                 op_type: Optional[str] = None, block_idx: int = -1,
+                 op_idx: int = -1, name_scope: str = "",
+                 def_site: Optional[str] = None, var: Optional[str] = None):
+        assert severity in SEVERITIES, severity
+        assert rule in RULES, rule
+        self.rule = rule
+        self.severity = severity
+        self.message = message
+        self.op_type = op_type
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.name_scope = name_scope
+        self.def_site = def_site
+        self.var = var
+
+    def format(self) -> str:
+        where = []
+        if self.op_type is not None:
+            where.append("op %s (block %d, #%d)"
+                         % (self.op_type, self.block_idx, self.op_idx))
+        if self.var:
+            where.append("var %r" % self.var)
+        if self.name_scope:
+            where.append("scope %s" % self.name_scope)
+        if self.def_site:
+            where.append("defined at %s" % self.def_site)
+        loc = "; ".join(where)
+        return "[%s] %s: %s%s" % (
+            self.severity, self.rule, self.message,
+            " (%s)" % loc if loc else "")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return "Finding(%s)" % self.format()
+
+
+def finding_for_op(rule: str, severity: str, message: str, block: Block,
+                   op, var: Optional[str] = None) -> Finding:
+    try:
+        op_idx = block.ops.index(op)
+    except ValueError:
+        op_idx = -1
+    return Finding(rule, severity, message, op_type=op.type,
+                   block_idx=block.idx, op_idx=op_idx,
+                   name_scope=getattr(op, "name_scope", "") or "",
+                   def_site=getattr(op, "def_site", None), var=var)
+
+
+class ProgramVerifyError(RuntimeError):
+    """Raised by Program.validate()/prepare-time checking when the
+    verifier produced error-severity findings. ``.findings`` carries the
+    full list (warnings/infos included)."""
+
+    def __init__(self, findings: Sequence[Finding]):
+        self.findings = list(findings)
+        errors = [f for f in self.findings if f.severity == "error"]
+        lines = ["program verification failed with %d error(s):"
+                 % len(errors)]
+        lines += ["  " + f.format() for f in errors]
+        others = len(self.findings) - len(errors)
+        if others:
+            lines.append("  (+%d non-error finding(s))" % others)
+        super().__init__("\n".join(lines))
+
+
+class InferError(Exception):
+    """Raised by shape rules via ``ctx.fail`` on a hard mismatch."""
+
+
+# ------------------------------------------------------------- shape algebra
+def normalize_shape(shape) -> Optional[Tuple[int, ...]]:
+    """None = unknown rank; dims are ints with -1 = unknown/symbolic."""
+    if shape is None:
+        return None
+    return tuple(-1 if (s is None or int(s) < 0) else int(s) for s in shape)
+
+
+def dims_compatible(a: int, b: int) -> bool:
+    return a == -1 or b == -1 or a == b
+
+
+def merge_dim(a: int, b: int) -> int:
+    return b if a == -1 else a
+
+
+def shapes_compatible(a, b) -> bool:
+    a, b = normalize_shape(a), normalize_shape(b)
+    if a is None or b is None:
+        return True
+    if len(a) != len(b):
+        return False
+    return all(dims_compatible(x, y) for x, y in zip(a, b))
+
+
+def merge_shapes(a, b):
+    """Most-concrete merge of two compatible shapes (None = unknown)."""
+    a, b = normalize_shape(a), normalize_shape(b)
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return tuple(merge_dim(x, y) for x, y in zip(a, b))
+
+
+def is_concrete(shape) -> bool:
+    shape = normalize_shape(shape)
+    return shape is not None and all(s >= 0 for s in shape)
+
+
+def numel(shape) -> Optional[int]:
+    shape = normalize_shape(shape)
+    if shape is None or any(s < 0 for s in shape):
+        return None
+    n = 1
+    for s in shape:
+        n *= s
+    return n
+
+
+# ----------------------------------------------------------------- context
+class InferContext:
+    """What a shape rule sees: the op's slots resolved to (shape, dtype)
+    through the inference environment, plus attrs and output setters.
+    Shapes are normalized tuples (-1 = symbolic/unknown) or None
+    (unknown rank); rules must tolerate None inputs by leaving the
+    affected outputs unset."""
+
+    def __init__(self, op, lookup: Callable[[str], Tuple[Optional[tuple],
+                                                         Optional[str]]]):
+        self.op = op
+        self._lookup = lookup
+        self.outputs: Dict[Tuple[str, int], Tuple[Optional[tuple],
+                                                  Optional[str]]] = {}
+
+    # ---- inputs ----
+    def input_name(self, slot: str, idx: int = 0) -> Optional[str]:
+        names = self.op.inputs.get(slot) or []
+        return names[idx] if idx < len(names) and names[idx] else None
+
+    def num_inputs(self, slot: str) -> int:
+        return len([n for n in (self.op.inputs.get(slot) or []) if n])
+
+    def input_shape(self, slot: str, idx: int = 0) -> Optional[tuple]:
+        name = self.input_name(slot, idx)
+        if name is None:
+            return None
+        return normalize_shape(self._lookup(name)[0])
+
+    def input_dtype(self, slot: str, idx: int = 0) -> Optional[str]:
+        name = self.input_name(slot, idx)
+        if name is None:
+            return None
+        return self._lookup(name)[1]
+
+    # ---- attrs ----
+    def attr(self, name: str, default: Any = None) -> Any:
+        return self.op.attrs.get(name, default)
+
+    # ---- outputs ----
+    def set(self, slot: str, shape, dtype: Optional[str] = None,
+            idx: int = 0) -> None:
+        self.outputs[(slot, idx)] = (normalize_shape(shape), dtype)
+
+    def set_dtype(self, slot: str, dtype: str, idx: int = 0) -> None:
+        prev = self.outputs.get((slot, idx), (None, None))
+        self.outputs[(slot, idx)] = (prev[0], dtype)
+
+    def fail(self, message: str) -> None:
+        raise InferError(message)
+
+    def require(self, cond: bool, message: str) -> None:
+        if not cond:
+            raise InferError(message)
+
+
+# ------------------------------------------------------------------ engine
+def _block_lookup(program: Program, block: Block,
+                  env: Dict[str, Tuple[Optional[tuple], Optional[str]]]):
+    def lookup(name: str):
+        if name in env:
+            return env[name]
+        v = block._find_var_recursive(name)
+        if v is not None:
+            return normalize_shape(v.shape), v.dtype
+        return None, None
+
+    return lookup
+
+
+def infer_block(program: Program, block: Block,
+                findings: List[Finding], fill: bool = True) -> None:
+    """Propagate shapes/dtypes through one block in op order."""
+    env: Dict[str, Tuple[Optional[tuple], Optional[str]]] = {}
+    lookup = _block_lookup(program, block, env)
+    for op in block.ops:
+        opdef = OPS.get(op.type)
+        rule = opdef.infer_shape if opdef is not None else None
+        inferred: Dict[Tuple[str, int], Tuple[Optional[tuple],
+                                              Optional[str]]] = {}
+        if rule is not None:
+            ctx = InferContext(op, lookup)
+            try:
+                rule(ctx)
+                inferred = ctx.outputs
+            except InferError as e:
+                findings.append(finding_for_op(
+                    "shape-infer", "error", str(e), block, op))
+            except Exception as e:  # a buggy rule must not sink validation
+                findings.append(finding_for_op(
+                    "shape-infer", "warning",
+                    "shape rule crashed: %s: %s" % (type(e).__name__, e),
+                    block, op))
+        for slot, names in op.outputs.items():
+            for idx, name in enumerate(names):
+                if not name:
+                    continue
+                shape, dtype = inferred.get((slot, idx), (None, None))
+                var = block._find_var_recursive(name)
+                declared = normalize_shape(var.shape) if var is not None \
+                    else None
+                if shape is not None and declared is not None \
+                        and not shapes_compatible(shape, declared):
+                    findings.append(finding_for_op(
+                        "shape-annotation", "warning",
+                        "output %r declared shape %s but inference says %s"
+                        % (name, tuple(declared), tuple(shape)),
+                        block, op, var=name))
+                    # trust the rule: it models what the lowering emits
+                    declared = None
+                if dtype is not None and var is not None \
+                        and var.dtype != dtype:
+                    findings.append(finding_for_op(
+                        "dtype-annotation", "warning",
+                        "output %r declared dtype %s but inference says %s"
+                        % (name, var.dtype, dtype), block, op, var=name))
+                merged = merge_shapes(shape, declared)
+                env[name] = (merged, dtype or (var.dtype if var else None))
+                if fill and var is not None and var.shape is None \
+                        and merged is not None:
+                    var.shape = tuple(merged)
+
+
+def infer_program_shapes(program: Program,
+                         findings: Optional[List[Finding]] = None,
+                         fill: bool = True) -> List[Finding]:
+    """Run shape/dtype inference over every block (parents first, so
+    sub-blocks see the shapes their outer block filled in)."""
+    findings = findings if findings is not None else []
+    for block in program.blocks:
+        infer_block(program, block, findings, fill=fill)
+    return findings
+
+
+# ------------------------------------------------------------- entry point
+def validation_enabled() -> bool:
+    """PADDLE_TPU_VALIDATE gates the Executor's prepare-time check
+    (off by default; tests/conftest.py turns it on for the suite)."""
+    return os.environ.get(
+        "PADDLE_TPU_VALIDATE", "0").lower() in ("1", "true", "on")
+
+
+def verify_program(program: Program, fetch_list=None, scope=None,
+                   raise_on_error: bool = True, fill: bool = True,
+                   site: str = "validate") -> List[Finding]:
+    """Shape/dtype inference + the IR lint suite over one Program.
+
+    Returns all findings (severity error/warning/info); with
+    ``raise_on_error``, error findings raise ``ProgramVerifyError``.
+    ``fetch_list`` (names or Variables) enables the fetch-of-undefined
+    and dead-op rules; ``scope`` lets reads of runtime state (persistable
+    vars living only in the Scope) resolve instead of reporting
+    undefined-input."""
+    import time
+
+    from ..observe.families import (ANALYSIS_FINDINGS, ANALYSIS_PROGRAMS,
+                                    ANALYSIS_VERIFY_SECONDS)
+    from .lint import lint_program
+
+    t0 = time.perf_counter()
+    fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                   for v in (fetch_list or [])]
+    findings: List[Finding] = []
+    infer_program_shapes(program, findings, fill=fill)
+    lint_program(program, fetch_names=fetch_names, scope=scope,
+                 findings=findings)
+    ANALYSIS_PROGRAMS.labels(site=site).inc()
+    for f in findings:
+        ANALYSIS_FINDINGS.labels(rule=f.rule).inc()
+    ANALYSIS_VERIFY_SECONDS.observe(time.perf_counter() - t0)
+    if raise_on_error and any(f.severity == "error" for f in findings):
+        raise ProgramVerifyError(findings)
+    return findings

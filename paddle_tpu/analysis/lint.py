@@ -1,0 +1,306 @@
+"""IR lint pass suite: structural checks over a Program's blocks.
+
+The rule catalog (docs/ANALYSIS.md) covers what the reference enforced
+piecemeal in op->InferShape/OpDesc checks and the SSA-graph validity
+passes (multi_devices_graph_check_pass): def-before-use, fetch of
+undefined vars, unregistered op types, dead ops/vars, double-writes to
+persistables, int64 feed-boundary hazards, grad-var pairing, and
+control-flow sub-block wiring. Severities:
+
+* ``error``   — the program cannot lower correctly; Program.validate()
+                and prepare-time checking raise ProgramVerifyError.
+* ``warning`` — almost certainly a bug (dead var, annotation drift);
+                reported + counted, never raised.
+* ``info``    — advisory (int64 feeds are narrowed with a runtime range
+                check; dead ops w.r.t. a PARTIAL fetch list are normal
+                for eval runs).
+
+Each rule is a function in LINT_RULES so tools/lint_program.py can list
+and filter them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core.program import GRAD_SUFFIX, Block, Program, op_effects
+from ..core.registry import has_op
+from .infer import Finding, finding_for_op
+
+__all__ = ["LINT_RULES", "lint_program"]
+
+# (reads, writes) of one op incl. control-flow sub-blocks: THE shared
+# definition in core/program.py — the executor's analyze_block uses the
+# same function, so lint and execution can never disagree on what a
+# while/recurrent/recompute op touches
+_op_reads_writes = op_effects
+
+
+def _var_of(program: Program, block: Block, name: str):
+    v = block._find_var_recursive(name)
+    if v is not None:
+        return v
+    for b in program.blocks:
+        if name in b.vars:
+            return b.vars[name]
+    return None
+
+
+def _scope_has(scope, name: str) -> bool:
+    return scope is not None and scope.has_var(name)
+
+
+# ------------------------------------------------------------------- rules
+def rule_unregistered_op(program, ctx, findings):
+    """Every op type must have a registered lowering (error)."""
+    for block in program.blocks:
+        for op in block.ops:
+            if not has_op(op.type):
+                findings.append(finding_for_op(
+                    "unregistered-op", "error",
+                    "op type %r has no registered lowering" % op.type,
+                    block, op))
+
+
+def rule_def_before_use(program, ctx, findings):
+    """A non-persistable, non-data var read before the op that produces
+    it would KeyError at lowering time (error); a read nothing in the
+    program produces and no declaration/scope explains is flagged as
+    undefined-input (warning — it may be fed by name at run time)."""
+    scope = ctx.get("scope")
+    for block in program.blocks:
+        if block.idx != 0:
+            continue  # sub-block reads resolve through op-bound names
+        produced_later: Dict[str, int] = {}
+        for i, op in enumerate(block.ops):
+            for n in _op_reads_writes(program, op)[1]:
+                produced_later.setdefault(n, i)
+        produced: Set[str] = set()
+        for i, op in enumerate(block.ops):
+            reads, writes = _op_reads_writes(program, op)
+            for n in reads:
+                if n in produced:
+                    continue
+                var = _var_of(program, block, n)
+                persist = (var is not None and var.persistable) or \
+                    _scope_has(scope, n)
+                is_data = var is not None and var.is_data
+                if persist or is_data:
+                    continue
+                first_def = produced_later.get(n)
+                if first_def is not None and first_def > i:
+                    findings.append(finding_for_op(
+                        "def-before-use", "error",
+                        "reads %r before op #%d defines it"
+                        % (n, first_def), block, op, var=n))
+                elif first_def is None and var is None:
+                    findings.append(finding_for_op(
+                        "undefined-input", "warning",
+                        "reads %r, which no op produces and no block "
+                        "declares (a run-time feed?)" % n, block, op,
+                        var=n))
+            produced.update(writes)
+
+
+def rule_fetch_undefined(program, ctx, findings):
+    """A fetch target that no op produces, no block declares, and (when
+    a scope is given) the scope does not hold is unfetchable (error) —
+    only checked when the caller supplied a fetch list."""
+    fetch_names = ctx.get("fetch_names") or ()
+    if not fetch_names:
+        return
+    produced: Set[str] = set()
+    for block in program.blocks:
+        for op in block.ops:
+            produced.update(_op_reads_writes(program, op)[1])
+    for name in fetch_names:
+        if name in produced:
+            continue
+        if _var_of(program, program.global_block(), name) is not None:
+            continue  # declared: may be fed or scope state at run time
+        if _scope_has(ctx.get("scope"), name):
+            continue
+        findings.append(Finding(
+            "fetch-undefined", "error",
+            "fetch target %r: no op produces it and no block declares "
+            "it%s" % (name, "" if ctx.get("scope") is None
+                      else ", and it is not in the scope"), var=name))
+
+
+def rule_dead_vars(program, ctx, findings):
+    """A declared, non-data, non-persistable var no op reads or writes
+    is build-time litter (warning)."""
+    fetch_names = set(ctx.get("fetch_names") or ())
+    referenced: Set[str] = set()
+    for block in program.blocks:
+        for op in block.ops:
+            referenced.update(op.input_names())
+            referenced.update(op.output_names())
+            cond = op.attrs.get("condition")
+            if cond:
+                referenced.add(cond)
+            referenced.update(op.attrs.get("__sub_bound__", ()))
+    for block in program.blocks:
+        for name, var in block.vars.items():
+            if name in referenced or name in fetch_names:
+                continue
+            if var.persistable or var.is_data:
+                continue
+            findings.append(Finding(
+                "dead-var", "warning",
+                "var %r is declared in block %d but no op reads or "
+                "writes it" % (name, block.idx), var=name,
+                block_idx=block.idx))
+
+
+def rule_dead_ops(program, ctx, findings):
+    """With a fetch list: ops whose outputs reach no fetch target and no
+    persistable write (and carry no side-effecting role) are dead
+    w.r.t. this run (info — eval runs legitimately fetch a slice)."""
+    fetch_names = set(ctx.get("fetch_names") or ())
+    if not fetch_names:
+        return
+    block = program.global_block()
+    needed = set(fetch_names)
+    for op in reversed(block.ops):
+        reads, writes = _op_reads_writes(program, op)
+        live = op.attrs.get("__op_role__") in ("optimize", "dist")
+        if not live:
+            for n in writes:
+                var = _var_of(program, block, n)
+                if n in needed or (var is not None and var.persistable):
+                    live = True
+                    break
+        if live:
+            needed.update(reads)
+        else:
+            findings.append(finding_for_op(
+                "dead-op", "info",
+                "contributes to no fetch target or persistable write "
+                "for this fetch list", block, op))
+
+
+def rule_double_write(program, ctx, findings):
+    """Two writes to a persistable var with no read between them: the
+    first write is lost state (warning)."""
+    for block in program.blocks:
+        last_write: Dict[str, Tuple[Block, object]] = {}
+        for op in block.ops:
+            reads, writes = _op_reads_writes(program, op)
+            for n in reads:
+                last_write.pop(n, None)
+            for n in writes:
+                var = _var_of(program, block, n)
+                if var is None or not var.persistable:
+                    continue
+                if n in last_write:
+                    findings.append(finding_for_op(
+                        "double-write", "warning",
+                        "persistable %r written again with no read of "
+                        "the first write" % n, block, op, var=n))
+                last_write[n] = (block, op)
+
+
+def rule_int64_boundaries(program, ctx, findings):
+    """x64 is disabled on device: int64/uint64 feeds are narrowed to
+    32-bit with a runtime range check (info), and ops that *materialize*
+    int64 intermediates (cast/fill_constant dtype=int64) silently run
+    as int32 (info)."""
+    for var in program.global_block().vars.values():
+        if var.is_data and var.dtype in ("int64", "uint64"):
+            findings.append(Finding(
+                "int64-feed", "info",
+                "feed var %r is %s: narrowed to 32-bit at the feed "
+                "boundary (range-checked; ids beyond int32 need the "
+                "distributed sparse table path)" % (var.name, var.dtype),
+                var=var.name))
+    for block in program.blocks:
+        for op in block.ops:
+            dt = None
+            if op.type == "cast":
+                dt = op.attrs.get("out_dtype")
+            elif op.type in ("fill_constant",
+                             "fill_constant_batch_size_like"):
+                dt = op.attrs.get("dtype")
+            if str(dt) in ("int64", "uint64"):
+                findings.append(finding_for_op(
+                    "int64-narrowing", "info",
+                    "materializes an %s value; the device computes in "
+                    "32-bit (x64 disabled)" % dt, block, op))
+
+
+def rule_grad_pairing(program, ctx, findings):
+    """An ``X@GRAD`` var whose base ``X`` exists nowhere in the program
+    is an orphaned gradient (warning)."""
+    names: Set[str] = set()
+    for block in program.blocks:
+        names.update(block.vars)
+        for op in block.ops:
+            names.update(op.input_names())
+            names.update(op.output_names())
+    for n in sorted(names):
+        if n.endswith(GRAD_SUFFIX):
+            base = n[: -len(GRAD_SUFFIX)]
+            # nested grads (X@GRAD@GRAD) pair against X@GRAD
+            if base and base not in names:
+                findings.append(Finding(
+                    "grad-pairing", "warning",
+                    "gradient var %r has no base var %r in the program"
+                    % (n, base), var=n))
+
+
+def rule_sub_blocks(program, ctx, findings):
+    """Control-flow ops must reference a valid sub-block and an existing
+    condition var (error)."""
+    n_blocks = len(program.blocks)
+    for block in program.blocks:
+        for op in block.ops:
+            if "sub_block" not in op.attrs:
+                continue
+            idx = op.attrs["sub_block"]
+            if not isinstance(idx, int) or not 0 <= idx < n_blocks:
+                findings.append(finding_for_op(
+                    "sub-block", "error",
+                    "sub_block=%r is not a valid block index (program "
+                    "has %d blocks)" % (idx, n_blocks), block, op))
+                continue
+            if idx == block.idx:
+                findings.append(finding_for_op(
+                    "sub-block", "error",
+                    "op's sub_block points at its own block %d" % idx,
+                    block, op))
+            cond = op.attrs.get("condition")
+            # strictly the sub-block's parent CHAIN — the all-blocks
+            # fallback of _var_of would let a declaration in an
+            # unrelated sibling sub-block mask a real wiring error
+            if cond and program.block(idx)._find_var_recursive(cond) is None:
+                findings.append(finding_for_op(
+                    "sub-block", "error",
+                    "condition var %r is not declared in the sub-block "
+                    "or any parent" % cond, block, op, var=cond))
+
+
+LINT_RULES = {
+    "unregistered-op": rule_unregistered_op,
+    "def-before-use": rule_def_before_use,
+    "fetch-undefined": rule_fetch_undefined,
+    "dead-var": rule_dead_vars,
+    "dead-op": rule_dead_ops,
+    "double-write": rule_double_write,
+    "int64-boundaries": rule_int64_boundaries,
+    "grad-pairing": rule_grad_pairing,
+    "sub-block": rule_sub_blocks,
+}
+
+
+def lint_program(program: Program, fetch_names: Sequence[str] = (),
+                 scope=None, findings: Optional[List[Finding]] = None,
+                 rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the lint pass suite; returns (and appends to) ``findings``."""
+    findings = findings if findings is not None else []
+    ctx = {"fetch_names": list(fetch_names), "scope": scope}
+    for name, fn in LINT_RULES.items():
+        if rules is not None and name not in rules:
+            continue
+        fn(program, ctx, findings)
+    return findings

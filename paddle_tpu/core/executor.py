@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .lowering import LowerContext, as_jax_dtype, lower_block
-from .program import Program, Variable, default_main_program
+from .program import Program, Variable, default_main_program, op_effects
 from .registry import get_op, has_op
 from .scope import Scope, global_scope
 # hoisted out of the per-step guards: resilience's module-level imports
@@ -648,6 +648,15 @@ class Executor:
         return (program._serial, program.version, sig, tuple(fetch_names))
 
     def _prepare(self, program: Program, feed_vals, fetch_names, scope) -> _Plan:
+        from ..analysis import validation_enabled, verify_program
+
+        if validation_enabled():
+            # opt-in prepare-time verification (PADDLE_TPU_VALIDATE=1; on
+            # by default under tests): a bad program fails HERE with op
+            # provenance instead of as a JAX trace error inside
+            # lower_block. Once per plan — cache hits never re-verify.
+            verify_program(program, fetch_list=fetch_names, scope=scope,
+                           raise_on_error=True, site="prepare")
         feed_names = sorted(feed_vals)
         (feed_names, fetch_names, const_state, mut_state, pure_written,
          needs_rng, step) = analyze_block(program, feed_names, fetch_names, scope)
@@ -875,27 +884,8 @@ def analyze_block(program: Program, feed_names, fetch_names, scope,
     external: List[str] = []
     needs_rng = False
 
-    def op_effects(op):
-        """(reads, writes) of one op, recursing into control-flow
-        sub-blocks (while_op/conditional_block carry their body's
-        reads/writes — the analog of while_op.cc's input/output lists)."""
-        reads = list(op.input_names())
-        writes = list(op.output_names())
-        if "sub_block" in op.attrs:
-            sub = program.block(op.attrs["sub_block"])
-            # names bound by the op itself inside its body (e.g. the
-            # recurrent op's per-step inputs and pre-state slots) are not
-            # external reads
-            sub_produced = set(op.attrs.get("__sub_bound__", ()))
-            for sop in sub.ops:
-                r, w = op_effects(sop)
-                reads.extend(n for n in r if n not in sub_produced)
-                writes.extend(w)
-                sub_produced.update(w)
-            cond = op.attrs.get("condition")
-            if cond:
-                reads.append(cond)
-        return reads, writes
+    # read/write semantics (incl. control-flow sub-blocks) live in ONE
+    # place — core/program.py op_effects — shared with analysis/lint.py
 
     def op_uses_rng(op):
         if get_op(op.type).uses_rng:
@@ -911,7 +901,7 @@ def analyze_block(program: Program, feed_names, fetch_names, scope,
             raise KeyError("op %r has no registered lowering" % op.type)
         if op_uses_rng(op):
             needs_rng = True
-        reads, writes = op_effects(op)
+        reads, writes = op_effects(program, op)
         for n in reads:
             if n not in produced and n not in external:
                 external.append(n)
@@ -929,7 +919,7 @@ def analyze_block(program: Program, feed_names, fetch_names, scope,
     written = []
     seen_w = set()
     for blk, op in all_blocks_ops:
-        for n in op_effects(op)[1]:
+        for n in op_effects(program, op)[1]:
             if n in seen_w:
                 continue
             var = _find_var(n)

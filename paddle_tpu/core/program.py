@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import os
+import sys
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -26,6 +28,7 @@ __all__ = [
     "Operator",
     "Block",
     "Program",
+    "op_effects",
     "default_main_program",
     "default_startup_program",
     "program_guard",
@@ -227,6 +230,45 @@ class Parameter(Variable):
         super().__init__(block, name, shape, dtype, **kw)
 
 
+# ---- op definition-site provenance (for analysis.ProgramVerifyError) ----
+# Frames inside the framework's op-appending machinery are skipped when
+# recording where an op was built, so the verifier reports the line of the
+# model/test code (or models/ builder) that called the layer — the closest
+# analog of the reference's per-op InferShape failing AT the op that built
+# it. PADDLE_TPU_PROVENANCE=0 disables the (cheap) per-op frame walk.
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MACHINERY_PREFIXES = (
+    os.path.join(_PKG_ROOT, "core") + os.sep,
+    os.path.join(_PKG_ROOT, "layers") + os.sep,
+)
+_MACHINERY_FILES = frozenset(
+    os.path.join(_PKG_ROOT, f)
+    for f in ("layer_helper.py", "nets.py", "optimizer.py", "regularizer.py",
+              "clip.py", "backward.py", "initializer.py")
+)
+_PROVENANCE = os.environ.get(
+    "PADDLE_TPU_PROVENANCE", "1").lower() not in ("0", "false", "off")
+
+
+def _op_def_site() -> Optional[str]:
+    """file:line of the nearest stack frame OUTSIDE the layer machinery."""
+    try:
+        f = sys._getframe(2)  # skip _op_def_site and Operator.__init__
+    except ValueError:  # pragma: no cover - interpreter without caller
+        return None
+    fallback = None
+    depth = 0
+    while f is not None and depth < 32:
+        fn = f.f_code.co_filename
+        if fallback is None:
+            fallback = "%s:%d" % (fn, f.f_lineno)
+        if not (fn.startswith(_MACHINERY_PREFIXES) or fn in _MACHINERY_FILES):
+            return "%s:%d" % (fn, f.f_lineno)
+        f = f.f_back
+        depth += 1
+    return fallback
+
+
 class Operator:
     """One op node: type + named input/output slots + attrs
     (reference framework.py:599 / OpDesc in framework.proto:43)."""
@@ -249,6 +291,8 @@ class Operator:
         role = getattr(block.program, "_op_role", None)
         if role and role != "forward":
             self.attrs.setdefault("__op_role__", role)
+        self.name_scope = current_name_scope()
+        self.def_site = _op_def_site() if _PROVENANCE else None
 
     def input_names(self) -> List[str]:
         return [n for ns in self.inputs.values() for n in ns if n]
@@ -511,6 +555,11 @@ class Program:
                 nop = Operator(nb, op.type, None, None, attrs)
                 nop.inputs = {k: list(v) for k, v in op.inputs.items()}
                 nop.outputs = {k: list(v) for k, v in op.outputs.items()}
+                # keep the ORIGINAL build site through clones: a verifier
+                # finding on a cloned (for_test/pruned) program must point
+                # at the line that built the op, not at clone()
+                nop.name_scope = op.name_scope
+                nop.def_site = op.def_site
                 nb.ops.append(nop)
             p.blocks.append(nb)
         p.current_block_idx = 0
@@ -519,6 +568,22 @@ class Program:
     def list_vars(self):
         for b in self.blocks:
             yield from b.vars.values()
+
+    # ---- static verification (analysis/: shape inference + IR lint) ----
+    def validate(self, fetch_list=None, scope=None, raise_on_error: bool = True):
+        """Run the static program verifier over this program: whole-block
+        shape/dtype inference (per-op rules registered on the OpDef
+        ``infer_shape`` hook; inferred shapes are filled back onto
+        Variables) plus the IR lint pass suite. Returns the list of
+        ``analysis.Finding``s; with ``raise_on_error`` (default) raises
+        ``analysis.ProgramVerifyError`` on any error-severity finding,
+        carrying the offending op's type, name-scope and definition site.
+        The Executor runs the same check at prepare time when
+        ``PADDLE_TPU_VALIDATE=1`` (on by default under tests)."""
+        from ..analysis import verify_program
+
+        return verify_program(self, fetch_list=fetch_list, scope=scope,
+                              raise_on_error=raise_on_error)
 
     def _prune(self, targets: Sequence[Variable]) -> "Program":
         """Backward-slice to the ops needed for `targets`
@@ -550,6 +615,36 @@ class Program:
             for op in b.ops:
                 lines.append("  " + repr(op))
         return "\n".join(lines)
+
+
+def op_effects(program: Program, op: Operator):
+    """(reads, writes) of one op, recursing into control-flow sub-blocks
+    (while_op/conditional_block carry their body's reads/writes — the
+    analog of while_op.cc's input/output lists). Names bound by the op
+    itself inside its body (``__sub_bound__``, e.g. the recurrent op's
+    per-step inputs and pre-state slots) are not external reads.
+
+    THE single definition of control-flow read/write semantics — shared
+    by the executor's block analysis (core/executor.py analyze_block)
+    and the IR lint suite (analysis/lint.py), so the two can never
+    disagree on what a while/recurrent/recompute op touches. Tolerant of
+    an invalid ``sub_block`` index (the lint sub-block rule reports it;
+    recursion is simply skipped)."""
+    reads = list(op.input_names())
+    writes = list(op.output_names())
+    sub_idx = op.attrs.get("sub_block")
+    if isinstance(sub_idx, int) and 0 <= sub_idx < len(program.blocks):
+        sub = program.block(sub_idx)
+        sub_produced = set(op.attrs.get("__sub_bound__", ()))
+        for sop in sub.ops:
+            r, w = op_effects(program, sop)
+            reads.extend(n for n in r if n not in sub_produced)
+            writes.extend(w)
+            sub_produced.update(w)
+        cond = op.attrs.get("condition")
+        if cond:
+            reads.append(cond)
+    return reads, writes
 
 
 # ---- default program registry (framework.py:3066-3134 analog) ----

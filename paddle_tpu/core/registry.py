@@ -38,6 +38,7 @@ class OpDef:
         uses_rng: bool = False,
         infer_shape: Optional[Callable] = None,
         needs_env: bool = False,
+        synthesized: bool = False,
     ):
         self.type = type
         self.lowering = lowering
@@ -47,7 +48,13 @@ class OpDef:
         # slots that may carry gradients; None = all float inputs
         self.diff_inputs = diff_inputs
         self.uses_rng = uses_rng
+        # compile-time shape/dtype rule: fn(InferContext) -> None, registered
+        # either at register_op time or attached later via
+        # register_shape_rule (analysis/shape_rules.py ships the core set)
         self.infer_shape = infer_shape
+        # True for *_grad OpDefs synthesized lazily by get_op from the
+        # forward lowering (they carry no hand-written kernel of their own)
+        self.synthesized = synthesized
         # control-flow ops get the live lowering env injected as
         # attrs["__env__"] and may return {"__env_update__": {...}}
         self.needs_env = needs_env
@@ -92,7 +99,34 @@ def register_grad_lowering(fwd_type: str):
     """Attach a custom grad lowering to an already-registered op."""
 
     def deco(fn: LoweringFn) -> LoweringFn:
+        if fwd_type not in OPS:
+            raise KeyError(
+                "cannot attach a grad lowering to op %r: it has no "
+                "registered forward lowering (known: %d ops) — register "
+                "the forward op first" % (fwd_type, len(OPS))
+            )
         OPS[fwd_type].grad_lowering = fn
+        return fn
+
+    return deco
+
+
+def register_shape_rule(*op_types: str):
+    """Attach a compile-time shape/dtype inference rule to already-
+    registered ops (fills the OpDef.infer_shape hook — the analog of the
+    reference's per-op InferShape). The rule receives an
+    ``analysis.InferContext`` and sets output shapes/dtypes or calls
+    ``ctx.fail(msg)`` on a mismatch. Raises for unregistered op types so
+    a typo'd rule never silently no-ops."""
+
+    def deco(fn: Callable) -> Callable:
+        for t in op_types:
+            if t not in OPS:
+                raise KeyError(
+                    "cannot attach a shape rule to op %r: it has no "
+                    "registered lowering (known: %d ops)" % (t, len(OPS))
+                )
+            OPS[t].infer_shape = fn
         return fn
 
     return deco
@@ -104,7 +138,8 @@ def get_op(type: str) -> OpDef:
             # synthesize the grad op from the forward lowering (see autodiff)
             from .autodiff import make_generic_grad
 
-            OPS[type] = OpDef(type, make_generic_grad(type[:-5]), no_grad=True)
+            OPS[type] = OpDef(type, make_generic_grad(type[:-5]),
+                              no_grad=True, synthesized=True)
         else:
             raise KeyError(
                 "op %r has no registered lowering (known: %d ops)" % (type, len(OPS))
@@ -117,4 +152,12 @@ def has_op(type: str) -> bool:
 
 
 def all_ops() -> List[str]:
+    """Sorted registered op types. ``*_grad`` ops whose lowering is derived
+    mechanically from the forward (via jax.vjp, see core.autodiff) are
+    synthesized LAZILY by get_op — they appear here only once something
+    has requested them (their OpDef carries ``synthesized=True``).
+    Eagerly materializing all of them would double the registry with
+    entries that add no information beyond ``<fwd> in OPS``; use
+    ``has_op("<fwd>_grad")`` to test differentiability instead of
+    scanning this list."""
     return sorted(OPS)

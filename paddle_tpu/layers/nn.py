@@ -664,7 +664,10 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
 def topk(input, k, name=None):
     helper = LayerHelper("top_k", name=name)
     vals = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
-    ids = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    # the lowering emits int32 indices (ops/nn.py top_k; x64 is disabled
+    # on device) — declaring int64 here was a latent annotation bug the
+    # static verifier flags as dtype-annotation drift
+    ids = helper.create_variable_for_type_inference("int32", stop_gradient=True)
     helper.append_op(type="top_k", inputs={"X": [input]},
                      outputs={"Out": [vals], "Indices": [ids]}, attrs={"k": k})
     if input.shape is not None:

@@ -120,26 +120,13 @@ class _RecurrentBase:
         params = []
         prog = self.helper.main_program
 
-        def op_effects(op):
-            """(reads, writes), recursing into nested While/cond bodies —
-            mirrors the executor's effect analysis (core/executor.py)."""
-            reads = list(op.input_names())
-            writes = list(op.output_names())
-            if "sub_block" in op.attrs:
-                nested = prog.block(op.attrs["sub_block"])
-                nested_local = set(op.attrs.get("__sub_bound__", ()))
-                for nop in nested.ops:
-                    r, w = op_effects(nop)
-                    reads.extend(n for n in r if n not in nested_local)
-                    writes.extend(w)
-                    nested_local.update(w)
-                cond = op.attrs.get("condition")
-                if cond:
-                    reads.append(cond)
-            return reads, writes
+        # nested While/cond bodies: THE shared effect analysis
+        # (core/program.py op_effects, also used by the executor and the
+        # IR lint suite — three hand-synchronized copies once drifted)
+        from ..core.program import op_effects
 
         for op in sub.ops:
-            reads, writes = op_effects(op)
+            reads, writes = op_effects(prog, op)
             for n in reads:
                 if n and n not in produced and n not in params:
                     params.append(n)

@@ -320,6 +320,42 @@ RESILIENCE_ORPHANS_CLEANED = REGISTRY.counter(
     "Stale checkpoint staging (.tmp) files left by DEAD writer "
     "processes, removed by a later save to the same path")
 
+# ------------------------------------------------------------- analysis
+# (paddle_tpu/analysis/: static program verifier — see docs/ANALYSIS.md)
+ANALYSIS_PROGRAMS = REGISTRY.counter(
+    "paddle_analysis_programs_verified_total",
+    "Programs run through analysis.verify_program, by trigger: "
+    "'validate' = explicit Program.validate(), 'prepare' = executor "
+    "prepare-time checking (PADDLE_TPU_VALIDATE=1), 'cli' = "
+    "tools/lint_program.py", labels=("site",))
+for _s in ("validate", "prepare", "cli"):
+    ANALYSIS_PROGRAMS.labels(site=_s)
+ANALYSIS_FINDINGS = REGISTRY.counter(
+    "paddle_analysis_findings_total",
+    "Verifier findings by rule (severity folded into the rule's "
+    "contract — see the catalog in docs/ANALYSIS.md); errors also "
+    "raise ProgramVerifyError at validate/prepare", labels=("rule",))
+# pre-materialize the rule schema (import placed at the bottom of this
+# module would cycle; the analysis package declares its rule list as a
+# plain tuple precisely so this stays a data dependency)
+_ANALYSIS_RULES = (
+    "shape-infer", "shape-annotation", "dtype-annotation",
+    "unregistered-op", "def-before-use", "undefined-input",
+    "fetch-undefined", "dead-var", "dead-op", "double-write",
+    "int64-feed", "int64-narrowing", "grad-pairing", "sub-block")
+for _r in _ANALYSIS_RULES:
+    ANALYSIS_FINDINGS.labels(rule=_r)
+ANALYSIS_VERIFY_SECONDS = REGISTRY.histogram(
+    "paddle_analysis_verify_seconds",
+    "Wall time of one verify_program pass (shape inference + lint "
+    "suite) — scales with op count, not with tensor sizes")
+
+# ----------------------------------------------------------------- spans
+SPAN_SECONDS = REGISTRY.histogram(
+    "paddle_span_seconds",
+    "Generic named-span latency (spans without a dedicated histogram)",
+    labels=("span",))
+
 # -------------------------------------------------------- backend/bench
 BACKEND_PROBE_SECONDS = REGISTRY.gauge(
     "paddle_backend_probe_seconds",

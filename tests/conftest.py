@@ -10,6 +10,11 @@ session's live TPU tunnel (JAX_PLATFORMS=axon) and crawls.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# prepare-time program verification (analysis/) is ON suite-wide: every
+# program the Executor compiles gets shape inference + lint first, so a
+# latent shape bug fails with op provenance instead of a JAX trace error.
+# Individual tests can monkeypatch it off to exercise the raw path.
+os.environ.setdefault("PADDLE_TPU_VALIDATE", "1")
 # kernel tests must keep exercising the Pallas path (interpret mode on
 # CPU) regardless of the short-S composed dispatch; policy tests
 # monkeypatch PADDLE_TPU_FLASH_MIN_SEQ themselves

@@ -1,0 +1,451 @@
+"""Static program verifier: shape/dtype inference + IR lint suite.
+
+Rule-by-rule positive/negative cases, symbolic batch-dim propagation,
+provenance in error messages, prepare-time integration
+(PADDLE_TPU_VALIDATE, on suite-wide via conftest), and the
+"all example model programs verify clean" gate (the builders are shared
+with tools/lint_program.py, the CLI face of the same checks).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.analysis import (Finding, ProgramVerifyError, lint_program,
+                                 validation_enabled, verify_program)
+from paddle_tpu.analysis.infer import RULES
+from paddle_tpu.core.registry import OPS, register_grad_lowering
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import lint_program as lint_cli  # noqa: E402
+import repo_lint  # noqa: E402
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ------------------------------------------------------------ rule coverage
+def test_core_vocabulary_has_shape_rules():
+    """Acceptance floor: >= 40 core op types carry a registered rule on
+    the OpDef.infer_shape hook."""
+    with_rules = [t for t in OPS if OPS[t].infer_shape is not None]
+    assert len(with_rules) >= 40, len(with_rules)
+    # spot-check every family the issue names
+    for t in ("elementwise_add", "matmul", "mul", "conv2d", "pool2d",
+              "reduce_sum", "reshape2", "transpose2", "concat", "split",
+              "lookup_table", "softmax", "softmax_with_cross_entropy",
+              "adam", "sgd", "dropout", "layer_norm", "batch_norm"):
+        assert OPS[t].infer_shape is not None, t
+
+
+def test_findings_rule_schema_matches_observe_families():
+    """observe/families.py pre-materializes the rule label set from a
+    copy of analysis.infer.RULES — the two must not drift."""
+    from paddle_tpu.observe.families import _ANALYSIS_RULES
+
+    assert set(_ANALYSIS_RULES) == set(RULES)
+
+
+# -------------------------------------------------- inference: happy paths
+def test_symbolic_batch_dim_propagates(fresh_programs):
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[784], dtype="float32")
+        h = fluid.layers.fc(x, size=64, act="relu")
+        y = fluid.layers.fc(h, size=10)
+        sm = fluid.layers.softmax(y)
+    findings = main.validate()
+    assert not [f for f in findings if f.severity != "info"], findings
+    assert tuple(h.shape) == (-1, 64)
+    assert tuple(y.shape) == (-1, 10)
+    assert tuple(sm.shape) == (-1, 10)
+
+
+def test_inference_fills_missing_shapes(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 6], dtype="float32")
+        out = main.global_block().create_var(dtype="float32")
+        main.global_block().append_op(
+            "transpose", {"X": [x]}, {"Out": [out]}, {"axis": [0, 2, 1]})
+        assert out.shape is None
+    main.validate()
+    assert tuple(out.shape) == (-1, 6, 4)
+
+
+def test_reshape_zero_and_minus_one_semantics(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6, 8], dtype="float32",
+                              append_batch_size=False)
+        y = fluid.layers.reshape(x, [0, 2, -1, 4])  # 0 copies dim0 = 6
+        z = fluid.layers.data("z", shape=[6, 8], dtype="float32")
+        w = fluid.layers.reshape(z, [0, 2, 24])  # batch -1 rides through
+    findings = main.validate()
+    assert not [f for f in findings if f.severity == "error"]
+    assert tuple(y.shape) == (6, 2, 1, 4)
+    assert tuple(w.shape) == (-1, 2, 24)
+
+
+# ------------------------------------------------ inference: hard mismatches
+def test_mismatched_matmul_fails_with_provenance(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[8, 32], dtype="float32")
+        b = fluid.layers.data("b", shape=[16, 4], dtype="float32")
+        with fluid.name_scope("bad_head"):
+            fluid.layers.matmul(a, b)  # 32 vs 16
+    with pytest.raises(ProgramVerifyError) as ei:
+        main.validate()
+    msg = str(ei.value)
+    assert "matmul" in msg
+    assert "contraction dim mismatch" in msg
+    assert "test_analysis.py" in msg          # def-site provenance
+    assert "bad_head" in msg                  # name-scope provenance
+    errors = [f for f in ei.value.findings if f.severity == "error"]
+    assert errors and errors[0].rule == "shape-infer"
+
+
+def test_mismatched_matmul_fails_at_prepare_not_in_jax(fresh_programs):
+    """The acceptance scenario: with PADDLE_TPU_VALIDATE=1 (suite
+    default) a bad program fails at executor prepare with op provenance,
+    NOT as a JAX trace error inside core/lowering.py."""
+    assert validation_enabled()
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[8, 32], dtype="float32")
+        b = fluid.layers.data("b", shape=[16, 4], dtype="float32")
+        c = fluid.layers.matmul(a, b)
+    exe = fluid.Executor(fluid.TPUPlace())
+    feed = {"a": np.zeros((2, 8, 32), "float32"),
+            "b": np.zeros((2, 16, 4), "float32")}
+    with pytest.raises(ProgramVerifyError, match="matmul"):
+        exe.run(main, feed=feed, fetch_list=[c], scope=scope)
+
+
+def test_validation_env_off_falls_back_to_lowering_error(
+        fresh_programs, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "0")
+    assert not validation_enabled()
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[8, 32], dtype="float32")
+        b = fluid.layers.data("b", shape=[16, 4], dtype="float32")
+        c = fluid.layers.matmul(a, b)
+    exe = fluid.Executor(fluid.TPUPlace())
+    feed = {"a": np.zeros((2, 8, 32), "float32"),
+            "b": np.zeros((2, 16, 4), "float32")}
+    with pytest.raises(Exception) as ei:
+        exe.run(main, feed=feed, fetch_list=[c], scope=scope)
+    assert not isinstance(ei.value, ProgramVerifyError)
+
+
+@pytest.mark.parametrize("case", ["elementwise", "mul", "concat", "reshape",
+                                  "optimizer", "lookup_dtype"])
+def test_shape_rule_negative_cases(fresh_programs, case):
+    main, startup, _ = fresh_programs
+    blk = main.global_block()
+    with fluid.program_guard(main, startup):
+        if case == "elementwise":
+            x = fluid.layers.data("x", shape=[4, 8], dtype="float32")
+            y = fluid.layers.data("y", shape=[4, 9], dtype="float32")
+            out = blk.create_var(dtype="float32")
+            blk.append_op("elementwise_add", {"X": [x], "Y": [y]},
+                          {"Out": [out]}, {"axis": -1})
+        elif case == "mul":
+            x = fluid.layers.data("x", shape=[32], dtype="float32")
+            w = blk.create_var(name="w", shape=(16, 10), dtype="float32")
+            out = blk.create_var(dtype="float32")
+            blk.append_op("mul", {"X": [x], "Y": [w]}, {"Out": [out]},
+                          {"x_num_col_dims": 1, "y_num_col_dims": 1})
+        elif case == "concat":
+            x = fluid.layers.data("x", shape=[4, 8], dtype="float32",
+                                  append_batch_size=False)
+            y = fluid.layers.data("y", shape=[4, 9], dtype="float32",
+                                  append_batch_size=False)
+            out = blk.create_var(dtype="float32")
+            blk.append_op("concat", {"X": [x, y]}, {"Out": [out]},
+                          {"axis": 0})  # non-axis dims 8 vs 9
+        elif case == "reshape":
+            x = fluid.layers.data("x", shape=[6, 8], dtype="float32",
+                                  append_batch_size=False)
+            out = blk.create_var(dtype="float32")
+            blk.append_op("reshape", {"X": [x]}, {"Out": [out]},
+                          {"shape": [7, 7]})  # 48 != 49
+        elif case == "optimizer":
+            p = blk.create_parameter(name="p", shape=[4, 4],
+                                     dtype="float32")
+            g = blk.create_var(name="g", shape=(4, 5), dtype="float32",
+                               persistable=True)
+            lr = blk.create_var(name="lr", shape=(1,), dtype="float32",
+                                persistable=True)
+            blk.append_op("sgd", {"Param": [p], "Grad": [g],
+                                  "LearningRate": [lr]},
+                          {"ParamOut": [p]})
+        elif case == "lookup_dtype":
+            w = blk.create_parameter(name="emb", shape=[10, 4],
+                                     dtype="float32")
+            ids = fluid.layers.data("ids", shape=[5], dtype="float32")
+            out = blk.create_var(dtype="float32")
+            blk.append_op("lookup_table", {"W": [w], "Ids": [ids]},
+                          {"Out": [out]}, {})
+    with pytest.raises(ProgramVerifyError):
+        main.validate()
+
+
+def test_shape_annotation_drift_is_a_warning(fresh_programs):
+    """A declared shape that disagrees with inference is reported but
+    does not fail validation (the rule models the lowering; the
+    annotation is the bug)."""
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        out = main.global_block().create_var(
+            name="lied_about", shape=(3, 3), dtype="float32")
+        main.global_block().append_op("relu", {"X": [x]}, {"Out": [out]})
+    findings = main.validate()  # warnings never raise
+    drift = _by_rule(findings, "shape-annotation")
+    assert drift and drift[0].var == "lied_about"
+
+
+# ------------------------------------------------------------- lint rules
+def test_lint_unregistered_op(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        main.global_block().append_op("totally_fake_op", {"X": [x]},
+                                      {"Out": [x]})
+    with pytest.raises(ProgramVerifyError, match="totally_fake_op"):
+        main.validate()
+
+
+def test_lint_def_before_use(fresh_programs):
+    main, startup, _ = fresh_programs
+    blk = main.global_block()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        late = blk.create_var(name="late", dtype="float32")
+        out = blk.create_var(name="out", dtype="float32")
+        blk.append_op("elementwise_add", {"X": [x], "Y": [late]},
+                      {"Out": [out]})
+        blk.append_op("relu", {"X": [x]}, {"Out": [late]})
+    with pytest.raises(ProgramVerifyError) as ei:
+        main.validate()
+    assert _by_rule(ei.value.findings, "def-before-use")
+
+
+def test_lint_fetch_undefined(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        fluid.layers.relu(x)
+    with pytest.raises(ProgramVerifyError, match="no_such_var"):
+        main.validate(fetch_list=["no_such_var"])
+    main.validate(fetch_list=[x])  # a real target passes
+
+
+def test_lint_dead_var_and_dead_op(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        kept = fluid.layers.relu(x)
+        fluid.layers.sigmoid(x)  # never fetched -> dead for this fetch
+        main.global_block().create_var(name="never_touched",
+                                       dtype="float32")
+    findings = main.validate(fetch_list=[kept])
+    dead_vars = _by_rule(findings, "dead-var")
+    assert [f for f in dead_vars if f.var == "never_touched"]
+    dead_ops = _by_rule(findings, "dead-op")
+    assert dead_ops and dead_ops[0].severity == "info"
+    assert dead_ops[0].op_type == "sigmoid"
+
+
+def test_lint_double_write(fresh_programs):
+    main, startup, _ = fresh_programs
+    blk = main.global_block()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        state = blk.create_var(name="state", shape=(4,), dtype="float32",
+                               persistable=True)
+        blk.append_op("assign", {"X": [x]}, {"Out": [state]})
+        blk.append_op("assign", {"X": [x]}, {"Out": [state]})
+    findings = main.validate()
+    dw = _by_rule(findings, "double-write")
+    assert dw and dw[0].var == "state" and dw[0].severity == "warning"
+    # a read between the writes clears it
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        blk2 = main2.global_block()
+        x2 = fluid.layers.data("x", shape=[4], dtype="float32")
+        st2 = blk2.create_var(name="state", shape=(4,), dtype="float32",
+                              persistable=True)
+        rd = blk2.create_var(name="rd", dtype="float32")
+        blk2.append_op("assign", {"X": [x2]}, {"Out": [st2]})
+        blk2.append_op("relu", {"X": [st2]}, {"Out": [rd]})
+        blk2.append_op("assign", {"X": [x2]}, {"Out": [st2]})
+    assert not _by_rule(main2.validate(), "double-write")
+
+
+def test_lint_grad_pairing(fresh_programs):
+    main, startup, _ = fresh_programs
+    blk = main.global_block()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        orphan = blk.create_var(name="phantom@GRAD", dtype="float32")
+        blk.append_op("relu", {"X": [x]}, {"Out": [orphan]})
+    gp = _by_rule(main.validate(), "grad-pairing")
+    assert gp and gp[0].var == "phantom@GRAD"
+
+
+def test_lint_sub_block_validation(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        main.global_block().append_op(
+            "relu", {"X": [x]}, {"Out": [x]}, {"sub_block": 99})
+    with pytest.raises(ProgramVerifyError) as ei:
+        main.validate()
+    assert _by_rule(ei.value.findings, "sub-block")
+
+
+def test_lint_condition_var_must_be_on_sub_blocks_parent_chain(
+        fresh_programs):
+    """A condition var declared only in an UNRELATED sibling sub-block
+    must not satisfy the check — at run time the executor would KeyError
+    on the never-produced var."""
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        body = main.create_block()
+        main.rollback()
+        sibling = main.create_block()
+        sibling.create_var(name="cond_elsewhere", dtype="bool")
+        main.rollback()
+        main.global_block().append_op(
+            "relu", {"X": [x]}, {"Out": [x]},
+            {"sub_block": body.idx, "condition": "cond_elsewhere"})
+    with pytest.raises(ProgramVerifyError) as ei:
+        main.validate()
+    sb = _by_rule(ei.value.findings, "sub-block")
+    assert sb and sb[0].var == "cond_elsewhere"
+    # declared on the actual parent chain -> clean
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = fluid.layers.data("x", shape=[4], dtype="float32")
+        body2 = main2.create_block()
+        main2.rollback()
+        main2.global_block().create_var(name="cond_ok", dtype="bool",
+                                        persistable=True)
+        main2.global_block().append_op(
+            "relu", {"X": [x2]}, {"Out": [x2]},
+            {"sub_block": body2.idx, "condition": "cond_ok"})
+    assert not _by_rule(main2.validate(raise_on_error=False), "sub-block")
+
+
+def test_lint_int64_feed_is_info(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        fluid.layers.data("ids", shape=[5], dtype="int64")
+    infos = _by_rule(main.validate(), "int64-feed")
+    assert infos and all(f.severity == "info" for f in infos)
+
+
+def test_backward_program_verifies_clean(fresh_programs):
+    """append_backward + Adam produce paired grads, no def-before-use,
+    no double-writes — the verifier agrees."""
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.fc(x, size=4, act="relu")
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    findings = main.validate(fetch_list=[loss])
+    assert not [f for f in findings if f.severity in ("error", "warning")], \
+        [f.format() for f in findings if f.severity != "info"]
+
+
+# ------------------------------------------------------------- provenance
+def test_operator_records_def_site_and_name_scope(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        with fluid.name_scope("tower"):
+            with fluid.name_scope("head"):
+                fluid.layers.relu(x)
+    op = main.global_block().ops[-1]
+    assert op.name_scope == "tower/head"
+    assert op.def_site and "test_analysis.py" in op.def_site
+
+
+def test_provenance_survives_clone(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        fluid.layers.relu(x)
+    site = main.global_block().ops[-1].def_site
+    clone = main.clone()
+    assert clone.global_block().ops[-1].def_site == site
+
+
+# ---------------------------------------------------- registry satellites
+def test_register_grad_lowering_unregistered_is_descriptive():
+    with pytest.raises(KeyError, match="no registered forward lowering"):
+        register_grad_lowering("never_registered_op")(lambda c, i, a: {})
+
+
+def test_synthesized_grad_ops_marked_and_listed():
+    from paddle_tpu.core.registry import all_ops, get_op
+
+    d = get_op("tanh_shrink_grad")  # forces lazy synthesis
+    assert d.synthesized
+    assert "tanh_shrink_grad" in all_ops()
+    assert not get_op("tanh").synthesized
+
+
+# -------------------------------------------------- example model programs
+@pytest.mark.parametrize("model", sorted(lint_cli.EXAMPLE_BUILDERS))
+def test_example_model_programs_verify_clean(model):
+    """Every model-zoo train program (forward + backward + Adam) and its
+    startup program verify with zero errors AND zero warnings; inferred
+    shapes are filled in (info-level advisories like int64 feeds are
+    expected)."""
+    findings, (main, startup) = lint_cli.verify_example(model)
+    noisy = [f.format() for f in findings
+             if f.severity in ("error", "warning")]
+    assert not noisy, noisy
+    # shapes got filled: no op output var (outside sub-blocks) is left
+    # shapeless unless nothing declared or inferred one
+    n_shaped = sum(1 for v in main.global_block().vars.values()
+                   if v.shape is not None)
+    assert n_shaped > len(main.global_block().vars) * 0.9
+
+
+def test_lint_program_cli_json(capsys):
+    rc = lint_cli.main(["--model", "mnist", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "mnist" in out
+    assert all(f["severity"] == "info" for f in out["mnist"])
+
+
+def test_verify_counts_into_observe():
+    from paddle_tpu import observe
+
+    def snap():
+        fam = observe.snapshot()["metrics"][
+            "paddle_analysis_programs_verified_total"]
+        return {tuple(s["labels"].items()): s["value"]
+                for s in fam["samples"]}
+
+    before = snap().get((("site", "validate"),), 0)
+    main = fluid.Program()
+    verify_program(main)
+    assert snap()[(("site", "validate"),)] == before + 1

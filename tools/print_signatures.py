@@ -19,6 +19,7 @@ import sys
 
 MODULES = [
     "paddle_tpu",
+    "paddle_tpu.analysis",
     "paddle_tpu.layers",
     "paddle_tpu.layers.sequence",
     "paddle_tpu.layers.detection",

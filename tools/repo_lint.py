@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""AST-based repo lint: cheap structural invariants CI can hold.
+
+Two rule families (both wired into the fast tier via
+tests/test_repo_lint.py):
+
+1. **bare-except** — ``except:`` swallows KeyboardInterrupt/SystemExit;
+   in the resilience and serving paths that turns an operator Ctrl-C or
+   a supervisor kill into a silently-absorbed fault, so those trees must
+   always name what they catch (``except Exception:`` at minimum).
+2. **undeclared-family** — every observe metric family name referenced
+   anywhere in code must be declared in ``paddle_tpu/observe/families.py``
+   (the schema-is-the-signal contract: a telemetry sidecar carries every
+   family's zeroed schema only when declaration is centralized). A
+   string literal that LOOKS like a family name (``paddle_*_total`` ...)
+   but is not declared is either a typo'd reference — which would
+   silently create an empty series — or a decentralized declaration.
+
+Usage: ``python tools/repo_lint.py [--root DIR]``; exit 1 on violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# directories whose bare excepts are load-bearing bugs (the fault/serving
+# planes must never absorb KeyboardInterrupt/SystemExit)
+BARE_EXCEPT_PATHS = (
+    os.path.join("paddle_tpu", "resilience"),
+    os.path.join("paddle_tpu", "serving"),
+)
+
+FAMILIES_FILE = os.path.join("paddle_tpu", "observe", "families.py")
+
+# a family-name-shaped string literal: paddle_<words>; the paddle_tpu
+# prefix is the package itself (env vars, module ids), never a family
+_FAMILY_RE = re.compile(r"paddle_(?!tpu(?:_|$))[a-z0-9]+(?:_[a-z0-9]+)+")
+# prometheus render suffixes a reference may legitimately carry
+_RENDER_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def iter_py_files(root: str) -> List[str]:
+    out = []
+    for sub in ("paddle_tpu", "tools", "tests", "examples"):
+        top = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            out.extend(os.path.join(dirpath, f) for f in filenames
+                       if f.endswith(".py"))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        out.append(bench)
+    return sorted(out)
+
+
+def _parse(path: str):
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return ast.parse(src, filename=path)
+
+
+def declared_families(root: str) -> Set[str]:
+    """Family names declared via REGISTRY.counter/gauge/histogram(...) in
+    observe/families.py (first positional string argument)."""
+    tree = _parse(os.path.join(root, FAMILIES_FILE))
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in ("counter", "gauge", "histogram")):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            names.add(node.args[0].value)
+    return names
+
+
+def bare_except_violations(root: str, paths=None) -> List[str]:
+    violations = []
+    targets = [p for p in iter_py_files(root)
+               if any(os.sep + bp + os.sep in p or p.endswith(bp)
+                      for bp in (paths or BARE_EXCEPT_PATHS))]
+    for path in targets:
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                violations.append(
+                    "%s:%d: bare `except:` in a resilience/serving path "
+                    "(name the exception type; bare except absorbs "
+                    "KeyboardInterrupt/SystemExit)"
+                    % (os.path.relpath(path, root), node.lineno))
+    return violations
+
+
+def family_ref_violations(root: str, files=None) -> List[str]:
+    declared = declared_families(root)
+    # a candidate must END like a real family does (the last token of
+    # some declared name, or a prometheus render suffix) — this keeps
+    # prose like "paddle_analysis_config" (an API-name transliteration)
+    # out while still catching mid-name typos of real references
+    suffixes = {n.rsplit("_", 1)[-1] for n in declared}
+    suffixes.update(s.lstrip("_") for s in _RENDER_SUFFIXES)
+    violations = []
+    fam_rel = FAMILIES_FILE.replace("/", os.sep)
+    for path in (files or iter_py_files(root)):
+        rel = os.path.relpath(path, root)
+        if rel == fam_rel:
+            continue  # the declaration site itself
+        refs: Dict[str, int] = {}
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for m in _FAMILY_RE.finditer(node.value):
+                    # only whole-literal or clearly-delimited mentions:
+                    # prose can legally mention a family mid-sentence, and
+                    # the regex already guarantees word-ish boundaries
+                    refs.setdefault(m.group(0), node.lineno)
+        for name, lineno in sorted(refs.items()):
+            if name.rsplit("_", 1)[-1] not in suffixes:
+                continue
+            base = name
+            for suf in _RENDER_SUFFIXES:
+                if base.endswith(suf) and base[: -len(suf)] in declared:
+                    base = base[: -len(suf)]
+                    break
+            if base not in declared:
+                violations.append(
+                    "%s:%d: observe family %r is referenced but not "
+                    "declared in %s" % (rel, lineno, name, FAMILIES_FILE))
+    return violations
+
+
+def run(root: str = REPO_ROOT) -> List[str]:
+    """All violations (empty list = clean). tests/test_repo_lint.py
+    asserts on this."""
+    return bare_except_violations(root) + family_ref_violations(root)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="AST-based repo lint")
+    p.add_argument("--root", default=REPO_ROOT)
+    args = p.parse_args(argv)
+    violations = run(args.root)
+    for v in violations:
+        print(v)
+    print("%d violation(s)" % len(violations))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
