@@ -20,6 +20,7 @@ import contextlib
 import os
 import time
 import warnings
+import zlib
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -34,6 +35,7 @@ from .scope import Scope, global_scope
 # hoisted out of the per-step guards: resilience's module-level imports
 # never touch core (no cycle), and the dispatch window must carry no
 # avoidable bytecode on the 2-core throttled CI box
+from ..observe import trace as _tr
 from ..resilience.faults import fault_point
 from ..resilience.watchdog import heartbeat
 
@@ -65,6 +67,9 @@ class _Plan:
         self.compiled_sigs = set()  # dispatch signatures already compiled:
         #                    the first dispatch of each lands in the
         #                    compile-time histogram, not the run histogram
+        self.sig = None   # short hex of the plan-cache key — stamped on
+        #                    every dispatch/complete trace span so per-op
+        #                    cost attribution falls out of a trace dump
 
 
 class Executor:
@@ -390,6 +395,23 @@ class Executor:
         blocked = 0.0
         step_i = 0
         t_loop = time.perf_counter()
+        loop_ctx = None
+        if _tr.trace_enabled():
+            # ONE trace for the whole loop: the caller's context when
+            # attached, else a fresh loop trace. The fill thread gets it
+            # by explicit hand-off (pinned BEFORE iter() starts the
+            # thread); the consumer side re-attaches it around each
+            # step's dispatch/wait below — attach() cannot span the
+            # yields (the thread-local would leak into whatever the
+            # consumer runs between steps), so the scope is per-step.
+            loop_ctx = _tr.current() or prefetcher.trace_ctx \
+                or _tr.new_trace()
+            if prefetcher.trace_ctx is None:
+                prefetcher.trace_ctx = loop_ctx
+        # attach(None) is a no-op scope, and one attach object is
+        # reusable (sequential enter/exit on the same thread) — no
+        # per-step allocation when tracing is off
+        att = _tr.attach(loop_ctx)
         feed_iter = iter(prefetcher)
         try:
             while True:
@@ -399,7 +421,7 @@ class Executor:
                 # the prefetch thread keeps filling during it either way
                 if len(window) >= max_in_flight:
                     tw = time.perf_counter()
-                    with _wait_guard(step_i):
+                    with att, _wait_guard(step_i):
                         window.popleft().wait()
                     dt = time.perf_counter() - tw
                     blocked += dt
@@ -414,16 +436,18 @@ class Executor:
                 # including (and on oversubscribed hosts every extra
                 # bytecode in this window collects scheduler noise)
                 observe_feed_gap()
-                plan, feed_list, const_state, mut_state, rng = self._gather(
-                    program, feeds, fetch_list, scope)
-                t0 = time.perf_counter()
-                with _dispatch_guard(plan, "run"):
-                    fetches, new_mut, new_pure, new_rng = plan.fn(
-                        feed_list, const_state, mut_state, rng)
-                # sig "run": same executable as run(), so a run() warmup
-                # already paid this signature's compile
-                steady = _record_dispatch(plan, "run", "run_pipelined", 1,
-                                          time.perf_counter() - t0)
+                with att:
+                    plan, feed_list, const_state, mut_state, rng = \
+                        self._gather(program, feeds, fetch_list, scope)
+                    t0 = time.perf_counter()
+                    with _dispatch_guard(plan, "run"):
+                        fetches, new_mut, new_pure, new_rng = plan.fn(
+                            feed_list, const_state, mut_state, rng)
+                    # sig "run": same executable as run(), so a run()
+                    # warmup already paid this signature's compile
+                    steady = _record_dispatch(plan, "run",
+                                              "run_pipelined", 1,
+                                              time.perf_counter() - t0)
                 # state write-back WITHOUT blocking: the new arrays are
                 # futures; the next dispatch chains on them device-side
                 _write_back_state(plan, scope, new_mut, new_pure, new_rng)
@@ -448,7 +472,7 @@ class Executor:
             # was fully serialized on its fetch waits
             while window:
                 tw = time.perf_counter()
-                with _wait_guard(step_i):
+                with att, _wait_guard(step_i):
                     window.popleft().wait()
                 dt = time.perf_counter() - tw
                 blocked += dt
@@ -610,6 +634,10 @@ class Executor:
             EXECUTOR_CACHE_MISSES.inc()
             t0 = time.perf_counter()
             plan = self._prepare(program, feed_vals, fetch_names, scope)
+            # stable within-process tag for this (program, feed-sig,
+            # fetch) plan: the trace spans' per-op attribution key
+            plan.sig = "%08x" % (zlib.crc32(repr(key).encode())
+                                 & 0xffffffff)
             EXECUTOR_PREPARE_SECONDS.observe(time.perf_counter() - t0)
             self._cache[key] = plan
             while len(self._cache) > self._cache_size:
@@ -671,12 +699,19 @@ def _wait_guard(step=None):
     block_until_ready, the numpy fetch conversion, pipelined window
     waits). Dispatch is async, so a wedged device manifests exactly
     here — without this stamp the watchdog would read a dead tunnel as
-    host idleness and never fire."""
+    host idleness and never fire. Doubles as the ``executor.complete``
+    trace span (dispatch-to-results-ready, the host's real wait)."""
     hb = heartbeat()
     tok = hb.begin("executor.wait", step=step)
+    sp = _tr.trace_span("executor.complete", step=step) \
+        if _tr.trace_enabled() else None
+    if sp is not None:
+        sp.__enter__()
     try:
         yield
     finally:
+        if sp is not None:
+            sp.__exit__(None, None, None)
         hb.end("executor.wait", tok)
 
 
@@ -690,14 +725,24 @@ def _dispatch_guard(plan, sig):
     fault-injection site. The fault fires AFTER the begin stamp —
     an injected wedge must look to the watchdog exactly like a real
     one — and the end stamp lands even when the fault raises, so the
-    watchdog re-arms once the error has surfaced."""
+    watchdog re-arms once the error has surfaced. The trace span opens
+    BEFORE the fault point for the same reason: a wedged dispatch must
+    sit in the flight recorder as an OPEN ``executor.dispatch`` span
+    (tagged with the plan signature) when the dump lands. Tracing
+    disabled is one bool check — no span, no allocations."""
     hb = heartbeat()
     tok = hb.begin("executor.dispatch",
                    compiling=sig not in plan.compiled_sigs)
+    sp = _tr.trace_span("executor.dispatch", plan=plan.sig) \
+        if _tr.trace_enabled() else None
+    if sp is not None:
+        sp.__enter__()
     try:
         fault_point("executor.dispatch")
         yield
     finally:
+        if sp is not None:
+            sp.__exit__(None, None, None)
         hb.end("executor.dispatch", tok)
 
 
@@ -1144,7 +1189,12 @@ def feeds_to_device(feed: Dict[str, Any], var_lookup, device=None):
     nbytes = sum(a.nbytes for a in host.values())
     if host:
         fault_point("device_put")
-        out.update(jax.device_put(host, device))
+        if _tr.trace_enabled():
+            with _tr.trace_span("executor.h2d", bytes=nbytes,
+                                feeds=len(host)):
+                out.update(jax.device_put(host, device))
+        else:
+            out.update(jax.device_put(host, device))
     return out, nbytes
 
 

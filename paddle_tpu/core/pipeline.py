@@ -48,6 +48,8 @@ from typing import Any, Dict, Iterator, Optional, Sequence
 import jax
 import numpy as np
 
+from ..observe import trace as _tr
+
 __all__ = ["DevicePrefetcher", "ConstFeedCache", "FetchHandle"]
 
 _END = object()
@@ -205,6 +207,11 @@ class DevicePrefetcher:
         self._var_lookup = (program.global_block().vars.get
                             if program is not None else lambda _n: None)
         self._device = place.jax_device() if place is not None else None
+        # trace hand-off: the CONSUMER sets this (run_pipelined pins its
+        # context here before iter() starts the thread) so the fill
+        # thread's spans link to the step loop instead of fragmenting
+        # into per-thread orphan traces — thread-locals don't cross
+        self.trace_ctx = None
         self._thread = threading.Thread(
             target=self._fill, name="DevicePrefetcher", daemon=True)
         self._started = False
@@ -220,14 +227,16 @@ class DevicePrefetcher:
         from .executor import feeds_to_device
 
         cached, rest = {}, {}
-        for n, v in feed.items():
-            dev = self.const_cache.lookup(n, v, device=self._device) \
-                if (self._dedup_unmarked or self.const_cache.is_const(n)) \
-                else None
-            if dev is not None:
-                cached[n] = dev
-            else:
-                rest[n] = v
+        with _tr.trace_span("pipeline.const_lookup", feeds=len(feed)):
+            for n, v in feed.items():
+                dev = self.const_cache.lookup(n, v, device=self._device) \
+                    if (self._dedup_unmarked or
+                        self.const_cache.is_const(n)) \
+                    else None
+                if dev is not None:
+                    cached[n] = dev
+                else:
+                    rest[n] = v
         out, nbytes = feeds_to_device(rest, self._var_lookup, self._device)
         for n, dev in out.items():
             if self.const_cache.is_const(n) or \
@@ -278,24 +287,30 @@ class DevicePrefetcher:
         try:
             it = self._reader() if callable(self._reader) \
                 else iter(self._reader)
-            for feed in it:
-                if self._stop.is_set():
-                    return
-                # fault-injection site: fires once per batch pulled; an
-                # injected raise lands in self._error and re-raises in
-                # the consumer, exactly like a real reader failure
-                fault_point("reader.next")
-                t0 = time.perf_counter()
-                dev, nbytes = self._convert(feed)
-                # block in THIS thread: the consumer must receive feeds
-                # that are truly resident, and the histogram must record
-                # real transfer latency, not an async hand-off
-                jax.block_until_ready(dev)
-                PIPELINE_H2D_SECONDS.observe(time.perf_counter() - t0)
-                PIPELINE_H2D_BYTES.inc(nbytes)
-                batches.inc()
-                if not self._put(dev):
-                    return
+            # explicit trace hand-off: adopt the consumer-pinned context
+            # for this whole fill thread (attach(None) is a no-op scope)
+            with _tr.attach(self.trace_ctx):
+                for feed in it:
+                    if self._stop.is_set():
+                        return
+                    # fault-injection site: fires once per batch pulled;
+                    # an injected raise lands in self._error and
+                    # re-raises in the consumer, exactly like a real
+                    # reader failure
+                    fault_point("reader.next")
+                    t0 = time.perf_counter()
+                    with _tr.trace_span("pipeline.prefetch"):
+                        dev, nbytes = self._convert(feed)
+                        # block in THIS thread: the consumer must receive
+                        # feeds that are truly resident, and the histogram
+                        # must record real transfer latency, not an async
+                        # hand-off
+                        jax.block_until_ready(dev)
+                    PIPELINE_H2D_SECONDS.observe(time.perf_counter() - t0)
+                    PIPELINE_H2D_BYTES.inc(nbytes)
+                    batches.inc()
+                    if not self._put(dev):
+                        return
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
             self._error = e
         finally:

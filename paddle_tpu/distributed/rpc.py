@@ -22,10 +22,35 @@ from ..native.dtypes import CODE_OF_DTYPE as _DTYPES
 from ..native.dtypes import DTYPE_OF_CODE as _NP_OF_CODE
 from ..resilience.backoff import backoff_delay, millis_env
 from ..resilience.faults import fault_point
+from ..observe import trace as _tr
 from ..observe.families import (RPC_BYTES_RECV, RPC_BYTES_SENT, RPC_CALLS,
                                 RPC_DEADLINE_EXPIRATIONS, RPC_ERRORS,
                                 RPC_RETRIES, RPC_SECONDS,
                                 RPC_SERVER_REQUESTS)
+
+# trace metadata rides RPC message name fields after this separator
+# ("w@GRAD\x1ft=<trace_id>,s=<span_id>"): the server strips it before any
+# name-keyed semantics (C store lookup for get_var; _batch_read for
+# sends) and emits a server-side span event linked to the CALLING
+# trainer's trace. 0x1f (ASCII unit separator) cannot appear in var
+# names. Absent metadata = the exact pre-trace wire bytes, so mixed
+# traced/untraced peers interoperate.
+_TRACE_SEP = "\x1f"
+
+
+def _wire_name(name: str) -> str:
+    """Suffix ``name`` with the current trace context (no-op when
+    tracing is off or no context is active)."""
+    meta = _tr.wire_metadata()
+    return name if meta is None else name + _TRACE_SEP + meta
+
+
+def _split_wire(name: str):
+    """``(clean_name, metadata_or_None)`` — inverse of ``_wire_name``."""
+    sep = name.find(_TRACE_SEP)
+    if sep < 0:
+        return name, None
+    return name[:sep], name[sep + 1:]
 
 __all__ = ["RPCClient", "RPCServer", "RPCError", "SelectedRows",
            "parse_endpoint"]
@@ -61,20 +86,29 @@ class _rpc_call:
     call actually burned the reconnect deadline (a fast failure, e.g.
     get_var exhausting its retry COUNT against a live server, is an
     error but not an expiration — the distinction a wedged-tunnel
-    post-mortem needs)."""
+    post-mortem needs). Also opens the ``rpc.client`` trace span, whose
+    context is what ``_wire_name`` serializes onto the wire — so the
+    server-side event parents to THIS call, not just the trainer."""
 
-    __slots__ = ("method", "_t0")
+    __slots__ = ("method", "_t0", "_sp")
 
     def __init__(self, method: str):
         self.method = method
 
     def __enter__(self):
         RPC_CALLS.labels(method=self.method).inc()
+        self._sp = _tr.trace_span("rpc.client", method=self.method) \
+            if _tr.trace_enabled() else None
+        if self._sp is not None:
+            self._sp.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dt = time.perf_counter() - self._t0
+        if self._sp is not None:
+            self._sp.__exit__(exc_type, exc, tb)
+            self._sp = None
         RPC_SECONDS.labels(method=self.method).observe(dt)
         if exc_type is not None and issubclass(exc_type, RPCError):
             RPC_ERRORS.labels(method=self.method).inc()
@@ -155,6 +189,8 @@ def _lib():
     lib.ps_server_poll_notify.restype = c.c_int
     lib.ps_server_poll_notify.argtypes = [c.c_void_p, c.c_char_p, c.c_int,
                                           c.c_int]
+    lib.ps_server_pop_trace.restype = c.c_int
+    lib.ps_server_pop_trace.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
     lib.ps_batch_count.restype = c.c_int
     lib.ps_batch_count.argtypes = [c.c_void_p]
     lib.ps_batch_name.restype = c.c_char_p
@@ -211,11 +247,17 @@ def _contig(value) -> np.ndarray:
         np.ascontiguousarray(a).reshape(a.shape))
 
 
-def _batch_read(lib, b) -> List[Tuple[str, object, int]]:
-    """Decode a native batch into [(name, ndarray | SelectedRows, trainer)]."""
+def _batch_read(lib, b, emit_site: Optional[str] = None
+                ) -> List[Tuple[str, object, int]]:
+    """Decode a native batch into [(name, ndarray | SelectedRows, trainer)].
+    Names may carry wire trace metadata (``_wire_name``): it is ALWAYS
+    stripped before the caller sees the name; when ``emit_site`` is given
+    (server-side decode paths — wait_grads/pop_async) each carried
+    context additionally emits a linked trace event, so the server span
+    joins the calling trainer's trace."""
     out = []
     for i in range(lib.ps_batch_count(b)):
-        name = lib.ps_batch_name(b, i).decode()
+        name, meta = _split_wire(lib.ps_batch_name(b, i).decode())
         code = lib.ps_batch_dtype(b, i)
         ndim = lib.ps_batch_ndim(b, i)
         dims = (ctypes.c_int64 * max(ndim, 1))()
@@ -238,7 +280,13 @@ def _batch_read(lib, b) -> List[Tuple[str, object, int]]:
                                height=height)
         else:
             arr = flat.reshape(shape).copy()
-        out.append((name, arr, lib.ps_batch_trainer(b, i)))
+        trainer = lib.ps_batch_trainer(b, i)
+        if emit_site is not None and meta is not None:
+            ctx = _tr.from_wire(meta)
+            if ctx is not None:
+                _tr.trace_event(emit_site, ctx=ctx, var=name,
+                                trainer=trainer)
+        out.append((name, arr, trainer))
     lib.ps_batch_free(b)
     return out
 
@@ -284,21 +332,56 @@ class RPCServer:
 
     def wait_grads(self) -> List[Tuple[str, object, int]]:
         """Block until every active trainer send-barriered; return the
-        cycle's received vars (dense ndarray or SelectedRows)."""
+        cycle's received vars (dense ndarray or SelectedRows). Wire
+        trace metadata on the names is stripped here, each emitting a
+        ``rpc.server.recv`` event linked to the sending trainer's
+        trace."""
         RPC_SERVER_REQUESTS.labels(method="wait_grads").inc()
         b = self._lib.ps_server_wait_grads(self._h)
-        return _batch_read(self._lib, b)
+        out = _batch_read(self._lib, b, emit_site="rpc.server.recv")
+        self.drain_trace_events()
+        return out
 
     def serve(self):
         """Publish the store and open the GET window for this cycle."""
         RPC_SERVER_REQUESTS.labels(method="serve").inc()
         self._lib.ps_server_serve(self._h)
+        self.drain_trace_events()
 
     def pop_async(self, timeout_ms: int = 100):
         b = self._lib.ps_server_pop_async(self._h, timeout_ms)
+        self.drain_trace_events()
         if not b:
             return None
-        return _batch_read(self._lib, b)[0]
+        return _batch_read(self._lib, b, emit_site="rpc.server.recv")[0]
+
+    def drain_trace_events(self, limit: int = 256) -> int:
+        """Drain the native get_var trace log, emitting one linked
+        ``rpc.server.get_var`` event per logged request. Called
+        opportunistically by wait_grads/serve/pop_async (cheap when
+        empty: one C call returning 0); returns the number drained."""
+        if not self._h or not _tr.trace_enabled():
+            return 0
+        buf = ctypes.create_string_buffer(512)
+        n = 0
+        while n < limit and \
+                self._lib.ps_server_pop_trace(self._h, buf, 512):
+            # count every POPPED entry (even a malformed/truncated one):
+            # `limit` bounds consumption and the return value reports it
+            n += 1
+            parts = buf.value.decode(errors="replace").split(_TRACE_SEP)
+            if len(parts) != 3:
+                continue
+            name, meta, trainer = parts
+            ctx = _tr.from_wire(meta)
+            if ctx is not None:
+                try:
+                    tid = int(trainer)
+                except ValueError:
+                    tid = -1
+                _tr.trace_event("rpc.server.get_var", ctx=ctx, var=name,
+                                trainer=tid)
+        return n
 
     def poll_notify(self, timeout_ms: int = 0) -> Optional[str]:
         buf = ctypes.create_string_buffer(4096)
@@ -352,8 +435,8 @@ class RPCClient:
                 dims, nrows, rows_ptr = vals.shape, -1, None
             vals = _contig(vals)
             ok = self._lib.ps_client_send_var(
-                self._h, name.encode(), _DTYPES[vals.dtype], len(dims),
-                _dims_ptr(dims), nrows, rows_ptr,
+                self._h, _wire_name(name).encode(), _DTYPES[vals.dtype],
+                len(dims), _dims_ptr(dims), nrows, rows_ptr,
                 vals.ctypes.data_as(ctypes.c_void_p), vals.nbytes)
             if not ok:
                 raise RPCError("send_var(%s)" % name, self.endpoint)
@@ -374,10 +457,11 @@ class RPCClient:
         base_s, cap_s = _retry_backoff_seconds()
         with _rpc_call("get_var"):
             t0 = time.monotonic()
+            wire = _wire_name(name).encode()
             for attempt in range(max(retries, 1)):
                 if attempt:
                     RPC_RETRIES.labels(method="get_var").inc()
-                b = self._lib.ps_client_get_var(self._h, name.encode())
+                b = self._lib.ps_client_get_var(self._h, wire)
                 if b:
                     out = _batch_read(self._lib, b)[0][1]
                     RPC_BYTES_RECV.inc(_payload_nbytes(out))

@@ -262,6 +262,14 @@ class PSServer {
     return active_;
   }
 
+  bool PopTrace(std::string* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (trace_q_.empty()) return false;
+    *out = std::move(trace_q_.front());
+    trace_q_.pop_front();
+    return true;
+  }
+
  private:
   void AcceptLoop() {
     while (!stopped_) {
@@ -311,6 +319,17 @@ class PSServer {
         case kGetVar: {
           std::string name;
           if (!ReadString(fd, &name)) return;
+          // optional trace metadata rides the name after a 0x1f
+          // separator ("name\x1ft=<trace>,s=<span>"): strip it before
+          // the store lookup and log the request so the host runtime
+          // can link a server-side get_var span to the calling
+          // trainer's trace (distributed/rpc.py drains the log)
+          std::string trace_meta;
+          size_t sep = name.find('\x1f');
+          if (sep != std::string::npos) {
+            trace_meta = name.substr(sep + 1);
+            name.resize(sep);
+          }
           std::shared_ptr<VarBlob> v;
           {
             std::unique_lock<std::mutex> lk(mu_);
@@ -321,6 +340,9 @@ class PSServer {
               });
             auto it = store_.find(name);
             v = it == store_.end() ? nullptr : it->second;
+            if (!trace_meta.empty() && trace_q_.size() < 1024)
+              trace_q_.push_back(name + '\x1f' + trace_meta + '\x1f' +
+                                 std::to_string(trainer_id));
           }
           uint8_t ok = v != nullptr;
           if (!WriteFull(fd, &ok, 1)) return;
@@ -443,6 +465,7 @@ class PSServer {
   std::vector<std::unique_ptr<VarBlob>> recv_;
   std::deque<std::unique_ptr<VarBlob>> async_q_;
   std::deque<std::string> notify_q_;
+  std::deque<std::string> trace_q_;  // "name\x1fmeta\x1ftrainer" get log
 };
 
 // ---- client ---------------------------------------------------------------
@@ -655,6 +678,16 @@ int ps_server_poll_notify(void* h, char* out, int cap, int timeout_ms) {
   if (!static_cast<PSServer*>(h)->PollNotify(&dir, timeout_ms)) return 0;
   if (static_cast<int>(dir.size()) + 1 > cap) return 0;
   std::memcpy(out, dir.c_str(), dir.size() + 1);
+  return 1;
+}
+
+int ps_server_pop_trace(void* h, char* out, int cap) {
+  // drain ONE "name\x1fmeta\x1ftrainer" get-log entry (0 = empty);
+  // non-blocking — the host runtime polls opportunistically
+  std::string entry;
+  if (!static_cast<PSServer*>(h)->PopTrace(&entry)) return 0;
+  if (static_cast<int>(entry.size()) + 1 > cap) entry.resize(cap - 1);
+  std::memcpy(out, entry.c_str(), entry.size() + 1);
   return 1;
 }
 

@@ -27,17 +27,25 @@ failed ones) built from these snapshots. See docs/OBSERVABILITY.md.
 from __future__ import annotations
 
 from . import families  # noqa: F401  (declares the well-known families)
+from . import trace  # noqa: F401  (trace contexts + flight recorder)
 from .families import REGISTRY
 from .metrics import (Counter, DEFAULT_BUCKETS, Family, Gauge,  # noqa: F401
                       Histogram, Registry)
 from .spans import (Span, mark_batch_produced,  # noqa: F401
                     observe_feed_gap, span)
+from .trace import (FlightRecorder, TraceContext, attach,  # noqa: F401
+                    current, dump_flight_recorder, export_chrome_trace,
+                    new_trace, record_span, trace_enabled, trace_event,
+                    trace_span)
 
 __all__ = ["REGISTRY", "counter", "gauge", "histogram", "get_metric",
            "snapshot", "render_prometheus", "dump", "reset",
            "span", "Span", "mark_batch_produced", "observe_feed_gap",
            "Counter", "Gauge", "Histogram", "Family", "Registry",
-           "DEFAULT_BUCKETS"]
+           "DEFAULT_BUCKETS",
+           "TraceContext", "FlightRecorder", "trace_enabled", "new_trace",
+           "current", "attach", "trace_span", "trace_event", "record_span",
+           "dump_flight_recorder", "export_chrome_trace"]
 
 # module-level facade over the process-wide registry
 counter = REGISTRY.counter
@@ -51,8 +59,10 @@ dump = REGISTRY.dump
 
 def reset():
     """Zero every metric AND the cross-subsystem span state (the pending
-    feed-to-run stamp) — full test isolation, not a runtime operation."""
+    feed-to-run stamp, the flight-recorder ring, this thread's trace
+    context) — full test isolation, not a runtime operation."""
     from . import spans as _spans
 
     REGISTRY.reset()
     _spans._clear_batch_stamp()
+    trace._reset()

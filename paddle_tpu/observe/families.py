@@ -356,6 +356,47 @@ SPAN_SECONDS = REGISTRY.histogram(
     "Generic named-span latency (spans without a dedicated histogram)",
     labels=("span",))
 
+# ---------------------------------------------------------------- tracing
+# (observe/trace.py: trace contexts + the crash flight recorder — see
+# docs/OBSERVABILITY.md "Trace propagation")
+TRACE_EVENTS = REGISTRY.counter(
+    "paddle_trace_events_recorded_total",
+    "Events appended to the flight-recorder ring (begin/end/instant); "
+    "stays 0 when PADDLE_TPU_TRACE=0 — the disabled-tracing no-op test "
+    "pins exactly that")
+TRACE_DUMPS = REGISTRY.counter(
+    "paddle_trace_flight_dumps_total",
+    "Flight-recorder dumps written, by trigger", labels=("reason",))
+for _r in ("wedge", "crash", "atexit", "manual"):
+    TRACE_DUMPS.labels(reason=_r)
+
+# Every span/trace-event SITE name used in code must appear here — the
+# same centralize-the-schema contract as the metric families above,
+# enforced by tools/repo_lint.py (trace-site rule): a typo'd site would
+# otherwise fragment a trace across names tools/trace_view.py can't
+# group. Grammar: <subsystem>.<noun-or-phase>, dotted lowercase.
+TRACE_SITES = (
+    # executor (core/executor.py): one dispatch span per step, tagged
+    # with the plan-cache signature; complete = the host block on results
+    "executor.dispatch", "executor.complete", "executor.h2d",
+    # pipelined input (core/pipeline.py): fill-thread spans under the
+    # loop context handed off explicitly by run_pipelined
+    "pipeline.prefetch", "pipeline.const_lookup",
+    # serving (serving/queue.py, batcher.py, engine.py): one trace per
+    # request from submit to its single terminal done event
+    "serving.request.submit", "serving.request.done",
+    "serving.queue.wait", "serving.batch.dispatch",
+    "serving.engine.admit", "serving.engine.prefill",
+    "serving.engine.splice", "serving.engine.step",
+    "serving.engine.retire",
+    # rpc (distributed/rpc.py): client call spans; server events linked
+    # to the calling trainer's trace via wire metadata
+    "rpc.client", "rpc.server.recv", "rpc.server.get_var",
+    # resilience (resilience/faults.py, watchdog.py): the events that
+    # explain a flight-recorder dump's final moments
+    "resilience.fault", "resilience.wedge",
+)
+
 # -------------------------------------------------------- backend/bench
 BACKEND_PROBE_SECONDS = REGISTRY.gauge(
     "paddle_backend_probe_seconds",

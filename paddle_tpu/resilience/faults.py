@@ -268,9 +268,16 @@ class FaultPlan:
                 self._injected += 1
         if fired is None:
             return
+        from ..observe import trace as _tr
         from ..observe.families import RESILIENCE_FAULTS_INJECTED
 
         RESILIENCE_FAULTS_INJECTED.labels(site=site, mode=fired.mode).inc()
+        # the injection is part of the story a flight-recorder dump
+        # tells: record it BEFORE acting, so a wedge dump (taken while
+        # this thread sleeps below) and a crash dump both contain it
+        if _tr.trace_enabled():
+            _tr.trace_event("resilience.fault", site=site,
+                            mode=fired.mode, occurrence=n)
         # act OUTSIDE the lock: a wedge must not serialize other sites
         if fired.mode == "delay":
             time.sleep(fired.seconds)
@@ -281,7 +288,13 @@ class FaultPlan:
         if fired.mode == "crash":
             # SIGKILL, not sys.exit: no finally blocks, no atexit — the
             # point is to leave the wreckage (staged tmp files, stale
-            # manifests) that real power-loss/OOM-kill leaves
+            # manifests) that real power-loss/OOM-kill leaves. The ONE
+            # exception: the flight recorder dumps first — that's its
+            # whole reason to exist, and a real OOM-killed process
+            # similarly leaves whatever its last dump wrote.
+            _tr.dump_flight_recorder(
+                reason="crash",
+                extra={"fault": {"site": site, "occurrence": n}})
             os.kill(os.getpid(), signal.SIGKILL)
         raise InjectedFault(site, n, "raise")
 

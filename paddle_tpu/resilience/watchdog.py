@@ -243,6 +243,25 @@ class Watchdog:
                                           " — killing process group"
                                           if self.kill else ""),
                   file=sys.stderr, flush=True)
+            # post-mortem evidence BEFORE escalating (callback, kill):
+            # the wedge event lands in the ring, then the whole ring
+            # (with the
+            # stalled dispatch's still-OPEN span — its trace id, site
+            # and plan tag) dumps to PADDLE_TPU_FLIGHT_RECORDER_PATH.
+            # dump_flight_recorder never raises and is a no-op when no
+            # path is configured, so the detector cannot die here.
+            from ..observe import trace as _tr
+
+            if _tr.trace_enabled():
+                _tr.trace_event("resilience.wedge", site=str(snap["site"]),
+                                step=snap["step"], age_s=snap["age_s"],
+                                compiling=snap["compiling"])
+            _tr.dump_flight_recorder(
+                reason="wedge",
+                extra={"wedge": {"site": snap["site"], "step": snap["step"],
+                                 "age_s": snap["age_s"],
+                                 "compiling": snap["compiling"],
+                                 "deadline_s": limit}})
             if self.on_wedge is not None:
                 try:
                     self.on_wedge(event)

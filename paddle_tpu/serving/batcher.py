@@ -35,6 +35,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..inference import batch_major
+from ..observe import trace as _tr
 from .queue import RequestQueue
 
 __all__ = ["MicroBatcher"]
@@ -173,15 +174,25 @@ class MicroBatcher:
 
         SERVING_BATCHES.inc()
         SERVING_BATCH_ROWS.observe(rows)
-        try:
-            feed = {n: np.concatenate([r.payload[n] for r in batch])
-                    for n in self._feed_names}
-            outs = self._predictor.run(feed)
-        except BaseException as exc:  # noqa: BLE001 — fail the batch's futures
+        # the batch span lists the traces it carries ("traces") so a
+        # request's coalesce + bucket-routed dispatch time is
+        # attributable even though B requests share one Predictor.run;
+        # the executor's dispatch span nests under this one
+        sp = _tr.trace_span("serving.batch.dispatch", rows=rows,
+                            requests=len(batch))
+        if sp.attrs is not None:
+            sp.attrs["traces"] = [r.trace.trace_id for r in batch
+                                  if r.trace is not None]
+        with sp:
+            try:
+                feed = {n: np.concatenate([r.payload[n] for r in batch])
+                        for n in self._feed_names}
+                outs = self._predictor.run(feed)
+            except BaseException as exc:  # noqa: BLE001 — fail the batch's futures
+                for r in batch:
+                    r.set_exception(exc)
+                return
+            off = 0
             for r in batch:
-                r.set_exception(exc)
-            return
-        off = 0
-        for r in batch:
-            r.set_result([o[off:off + r.rows] for o in outs])
-            off += r.rows
+                r.set_result([o[off:off + r.rows] for o in outs])
+                off += r.rows

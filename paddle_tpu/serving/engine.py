@@ -40,6 +40,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..observe import trace as _tr
 from .queue import RequestQueue
 
 __all__ = ["DecodeEngine"]
@@ -209,6 +210,10 @@ class DecodeEngine:
     def _loop(self) -> None:
         from .queue import Cancelled
 
+        # one trace identity for the scheduler loop: every decode-step
+        # span groups under it (requests keep their own traces; the
+        # step spans reference them via the "traces" attr)
+        self._loop_trace = _tr.new_trace() if _tr.trace_enabled() else None
         try:
             while not self._stop.is_set():
                 # admit into free slots at the step boundary; block on
@@ -254,7 +259,12 @@ class DecodeEngine:
         p = req.payload
         slot = _Slot(req, p["prompt"], p["n_new"], p["eos_id"],
                      p["temperature"], p["top_k"], p["seed"])
-        first = self._prefill_insert(slot_idx, p["prompt"], slot)
+        # admission runs under the REQUEST's trace (explicit hand-off
+        # from the caller thread via req.trace): prefill + splice child
+        # spans attribute the one-time admission cost to this request
+        with _tr.trace_span("serving.engine.admit", ctx=req.trace,
+                            slot=slot_idx, prompt_len=len(p["prompt"])):
+            first = self._prefill_insert(slot_idx, p["prompt"], slot)
         SERVING_ADMITTED.inc()
         SERVING_TOKENS.inc()
         slot.tokens.append(first)
@@ -278,17 +288,19 @@ class DecodeEngine:
 
         P = prompt.shape[0]
         prog, logits_var = self._prefill_program(P)
-        with self._scope_guard(self._prefill_scope):
-            (full,) = self._exe.run(
-                prog, feed={"tokens": prompt[None, :]},
-                fetch_list=[logits_var], scope=self._prefill_scope)
-        bigs = [jnp.asarray(self._scope.find_var(n))
-                for n in self._cache_names]
-        smalls = [jnp.asarray(self._prefill_scope.find_var(n))
-                  for n in self._cache_names]
-        for n, out in zip(self._cache_names,
-                          self._splice(bigs, smalls, slot_idx)):
-            self._scope.set_var(n, out)
+        with _tr.trace_span("serving.engine.prefill", prompt_len=P):
+            with self._scope_guard(self._prefill_scope):
+                (full,) = self._exe.run(
+                    prog, feed={"tokens": prompt[None, :]},
+                    fetch_list=[logits_var], scope=self._prefill_scope)
+        with _tr.trace_span("serving.engine.splice", slot=slot_idx):
+            bigs = [jnp.asarray(self._scope.find_var(n))
+                    for n in self._cache_names]
+            smalls = [jnp.asarray(self._prefill_scope.find_var(n))
+                      for n in self._cache_names]
+            for n, out in zip(self._cache_names,
+                              self._splice(bigs, smalls, slot_idx)):
+                self._scope.set_var(n, out)
         return slot.sample(full[0, P - 1])
 
     def _prefill_program(self, P: int):
@@ -336,27 +348,45 @@ class DecodeEngine:
             active.append(i)
             token[i, 0] = slot.tokens[-1]
             pos[i, 0] = len(slot.tokens) - 1
-        with self._scope_guard(self._scope):
-            (logits,) = self._exe.run(
-                self._decode_prog, feed={"token": token, "pos": pos},
-                fetch_list=[self._logits], scope=self._scope)
-        SERVING_DECODE_STEPS.inc()
-        SERVING_OCCUPANCY.observe(len(active) / float(self.b_max))
-        SERVING_TOKENS.inc(len(active))
-        for i in active:
-            slot = self._slots[i]
-            tok = slot.sample(logits[i, 0])
-            slot.tokens.append(tok)
-            if slot.finished(tok):
-                self._slots[i] = None
-                self._n_active -= 1
-                self._retire(i, slot)
-        self._set_active_gauge()
+        # one span per continuous-batching step under the engine thread;
+        # "traces" lists every rider's trace id so a request's share of
+        # the batched decode time is attributable post-hoc (the span is
+        # shared — B slots advance in ONE dispatch by design). Attrs are
+        # attached BEFORE entering: the ring copies attrs per event, so
+        # only enter-time keys ride the B event (and an unfinished step
+        # in a wedge dump must still name its riders)
+        sp = _tr.trace_span("serving.engine.step",
+                            ctx=getattr(self, "_loop_trace", None))
+        if sp.attrs is not None:
+            sp.attrs["active"] = len(active)
+            sp.attrs["traces"] = [
+                self._slots[i].request.trace.trace_id for i in active
+                if self._slots[i].request.trace is not None]
+        with sp:
+            with self._scope_guard(self._scope):
+                (logits,) = self._exe.run(
+                    self._decode_prog, feed={"token": token, "pos": pos},
+                    fetch_list=[self._logits], scope=self._scope)
+            SERVING_DECODE_STEPS.inc()
+            SERVING_OCCUPANCY.observe(len(active) / float(self.b_max))
+            SERVING_TOKENS.inc(len(active))
+            for i in active:
+                slot = self._slots[i]
+                tok = slot.sample(logits[i, 0])
+                slot.tokens.append(tok)
+                if slot.finished(tok):
+                    self._slots[i] = None
+                    self._n_active -= 1
+                    self._retire(i, slot)
+            self._set_active_gauge()
 
     def _retire(self, slot_idx: int, slot: _Slot) -> None:
         from ..observe.families import SERVING_RETIRED
 
         SERVING_RETIRED.inc()
+        if slot.request.trace is not None:
+            _tr.trace_event("serving.engine.retire", ctx=slot.request.trace,
+                            slot=slot_idx, tokens=len(slot.tokens))
         slot.request.set_result(np.asarray(slot.tokens, dtype="int64"))
 
     def _set_active_gauge(self) -> None:

@@ -34,6 +34,8 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+from ..observe import trace as _tr
+
 __all__ = ["Cancelled", "DeadlineExpired", "QueueFull", "RequestQueue",
            "ServingRequest"]
 
@@ -65,7 +67,7 @@ class ServingRequest:
     scheduler set); ``cancel()`` succeeds only while still queued.
     """
 
-    __slots__ = ("payload", "rows", "submitted_at", "deadline",
+    __slots__ = ("payload", "rows", "submitted_at", "deadline", "trace",
                  "_lock", "_event", "_state", "_value", "_exc")
 
     def __init__(self, payload: Any, deadline_s: Optional[float] = None,
@@ -78,6 +80,14 @@ class ServingRequest:
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + deadline_s
                          if deadline_s is not None else None)
+        # one trace per request, born at submit and pinned on the object
+        # — the explicit hand-off that lets the batcher/engine scheduler
+        # threads link their spans back to this caller's request
+        self.trace = _tr.new_trace() if _tr.trace_enabled() else None
+        if self.trace is not None:
+            _tr.trace_event("serving.request.submit", ctx=self.trace,
+                            rows=self.rows,
+                            deadline_s=deadline_s)
         self._lock = threading.Lock()
         self._event = threading.Event()
         self._state = _PENDING
@@ -171,6 +181,13 @@ class ServingRequest:
         from ..observe.families import SERVING_REQUESTS
 
         SERVING_REQUESTS.labels(outcome=outcome).inc()
+        # the ONE terminal trace event per request — every terminal path
+        # (ok / expired / cancelled / error, plus submit-time rejection
+        # in RequestQueue.submit) funnels through here exactly once,
+        # mirroring the requests_total{outcome} invariant
+        if self.trace is not None:
+            _tr.trace_event("serving.request.done", ctx=self.trace,
+                            outcome=outcome)
         self._event.set()
 
 
@@ -199,19 +216,29 @@ class RequestQueue:
         overloaded server must be visible, not silent) and
         ``RuntimeError`` after ``close()``."""
         from ..observe.families import (SERVING_QUEUE_DEPTH,
-                                        SERVING_QUEUE_REJECTED,
-                                        SERVING_REQUESTS)
+                                        SERVING_QUEUE_REJECTED)
 
-        req = ServingRequest(payload, deadline_s=deadline_s, rows=rows)
         with self._cond:
+            # closed check BEFORE constructing the request: a request
+            # object mints a trace + submit event, and the closed path
+            # raises without a terminal outcome — a trace with a submit
+            # and no done event would break the exactly-once invariant
             if self._closed:
                 raise RuntimeError("RequestQueue is closed")
+            req = ServingRequest(payload, deadline_s=deadline_s, rows=rows)
             if len(self._q) >= self.capacity:
                 SERVING_QUEUE_REJECTED.inc()
-                SERVING_REQUESTS.labels(outcome="rejected").inc()
-                raise QueueFull(
+                exc = QueueFull(
                     "admission queue full (capacity %d); retry with "
                     "backoff or raise capacity" % self.capacity)
+                # terminal-ize the stillborn request through _finish so
+                # the one-terminal-outcome invariant (metric AND trace
+                # event) covers rejection like every other path
+                with req._lock:
+                    req._state = _DONE
+                    req._exc = exc
+                req._finish("rejected")
+                raise exc
             self._q.append(req)
             SERVING_QUEUE_DEPTH.set(len(self._q))
             self._cond.notify()
@@ -241,8 +268,13 @@ class RequestQueue:
                         continue
                     if not req._admit():
                         continue        # cancel raced the pop and won
-                    SERVING_QUEUE_WAIT_SECONDS.observe(
-                        time.monotonic() - req.submitted_at)
+                    wait = time.monotonic() - req.submitted_at
+                    SERVING_QUEUE_WAIT_SECONDS.observe(wait)
+                    if req.trace is not None:
+                        # retroactive span: the wait is only known now
+                        _tr.record_span("serving.queue.wait",
+                                        time.perf_counter() - wait, wait,
+                                        ctx=req.trace)
                     return req
                 if self._closed:
                     return None

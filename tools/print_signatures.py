@@ -43,6 +43,7 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.serving",
     "paddle_tpu.resilience",
+    "paddle_tpu.observe",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.transpiler",
     "paddle_tpu.transpiler",

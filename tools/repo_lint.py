@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST-based repo lint: cheap structural invariants CI can hold.
 
-Two rule families (both wired into the fast tier via
+Three rule families (all wired into the fast tier via
 tests/test_repo_lint.py):
 
 1. **bare-except** — ``except:`` swallows KeyboardInterrupt/SystemExit;
@@ -15,6 +15,12 @@ tests/test_repo_lint.py):
    string literal that LOOKS like a family name (``paddle_*_total`` ...)
    but is not declared is either a typo'd reference — which would
    silently create an empty series — or a decentralized declaration.
+3. **undeclared-trace-site** — the same contract for span/trace-event
+   SITE names: every literal first argument of a
+   ``trace_span``/``trace_event``/``record_span`` call must appear in
+   ``families.py``'s ``TRACE_SITES`` tuple. A typo'd site would
+   fragment a trace across names ``tools/trace_view.py`` can't group —
+   and would silently drop out of the dump validator's vocabulary.
 
 Usage: ``python tools/repo_lint.py [--root DIR]``; exit 1 on violations.
 """
@@ -137,10 +143,60 @@ def family_ref_violations(root: str, files=None) -> List[str]:
     return violations
 
 
+# calls whose literal first argument is a trace SITE name (observe/trace.py
+# API); new_trace() takes no site, so it is not in the set
+_TRACE_CALL_FNS = ("trace_span", "trace_event", "record_span")
+
+
+def declared_trace_sites(root: str) -> Set[str]:
+    """Site names in families.py's ``TRACE_SITES = (...)`` tuple."""
+    tree = _parse(os.path.join(root, FAMILIES_FILE))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "TRACE_SITES"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {el.value for el in node.value.elts
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)}
+    return set()
+
+
+def trace_site_violations(root: str, files=None) -> List[str]:
+    declared = declared_trace_sites(root)
+    violations = []
+    fam_rel = FAMILIES_FILE.replace("/", os.sep)
+    for path in (files or iter_py_files(root)):
+        rel = os.path.relpath(path, root)
+        if rel == fam_rel:
+            continue
+        for node in ast.walk(_parse(path)):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fn_name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if fn_name not in _TRACE_CALL_FNS:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue  # dynamic sites are a deliberate escape hatch
+            site = node.args[0].value
+            if site not in declared:
+                violations.append(
+                    "%s:%d: trace site %r is used by %s() but not "
+                    "declared in %s TRACE_SITES"
+                    % (rel, node.lineno, site, fn_name, FAMILIES_FILE))
+    return violations
+
+
 def run(root: str = REPO_ROOT) -> List[str]:
     """All violations (empty list = clean). tests/test_repo_lint.py
     asserts on this."""
-    return bare_except_violations(root) + family_ref_violations(root)
+    return (bare_except_violations(root) + family_ref_violations(root)
+            + trace_site_violations(root))
 
 
 def main(argv=None) -> int:

@@ -155,9 +155,20 @@ def render_diff(snap_a, snap_b, name_a="A", name_b="B", show_all=False,
     for key in sorted(set(sa) | set(sb)):
         if grep and grep not in key:
             continue
+        # a series present in only one sidecar is a schema change
+        # (family added/removed between the two runs), not a value
+        # delta — and a KIND change across versions must render, not
+        # raise (treat it as removed-then-added, by each side's kind)
+        in_a, in_b = key in sa, key in sb
+        if in_a and in_b and sa[key][0] != sb[key][0]:
+            scalar_rows.append((key, "%s->%s" % (sa[key][0], sb[key][0]),
+                                "-", "-", "kind changed"))
+            continue
         kind = (sa.get(key) or sb.get(key))[0]
         a = sa.get(key, (None, None))[1]
         b = sb.get(key, (None, None))[1]
+        schema_note = None if (in_a and in_b) else (
+            "removed" if in_a else "added")
         if kind == "histogram":
             def stats(s):
                 if s is None or not s["count"]:
@@ -168,9 +179,10 @@ def render_diff(snap_a, snap_b, name_a="A", name_b="B", show_all=False,
                         _percentile(s["buckets"], cnt, 0.99))
             ca, ma, p50a, p99a = stats(a)
             cb, mb, p50b, p99b = stats(b)
-            if not show_all and not ca and not cb:
+            if not show_all and not ca and not cb and schema_note is None:
                 continue
-            hist_rows.append((key, ca, cb, _fmt(ma), _fmt(mb),
+            key_note = key + (" [%s]" % schema_note if schema_note else "")
+            hist_rows.append((key_note, ca, cb, _fmt(ma), _fmt(mb),
                               _fmt(p50a), _fmt(p50b), _fmt(p99a),
                               _fmt(p99b)))
         else:
@@ -178,11 +190,13 @@ def render_diff(snap_a, snap_b, name_a="A", name_b="B", show_all=False,
             vb = b["value"] if b is not None else None
             # gauges always render, as in render_table: a gauge at 0 in
             # both snapshots (backend_probe_ok) IS the diagnosis
-            if not show_all and kind != "gauge" and not va and not vb:
+            if not show_all and kind != "gauge" and not va and not vb \
+                    and schema_note is None:
                 continue
             delta = (vb or 0) - (va or 0)
             scalar_rows.append((key, kind, _fmt(va), _fmt(vb),
-                                "%+g" % delta if delta else "0"))
+                                schema_note if schema_note
+                                else ("%+g" % delta if delta else "0")))
     if scalar_rows:
         w = max(len(r[0]) for r in scalar_rows)
         print("%-*s %-8s %12s %12s %12s"

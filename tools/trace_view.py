@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""trace_view: summarize / validate / export a flight-recorder dump.
+
+The flight recorder (paddle_tpu/observe/trace.py) dumps its ring on
+wedge, fault-plan crash and atexit (``PADDLE_TPU_FLIGHT_RECORDER_PATH``).
+This is the post-mortem reader:
+
+    python tools/trace_view.py flight.json            # summary
+    python tools/trace_view.py flight.json --trace ID # one trace's events
+    python tools/trace_view.py flight.json --validate # pairing/site checks
+    python tools/trace_view.py flight.json --chrome out.json
+                                                      # chrome://tracing
+
+The summary leads with what a wedge post-mortem needs first: the dump
+reason, the recorded wedge/fault context, and every OPEN span (a ``B``
+with no matching ``E`` — the operation that never returned), each with
+its trace id, site, tags and how long it had been open when the dump
+landed. Then per-site span counts/totals, so "where did the time go"
+falls out of the same file.
+
+``--validate`` holds the dump to the recorder's own grammar: every
+``E`` has a matching ``B``, durations are non-negative and consistent
+with the B/E timestamps, and every site name is declared in
+``observe/families.py:TRACE_SITES`` (the same centralized-schema rule
+tools/repo_lint.py enforces on the code). Exit 1 on violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+# runnable from any cwd: the repo root (parent of tools/) owns paddle_tpu
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    if "events" not in d:
+        raise ValueError("%s is not a flight-recorder dump "
+                         "(no 'events' key)" % path)
+    return d
+
+
+def open_spans(dump: dict):
+    """B events with no matching E — the operations still in flight
+    when the dump landed (a wedged dispatch shows up exactly here)."""
+    ended = {e["span"] for e in dump["events"] if e["ph"] == "E"}
+    t_end = dump.get("dumped_at_perf")
+    out = []
+    for e in dump["events"]:
+        if e["ph"] == "B" and e["span"] not in ended:
+            age = (t_end - e["t"]) if t_end is not None else None
+            out.append(dict(e, open_age_s=age))
+    return out
+
+
+def summarize(dump: dict, out=sys.stdout) -> None:
+    evs = dump["events"]
+    print("flight recorder dump: pid=%s reason=%s events=%d "
+          "(of %s recorded, ring capacity %s)"
+          % (dump.get("pid"), dump.get("reason"), len(evs),
+             dump.get("recorded_total"), dump.get("capacity")), file=out)
+    extra = dump.get("extra") or {}
+    for k, v in sorted(extra.items()):
+        print("  %s: %s" % (k, json.dumps(v, sort_keys=True)), file=out)
+    opens = open_spans(dump)
+    if opens:
+        print("\nOPEN spans (started, never finished — the wedge "
+              "suspects):", file=out)
+        for e in opens:
+            age = ("%.3fs" % e["open_age_s"]
+                   if e.get("open_age_s") is not None else "?")
+            print("  %-24s trace=%s span=%d open %s  %s"
+                  % (e["site"], e["trace"], e["span"], age,
+                     json.dumps(e["attrs"] or {}, sort_keys=True)),
+                  file=out)
+    per_site = defaultdict(lambda: [0, 0.0])  # site -> [spans, total_s]
+    instants = defaultdict(int)
+    for e in evs:
+        if e["ph"] == "E" and e.get("dur") is not None:
+            per_site[e["site"]][0] += 1
+            per_site[e["site"]][1] += e["dur"]
+        elif e["ph"] == "I":
+            instants[e["site"]] += 1
+    if per_site:
+        print("\n%-24s %8s %12s %12s" % ("span site", "count",
+                                         "total(s)", "mean(s)"), file=out)
+        for site in sorted(per_site, key=lambda s: -per_site[s][1]):
+            n, tot = per_site[site]
+            print("%-24s %8d %12.6f %12.6f" % (site, n, tot, tot / n),
+                  file=out)
+    if instants:
+        print("\n%-24s %8s" % ("instant site", "count"), file=out)
+        for site in sorted(instants):
+            print("%-24s %8d" % (site, instants[site]), file=out)
+    traces = {e["trace"] for e in evs}
+    print("\n%d distinct trace(s)" % len(traces), file=out)
+
+
+def show_trace(dump: dict, trace_id: str, out=sys.stdout) -> None:
+    evs = [e for e in dump["events"] if e["trace"] == trace_id]
+    if not evs:
+        print("no events for trace %s" % trace_id, file=out)
+        return
+    # sort by timestamp, not ring-append order: retroactive spans
+    # (serving.queue.wait) are appended AFTER later-timestamped events
+    # by construction, and a timeline must read as a timeline
+    evs.sort(key=lambda e: e["t"])
+    t0 = evs[0]["t"]
+    print("trace %s: %d events" % (trace_id, len(evs)), file=out)
+    for e in evs:
+        dur = " dur=%.6fs" % e["dur"] if e.get("dur") is not None else ""
+        print("  +%.6fs %-2s %-24s span=%-6d%s %s"
+              % (e["t"] - t0, e["ph"], e["site"], e["span"], dur,
+                 json.dumps(e["attrs"] or {}, sort_keys=True)), file=out)
+
+
+def validate(dump: dict, out=sys.stdout):
+    """Grammar check; returns a list of problem strings (empty = ok)."""
+    from paddle_tpu.observe.families import TRACE_SITES
+
+    problems = []
+    begins = {}
+    # a ring that wrapped legitimately evicted old B events, so
+    # E-without-B is only a grammar violation in complete dumps
+    cap = dump.get("capacity")
+    complete = cap is None or dump.get("recorded_total", 0) <= cap
+    for i, e in enumerate(dump["events"]):
+        for field in ("t", "ph", "site", "trace", "span"):
+            if field not in e:
+                problems.append("event %d: missing field %r" % (i, field))
+        if e.get("ph") not in ("B", "E", "I"):
+            problems.append("event %d: bad phase %r" % (i, e.get("ph")))
+            continue
+        if e["site"] not in TRACE_SITES:
+            problems.append("event %d: site %r not declared in "
+                            "observe/families.py TRACE_SITES"
+                            % (i, e["site"]))
+        if e["ph"] == "B":
+            begins[e["span"]] = e
+        elif e["ph"] == "E":
+            b = begins.pop(e["span"], None)
+            if b is None and complete:
+                problems.append("event %d: E for span %d with no B "
+                                "(dump is complete, so this is not ring "
+                                "eviction)" % (i, e["span"]))
+            dur = e.get("dur")
+            if dur is None or dur < 0:
+                problems.append("event %d: E missing/negative dur" % i)
+            elif b is not None and abs((e["t"] - b["t"]) - dur) > 1e-6:
+                problems.append("event %d: dur %.9f disagrees with B/E "
+                                "timestamps (%.9f)"
+                                % (i, dur, e["t"] - b["t"]))
+    return problems
+
+
+def export_chrome(dump: dict, path: str) -> None:
+    from paddle_tpu.observe.trace import to_chrome_events
+
+    trace = to_chrome_events(dump["events"], pid=dump.get("pid"))
+    with open(path, "w") as f:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize/validate a flight-recorder dump")
+    ap.add_argument("dump", help="path to a flight-recorder JSON dump")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="print one trace's events, time-ordered")
+    ap.add_argument("--validate", action="store_true",
+                    help="check B/E pairing, durations and declared "
+                         "sites; exit 1 on violations")
+    ap.add_argument("--chrome", default=None, metavar="OUT",
+                    help="write chrome://tracing JSON (open B spans "
+                         "render as dangling slices — the wedge)")
+    args = ap.parse_args(argv)
+
+    try:
+        dump = load_dump(args.dump)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("error: %s" % e, file=sys.stderr)
+        return 2
+
+    if args.validate:
+        problems = validate(dump)
+        for p in problems:
+            print(p)
+        print("%d problem(s)" % len(problems))
+        return 1 if problems else 0
+    if args.chrome:
+        export_chrome(dump, args.chrome)
+        print("wrote %s (%d events)" % (args.chrome, len(dump["events"])))
+        return 0
+    if args.trace:
+        show_trace(dump, args.trace)
+        return 0
+    summarize(dump)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
