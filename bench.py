@@ -167,6 +167,13 @@ def _bscale():
     return max(1, int(os.environ.get("PADDLE_TPU_BENCH_BATCH_SCALE", "1")))
 
 
+def _optimize_level():
+    """Effective graph-optimizer level for this worker (core/passes)."""
+    from paddle_tpu.core.passes import optimize_level
+
+    return optimize_level()
+
+
 def _batch(default, quick, quick_default):
     """Per-workload batch size: the non-quick default scales by
     PADDLE_TPU_BENCH_BATCH_SCALE (int, default 1) so hardware batch
@@ -322,6 +329,7 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
         peak = peak_flops()
         import jax as _jax
 
+        opt_level = _optimize_level()
         rec = {
             "metric": name,
             # which backend actually ran — a CPU row must never pass
@@ -361,6 +369,12 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # so rows record them like every other non-default knob
             **({"pipelined": True, "in_flight": in_flight,
                 "prefetch_depth": depth} if pipelined else {}),
+            # a non-default PADDLE_TPU_OPTIMIZE level (the graph-pass
+            # pipeline, docs/OPTIMIZER.md) marks the row: a level-0/1
+            # run compiled a different program than the default config.
+            # The sidecar's paddle_optimizer_* families carry the full
+            # per-pass story (stats_dump --grep paddle_optimizer)
+            **({"optimize_level": opt_level} if opt_level != 2 else {}),
             # batch multiplier (PADDLE_TPU_BENCH_BATCH_SCALE): scaled
             # rows never regression-compare against the default-batch
             # baseline silently

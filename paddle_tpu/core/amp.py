@@ -19,11 +19,12 @@ parameter gradients are upcast at the optimizer boundary (FP32_OPS).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import jax.numpy as jnp
 
-__all__ = ["BF16_OPS", "FP32_OPS", "apply_amp_policy"]
+__all__ = ["BF16_OPS", "FP32_OPS", "amp_cast", "apply_amp_policy",
+           "policy_for"]
 
 # Compute ops: cast every floating input to bf16. Dots/convs hit the MXU at
 # bf16 rate; elementwise/activation ops halve their HBM traffic; the f32
@@ -82,12 +83,39 @@ def _cast_ins(ins: Dict[str, List[Any]], dtype) -> Dict[str, List[Any]]:
     return out
 
 
-def apply_amp_policy(op_type: str, ins: Dict[str, List[Any]]):
-    """Cast `ins` per the policy for `op_type` (grad ops follow their
-    forward op's class so jax.vjp re-traces see consistent dtypes)."""
+def policy_for(op_type: str) -> str:
+    """The three-way policy class for one op type: "bf16", "f32", or
+    "keep" (grad ops follow their forward op's class so jax.vjp
+    re-traces see consistent dtypes). This is the decision the
+    ``amp_bf16_pass`` (core/passes/amp_pass.py) stamps onto the IR as
+    each op's ``__amp__`` attr."""
     base = op_type[:-5] if op_type.endswith("_grad") else op_type
     if base in BF16_OPS:
-        return _cast_ins(ins, jnp.bfloat16)
+        return "bf16"
     if base in FP32_OPS:
+        return "f32"
+    return "keep"
+
+
+def _apply_tag(tag: Optional[str], ins: Dict[str, List[Any]]):
+    if tag == "bf16":
+        return _cast_ins(ins, jnp.bfloat16)
+    if tag == "f32":
         return _cast_ins(ins, jnp.float32)
     return ins
+
+
+def amp_cast(op_type: str, attrs: Dict[str, Any],
+             ins: Dict[str, List[Any]]):
+    """Cast ``ins`` for one op under AMP: an ``__amp__`` attr stamped by
+    the IR pass (or set per op by the user) wins; otherwise the table
+    policy applies. THE one casting entry point — ``lower_op`` and the
+    ``fused_elementwise`` body share it, so the stamped and table paths
+    cannot drift."""
+    return _apply_tag(attrs.get("__amp__") or policy_for(op_type), ins)
+
+
+def apply_amp_policy(op_type: str, ins: Dict[str, List[Any]]):
+    """Cast `ins` per the table policy for `op_type` (no per-op
+    override; kept for callers without an attr dict in hand)."""
+    return _apply_tag(policy_for(op_type), ins)

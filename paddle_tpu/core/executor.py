@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .lowering import LowerContext, as_jax_dtype, lower_block
+from .passes import optimize_for_execution
+from .passes import config_key as _optimizer_config_key
 from .program import Program, Variable, default_main_program, op_effects
 from .registry import get_op, has_op
 from .scope import Scope, global_scope
@@ -673,7 +675,11 @@ class Executor:
     # -------------------------------------------------------------- prepare
     def _cache_key(self, program, feed_vals, fetch_names):
         sig = tuple(sorted((n, v.shape, str(v.dtype)) for n, v in feed_vals.items()))
-        return (program._serial, program.version, sig, tuple(fetch_names))
+        # the optimizer config (level + every output-changing knob) keys
+        # the cache too: a plan compiled from the optimized clone must
+        # never serve a differently-configured run
+        return (program._serial, program.version, _optimizer_config_key(),
+                sig, tuple(fetch_names))
 
     def _prepare(self, program: Program, feed_vals, fetch_names, scope) -> _Plan:
         from ..analysis import validation_enabled, verify_program
@@ -683,8 +689,16 @@ class Executor:
             # by default under tests): a bad program fails HERE with op
             # provenance instead of as a JAX trace error inside
             # lower_block. Once per plan — cache hits never re-verify.
+            # Runs on the USER program (before optimization) so findings
+            # carry the original build-site provenance.
             verify_program(program, fetch_list=fetch_names, scope=scope,
                            raise_on_error=True, site="prepare")
+        # graph-optimizing pass pipeline (core/passes): fold/copy-prop/
+        # CSE/DCE/fusion on a CLONE, so the optimized plan is what gets
+        # cached and the user's program is untouched. Level 0 bypasses
+        # entirely (the level is part of the plan-cache key). Once per
+        # plan-cache miss, like verification.
+        program = optimize_for_execution(program, fetch_names, scope=scope)
         feed_names = sorted(feed_vals)
         (feed_names, fetch_names, const_state, mut_state, pure_written,
          needs_rng, step) = analyze_block(program, feed_names, fetch_names, scope)

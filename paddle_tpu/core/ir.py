@@ -93,12 +93,32 @@ class Graph:
         node.var = var
         return node
 
-    def insert_op_node(self, type: str, inputs, outputs, attrs=None) -> Node:
+    def insert_op_node(self, type: str, inputs, outputs, attrs=None,
+                       provenance_from=()) -> Node:
         """Create an Operator (not yet placed — topology_sort orders it)
         and wire its var edges. Input/output vars must already have
-        nodes (create_var_node for fresh ones)."""
+        nodes (create_var_node for fresh ones).
+
+        ``provenance_from`` (Operators or op Nodes) synthesizes the new
+        op's name_scope/def_site from the source ops it replaces
+        (``fused:{original scopes}``), so a verifier finding on a
+        pass-created op still points at the model code that built the
+        originals instead of at the pass."""
         block = self.program.global_block()
         op = Operator(block, type, inputs, outputs, attrs or {})
+        srcs = [s.op if isinstance(s, Node) else s for s in provenance_from]
+        if srcs:
+            scopes = []
+            for s in srcs:
+                sc = getattr(s, "name_scope", "") or ""
+                if sc and sc not in scopes:
+                    scopes.append(sc)
+            op.name_scope = "fused:%s" % ",".join(scopes) if scopes \
+                else "fused:%s" % "+".join(
+                    dict.fromkeys(s.type for s in srcs))
+            op.def_site = next(
+                (s.def_site for s in srcs
+                 if getattr(s, "def_site", None)), op.def_site)
         onode = Node("op", type, op=op)
         self.op_nodes.append(onode)
         for n in op.input_names():
@@ -133,16 +153,45 @@ class Graph:
         Unlike topology_sort (which assumes SSA-ish programs and reports
         a cycle on in-place updates like `sgd ParamOut=param` feeding an
         earlier read of `param`), this preserves the original program
-        order for surviving ops and splices each NEW op immediately
-        before its first consumer (or after its last producer when
-        nothing consumes it) — the order an in-place insertion would
-        have produced."""
+        order for surviving ops. New ops are placed by two rules:
+
+        * a REPLACEMENT op — every output name had a now-removed
+          original producer — takes the original producer's slot (the
+          last one, for multi-output). The original program proved that
+          slot is after the op's input producers and before its output
+          consumers, and it stays correct even when one pass creates
+          several interdependent new ops (fused chain B consuming fused
+          chain A's output: anchors inherit the original chains'
+          relative order).
+        * an op with genuinely NEW output names (e.g. the quantize
+          transpiler's fake_quantize inserts) splices immediately
+          before its first consumer, or after its last producer when
+          nothing consumes it — the order an in-place insertion would
+          have produced."""
         block = self.program.global_block()
         old_pos = {id(op): i for i, op in enumerate(block.ops)}
         alive = {id(n.op) for n in self.op_nodes}
-        order = [op for op in block.ops if id(op) in alive]
+        orig_writer = {}  # name -> last REMOVED original writer's slot
+        for i, op in enumerate(block.ops):
+            if id(op) not in alive:
+                for n in op.output_names():
+                    if n:
+                        orig_writer[n] = i
         new_nodes = [n for n in self.op_nodes if id(n.op) not in old_pos]
-        for node in new_nodes:
+        keyed = [(old_pos[id(op)], k, op)
+                 for k, op in enumerate(block.ops) if id(op) in alive]
+        unanchored = []
+        base = len(block.ops)
+        for k, node in enumerate(new_nodes):
+            outs = [n for n in node.op.output_names() if n]
+            if outs and all(n in orig_writer for n in outs):
+                keyed.append((max(orig_writer[n] for n in outs),
+                              base + k, node.op))
+            else:
+                unanchored.append(node)
+        keyed.sort()
+        order = [op for _i, _k, op in keyed]
+        for node in unanchored:
             pos = {id(op): i for i, op in enumerate(order)}
             consumers = [pos[id(c.op)] for vn in node.outputs
                          for c in vn.outputs

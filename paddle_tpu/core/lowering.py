@@ -75,9 +75,11 @@ def lower_op(ctx: LowerContext, op, env: Dict[str, Any]) -> None:
     for slot, names in op.inputs.items():
         ins[slot] = [env[n] if n else None for n in names]
     if ctx.amp:
-        from .amp import apply_amp_policy
+        from .amp import amp_cast
 
-        ins = apply_amp_policy(op.type, ins)
+        # the __amp__ attr stamped by core/passes/amp_pass.py (or set per
+        # op by the user) overrides the table policy
+        ins = amp_cast(op.type, op.attrs, ins)
     attrs = op.attrs
     if opdef.needs_env:
         attrs = dict(op.attrs)

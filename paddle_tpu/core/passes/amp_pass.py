@@ -1,0 +1,48 @@
+"""AMP/bf16 as an IR pass: materialize the mixed-precision policy.
+
+``core/amp.py`` decides, per op type, whether floating inputs compute
+in bf16 (MXU compute), f32 (numerics-sensitive reductions/losses/
+optimizer), or pass through. Before this pass that decision lived ONLY
+inside ``lower_op`` — invisible in the program text, undiagnosable, and
+un-overridable per op. The pass stamps the decision onto each op as an
+``__amp__`` attr ("bf16" / "f32" / "keep"); lowering obeys the stamp
+when present and falls back to the table policy otherwise (unoptimized
+paths — ``PADDLE_TPU_OPTIMIZE=0``, the parallel-engine lowering — keep
+working unchanged). The stamped and table paths cast at exactly the
+same points, so they are bitwise identical; tests pin it.
+
+An op carrying a pre-existing ``__amp__`` attr (user override) is left
+untouched — that is the point of materializing the policy in the IR.
+"""
+
+from __future__ import annotations
+
+from ..ir import Graph, Pass, register_pass
+
+
+@register_pass("amp_bf16_pass")
+class AmpBf16Pass(Pass):
+    """Stamp the bf16/f32/keep AMP policy onto every op as an
+    ``__amp__`` attr (no-op unless the program has AMP enabled;
+    pre-existing per-op overrides are preserved)."""
+
+    fetch_names = frozenset()
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        program = graph.program
+        self.changed = False  # attr-only: never alters structure
+        if not getattr(program, "amp", False):
+            self.stats = {"amp_tagged": 0}
+            return graph
+        from ..amp import policy_for
+
+        tagged = 0
+        for block in program.blocks:
+            for op in block.ops:
+                if "__amp__" in op.attrs:
+                    continue  # explicit per-op override wins
+                op.attrs["__amp__"] = policy_for(op.type)
+                tagged += 1
+        self.stats = {"amp_tagged": tagged}
+        return graph

@@ -1,0 +1,215 @@
+"""Copy propagation + common-subexpression elimination.
+
+Pure copies (``assign``/``share_data``) enter programs through user
+code, the transpilers, and grad materialization on renamed
+contributions; copy propagation rewires each copy's consumers to the
+source and drops it — which also normalizes names so CSE sees through
+copies. CSE then value-numbers the surviving ops — key = (type, attrs,
+input names AT THEIR CURRENT WRITE VERSION) — and rewires duplicates
+onto the first occurrence. Both are bitwise no-ops by construction: a
+consumer reads the identical value through a different name.
+
+Versioned inputs are what make this safe on a non-SSA program: an op
+reading ``param`` before and after ``sgd ParamOut=param`` sees two
+different versions, so the two reads never merge.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..ir import Graph, Pass, register_pass
+from ..program import op_effects
+from .common import (Unfingerprintable, attrs_fingerprint, is_pure,
+                     pinned_names, removable_output, var_of, write_counts)
+
+COPY_OPS = ("assign", "share_data")
+
+
+def _rewire_consumers(graph: Graph, node, alias: Dict[str, str]):
+    """Point every consumer of ``node``'s output vars at the alias
+    target, updating Operator slots and graph edges."""
+    for vn in list(node.outputs):
+        new = alias.get(vn.name)
+        if new is None:
+            continue
+        for consumer in list(vn.outputs):
+            if consumer is node:
+                continue
+            for slot, names in list(consumer.op.inputs.items()):
+                if vn.name in names:
+                    graph.rewire_input(consumer, slot, vn.name, new)
+
+
+@register_pass("copy_propagation_pass")
+class CopyPropagationPass(Pass):
+    """Drop pure copies (``assign``/``share_data``) whose source and
+    destination are both written exactly once, rewiring the copy's
+    consumers to read the source directly."""
+
+    fetch_names = frozenset()
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        program = graph.program
+        counts = write_counts(program)
+        pinned = pinned_names(program)
+        fetch = set(self.fetch_names or ())
+        # last write position per name (program order): a copy is only
+        # droppable when NOTHING writes its source at-or-after the copy
+        # — a later in-place update (sgd ParamOut=param is a single
+        # write, so a count check alone misses it) would make rewired
+        # consumers read the updated value instead of the snapshot
+        last_write = {}
+        for i, n_node in enumerate(graph.op_nodes):
+            for n in op_effects(program, n_node.op)[1]:
+                last_write[n] = i
+        removed = 0
+        for pos, node in enumerate(list(graph.op_nodes)):
+            op = node.op
+            if op.type not in COPY_OPS or not is_pure(program, op):
+                continue
+            srcs = [n for n in op.input_names() if n]
+            dsts = [n for n in op.output_names() if n]
+            if len(srcs) != 1 or len(dsts) != 1 or srcs[0] == dsts[0]:
+                continue
+            src, dst = srcs[0], dsts[0]
+            if not removable_output(program, dst, fetch, pinned,
+                                    counts, scope=self.scope):
+                continue
+            if last_write.get(src, -1) >= pos:
+                continue  # source (re)written at/after the copy:
+                #           dst is a SNAPSHOT, not an alias
+            sv = var_of(program, src)
+            dv = var_of(program, dst)
+            if sv is not None and dv is not None and \
+                    sv.dtype != dv.dtype:
+                continue  # assign doubles as a cast only via declared dtype
+            _rewire_consumers(graph, node, {dst: src})
+            graph.remove_op_node(node)
+            removed += 1
+        self.stats = {"copies_removed": removed}
+        self.changed = removed > 0
+        return graph
+
+
+@register_pass("common_subexpression_elimination_pass")
+class CommonSubexpressionEliminationPass(Pass):
+    """Merge ops that provably compute the same value: identical type,
+    attrs, and input names at identical write versions; duplicates are
+    removed and their consumers rewired onto the first occurrence."""
+
+    fetch_names = frozenset()
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        program = graph.program
+        counts = write_counts(program)
+        pinned = pinned_names(program)
+        fetch = set(self.fetch_names or ())
+        version: Dict[str, int] = {}
+        seen: Dict[tuple, object] = {}  # key -> first op node
+        removed = 0
+        for node in list(graph.op_nodes):
+            op = node.op
+            reads, writes = op_effects(program, op)
+            key = None
+            if is_pure(program, op):
+                key = self._key(op, version)
+            if key is not None and key in seen and \
+                    self._mergeable(program, node, seen[key], fetch,
+                                    pinned, counts, self.scope):
+                first = seen[key]
+                alias = {}
+                for slot, names in op.outputs.items():
+                    fnames = first.op.outputs.get(slot, [])
+                    for i, n in enumerate(names):
+                        if n:
+                            alias[n] = fnames[i]
+                _rewire_consumers(graph, node, alias)
+                graph.remove_op_node(node)
+                removed += 1
+                continue  # removed: contributes no writes
+            if key is not None and key not in seen and all(
+                    counts.get(n, 0) == 1 for n in op.output_names()
+                    if n):
+                # only a merge TARGET whose outputs are written exactly
+                # once (by this op) is stable for the rest of the block
+                # — a later rewrite of an output name would hand rewired
+                # consumers the overwritten value, not this op's
+                seen[key] = node
+            for n in writes:
+                version[n] = version.get(n, 0) + 1
+        self.stats = {"cse_removed": removed}
+        self.changed = removed > 0
+        return graph
+
+    @staticmethod
+    def _key(op, version):
+        try:
+            ins = tuple(sorted(
+                (slot, i, n, version.get(n, 0))
+                for slot, names in op.inputs.items()
+                for i, n in enumerate(names) if n))
+            return (op.type, attrs_fingerprint(op.attrs), ins)
+        except Unfingerprintable:
+            return None
+
+    @staticmethod
+    def _mergeable(program, dup, first, fetch, pinned, counts, scope):
+        """Every nonempty output of ``dup`` must be droppable AND have a
+        nonempty counterpart at the same (slot, idx) of ``first``."""
+        for slot, names in dup.op.outputs.items():
+            fnames = first.op.outputs.get(slot, [])
+            for i, n in enumerate(names):
+                if not n:
+                    continue
+                if i >= len(fnames) or not fnames[i]:
+                    return False
+                if not removable_output(program, n, fetch, pinned,
+                                        counts, scope=scope):
+                    return False
+        return True
+
+
+@register_pass("dead_op_elimination_pass")
+class DeadOpEliminationPass(Pass):
+    """Fetch-relative dead-op elimination over the shared ``op_effects``
+    semantics: a backward slice from the fetch targets keeps every op
+    that (transitively) feeds a fetch, writes persistable/scope state,
+    carries a side-effecting role (optimize/dist), owns a control-flow
+    body, or consumes RNG (removing an RNG consumer would shift the key
+    chain for every later op — bitwise parity forbids it). Everything
+    else is removed. This is the acting counterpart of the lint suite's
+    advisory ``dead-op`` rule (analysis/lint.py)."""
+
+    fetch_names = frozenset()
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        program = graph.program
+        needed = set(self.fetch_names or ())
+        scope = self.scope
+        removed = 0
+        for node in reversed(list(graph.op_nodes)):
+            op = node.op
+            reads, writes = op_effects(program, op)
+            live = (op.attrs.get("__op_role__") in ("optimize", "dist")
+                    or not is_pure(program, op))
+            if not live:
+                for n in writes:
+                    v = var_of(program, n)
+                    persist = (v is not None and v.persistable) or (
+                        v is None and scope is not None
+                        and scope.has_var(n))
+                    if n in needed or persist:
+                        live = True
+                        break
+            if live:
+                needed.update(reads)
+            else:
+                graph.remove_op_node(node)
+                removed += 1
+        self.stats = {"dce_removed": removed}
+        self.changed = removed > 0
+        return graph

@@ -1,0 +1,181 @@
+"""Elementwise / activation-chain fusion into ``fused_elementwise``.
+
+A PatternMatcher finds every (fusable op) -> (single-consumer temp var)
+-> (fusable op) link; links chain into maximal runs, and each run of
+length >= 2 is replaced by ONE ``fused_elementwise`` op whose attrs
+carry the constituent op descriptors. The fused op's single registered
+lowering (ops/fused_ops.py) replays each constituent's OWN registered
+lowering in order — same functions, same order, same AMP casts — so the
+fused body is bitwise the unfused chain by construction; fusion buys a
+smaller program (fewer ops to verify/trace/lower, one op in every
+listing) rather than different numerics.
+
+Chains never cross an RNG consumer, a role boundary (forward vs
+backward matters to the gradient-accumulation partition), a fetch, or a
+var that is multiply-written / read from a sub-block. Gradient ops
+(``<unary>_grad``) fuse too — their synthesized lowerings are ordinary
+pure functions of their slots.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..ir import Graph, Node, Pass, PatternMatcher, register_pass
+from ..program import op_effects
+from .common import (ELEMENTWISE_BINARY, ELEMENTWISE_UNARY,
+                     Unfingerprintable, attrs_fingerprint, is_pure,
+                     pinned_names, removable_output, single_output_name,
+                     write_counts)
+
+# the shared elementwise vocabulary (common.py): unary ops' forward AND
+# synthesized grad lower to single-tensor-in/single-tensor-out bodies
+FUSABLE_UNARY = ELEMENTWISE_UNARY
+FUSABLE_BINARY = ELEMENTWISE_BINARY
+
+
+def fusable_op_type(t: str) -> bool:
+    if t in FUSABLE_UNARY or t in FUSABLE_BINARY:
+        return True
+    return t.endswith("_grad") and t[:-5] in FUSABLE_UNARY
+
+
+@register_pass("fuse_elementwise_pass")
+class FuseElementwisePass(Pass):
+    """Collapse single-consumer chains of elementwise/activation ops
+    into one ``fused_elementwise`` op per chain (see module docstring
+    for the safety conditions and the bitwise-parity argument)."""
+
+    fetch_names = frozenset()
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        program = graph.program
+        counts = write_counts(program)
+        pinned = pinned_names(program)
+        fetch = set(self.fetch_names or ())
+
+        def fusable(node: Node) -> bool:
+            op = node.op
+            if not fusable_op_type(op.type) or not is_pure(program, op):
+                return False
+            out = single_output_name(op)
+            if out is None or counts.get(out, 0) != 1:
+                return False
+            try:
+                # the fused descriptor must round-trip these attrs
+                attrs_fingerprint(op.attrs)
+            except Unfingerprintable:
+                return False
+            return True
+
+        def linkable(vn: Node) -> bool:
+            # the chain's internal value: one producer, one consumer,
+            # and a name nothing else (fetches, sub-blocks, reruns)
+            # needs once the chain swallows it
+            return (len(vn.inputs) == 1 and len(vn.outputs) == 1
+                    and removable_output(program, vn.name, fetch,
+                                         pinned, counts,
+                                         scope=self.scope))
+
+        pm = PatternMatcher()
+        prod = pm.new_op("producer", pred=fusable)
+        link = pm.new_var("link", pred=linkable)
+        cons = pm.new_op("consumer", pred=fusable)
+        pm.feeds(prod, link)
+        pm.feeds(link, cons)
+
+        # adjacent-pair matches overlap at shared ops (a->b, b->c); chain
+        # assembly resolves the overlap: each op joins at most one chain,
+        # first pair (program order) wins a contested junction
+        order = {id(n): i for i, n in enumerate(graph.op_nodes)}
+        pairs = sorted(
+            ((m["producer"], m["consumer"]) for m in pm.match(graph)
+             if m["producer"].op.attrs.get("__op_role__")
+             == m["consumer"].op.attrs.get("__op_role__")),
+            key=lambda pc: (order[id(pc[0])], order[id(pc[1])]))
+        nxt: Dict[int, Node] = {}
+        prev: Dict[int, Node] = {}
+        for a, b in pairs:
+            if id(a) in nxt or id(b) in prev:
+                continue
+            nxt[id(a)] = b
+            prev[id(b)] = a
+
+        # write positions per name (program order): the fused op runs at
+        # the chain TAIL's slot, so every constituent's external read is
+        # effectively moved from its own slot to the tail's — that move
+        # is only sound when nothing writes the read name in between
+        write_pos: Dict[str, List[int]] = {}
+        for i, n_node in enumerate(graph.op_nodes):
+            for n in op_effects(program, n_node.op)[1]:
+                write_pos.setdefault(n, []).append(i)
+
+        def chain_safe(chain: List[Node]) -> bool:
+            p_tail = order[id(chain[-1])]
+            internal = {single_output_name(n.op) for n in chain[:-1]}
+            for cnode in chain:
+                p_i = order[id(cnode)]
+                for n in cnode.op.input_names():
+                    if not n or n in internal:
+                        continue
+                    if any(p_i < w <= p_tail for w in
+                           write_pos.get(n, ())):
+                        return False  # read would move past a write
+            return True
+
+        fused = 0
+        removed = 0
+        for node in list(graph.op_nodes):
+            if id(node) in prev or id(node) not in nxt:
+                continue  # not a chain head
+            chain: List[Node] = [node]
+            while id(chain[-1]) in nxt:
+                chain.append(nxt[id(chain[-1])])
+            if len(chain) < 2 or not chain_safe(chain):
+                continue
+            self._fuse_chain(graph, chain)
+            fused += 1
+            removed += len(chain) - 1
+        self.stats = {"chains_fused": fused, "ops_fused_away": removed}
+        self.changed = fused > 0
+        return graph
+
+    @staticmethod
+    def _fuse_chain(graph: Graph, chain: List[Node]):
+        internal = {single_output_name(n.op): i
+                    for i, n in enumerate(chain[:-1])}
+        ext: List[str] = []
+        ext_idx: Dict[str, int] = {}
+        specs = []
+        for node in chain:
+            op = node.op
+            ins = {}
+            for slot, names in op.inputs.items():
+                refs = []
+                for n in names:
+                    if not n:
+                        refs.append(["none", 0])
+                    elif n in internal:
+                        refs.append(["t", internal[n]])
+                    else:
+                        if n not in ext_idx:
+                            ext_idx[n] = len(ext)
+                            ext.append(n)
+                        refs.append(["x", ext_idx[n]])
+                ins[slot] = refs
+            out_slot = next(s for s, ns in op.outputs.items()
+                            if any(ns))
+            specs.append({"type": op.type, "attrs": dict(op.attrs),
+                          "ins": ins, "out_slot": out_slot})
+        final_out = single_output_name(chain[-1].op)
+        attrs = {"ops": specs,
+                 "fused_types": "+".join(s["type"] for s in specs)}
+        role = chain[0].op.attrs.get("__op_role__")
+        if role:
+            attrs["__op_role__"] = role
+        for node in chain:
+            graph.remove_op_node(node)
+        graph.insert_op_node(
+            "fused_elementwise", {"X": list(ext)}, {"Out": [final_out]},
+            attrs=attrs, provenance_from=[n.op for n in chain])

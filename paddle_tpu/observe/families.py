@@ -350,6 +350,64 @@ ANALYSIS_VERIFY_SECONDS = REGISTRY.histogram(
     "Wall time of one verify_program pass (shape inference + lint "
     "suite) — scales with op count, not with tensor sizes")
 
+# ------------------------------------------------------------- optimizer
+# (paddle_tpu/core/passes/: graph-optimizing pass pipeline — see
+# docs/OPTIMIZER.md. PADDLE_TPU_OPTIMIZE=0 bypasses the pipeline; tests
+# pin that NONE of these families move then.)
+OPTIMIZER_PROGRAMS = REGISTRY.counter(
+    "paddle_optimizer_programs_optimized_total",
+    "Programs run through the optimizing pass pipeline at executor "
+    "prepare time (once per plan-cache miss), by effective "
+    "PADDLE_TPU_OPTIMIZE level", labels=("level",))
+for _lv in ("1", "2"):
+    OPTIMIZER_PROGRAMS.labels(level=_lv)
+OPTIMIZER_OPS_IN = REGISTRY.counter(
+    "paddle_optimizer_ops_in_total",
+    "Global-block ops entering the pipeline (sum over optimized "
+    "programs); with ops_out_total this is the lifetime op-count "
+    "reduction ratio")
+OPTIMIZER_OPS_OUT = REGISTRY.counter(
+    "paddle_optimizer_ops_out_total",
+    "Global-block ops surviving the pipeline (sum over optimized "
+    "programs)")
+OPTIMIZER_OPS_REMOVED = REGISTRY.counter(
+    "paddle_optimizer_ops_removed_total",
+    "Net ops removed from the program, by pass (copy-prop/CSE/DCE "
+    "removals, folding net of materialized constants, fusion net of "
+    "inserted fused ops)", labels=("pass",))
+OPTIMIZER_OPS_FOLDED = REGISTRY.counter(
+    "paddle_optimizer_ops_folded_total",
+    "Const-subgraph ops evaluated at optimize time by "
+    "constant_folding_pass (before netting out the assign_value ops "
+    "that materialize still-consumed results)")
+OPTIMIZER_OPS_FUSED = REGISTRY.counter(
+    "paddle_optimizer_ops_fused_total",
+    "Elementwise-chain ops swallowed into fused_elementwise ops "
+    "(constituents counted, one fused op re-inserted per chain)")
+OPTIMIZER_PASS_SECONDS = REGISTRY.histogram(
+    "paddle_optimizer_pass_seconds",
+    "Wall time of one pass application (graph build + apply + "
+    "materialize; the per-pass verify is not included — it rides "
+    "optimize_seconds)", labels=("pass",))
+OPTIMIZER_SECONDS = REGISTRY.histogram(
+    "paddle_optimizer_optimize_seconds",
+    "Wall time of one whole pipeline run over a program, including "
+    "the verify-after-every-pass checks")
+# pre-materialize the per-pass schema from the pipeline's pass list —
+# kept as a plain tuple HERE (not imported from core.passes, which
+# would cycle); tests pin it equal to core.passes.PIPELINE's names
+_OPTIMIZER_PASSES = (
+    "constant_folding_pass",
+    "copy_propagation_pass",
+    "common_subexpression_elimination_pass",
+    "dead_op_elimination_pass",
+    "fuse_elementwise_pass",
+    "amp_bf16_pass",
+)
+for _p in _OPTIMIZER_PASSES:
+    OPTIMIZER_OPS_REMOVED.labels(**{"pass": _p})
+    OPTIMIZER_PASS_SECONDS.labels(**{"pass": _p})
+
 # ----------------------------------------------------------------- spans
 SPAN_SECONDS = REGISTRY.histogram(
     "paddle_span_seconds",
@@ -395,6 +453,10 @@ TRACE_SITES = (
     # resilience (resilience/faults.py, watchdog.py): the events that
     # explain a flight-recorder dump's final moments
     "resilience.fault", "resilience.wedge",
+    # optimizer (core/passes): one pipeline span per optimized program,
+    # one child span per applied pass — optimization cost shows up in
+    # the flight recorder next to the compile it feeds
+    "optimizer.pipeline", "optimizer.pass",
 )
 
 # -------------------------------------------------------- backend/bench

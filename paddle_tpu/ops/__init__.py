@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     control_flow_ops,
     detection_ops,
     distributed_ops,
+    fused_ops,
     loss_ops,
     math,
     metrics,

@@ -175,6 +175,102 @@ def test_pattern_matcher_finds_slot_edges(fresh_programs):
     assert pm2.match(g) == []
 
 
+def test_pattern_matcher_overlapping_adjacent_matches(fresh_programs):
+    """A chain a->b->c yields BOTH adjacent (producer, consumer) pairs —
+    the matcher reports every occurrence and leaves overlap resolution
+    (b appears as consumer of one match and producer of the next) to
+    the client, which is exactly what the fusion pass's chain assembly
+    relies on. A node never binds two roles within ONE match."""
+    from paddle_tpu.core.ir import Graph, PatternMatcher
+
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.relu(x)
+        h = fluid.layers.tanh(h)
+        fluid.layers.sigmoid(h)
+    g = Graph(main)
+    act = ("relu", "tanh", "sigmoid")
+    pm = PatternMatcher()
+    a = pm.new_op("a", pred=lambda n: n.op.type in act)
+    v = pm.new_var("v", pred=lambda n: len(n.inputs) == 1
+                   and len(n.outputs) == 1)
+    b = pm.new_op("b", pred=lambda n: n.op.type in act)
+    pm.feeds(a, v)
+    pm.feeds(v, b)
+    matches = pm.match(g)
+    pairs = {(m["a"].op.type, m["b"].op.type) for m in matches}
+    # both adjacent pairs present; the shared middle op (tanh) overlaps
+    assert pairs == {("relu", "tanh"), ("tanh", "sigmoid")}
+    for m in matches:
+        assert m["a"] is not m["b"]  # one node never binds two roles
+
+
+def test_materialize_splices_between_producer_and_consumer(fresh_programs):
+    """A pass-created op that CONSUMES a surviving op's output and
+    PRODUCES a var another surviving op reads must land after its
+    producer and before its consumer."""
+    from paddle_tpu.core.ir import Graph
+
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.relu(x)           # producer of h
+        out = fluid.layers.tanh(h)         # will be rewired to read t
+        fluid.layers.sigmoid(out)
+    g = Graph(main)
+    relu_out = [op for op in main.global_block().ops
+                if op.type == "relu"][0].output("Out")[0]
+    g.create_var_node("t_spliced", shape=(-1, 4), dtype="float32")
+    node = g.insert_op_node("scale", {"X": [relu_out]},
+                            {"Out": ["t_spliced"]}, attrs={"scale": 2.0})
+    tanh_node = [n for n in g.op_nodes if n.op.type == "tanh"][0]
+    g.rewire_input(tanh_node, "X", relu_out, "t_spliced")
+    g.materialize()
+    types = [op.type for op in main.global_block().ops]
+    i_relu, i_scale, i_tanh = (types.index(t)
+                               for t in ("relu", "scale", "tanh"))
+    assert i_relu < i_scale < i_tanh, types
+    assert node.op in main.global_block().ops
+
+
+def test_insert_op_node_synthesizes_provenance(fresh_programs):
+    """Ops created by passes carry name_scope/def_site synthesized from
+    the ops they replace (fused:{original scopes}), so verifier errors
+    on optimized programs still point at the model code."""
+    from paddle_tpu.core.ir import Graph
+    from paddle_tpu.core.program import name_scope
+
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        with name_scope("encoder"):
+            h = fluid.layers.relu(x)
+        with name_scope("head"):
+            fluid.layers.tanh(h)
+    ops = main.global_block().ops
+    relu, tanh = ops[-2], ops[-1]
+    assert relu.def_site and "test_ir_and_slim" in relu.def_site
+    g = Graph(main)
+    node = g.insert_op_node("sigmoid", {"X": [relu.output("Out")[0]]},
+                            {"Out": [tanh.output("Out")[0]]},
+                            provenance_from=[relu, tanh])
+    assert node.op.name_scope == "fused:encoder,head"
+    assert node.op.def_site == relu.def_site
+    # without sources: scopes fall back to the source op types — but
+    # with NO sources at all the default Operator provenance stands
+    bare = g.insert_op_node("sigmoid", {"X": [relu.output("Out")[0]]},
+                            {"Out": ["t_unused"]})
+    assert not bare.op.name_scope.startswith("fused:")
+    # scope-less sources synthesize from op types instead
+    relu2 = type(relu)(main.global_block(), "relu",
+                       {"X": [relu.output("Out")[0]]}, {"Out": ["t2"]})
+    relu2.name_scope = ""
+    anon = g.insert_op_node("sigmoid", {"X": ["t2"]}, {"Out": ["t3"]},
+                            provenance_from=[relu2])
+    assert anon.op.name_scope == "fused:relu"
+
+
 def test_quantize_pass_via_registry(fresh_programs):
     """quantize_pass runs through the pass registry and rewires the
     graph; the program then trains (QAT) like the transpiler path."""

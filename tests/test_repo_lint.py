@@ -130,6 +130,55 @@ def test_undeclared_trace_site_detected(tmp_path):
         and "TRACE_SITES" in out[0]
 
 
+def test_undocumented_pass_detected(tmp_path):
+    # a register_pass class without a docstring is a violation; with one
+    # (and for non-pass classes) the rule stays silent
+    bad = (
+        "def register_pass(name):\n"
+        "    def deco(cls):\n        return cls\n    return deco\n"
+        '@register_pass("p1")\n'
+        "class NoDoc:\n    pass\n"
+    )
+    root = _fake_repo(tmp_path, "x = 1\n", bad)
+    out = repo_lint.pass_docstring_violations(root)
+    assert len(out) == 1 and "NoDoc" in out[0] and "docstring" in out[0]
+    good = (
+        "def register_pass(name):\n"
+        "    def deco(cls):\n        return cls\n    return deco\n"
+        '@register_pass("p1")\n'
+        'class WithDoc:\n    """Documented."""\n'
+        "class Plain:\n    pass\n"
+    )
+    root2 = _fake_repo(tmp_path / "second", "x = 1\n", good)
+    assert repo_lint.pass_docstring_violations(root2) == []
+
+
+def test_repo_pass_classes_are_documented():
+    # subset of test_repo_is_clean, kept separate so a regression names
+    # the rule (same pattern as the trace-site rule below)
+    assert repo_lint.pass_docstring_violations(ROOT) == []
+
+
+def test_optimizer_family_refs_in_passes_are_declared():
+    # the paddle_optimizer_* families the pass pipeline records are
+    # covered by the undeclared-family rule like everything else — pin
+    # it explicitly on the pass package's files
+    passes_dir = os.path.join(ROOT, "paddle_tpu", "core", "passes")
+    files = [os.path.join(passes_dir, f) for f in os.listdir(passes_dir)
+             if f.endswith(".py")]
+    assert files, "pass package moved?"
+    assert repo_lint.family_ref_violations(ROOT, files=files) == []
+
+
+def test_optimizer_pass_schema_matches_pipeline():
+    # families.py pre-materializes the per-pass series from a plain
+    # tuple (imports would cycle); it must track the runtime pipeline
+    from paddle_tpu.core.passes import PIPELINE
+    from paddle_tpu.observe.families import _OPTIMIZER_PASSES
+
+    assert tuple(name for name, _lvl in PIPELINE) == _OPTIMIZER_PASSES
+
+
 def test_repo_uses_only_declared_trace_sites():
     # the real tree is clean under the new rule (subset of
     # test_repo_is_clean, kept separate so a trace-site regression

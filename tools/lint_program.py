@@ -127,20 +127,29 @@ def _build_mt():
     return machine_translation.build(cfg)[0]
 
 
-def verify_example(name, optimize=True):
-    """Build example ``name`` and verify train + startup programs.
-    Returns (findings, programs) where findings is a flat Finding list."""
+def build_example(name, optimizer=True):
+    """Build example ``name``'s (main, startup, loss) under fresh
+    programs — shared by this CLI, tools/optimize_program.py, and the
+    model-zoo gates in tests/test_analysis.py / tests/test_optimizer.py.
+    ``optimizer=False`` skips the Adam step (forward-only program)."""
     import paddle_tpu as fluid
-
-    from paddle_tpu.analysis import verify_program
 
     builder = EXAMPLE_BUILDERS[name]
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         with fluid.unique_name.guard():
             loss = builder()
-            if optimize:
+            if optimizer:
                 fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main, startup, loss
+
+
+def verify_example(name, optimize=True):
+    """Build example ``name`` and verify train + startup programs.
+    Returns (findings, programs) where findings is a flat Finding list."""
+    from paddle_tpu.analysis import verify_program
+
+    main, startup, loss = build_example(name, optimizer=optimize)
     findings = verify_program(main, fetch_list=[loss],
                               raise_on_error=False, site="cli")
     findings += verify_program(startup, raise_on_error=False, site="cli")

@@ -66,6 +66,7 @@ MODULES = [
     "paddle_tpu.net_drawer",
     "paddle_tpu.async_executor",
     "paddle_tpu.parallel",
+    "paddle_tpu.core.passes",
 ]
 
 

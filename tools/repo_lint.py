@@ -21,6 +21,13 @@ tests/test_repo_lint.py):
    ``families.py``'s ``TRACE_SITES`` tuple. A typo'd site would
    fragment a trace across names ``tools/trace_view.py`` can't group —
    and would silently drop out of the dump validator's vocabulary.
+4. **undocumented-pass** — every class registered with
+   ``@register_pass(...)`` must carry a docstring: the pass registry IS
+   the optimizer's catalog (docs/OPTIMIZER.md points at it), and an
+   ``OptimizerPassError`` names the failing pass — a nameable pass with
+   no stated contract is undiagnosable. (The ``paddle_optimizer_*``
+   families a pass records are covered by rule 2 like every other
+   family reference.)
 
 Usage: ``python tools/repo_lint.py [--root DIR]``; exit 1 on violations.
 """
@@ -192,11 +199,36 @@ def trace_site_violations(root: str, files=None) -> List[str]:
     return violations
 
 
+def pass_docstring_violations(root: str, files=None) -> List[str]:
+    """Every ``@register_pass("...")``-decorated class needs a
+    docstring (rule 4 above)."""
+    violations = []
+    for path in (files or iter_py_files(root)):
+        rel = os.path.relpath(path, root)
+        for node in ast.walk(_parse(path)):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for deco in node.decorator_list:
+                fn = deco.func if isinstance(deco, ast.Call) else deco
+                fn_name = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if fn_name != "register_pass":
+                    continue
+                if not ast.get_docstring(node):
+                    violations.append(
+                        "%s:%d: pass class %r is registered via "
+                        "register_pass but has no docstring (the pass "
+                        "registry is the optimizer's catalog)"
+                        % (rel, node.lineno, node.name))
+    return violations
+
+
 def run(root: str = REPO_ROOT) -> List[str]:
     """All violations (empty list = clean). tests/test_repo_lint.py
     asserts on this."""
     return (bare_except_violations(root) + family_ref_violations(root)
-            + trace_site_violations(root))
+            + trace_site_violations(root)
+            + pass_docstring_violations(root))
 
 
 def main(argv=None) -> int:
