@@ -167,6 +167,33 @@ def _bscale():
     return max(1, int(os.environ.get("PADDLE_TPU_BENCH_BATCH_SCALE", "1")))
 
 
+def _kernel_tier_fields():
+    """Row fields for the kernel-tier decisions this workload actually
+    exercised (paddle_tpu.kernels.decisions_seen(), reset per workload):
+
+    * ``kernel_tier`` — op -> choice map ("flash"/"composed"/
+      "pallas:<cfg>"/"bypass"), so a regression is attributable to a
+      specific kernel choice instead of an opaque number;
+    * ``kernel_tuned`` — True when any decision came from a TUNED cache
+      entry rather than the static defaults (pin_baselines treats such
+      rows as incomparable with the default-config baseline);
+    * ``kernels: "off"`` — the PADDLE_TPU_KERNELS=0 bypass ran (also
+      incomparable; the A/B lever's row marker).
+    """
+    from paddle_tpu import kernels
+
+    fields = {}
+    seen = kernels.decisions_seen()
+    if seen:
+        fields["kernel_tier"] = {op: d["choice"]
+                                 for op, d in sorted(seen.items())}
+        if any(d.get("tuned") for d in seen.values()):
+            fields["kernel_tuned"] = True
+    if not kernels.kernels_enabled():
+        fields["kernels"] = "off"
+    return fields
+
+
 def _optimize_level():
     """Effective graph-optimizer level for this worker (core/passes)."""
     from paddle_tpu.core.passes import optimize_level
@@ -228,7 +255,12 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
         steps, warmup = 2, 1
     pallas = _check_pallas_mode(uses_flash)
     import paddle_tpu as fluid
+    from paddle_tpu import kernels as _kernels
     from paddle_tpu.core.scope import Scope, scope_guard
+
+    # per-workload decision ledger: the row must describe THIS run's
+    # kernel choices, not a previous workload's leftovers
+    _kernels.reset_decisions()
 
     main, startup = fluid.Program(), fluid.Program()
     scope = Scope()
@@ -347,6 +379,11 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # "compiled" (Mosaic) / "interpret"; absent on non-attention
             # workloads and on composed-path (unfused) runs
             **({"pallas_mode": pallas} if pallas else {}),
+            # the full kernel-tier decision map rides next to
+            # pallas_mode on EVERY row (attention included), so a
+            # regression is attributable to a specific kernel choice;
+            # kernel_tuned / kernels="off" rows never pin as baselines
+            **_kernel_tier_fields(),
             # attention workloads always say which attention math ran —
             # "flash" (Pallas kernel) or "composed" (XLA-fused dense
             # scores; via either the short-S dispatch or

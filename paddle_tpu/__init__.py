@@ -44,8 +44,8 @@ from . import (  # noqa: F401
     regularizer,
 )
 from . import (contrib, flags, imperative, inference,  # noqa: F401
-               learning_rate_decay, lod_tensor, reader, recordio_writer,
-               resilience, transpiler)
+               kernels, learning_rate_decay, lod_tensor, reader,
+               recordio_writer, resilience, transpiler)
 from .lod_tensor import (LoDTensor, LoDTensorArray, Tensor,  # noqa: F401
                          create_lod_tensor, create_random_int_lodtensor)
 from .reader import batch  # noqa: F401  (paddle.batch top-level parity)

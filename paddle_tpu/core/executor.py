@@ -677,9 +677,14 @@ class Executor:
         sig = tuple(sorted((n, v.shape, str(v.dtype)) for n, v in feed_vals.items()))
         # the optimizer config (level + every output-changing knob) keys
         # the cache too: a plan compiled from the optimized clone must
-        # never serve a differently-configured run
+        # never serve a differently-configured run. Same deal for the
+        # kernel tier: its config_key carries the PADDLE_TPU_KERNELS
+        # switch and the tuned-decision table epoch, so a plan lowered
+        # against one set of tuned winners never serves another
+        from .. import kernels as _kernels
+
         return (program._serial, program.version, _optimizer_config_key(),
-                sig, tuple(fetch_names))
+                _kernels.config_key(), sig, tuple(fetch_names))
 
     def _prepare(self, program: Program, feed_vals, fetch_names, scope) -> _Plan:
         from ..analysis import validation_enabled, verify_program

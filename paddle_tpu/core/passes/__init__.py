@@ -18,6 +18,9 @@ constant_folding_pass                    1   evaluate const-only subgraphs
 copy_propagation_pass                    1   drop assign/share_data copies
 common_subexpression_elimination_pass    1   merge value-identical ops
 dead_op_elimination_pass                 1   fetch-relative backward slice
+fuse_kernel_tier_pass                    2   residual+layernorm pairs and
+                                             optimizer runs -> kernel-tier
+                                             fused ops (PADDLE_TPU_KERNELS)
 fuse_elementwise_pass                    2   chain -> one fused op
 amp_bf16_pass                            1   stamp bf16 policy onto the IR
 ====================================== ===== ==============================
@@ -39,7 +42,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..ir import Graph, get_pass
 from ..program import Program
-from . import amp_pass, cse, fold, fuse  # noqa: F401  (register passes)
+from . import amp_pass, cse, fold, fuse, kernel_fuse  # noqa: F401
 
 __all__ = [
     "PIPELINE",
@@ -61,6 +64,11 @@ PIPELINE = (
     ("copy_propagation_pass", 1),
     ("common_subexpression_elimination_pass", 1),
     ("dead_op_elimination_pass", 1),
+    # kernel-tier fusion BEFORE generic elementwise fusion: the residual
+    # add would otherwise be swallowed into an elementwise chain and the
+    # add->layer_norm seam lost (kernel_fuse.py; PADDLE_TPU_KERNELS=0
+    # makes it a provable no-op)
+    ("fuse_kernel_tier_pass", 2),
     ("fuse_elementwise_pass", 2),
     ("amp_bf16_pass", 1),
 )

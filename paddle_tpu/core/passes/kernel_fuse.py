@@ -1,0 +1,309 @@
+"""Kernel-tier fusion: rewrite hot patterns onto the tier's fused ops.
+
+This is PR 7's fusion machinery pointed at layer 4 (the kernel tier,
+docs/KERNELS.md) instead of at generic elementwise chains. Two rewrites,
+both gated on ``PADDLE_TPU_KERNELS`` (the tier's master switch — with it
+off this pass is a provable no-op):
+
+1. **residual+layernorm** — ``elementwise_add`` feeding a
+   single-producer ``layer_norm`` (the pre-norm transformer's per-layer
+   seam: block N's residual add is block N+1's norm input) collapses
+   into ONE ``fused_layernorm_residual`` op that emits BOTH originals'
+   outputs under their original names, so the program's pre-built
+   backward ops are untouched. Runs BEFORE ``fuse_elementwise_pass`` in
+   the pipeline — the add would otherwise be swallowed into a generic
+   elementwise chain and the pattern lost.
+
+2. **optimizer runs** — a CONSECUTIVE run of >= 2 ``adam``/``sgd`` ops
+   with identical hyperparameters (and param dtype) bundles into ONE
+   ``fused_optimizer_update`` op whose lowering sweeps all params as a
+   single flattened elementwise update. Consecutiveness is the safety
+   argument: nothing executes between the constituents, their writes are
+   verified disjoint, and the only shared read (the learning rate) folds
+   per-element — so the bundle is bitwise the per-op sequence.
+
+Like every pass here, the rewires preserve BITWISE semantics on the
+default (composed) dispatch path; a tuned Pallas winner changes numerics
+only within each kernel's stated tolerance, and only when a tuned cache
+entry exists (never in a fresh process).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..ir import Graph, Node, Pass, PatternMatcher, register_pass
+from ..program import op_effects
+from .common import (Unfingerprintable, attrs_fingerprint, is_pure,
+                     pinned_names, write_counts)
+
+# the shared slot tables (kernels/optimizer_update.py): this pass
+# assembles fused_optimizer_update's ins/outs from the SAME definition
+# the lowering consumes
+from ...kernels.optimizer_update import OPT_IN_SLOTS, OPT_OUT_SLOTS
+
+_OPTIMIZER_KINDS = tuple(sorted(OPT_IN_SLOTS))
+
+
+def _single(op, slot):
+    names = [n for n in (op.inputs.get(slot) or []) if n]
+    return names[0] if len(names) == 1 else None
+
+
+def _single_out(op, slot):
+    names = [n for n in (op.outputs.get(slot) or []) if n]
+    return names[0] if len(names) == 1 else None
+
+
+@register_pass("fuse_kernel_tier_pass")
+class FuseKernelTierPass(Pass):
+    """Rewrite residual+layernorm pairs and consecutive optimizer runs
+    onto the kernel tier's fused ops (``fused_layernorm_residual``,
+    ``fused_optimizer_update``) — see the module docstring for the
+    pattern conditions and the bitwise argument. No-op (``changed``
+    False, zero stats) when ``PADDLE_TPU_KERNELS=0``."""
+
+    fetch_names = frozenset()
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        self.changed = False
+        self.stats: Dict[str, int] = {"ln_residual_fused": 0,
+                                      "optimizer_groups": 0,
+                                      "ops_fused_away": 0}
+        from ... import kernels
+
+        if not kernels.kernels_enabled():
+            return graph
+        program = graph.program
+        counts = write_counts(program)
+        pinned = pinned_names(program)
+        # ORIGINAL program positions + write positions, snapshotted
+        # before either rewrite mutates graph.op_nodes: both rewrites
+        # reason about where ops sat in the PROGRAM, never about where
+        # a prior rewrite's replacement node landed in the node list
+        # (op_nodes adjacency after a removal is not program adjacency)
+        orig_pos = {id(n): i for i, n in enumerate(graph.op_nodes)}
+        write_pos: Dict[str, List[int]] = {}
+        for i, onode in enumerate(graph.op_nodes):
+            for nm in op_effects(program, onode.op)[1]:
+                write_pos.setdefault(nm, []).append(i)
+        n_opt, opt_removed = self._fuse_optimizer_runs(
+            graph, program, counts, pinned, orig_pos)
+        n_ln = self._fuse_ln_residual(graph, program, counts, pinned,
+                                      orig_pos, write_pos)
+        self.stats = {"ln_residual_fused": n_ln,
+                      "optimizer_groups": n_opt,
+                      "ops_fused_away": n_ln + opt_removed}
+        self.changed = (n_ln + n_opt) > 0
+        return graph
+
+    # ------------------------------------------------ residual+layernorm
+    def _fuse_ln_residual(self, graph, program, counts, pinned,
+                          orig_pos, write_pos) -> int:
+        def shapes_equal(*names):
+            shapes = []
+            for n in names:
+                v = program.global_block()._find_var_recursive(n)
+                if v is None or v.shape is None:
+                    return False
+                shapes.append(tuple(v.shape))
+            return len(set(shapes)) == 1
+
+        def add_ok(node: Node) -> bool:
+            op = node.op
+            if not is_pure(program, op):
+                return False
+            x, y = _single(op, "X"), _single(op, "Y")
+            out = _single_out(op, "Out")
+            if not (x and y and out):
+                return False
+            if counts.get(out, 0) != 1 or out in pinned:
+                return False
+            # the fused kernel adds same-shape streams; a broadcasting
+            # bias-add is NOT the residual seam
+            if not shapes_equal(x, y, out):
+                return False
+            try:
+                attrs_fingerprint(op.attrs)
+            except Unfingerprintable:
+                return False
+            return True
+
+        def ln_ok(node: Node) -> bool:
+            op = node.op
+            if not is_pure(program, op):
+                return False
+            if not (_single(op, "Scale") and _single(op, "Bias")):
+                return False  # kernel + fused lowering assume both
+            for slot in ("Y", "Mean", "Variance"):
+                out = _single_out(op, slot)
+                if not out or counts.get(out, 0) != 1:
+                    return False
+            try:
+                attrs_fingerprint(op.attrs)
+            except Unfingerprintable:
+                return False
+            return True
+
+        pm = PatternMatcher()
+        addn = pm.new_op("add", op_type="elementwise_add", pred=add_ok)
+        link = pm.new_var("link",
+                          pred=lambda vn: len(vn.inputs) == 1)
+        lnn = pm.new_op("ln", op_type="layer_norm", pred=ln_ok)
+        pm.feeds(addn, link, slot="Out")
+        pm.feeds(link, lnn, slot="X")
+
+        # snapshotted ORIGINAL positions: moving the add's reads to the
+        # ln's slot is only sound when nothing writes them in between
+        # (the fuse_elementwise chain_safe rule, specialized to one
+        # link). Conservative vs the optimizer rewrite that already
+        # ran: its replacement writes stay within its run's span, which
+        # the original write positions already cover
+        order = orig_pos
+
+        claimed = set()
+        fused = 0
+        for m in sorted(pm.match(graph),
+                        key=lambda m: order[id(m["add"])]):
+            add, ln, link_vn = m["add"], m["ln"], m["link"]
+            if id(add) in claimed or id(ln) in claimed:
+                continue
+            if add.op.attrs.get("__op_role__") \
+                    != ln.op.attrs.get("__op_role__"):
+                continue
+            p_add, p_ln = order[id(add)], order[id(ln)]
+            if p_ln <= p_add:
+                continue
+            # every OTHER consumer of the residual stream must sit at or
+            # after the ln's slot — the fused op produces the name there
+            if any(order.get(id(c), -1) < p_ln for c in link_vn.outputs
+                   if c is not ln):
+                continue
+            moved = [_single(add.op, "X"), _single(add.op, "Y")]
+            if any(p_add < w <= p_ln for n in moved
+                   for w in write_pos.get(n, ())):
+                continue
+            attrs = {"add_attrs": dict(add.op.attrs),
+                     "ln_attrs": dict(ln.op.attrs)}
+            role = add.op.attrs.get("__op_role__")
+            if role:
+                attrs["__op_role__"] = role
+            ins = {"X": [moved[0]], "Residual": [moved[1]],
+                   "Scale": [_single(ln.op, "Scale")],
+                   "Bias": [_single(ln.op, "Bias")]}
+            outs = {"ResOut": [_single_out(add.op, "Out")],
+                    "Y": [_single_out(ln.op, "Y")],
+                    "Mean": [_single_out(ln.op, "Mean")],
+                    "Variance": [_single_out(ln.op, "Variance")]}
+            srcs = [add.op, ln.op]
+            claimed.update((id(add), id(ln)))
+            graph.remove_op_node(add)
+            graph.remove_op_node(ln)
+            graph.insert_op_node("fused_layernorm_residual", ins, outs,
+                                 attrs=attrs, provenance_from=srcs)
+            fused += 1
+        return fused
+
+    # --------------------------------------------------- optimizer runs
+    def _fuse_optimizer_runs(self, graph, program, counts, pinned,
+                             orig_pos):
+        def group_key(op):
+            if op.type not in _OPTIMIZER_KINDS:
+                return None
+            slots = OPT_IN_SLOTS[op.type]
+            outs = OPT_OUT_SLOTS[op.type]
+            names = [_single(op, s) for s in slots]
+            out_names = [_single_out(op, s) for s in outs]
+            if not all(names) or not all(out_names):
+                return None
+            if any(n in pinned for n in names + out_names):
+                return None
+            if any(counts.get(n, 0) != 1 for n in out_names):
+                return None
+            pvar = program.global_block()._find_var_recursive(names[0])
+            if pvar is None or pvar.dtype is None:
+                return None
+            try:
+                fp = attrs_fingerprint(
+                    {k: v for k, v in op.attrs.items()
+                     if not k.startswith("__")})
+            except Unfingerprintable:
+                return None
+            # a per-op __amp__ user override is part of the identity:
+            # ops with different casting overrides must never share a
+            # fused replay (the lowering applies ONE tag per group)
+            return (op.type, op.attrs.get("__op_role__"),
+                    op.attrs.get("__amp__"), pvar.dtype, fp)
+
+        # runs require ORIGINAL-program adjacency (orig_pos delta of
+        # exactly 1), not node-list adjacency: a prior rewrite removing
+        # ops between two optimizer ops must not make them "consecutive"
+        # — the fused op anchors at the run tail, and an op that
+        # genuinely sat between the constituents would then read a
+        # param update too early/late
+        runs: List[List[Node]] = []
+        cur: List[Node] = []
+        cur_key = None
+        for node in sorted((n for n in graph.op_nodes
+                            if id(n) in orig_pos),
+                           key=lambda n: orig_pos[id(n)]):
+            key = group_key(node.op)
+            if key is not None and key == cur_key and cur \
+                    and orig_pos[id(node)] == orig_pos[id(cur[-1])] + 1:
+                cur.append(node)
+                continue
+            if len(cur) >= 2:
+                runs.append(cur)
+            cur, cur_key = ([node], key) if key is not None else ([], None)
+        if len(cur) >= 2:
+            runs.append(cur)
+
+        fused = removed = 0
+        for run in runs:
+            kind = run[0].op.type
+            slots = OPT_IN_SLOTS[kind]
+            out_slots = OPT_OUT_SLOTS[kind]
+            # the fused lowering fetches EVERY input at op entry, so a
+            # LATER constituent reading a name an EARLIER one writes
+            # would see the stale pre-update value (unfused, it reads
+            # the updated one) — reject the run. The other direction
+            # (earlier read, later write) is safe: entry-time fetch and
+            # the unfused sequence both see the pre-update value.
+            # Params are disjoint in real programs; this catches exotic
+            # wiring like sgd(Param=a); sgd(Param=b, Grad=a).
+            ok = True
+            for i, node in enumerate(run):
+                writes = {_single_out(node.op, s) for s in out_slots}
+                for later in run[i + 1:]:
+                    reads = {_single(later.op, s) for s in slots}
+                    if writes & reads:
+                        ok = False
+                        break
+                if not ok:
+                    break
+            if not ok:
+                continue
+            ins = {s: [_single(n.op, s) for n in run] for s in slots}
+            outs = {s: [_single_out(n.op, s) for n in run]
+                    for s in out_slots}
+            hyper = {k: v for k, v in run[0].op.attrs.items()
+                     if not k.startswith("__")}
+            attrs = {"kind": kind, "hyper": hyper}
+            role = run[0].op.attrs.get("__op_role__")
+            if role:
+                attrs["__op_role__"] = role
+            # carried under a NON-dunder key: stamping __amp__ on the
+            # fused op itself would make lower_op's top-level cast
+            # apply the tag to the whole op instead of per constituent
+            amp_tag = run[0].op.attrs.get("__amp__")
+            if amp_tag:
+                attrs["amp_override"] = amp_tag
+            srcs = [n.op for n in run]
+            for node in run:
+                graph.remove_op_node(node)
+            graph.insert_op_node("fused_optimizer_update", ins, outs,
+                                 attrs=attrs, provenance_from=srcs)
+            fused += 1
+            removed += len(run) - 1
+        return fused, removed

@@ -1,0 +1,109 @@
+"""Kernel-tier registry: op name -> Pallas implementation + composed fallback.
+
+The layer-4 analog of ``core/registry.py``'s op registry: where an OpDef
+maps an op type to ONE lowering, a KernelDef maps a hot op to a FAMILY of
+implementations — a Pallas kernel parameterized by a block-shape config,
+and a composed-XLA fallback that is the numerics reference — plus the
+candidate grid the autotuner (``kernels/tune.py``) measures to pick
+between them per input signature.
+
+Contract (enforced by tools/repo_lint.py rule 5, same catalog-is-the-
+registry deal as the pass registry's rule 4): every ``@register_kernel``
+entry MUST declare a ``fallback=`` composed lowering and the decorated
+Pallas implementation MUST carry a docstring. A kernel with no fallback
+has no parity baseline and no composed dispatch target; a kernel with no
+docstring is an undiagnosable catalog entry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["KernelDef", "register_kernel", "get_kernel", "has_kernel",
+           "all_kernels", "KERNELS"]
+
+
+class KernelDef:
+    """One kernel-tier entry.
+
+    ``pallas(cfg, *args, **attrs)`` — the Pallas implementation; ``cfg``
+    is one candidate from ``candidates(sig)`` (a hashable tuple, e.g. a
+    row-block size), or None for the kernel's default blocks.
+    ``fallback(*args, **attrs)`` — the composed-XLA math, structurally
+    identical output pytree; the tuner measures it as the "composed"
+    candidate and dispatch uses it whenever no tuned entry says
+    otherwise. ``signature(args)`` — the (shape, dtype)-derived tuple
+    that keys tuned decisions. ``candidates(sig)`` — Mosaic-legal block
+    configs to measure. ``check(cfg, sig)`` — raises on a Mosaic-illegal
+    (cfg, sig) pair; the tuner asserts it for EVERY candidate, even in
+    deterministic-measurement mode. ``make_inputs(sig, rs)`` — synthetic
+    concrete inputs for measurement (rs: numpy RandomState).
+    """
+
+    def __init__(self, name: str, pallas: Callable, fallback: Callable,
+                 signature: Callable, candidates: Callable,
+                 check: Callable, make_inputs: Callable,
+                 tol: Optional[str] = None):
+        self.name = name
+        self.pallas = pallas
+        self.fallback = fallback
+        self.signature = signature
+        self.candidates = candidates
+        self.check = check
+        self.make_inputs = make_inputs
+        # stated parity tolerance vs the fallback (docs + test anchors)
+        self.tol = tol or "see kernel docstring"
+        self.doc = (pallas.__doc__ or "").strip()
+
+
+KERNELS: Dict[str, KernelDef] = {}
+
+
+def register_kernel(name: str, *, fallback: Callable,
+                    signature: Callable, candidates: Callable,
+                    check: Callable, make_inputs: Callable,
+                    tol: Optional[str] = None):
+    """Decorator over the Pallas implementation:
+
+        @register_kernel("layernorm_residual", fallback=composed_fn, ...)
+        def _layernorm_residual_pallas(cfg, x, r, scale, bias, *, eps):
+            \"\"\"catalog entry docstring\"\"\"
+
+    ``fallback=`` is keyword-REQUIRED by signature and the docstring is
+    enforced here too (not only by repo_lint): an entry that reaches the
+    registry without either would fail at dispatch or in the catalog.
+    """
+
+    def deco(fn: Callable) -> Callable:
+        if name in KERNELS:
+            raise ValueError("kernel %r registered twice" % name)
+        if fallback is None:
+            raise ValueError(
+                "kernel %r must declare a composed fallback= lowering"
+                % name)
+        if not (fn.__doc__ or "").strip():
+            raise ValueError(
+                "kernel %r implementation must carry a docstring (the "
+                "registry is the kernel tier's catalog)" % name)
+        KERNELS[name] = KernelDef(name, fn, fallback, signature,
+                                  candidates, check, make_inputs, tol=tol)
+        return fn
+
+    return deco
+
+
+def get_kernel(name: str) -> KernelDef:
+    if name not in KERNELS:
+        raise KeyError("kernel %r has no registry entry (known: %s)"
+                       % (name, sorted(KERNELS)))
+    return KERNELS[name]
+
+
+def has_kernel(name: str) -> bool:
+    return name in KERNELS
+
+
+def all_kernels() -> List[str]:
+    """Sorted registered kernel names (the catalog docs/KERNELS.md and
+    ``tools/kernel_tune.py --op`` both draw from)."""
+    return sorted(KERNELS)

@@ -401,12 +401,59 @@ _OPTIMIZER_PASSES = (
     "copy_propagation_pass",
     "common_subexpression_elimination_pass",
     "dead_op_elimination_pass",
+    "fuse_kernel_tier_pass",
     "fuse_elementwise_pass",
     "amp_bf16_pass",
 )
 for _p in _OPTIMIZER_PASSES:
     OPTIMIZER_OPS_REMOVED.labels(**{"pass": _p})
     OPTIMIZER_PASS_SECONDS.labels(**{"pass": _p})
+
+# --------------------------------------------------------------- kernels
+# (paddle_tpu/kernels/: the Pallas kernel tier + per-shape autotuner —
+# see docs/KERNELS.md. PADDLE_TPU_KERNELS=0 bypasses the tier; tests pin
+# that NONE of these families move then.)
+KERNEL_TUNER_HITS = REGISTRY.counter(
+    "paddle_kernel_tuner_hits_total",
+    "Tuned-table LOOKUPS served by a winner entry, by tier: 'memory' = "
+    "this process already held the decision, 'disk' = the persisted "
+    "winner cache (PADDLE_TPU_KERNEL_CACHE_DIR) supplied it — a warmed "
+    "second process shows all-disk hits and zero tunes. Lookups, not "
+    "dispatches: flash_effective probes and bench row labeling consult "
+    "the table too; dispatches_total below counts actual dispatches",
+    labels=("tier",))
+for _t in ("memory", "disk"):
+    KERNEL_TUNER_HITS.labels(tier=_t)
+KERNEL_TUNER_MISSES = REGISTRY.counter(
+    "paddle_kernel_tuner_misses_total",
+    "Tuned-table lookups finding no entry anywhere — the caller takes "
+    "its composed/static default (and tunes inline only under "
+    "PADDLE_TPU_KERNEL_TUNE=1). Lookups, not dispatches — see "
+    "tuner_hits_total")
+KERNEL_TUNE_SECONDS = REGISTRY.histogram(
+    "paddle_kernel_tune_seconds",
+    "Wall time of one autotune run over an (op, signature): candidate "
+    "grid measurement + winner persistence; rides prepare, never the "
+    "steady-state step")
+KERNEL_WINNERS = REGISTRY.counter(
+    "paddle_kernel_winners_total",
+    "Tuned winners recorded, by op and choice — 'pallas' = a kernel "
+    "block config beat the composed path at that signature",
+    labels=("op", "choice"))
+KERNEL_DISPATCHES = REGISTRY.counter(
+    "paddle_kernel_dispatches_total",
+    "Kernel-tier dispatches by op and implementation taken. Counted at "
+    "LOWERING time (once per plan-cache miss), not per step — the same "
+    "per-compile semantics as paddle_engine_collectives_total",
+    labels=("op", "impl"))
+# pre-materialize the op schema — kept as a plain tuple HERE (importing
+# kernels would cycle); tests pin it equal to kernels.all_kernels()
+_KERNEL_OPS = ("adam_update", "attention", "layernorm_residual",
+               "sgd_update")
+for _op in _KERNEL_OPS:
+    for _c in ("pallas", "composed"):
+        KERNEL_WINNERS.labels(op=_op, choice=_c)
+        KERNEL_DISPATCHES.labels(op=_op, impl=_c)
 
 # ----------------------------------------------------------------- spans
 SPAN_SECONDS = REGISTRY.histogram(
@@ -457,6 +504,9 @@ TRACE_SITES = (
     # one child span per applied pass — optimization cost shows up in
     # the flight recorder next to the compile it feeds
     "optimizer.pipeline", "optimizer.pass",
+    # kernel tier (kernels/tune.py): one span per autotune run, so a
+    # slow first-compile is attributable to measurement, not a wedge
+    "kernel.tune",
 )
 
 # -------------------------------------------------------- backend/bench

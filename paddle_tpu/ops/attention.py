@@ -52,6 +52,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..core.registry import register_grad_lowering, register_op
+from ..kernels.common import (assert_mosaic_ok, ceil_to, checked_pallas_call,
+                              pad_axis, pad_len, use_interpret)
 
 __all__ = ["flash_attention", "flash_attention_with_lse", "pallas_mode",
            "fused_attention_enabled", "flash_min_seq", "flash_effective",
@@ -97,24 +99,10 @@ def causal_bias_block(s, dtype=None):
         dtype or jnp.float32)[None, None]
 
 
-def _use_interpret() -> bool:
-    """Pallas interpret mode off only on real TPU backends (including the
-    'axon' PJRT tunnel, whose platform name is not 'tpu').
-
-    PADDLE_TPU_FLASH_INTERPRET overrides the autodetect: "1" forces
-    interpret mode (debugging numerics on any backend), "0" forces the
-    compiled Mosaic path (the operator's escape hatch when a renamed
-    tunnel platform defeats the autodetect; bench.py refuses to record a
-    fused row that would run interpret on non-CPU hardware)."""
-    env = _os.environ.get("PADDLE_TPU_FLASH_INTERPRET", "")
-    if env != "":
-        return env != "0"
-    try:
-        dev = jax.devices()[0]
-    except Exception:
-        return True
-    plat = dev.platform.lower()
-    return not (plat in ("tpu", "axon") or "tpu" in dev.device_kind.lower())
+# interpret-mode autodetect: hoisted to kernels/common.py (the whole
+# kernel tier shares the PADDLE_TPU_FLASH_INTERPRET knob); kept under
+# the historical private name for this module's many call sites
+_use_interpret = use_interpret
 
 
 def fused_attention_enabled() -> bool:
@@ -125,7 +113,9 @@ def fused_attention_enabled() -> bool:
 
 
 def flash_min_seq() -> int:
-    """Sequence-length dispatch threshold for the fused-attention op.
+    """STATIC sequence-length dispatch threshold for the fused-attention
+    op — the last tier of the flash-vs-composed precedence (see
+    ``flash_effective``).
 
     Below this, ``flash_attention`` lowers to the COMPOSED XLA math
     (materialized [Sq,Sk] scores — fully fused by XLA, no kernel-launch
@@ -133,12 +123,15 @@ def flash_min_seq() -> int:
     S the score matrix is tiny and the blocked online-softmax scheme
     costs more than it saves. The 2026-07-31 v5e window measured the
     S=128 transformer at 93.6k tok/s on the flash path vs a 103.6k
-    composed baseline — flash pays off at long S, where the composed
-    path's O(S^2) HBM traffic dominates.
+    composed baseline — but those static numbers are SUPERSEDED the
+    moment a tuned kernel-tier entry exists for the sequence lengths in
+    play (``tools/kernel_tune.py --op attention`` measures and persists
+    the real flash-vs-composed winner per shape; docs/KERNELS.md).
 
-    PADDLE_TPU_FLASH_MIN_SEQ overrides (0 forces the kernel always — the
-    hardware A/B lever; a huge value forces composed always). Parsed at
-    call time, not import, per the round-3 advisor rule."""
+    PADDLE_TPU_FLASH_MIN_SEQ overrides BOTH the static default and any
+    tuned entry (0 forces the kernel always — the hardware A/B lever; a
+    huge value forces composed always). Parsed at call time, not
+    import, per the round-3 advisor rule."""
     raw = _os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "256")
     try:
         return int(raw)
@@ -151,9 +144,33 @@ def flash_min_seq() -> int:
 def flash_effective(seq_len: int, kv_len: int = None) -> bool:
     """Whether the fused-attention op would actually run the Pallas
     kernel at these sequence lengths (bench rows label flash vs composed
-    from this, so a short-S run never claims a kernel measurement)."""
-    return max(seq_len, kv_len if kv_len is not None else seq_len) \
-        >= flash_min_seq()
+    from this, so a short-S run never claims a kernel measurement).
+
+    Three-tier precedence, tested in tests/test_flash_dispatch.py:
+
+    1. an EXPLICIT ``PADDLE_TPU_FLASH_MIN_SEQ`` env value wins — the
+       operator's A/B lever stays absolute;
+    2. else a tuned kernel-tier entry for ``("attention", (Sq, Sk))``
+       decides (the measured winner persisted by ``tools/kernel_tune.py``
+       or a PADDLE_TPU_KERNEL_TUNE=1 run; keyed by sequence lengths —
+       batch/heads/head-dim are deliberately coarse, docs/KERNELS.md);
+    3. else the static ``flash_min_seq()`` default (256)."""
+    return _flash_decision(seq_len, kv_len)[0]
+
+
+def _flash_decision(seq_len: int, kv_len: int = None):
+    """(use_flash, from_tuned_entry) per the three-tier precedence."""
+    sq = int(seq_len)
+    sk = int(kv_len) if kv_len is not None else sq
+    s = max(sq, sk)
+    if _os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ") is not None:
+        return s >= flash_min_seq(), False  # tier 1: explicit env wins
+    from .. import kernels
+
+    choice = kernels.tuned_choice("attention", (sq, sk))
+    if choice is not None:
+        return choice == "pallas", True     # tier 2: measured winner
+    return s >= flash_min_seq(), False      # tier 3: static threshold
 
 
 def composed_attention(q, k, v, bias=None, scale=1.0, causal=False):
@@ -188,64 +205,15 @@ def pallas_mode() -> str:
 _NEG = -1e30
 
 
-def _assert_mosaic_ok(block_shape, array_shape, what):
-    """Mirror of Mosaic's _check_block_mappings rule (jax/_src/pallas/
-    mosaic/lowering.py): the last two block dims must be divisible by
-    (8, 128) respectively or equal to the corresponding array dims.
-
-    Runs on every backend — including interpret mode — so the CPU test
-    suite rejects block specs that real-TPU lowering would refuse."""
-    if len(block_shape) < 2 or len(array_shape) < 2:
-        return
-    b2, b1 = block_shape[-2], block_shape[-1]
-    a2, a1 = array_shape[-2], array_shape[-1]
-    if not ((b2 % 8 == 0 or b2 == a2) and (b1 % 128 == 0 or b1 == a1)):
-        raise ValueError(
-            f"Mosaic-illegal BlockSpec for {what}: block {tuple(block_shape)} "
-            f"on array {tuple(array_shape)} — last two block dims must be "
-            f"divisible by (8, 128) or equal to the array dims")
-
-
-def _checked_pallas_call(kern, *, grid, in_specs, operands, out_specs,
-                         out_shape, scratch_shapes, interpret):
-    single_out = not isinstance(out_specs, (list, tuple))
-    specs = list(out_specs) if not single_out else [out_specs]
-    shapes = list(out_shape) if not single_out else [out_shape]
-    for i, (sp, op) in enumerate(zip(in_specs, operands)):
-        _assert_mosaic_ok(sp.block_shape, op.shape, f"inputs[{i}]")
-    for i, (sp, sh) in enumerate(zip(specs, shapes)):
-        _assert_mosaic_ok(sp.block_shape, sh.shape, f"outputs[{i}]")
-    # under shard_map, outputs vary over every mesh axis an operand does
-    # (ring attention runs these kernels per shard)
-    vma = frozenset().union(*(getattr(jax.typeof(x), "vma", frozenset())
-                              for x in operands))
-    if vma:
-        shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
-                  for s in shapes]
-        out_shape = shapes if not single_out else shapes[0]
-    return pl.pallas_call(
-        kern, grid=grid, in_specs=in_specs, out_specs=out_specs,
-        out_shape=out_shape, scratch_shapes=scratch_shapes,
-        interpret=interpret)(*operands)
-
-
-def _ceil_to(n, b):
-    return -(-n // b) * b
-
-
-def _pad_len(S, blk):
-    """Padded length: multiples of blk when blocked, else S (a single
-    block equal to the array dims is Mosaic-legal for any S)."""
-    return _ceil_to(S, blk) if S > blk else S
-
-
-def _pad_axis(x, axis, to, value=0.0):
-    S = x.shape[axis]
-    if S == to:
-        return x
-    cfg = [(0, 0)] * x.ndim
-    cfg[axis] = (0, to - S)
-    return jnp.pad(x, cfg, constant_values=value)
+# Mosaic legality mirror + checked pallas_call + padding helpers were
+# born here and are now SHARED kernel-tier infrastructure
+# (kernels/common.py) — the attention kernels keep their historical
+# private names so the blocked-kernel code below reads unchanged.
+_assert_mosaic_ok = assert_mosaic_ok
+_checked_pallas_call = checked_pallas_call
+_ceil_to = ceil_to
+_pad_len = pad_len
+_pad_axis = pad_axis
 
 
 def _pad_bias(bias, Sq, Sqp, Sk, Skp):
@@ -775,12 +743,26 @@ def flash_attention(q, k, v, bias=None, scale=1.0, bias_grad=False,
             bias = bias + jax.lax.stop_gradient(
                 causal_bias_block(S, bias.dtype))
             causal = False
-    if not flash_effective(q.shape[2], k.shape[2]):
+    from .. import kernels
+
+    use_flash, tuned = _flash_decision(q.shape[2], k.shape[2])
+    kernels.note_decision("attention", "flash" if use_flash else "composed",
+                          tuned=tuned)
+    if kernels.kernels_enabled():
+        from ..observe.families import KERNEL_DISPATCHES
+
+        # same per-compile semantics as the other tier ops (and the
+        # bypass contract: PADDLE_TPU_KERNELS=0 moves nothing)
+        KERNEL_DISPATCHES.labels(
+            op="attention",
+            impl="pallas" if use_flash else "composed").inc()
+    if not use_flash:
         # short-S dispatch: the composed XLA path wins below the
-        # threshold (see flash_min_seq). Same numerics, same bias
-        # semantics (constant mask unless bias_grad — autodiff then
-        # yields the true bias cotangent, like the trainable-bias
-        # kernel)
+        # threshold (see flash_min_seq; a tuned kernel-tier entry
+        # supersedes the static default — precedence in
+        # flash_effective). Same numerics, same bias semantics
+        # (constant mask unless bias_grad — autodiff then yields the
+        # true bias cotangent, like the trainable-bias kernel)
         cbias = bias if (bias is None or bias_grad) \
             else jax.lax.stop_gradient(bias)
         return composed_attention(q, k, v, cbias, scale, causal)
@@ -935,6 +917,86 @@ def _fused_attention(ctx, ins, attrs):
     else:
         mask = jnp.ones_like(out)
     return {"Out": [out * mask], "Mask": [mask]}
+
+
+# ----------------------------------------------------- kernel-tier entry
+# (kernels/registry.py): flash attention in the same catalog as the
+# other tier kernels, so tools/kernel_tune.py can measure its BQ x BK
+# grid against the composed path and persist the winner the
+# flash_effective precedence (tier 2) then serves. Tuning signatures
+# are (Sq, Sk) only — batch/heads/head-dim are fixed at representative
+# values below, a deliberate coarseness documented in docs/KERNELS.md.
+from ..kernels.registry import register_kernel as _register_kernel
+
+_TUNE_B, _TUNE_H, _TUNE_D = 2, 4, 64
+
+
+def _attention_composed(q, k, v, *, scale=1.0, causal=False):
+    return composed_attention(q, k, v, None, scale, causal)
+
+
+def _attn_candidates(sig):
+    sq, sk = sig
+    cands = []
+    for bq in (128, 256, 512):
+        for bk in (128, 256):
+            if bq <= _pad_len(int(sq), bq) and bk <= _pad_len(int(sk), bk):
+                cands.append((bq, bk))
+    return cands or [(128, 128)]
+
+
+def _attn_check(cfg, sig):
+    bq, bk = cfg
+    if bq % 8 or bk % 128 or bq <= 0 or bk <= 0:
+        raise ValueError(
+            "attention candidate (BQ=%s, BK=%s) violates the Mosaic "
+            "tiling rule: BQ must be a positive multiple of 8 and BK a "
+            "positive multiple of 128" % (bq, bk))
+    sq, sk = int(sig[0]), int(sig[1])
+    sp, skp = _pad_len(sq, bq), _pad_len(sk, bk)
+    assert_mosaic_ok((1, min(bq, sp), _TUNE_D), (1, sp, _TUNE_D),
+                     "attention q block")
+    assert_mosaic_ok((1, min(bk, skp), _TUNE_D), (1, skp, _TUNE_D),
+                     "attention k block")
+
+
+def _attn_make_inputs(sig, rs):
+    sq, sk = int(sig[0]), int(sig[1])
+    mk = lambda s: jnp.asarray(
+        rs.randn(_TUNE_B, _TUNE_H, s, _TUNE_D).astype("float32"))
+    return (mk(sq), mk(sk), mk(sk))
+
+
+@_register_kernel(
+    "attention",
+    fallback=_attention_composed,
+    signature=lambda args: (int(args[0].shape[2]), int(args[1].shape[2])),
+    candidates=_attn_candidates,
+    check=_attn_check,
+    make_inputs=_attn_make_inputs,
+    tol="atol 2e-5 fwd / 5e-5 bwd at float32 (tests/test_attention.py)",
+)
+def _attention_pallas(cfg, q, k, v, *, scale=1.0, causal=False):
+    """Flash attention at a forced (BQ, BK) block config: the tuner's
+    measurement wrapper around the production kernels above. The block
+    sizes ride the PADDLE_TPU_FLASH_BQ/BK env (saved and restored) —
+    production dispatch keeps reading those knobs, so a tuned winner is
+    REPORTED as the env pair to pin rather than silently threaded; the
+    tuned entry's flash-vs-composed CHOICE is what flash_effective
+    consumes (precedence tier 2)."""
+    bq, bk = cfg or (128, 128)
+    saved = {name: _os.environ.get(name)
+             for name in ("PADDLE_TPU_FLASH_BQ", "PADDLE_TPU_FLASH_BK")}
+    _os.environ["PADDLE_TPU_FLASH_BQ"] = str(bq)
+    _os.environ["PADDLE_TPU_FLASH_BK"] = str(bk)
+    try:
+        return _fa_maskbias(q, k, v, None, scale, causal)
+    finally:
+        for name, val in saved.items():
+            if val is None:
+                _os.environ.pop(name, None)
+            else:
+                _os.environ[name] = val
 
 
 @register_grad_lowering("fused_attention")

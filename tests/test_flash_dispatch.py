@@ -12,6 +12,19 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _clean_kernel_tier():
+    """Injected tuned entries and dispatch-ledger state must never leak
+    into later tests — even when an assert fails mid-test (the
+    test_kernel_tune.py pattern)."""
+    yield
+    from paddle_tpu import kernels
+    from paddle_tpu.kernels import tune
+
+    tune.reset()
+    kernels.reset_decisions()
+
+
 def _qkv(B=2, H=2, S=64, D=32, seed=0):
     import jax.numpy as jnp
 
@@ -96,6 +109,102 @@ def test_short_seq_causal_and_bias_parity(monkeypatch):
     out_k = A.flash_attention(q, k, v, bias, scale=scale, causal=True)
     np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_k),
                                atol=2e-5)
+
+
+def test_flash_dispatch_precedence_three_tiers(monkeypatch, tmp_path):
+    """Explicit env > tuned kernel-tier entry > static threshold — the
+    documented precedence (flash_effective docstring, docs/KERNELS.md),
+    each tier exercised in isolation."""
+    from paddle_tpu.kernels import tune
+    from paddle_tpu.ops import attention as A
+
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_CACHE_DIR",
+                       str(tmp_path / "kc"))
+    tune.reset()
+
+    # tier 3: no env, no tuned entry -> the static 256 default
+    monkeypatch.delenv("PADDLE_TPU_FLASH_MIN_SEQ", raising=False)
+    assert not A.flash_effective(128)
+    assert A.flash_effective(512)
+
+    # tier 2: a tuned entry supersedes the static threshold (both ways)
+    tune.set_entry("attention", (128, 128),
+                   {"choice": "pallas", "cfg": [128, 128]})
+    tune.set_entry("attention", (512, 512),
+                   {"choice": "composed", "cfg": None})
+    assert A.flash_effective(128)       # tuned flash below the default
+    assert not A.flash_effective(512)   # tuned composed above it
+    assert A.flash_effective(1024)      # untouched sig: static tier
+
+    # tier 1: an explicit env value wins over the tuned entries
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "1024")
+    assert not A.flash_effective(128)
+    assert not A.flash_effective(512)
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "0")
+    assert A.flash_effective(512)
+
+    # the kernel-tier bypass disables tier 2 (back to static), and the
+    # dispatch decision ledger records what ran
+    monkeypatch.delenv("PADDLE_TPU_FLASH_MIN_SEQ", raising=False)
+    monkeypatch.setenv("PADDLE_TPU_KERNELS", "0")
+    assert not A.flash_effective(128)   # tuned flash entry ignored
+
+
+def test_flash_env_keys_the_plan_cache(monkeypatch):
+    """Changing PADDLE_TPU_FLASH_MIN_SEQ mid-process re-prepares: the
+    precedence's tier-1 lever is absolute, so a plan cached under one
+    env value must never be served under another (the flash knobs ride
+    kernels.config_key() into the executor's plan-cache key)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.observe.families import EXECUTOR_CACHE_MISSES
+
+    monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "100000")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data("x", [2, 8, 32], dtype="float32")
+            out = fluid.layers.fused_attention(x, x, x, scale=0.2)
+            loss = fluid.layers.mean(out)
+    scope = Scope()
+    X = np.random.RandomState(0).randn(2, 2, 8, 32).astype(np.float32)
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        exe.run(main, feed={"x": X}, fetch_list=[loss], scope=scope)
+        m0 = EXECUTOR_CACHE_MISSES.value
+        monkeypatch.setenv("PADDLE_TPU_FLASH_MIN_SEQ", "0")
+        exe.run(main, feed={"x": X}, fetch_list=[loss], scope=scope)
+        assert EXECUTOR_CACHE_MISSES.value == m0 + 1  # re-prepared
+        exe.run(main, feed={"x": X}, fetch_list=[loss], scope=scope)
+        assert EXECUTOR_CACHE_MISSES.value == m0 + 1  # then cache-hits
+
+
+def test_tuned_dispatch_same_numerics(monkeypatch, tmp_path):
+    """A tuned 'composed' entry at a kernel-eligible S produces the
+    composed result exactly (the dispatch flip is numerics-neutral),
+    and the decision ledger marks the choice as tuned — what bench rows
+    record as kernel_tuned (pin_baselines then skips them)."""
+    from paddle_tpu import kernels
+    from paddle_tpu.kernels import tune
+    from paddle_tpu.ops import attention as A
+
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_CACHE_DIR",
+                       str(tmp_path / "kc"))
+    monkeypatch.delenv("PADDLE_TPU_FLASH_MIN_SEQ", raising=False)
+    tune.reset()
+    kernels.reset_decisions()
+    q, k, v = _qkv(S=320)
+    scale = q.shape[-1] ** -0.5
+    tune.set_entry("attention", (320, 320),
+                   {"choice": "composed", "cfg": None})
+    out = A.flash_attention(q, k, v, scale=scale)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(A.composed_attention(q, k, v, scale=scale)),
+        rtol=0, atol=0)  # identical: it IS the composed path
+    dec = kernels.decisions_seen()["attention"]
+    assert dec == {"choice": "composed", "tuned": True}
 
 
 def test_fused_attention_op_short_seq_trains(monkeypatch):

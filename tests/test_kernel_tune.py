@@ -1,0 +1,418 @@
+"""Kernel autotuner (paddle_tpu/kernels/tune.py): winner-cache
+round-trip, corrupt/version-skewed files degrading to re-tunes (never
+crashes), concurrent writers through the atomic tmp+rename cycle,
+deterministic-measurement mode, the offline CLI, the two-process
+end-to-end contract (first run tunes and persists, the second process
+serves every signature from disk with ZERO tune invocations — pinned on
+the paddle_kernel_* counters), and the slow perf pin: the measured
+kernel-vs-composed decision beats the static flash threshold by >=1.15x
+steps/sec on a layernorm+residual-heavy workload, with PADDLE_TPU_
+KERNELS=0 provably moving zero paddle_kernel_* counters.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+from paddle_tpu import kernels  # noqa: E402
+from paddle_tpu.kernels import tune  # noqa: E402
+from paddle_tpu.observe.families import (  # noqa: E402
+    KERNEL_TUNE_SECONDS, KERNEL_TUNER_HITS, KERNEL_TUNER_MISSES)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tuner(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_CACHE_DIR", str(tmp_path / "kc"))
+    monkeypatch.delenv("PADDLE_TPU_KERNELS", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_KERNEL_TUNE", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_KERNEL_TUNE_DETERMINISTIC",
+                       raising=False)
+    tune.reset()
+    kernels.reset_decisions()
+    yield
+    tune.reset()
+    kernels.reset_decisions()
+
+
+def _tune_count():
+    return KERNEL_TUNE_SECONDS.labels().count
+
+
+# ----------------------------------------------------------- cache basics
+def test_cache_round_trip(monkeypatch):
+    """tune() persists the winner; a fresh in-memory table (a 'new
+    process') serves it from disk — one disk hit, no second tune."""
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE_DETERMINISTIC", "3")
+    sig = ("float32", 640, 4)
+    dec = tune.tune("sgd_update", sig)
+    assert dec["choice"] in ("pallas", "composed")
+    path = tune.cache_path()
+    assert os.path.exists(path)
+    data = json.load(open(path))
+    assert data["version"] == tune.CACHE_VERSION
+    assert tune.sig_key("sgd_update", sig) in data["entries"]
+
+    tune.reset()  # forget memory: simulate a new process
+    h0 = KERNEL_TUNER_HITS.labels(tier="disk").value
+    t0 = _tune_count()
+    again = tune.lookup("sgd_update", sig)
+    assert again is not None and again["choice"] == dec["choice"]
+    assert KERNEL_TUNER_HITS.labels(tier="disk").value == h0 + 1
+    assert _tune_count() == t0
+
+
+def test_corrupt_cache_degrades_to_miss(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE_DETERMINISTIC", "3")
+    sig = ("float32", 640, 4)
+    tune.tune("sgd_update", sig)
+    path = tune.cache_path()
+    with open(path, "w") as f:
+        f.write("{not json at all")
+    tune.reset()
+    m0 = KERNEL_TUNER_MISSES.labels().value
+    assert tune.lookup("sgd_update", sig) is None  # miss, not a crash
+    assert KERNEL_TUNER_MISSES.labels().value == m0 + 1
+    # and the next tune heals the file
+    tune.tune("sgd_update", sig)
+    assert json.load(open(path))["version"] == tune.CACHE_VERSION
+
+
+def test_version_skew_degrades_to_miss(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE_DETERMINISTIC", "3")
+    sig = ("float32", 640, 4)
+    tune.tune("sgd_update", sig)
+    path = tune.cache_path()
+    data = json.load(open(path))
+    data["version"] = tune.CACHE_VERSION + 1
+    json.dump(data, open(path, "w"))
+    tune.reset()
+    assert tune.lookup("sgd_update", sig) is None
+    # malformed entry values are dropped too
+    json.dump({"version": tune.CACHE_VERSION,
+               "entries": {"sgd_update|float32,640,4":
+                           {"choice": "warp-drive"}}}, open(path, "w"))
+    tune.reset()
+    assert tune.lookup("sgd_update", sig) is None
+
+
+def test_concurrent_writers_never_torch_the_cache():
+    """N threads persisting distinct entries through the read-merge-write
+    cycle: the file stays valid JSON at the current version throughout,
+    and (sequential-consistency floor) at least the last writer's entry
+    survives. A lost-update between simultaneous writers re-tunes; a
+    torn file would crash every later process."""
+    errors = []
+
+    def writer(i):
+        try:
+            for j in range(10):
+                tune.persist_entry("op%d|float32,%d" % (i, j),
+                                   {"choice": "composed", "cfg": None,
+                                    "seconds": 0.001})
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    data = json.load(open(tune.cache_path()))  # valid JSON or this raises
+    assert data["version"] == tune.CACHE_VERSION
+    assert len(data["entries"]) >= 10  # plenty of merges survived
+    # no staging litter left behind
+    d = os.path.dirname(tune.cache_path())
+    assert not [f for f in os.listdir(d) if ".tmp." in f]
+
+
+def test_deterministic_mode_is_stable(monkeypatch):
+    """Same seed -> identical decision (selection is a pure function of
+    the inputs: tier-1 never flakes on timing); candidates' Mosaic
+    legality is still asserted."""
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE_DETERMINISTIC", "11")
+    sig = ("float32", 64, 32)
+    d1 = tune.tune("layernorm_residual", sig)
+    tune.reset()
+    d2 = tune.tune("layernorm_residual", sig)
+    assert (d1["choice"], d1["cfg"]) == (d2["choice"], d2["cfg"])
+    with pytest.raises(ValueError, match="Mosaic-illegal"):
+        tune.tune("layernorm_residual", sig, candidates=[(9,)])
+
+
+def test_crashing_candidate_loses_not_crashes(monkeypatch):
+    """A candidate that raises DURING MEASUREMENT is recorded with
+    infinite cost (it can never win) and reported in the decision."""
+    kdef = kernels.get_kernel("sgd_update")
+
+    def exploding(cfg, *args, **kw):
+        raise RuntimeError("boom at cfg %s" % (cfg,))
+
+    monkeypatch.setattr(kdef, "pallas", exploding)
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE_REPEATS", "1")
+    dec = tune.tune("sgd_update", ("float32", 128, 2))
+    assert dec["choice"] == "composed"
+    assert dec["errors"] and "boom" in dec["errors"][0]
+
+
+def test_real_measurement_picks_a_winner(monkeypatch):
+    """No deterministic seed: actual wall-clock measurement end to end
+    on a tiny signature (whichever side wins, the decision is recorded
+    and persisted)."""
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE_REPEATS", "1")
+    dec = tune.tune("sgd_update", ("float32", 256, 2))
+    assert dec["choice"] in ("pallas", "composed")
+    assert all(t["seconds"] > 0 for t in dec["timings"])
+    assert os.path.exists(tune.cache_path())
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_tunes_and_reports(monkeypatch, capsys):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import kernel_tune as cli
+
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE_DETERMINISTIC", "5")
+    rc = cli.main(["--op", "layernorm_residual", "--shapes", "64x32",
+                   "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    report = json.loads(out)
+    run = report["runs"][0]
+    assert run["winner"]["choice"] in ("pallas", "composed")
+    assert any(c["label"] == "composed" for c in run["candidates"])
+    assert os.path.exists(tune.cache_path())
+
+
+def test_cli_exits_nonzero_on_illegal_candidate(monkeypatch, capsys):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import kernel_tune as cli
+
+    rc = cli.main(["--op", "layernorm_residual", "--shapes", "64x32",
+                   "--candidates", "9"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_rejects_shapes_without_op(capsys):
+    # each op has its own shape grammar: a bare --shapes applied to all
+    # registered ops would crash mid-run after persisting partial
+    # winners — argparse rejects it up front
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import kernel_tune as cli
+
+    with pytest.raises(SystemExit):
+        cli.main(["--shapes", "64x32"])
+    assert "--shapes requires --op" in capsys.readouterr().err
+
+
+# ------------------------------------------------- two-process end-to-end
+_E2E_SCRIPT = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+from paddle_tpu import kernels
+from paddle_tpu.kernels import tune
+from paddle_tpu.observe.families import (KERNEL_TUNE_SECONDS,
+                                         KERNEL_TUNER_HITS,
+                                         KERNEL_TUNER_MISSES)
+import jax.numpy as jnp
+import numpy as np
+
+rs = np.random.RandomState(0)
+x = jnp.asarray(rs.randn(16, 32).astype("float32"))
+sc = jnp.asarray(rs.rand(32).astype("float32"))
+# two distinct ops / signatures through the REAL dispatch path
+kernels.run_kernel("layernorm_residual", (x, x, sc, sc), {"eps": 1e-5})
+p = jnp.asarray(rs.rand(500).astype("float32"))
+one = jnp.full((1,), 0.5, jnp.float32)
+kernels.run_kernel("adam_update", ({
+    "Param": [p], "Grad": [p], "Moment1": [p], "Moment2": [p],
+    "Beta1Pow": [one], "Beta2Pow": [one], "LearningRate": [one]},))
+print(json.dumps({
+    "tunes": KERNEL_TUNE_SECONDS.labels().count,
+    "hits_disk": KERNEL_TUNER_HITS.labels(tier="disk").value,
+    "hits_memory": KERNEL_TUNER_HITS.labels(tier="memory").value,
+    "misses": KERNEL_TUNER_MISSES.labels().value,
+    "decisions": kernels.decisions_seen(),
+}))
+"""
+
+
+def test_autotuner_end_to_end_two_processes(tmp_path):
+    """Acceptance: process 1 (tune-on-miss armed) tunes and persists
+    every dispatched signature; process 2 serves ALL of them from the
+    disk cache with zero tune invocations — pinned via the
+    paddle_kernel_* hit/miss/tune counters each process reports."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_KERNEL_CACHE_DIR": str(tmp_path / "shared"),
+        "PADDLE_TPU_KERNEL_TUNE": "1",
+        "PADDLE_TPU_KERNEL_TUNE_DETERMINISTIC": "9",
+    })
+
+    def run_once():
+        out = subprocess.run(
+            [sys.executable, "-c", _E2E_SCRIPT], env=env, cwd=ROOT,
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    first = run_once()
+    assert first["tunes"] == 2          # one tune per signature
+    assert first["misses"] == 2
+    assert first["hits_disk"] == 0
+    second = run_once()
+    assert second["tunes"] == 0         # EVERY signature from the cache
+    assert second["misses"] == 0
+    assert second["hits_disk"] == 2
+    # and both processes took the same (tuned) decisions
+    assert second["decisions"] == first["decisions"]
+
+
+def test_inline_tune_does_not_strand_the_plan_cache(monkeypatch):
+    """PADDLE_TPU_KERNEL_TUNE=1: the inline tune during _prepare bumps
+    the decision-table epoch the plan-cache key embeds — the executor
+    must store the plan under the POST-prepare key, or the very next
+    run of the same program misses and recompiles an identical plan."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.observe.families import EXECUTOR_CACHE_MISSES
+
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE", "1")
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE_DETERMINISTIC", "4")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[4, 32],
+                                  dtype="float32")
+            s = fluid.layers.elementwise_add(x, x)
+            h = fluid.layers.layer_norm(s, begin_norm_axis=2)
+            loss = fluid.layers.reduce_mean(h)
+    scope = Scope()
+    X = np.random.RandomState(0).randn(2, 4, 32).astype(np.float32)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        exe.run(main, feed={"x": X}, fetch_list=[loss.name], scope=scope)
+        assert _tune_count() > 0, "the dispatch must have tuned inline"
+        m0 = EXECUTOR_CACHE_MISSES.value
+        exe.run(main, feed={"x": X}, fetch_list=[loss.name], scope=scope)
+        assert EXECUTOR_CACHE_MISSES.value == m0  # cache HIT, no re-prep
+
+
+# --------------------------------------------------------- slow perf pin
+_S, _DM, _H = 256, 64, 2
+
+
+def _attn_ln_stack(n_blocks=2):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[_S, _DM],
+                                  dtype="float32")
+            h = x
+            for _ in range(n_blocks):
+                q = fluid.layers.fc(h, size=_DM, num_flatten_dims=2)
+                qh = fluid.layers.transpose(
+                    fluid.layers.reshape(q, [0, _S, _H, _DM // _H]),
+                    [0, 2, 1, 3])
+                att = fluid.layers.fused_attention(
+                    qh, qh, qh, scale=(_DM // _H) ** -0.5)
+                att = fluid.layers.reshape(
+                    fluid.layers.transpose(att, [0, 2, 1, 3]),
+                    [0, _S, _DM])
+                s1 = fluid.layers.elementwise_add(h, att)
+                h = fluid.layers.layer_norm(s1, begin_norm_axis=2)
+                f = fluid.layers.fc(h, size=_DM, num_flatten_dims=2,
+                                    act="relu")
+                s2 = fluid.layers.elementwise_add(h, f)
+                h = fluid.layers.layer_norm(s2, begin_norm_axis=2)
+            loss = fluid.layers.reduce_mean(h)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+@pytest.mark.slow
+def test_tuned_tier_beats_bypass_on_ln_heavy_workload(monkeypatch):
+    """Acceptance: >= 1.15x steps/sec with the kernel tier ON (tuned)
+    vs the PADDLE_TPU_KERNELS=0 bypass on a layernorm+residual-heavy
+    workload, AND the bypass provably moves zero paddle_kernel_*
+    counters.
+
+    The mechanism under test is MEASURED per-shape selection beating the
+    static flash_min_seq heuristic: at S=256 the static threshold sends
+    fused_attention to the Pallas kernel, which on this CPU box runs
+    interpret mode — the tuner measures that against the composed path
+    and pins the (much faster here) composed winner. On TPU hardware the
+    same machinery flips the decision the other way at long S; either
+    way dispatch follows the measurement, not the constant. The tier-on
+    leg also exercises the fused layernorm+residual and optimizer-sweep
+    rewrites. Calibrated best-of-5 ratio, no absolute-ms asserts."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.observe.families import REGISTRY
+
+    # the suite-wide FLASH_MIN_SEQ=0 pin would win over tuned entries
+    # (precedence tier 1) — this test exercises tiers 2/3
+    monkeypatch.delenv("PADDLE_TPU_FLASH_MIN_SEQ", raising=False)
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_TUNE_REPEATS", "1")
+
+    def kernel_counters():
+        return {k: v["samples"]
+                for k, v in REGISTRY.snapshot()["metrics"].items()
+                if k.startswith("paddle_kernel")}
+
+    def steps_per_sec(kernels_on, steps=3):
+        monkeypatch.setenv("PADDLE_TPU_KERNELS",
+                           "1" if kernels_on else "0")
+        tune.reset()
+        if kernels_on:
+            # REAL measurement: interpret-mode flash vs composed at this
+            # shape; one candidate keeps the tune cheap
+            dec = tune.tune("attention", (_S, _S),
+                            candidates=[(128, 128)])
+            assert dec["choice"] == "composed", \
+                "on CPU the composed path must out-measure interpret"
+        main, startup, loss = _attn_ln_stack()
+        scope = Scope()
+        X = np.random.RandomState(0).randn(2, _S, _DM) \
+            .astype(np.float32)
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            exe.run(main, feed={"x": X}, fetch_list=[loss.name],
+                    scope=scope)  # compile
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                vals = exe.run(main, feed={"x": X},
+                               fetch_list=[loss.name], scope=scope)
+            float(np.asarray(vals[0]).reshape(-1)[0])
+            dt = time.perf_counter() - t0
+        return steps / dt
+
+    best = 0.0
+    for _attempt in range(5):
+        before = kernel_counters()
+        sps_off = steps_per_sec(False)
+        assert kernel_counters() == before, \
+            "PADDLE_TPU_KERNELS=0 must move zero paddle_kernel_* counters"
+        sps_on = steps_per_sec(True)
+        best = max(best, sps_on / sps_off)
+        if best >= 1.15:
+            break
+    assert best >= 1.15, \
+        "tier-on/bypass steps/sec ratio %.3f" % best

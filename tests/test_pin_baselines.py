@@ -142,3 +142,24 @@ def test_cpu_platform_rows_never_pin(tmp_path):
          "platform": "cpu"}])
     assert "CPU backend" in proc.stdout
     assert base[ROW] == 509.8
+
+
+def test_kernel_tuned_and_bypass_rows_never_pin(tmp_path):
+    # rows whose kernel-tier decisions differ from the default config —
+    # a tuned winner cache was active, or PADDLE_TPU_KERNELS=0 bypassed
+    # the tier — compiled different kernels and are incomparable with
+    # the plain-config baseline
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 9999.0, "steps_per_call": 10,
+         "kernel_tier": {"attention": "composed"}, "kernel_tuned": True},
+        {"metric": RESNET, "value": 9999.0, "steps_per_call": 10,
+         "kernels": "off"}])
+    assert proc.stdout.count("kernel-tier") == 2
+    assert base[ROW] == 509.8
+    assert base[RESNET] == 2272.1
+    # the decision MAP alone (default choices, nothing tuned, tier on)
+    # stays pinnable: it is the default config, just labeled
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 9999.0, "steps_per_call": 10,
+         "kernel_tier": {"attention": "flash"}}])
+    assert base[ROW] == 9999.0

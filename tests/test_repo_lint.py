@@ -184,3 +184,45 @@ def test_repo_uses_only_declared_trace_sites():
     # test_repo_is_clean, kept separate so a trace-site regression
     # names the rule in the failure)
     assert repo_lint.trace_site_violations(ROOT) == []
+
+
+def test_kernel_registry_rule_detected(tmp_path):
+    # rule 5: a register_kernel entry without fallback= or without a
+    # docstring is a violation; a complete entry (and undecorated
+    # functions) stay silent
+    bad = (
+        "def _register_kernel(name, **kw):\n"  # aliased import form:
+        "    def deco(fn):\n        return fn\n    return deco\n"
+        '@_register_kernel("k1")\n'            # must still be caught
+        "def no_fallback_no_doc(cfg):\n    return cfg\n"
+    )
+    root = _fake_repo(tmp_path, "x = 1\n", bad)
+    out = repo_lint.kernel_registry_violations(root)
+    assert len(out) == 2
+    assert any("fallback" in v for v in out)
+    assert any("docstring" in v for v in out)
+    good = (
+        "def register_kernel(name, **kw):\n"
+        "    def deco(fn):\n        return fn\n    return deco\n"
+        "def composed(*a):\n    return a\n"
+        '@register_kernel("k1", fallback=composed)\n'
+        'def documented(cfg):\n    """Catalog entry."""\n    return cfg\n'
+        "def plain():\n    pass\n"
+    )
+    root2 = _fake_repo(tmp_path / "second", "x = 1\n", good)
+    assert repo_lint.kernel_registry_violations(root2) == []
+
+
+def test_repo_kernel_registry_entries_are_complete():
+    # subset of test_repo_is_clean: every real @register_kernel entry
+    # declares fallback= and carries a docstring (rule 5)
+    assert repo_lint.kernel_registry_violations(ROOT) == []
+
+
+def test_kernel_op_schema_matches_registry():
+    # families.py pre-materializes the per-op kernel series from a plain
+    # tuple (importing kernels would cycle); it must track the registry
+    from paddle_tpu.kernels import all_kernels
+    from paddle_tpu.observe.families import _KERNEL_OPS
+
+    assert tuple(all_kernels()) == _KERNEL_OPS

@@ -95,6 +95,16 @@ def main():
                   "pipelined/serving rows never pin over the plain-config "
                   "baseline" % name)
             continue
+        if row.get("kernel_tuned") or row.get("kernels") == "off":
+            # a tuned kernel-tier cache or the PADDLE_TPU_KERNELS=0
+            # bypass compiled DIFFERENT kernels than the default config:
+            # the numbers are incomparable with (and must never
+            # re-anchor) the plain-config baseline
+            print("SKIP %s: kernel-tier decisions differ from the "
+                  "default config (tuned cache entries or "
+                  "PADDLE_TPU_KERNELS=0) — incomparable with the "
+                  "plain-config baseline" % name)
+            continue
         if row.get("quick"):
             print("SKIP %s: --quick smoke row (tiny batch) never pins "
                   "as a baseline" % name)

@@ -41,6 +41,7 @@ MODULES = [
     "paddle_tpu.imperative",
     "paddle_tpu.imperative.nn",
     "paddle_tpu.inference",
+    "paddle_tpu.kernels",
     "paddle_tpu.serving",
     "paddle_tpu.resilience",
     "paddle_tpu.observe",
