@@ -1082,6 +1082,89 @@ def bench_serving_predictor(amp, quick, uses_flash=False):
         shutil.rmtree(model_dir, ignore_errors=True)
 
 
+def bench_serving_fleet(amp, quick, uses_flash=False):
+    """Fleet-tier serving under a shared-prefix arrival mix: a
+    2-replica router with a SHARED prefix store and a speculative
+    draft model, driven by tools/serving_load.py's open-loop generator
+    (80% of requests share one system-prompt head). Reports aggregate
+    tokens/sec + p50/p99 and the two fleet rates — prefix_hit_rate and
+    spec_accept_rate — that tell whether the cache and the draft are
+    earning their keep. Rows are marked "fleet" (and "serving"):
+    pin_baselines treats them as incomparable with non-fleet rows."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        from serving_load import drive
+    finally:
+        _sys.path.pop(0)
+    from paddle_tpu.observe.families import (SERVING_SPEC_ACCEPT_RATE,
+                                             SERVING_TOKENS_PER_SEC)
+    from paddle_tpu.serving import DecodeEngine, PrefixStore, ReplicaRouter
+
+    vocab, max_len = 1024, 160
+    P, prefix_len, n_new = 96, 64, 8 if quick else 16
+    n_req = 12 if quick else 64
+    b_max = 2 if quick else 4
+    cfg = dict(d_model=128, d_ff=512, n_head=4, n_layer=4, vocab=vocab,
+               max_length=max_len, dropout=0.0)
+    draft = dict(d_model=32, d_ff=128, n_head=2, n_layer=1, vocab=vocab,
+                 max_length=max_len, dropout=0.0)
+    store = PrefixStore(256 << 20)
+
+    def factory(idx):
+        return DecodeEngine(cfg, params=None, b_max=b_max,
+                            max_len=max_len, prefix_store=store,
+                            draft_cfg=draft, spec_k=3,
+                            queue_capacity=max(64, 2 * n_req))
+
+    router = ReplicaRouter(factory, n_replicas=2,
+                           stall_deadline_s=30.0)
+    try:
+        _log("serving_fleet: warmup (compiles both replicas' prefill/"
+             "decode/verify programs)")
+        with _beacon("serving_fleet", "compile/warmup"):
+            rs = np.random.RandomState(0)
+            warm = rs.randint(1, vocab, (P,)).astype("int64")
+            t0 = time.perf_counter()
+            router.submit(warm, n_new,
+                          prefix_len=prefix_len).result(timeout=600)
+            per_req = time.perf_counter() - t0
+            router.submit(warm, n_new,
+                          prefix_len=prefix_len).result(timeout=600)
+        mean_gap = max(per_req / (2 * b_max), 1e-4)
+        _log("serving_fleet: open-loop drive (%d requests, 80%% shared "
+             "%d-token prefix)" % (n_req, prefix_len))
+        stats = drive(router, n_req, mean_gap, seed=1, vocab=vocab,
+                      prompt_len=P, n_new=n_new, prefix_share=0.8,
+                      prefix_len=prefix_len)
+        SERVING_TOKENS_PER_SEC.set(stats["tokens_per_sec"])
+        if stats["spec_accept_rate"] is not None:
+            SERVING_SPEC_ACCEPT_RATE.set(stats["spec_accept_rate"])
+        # drive() already measured completion-time percentiles: ride
+        # them in through extra (update runs before the row prints)
+        return _serving_row(
+            "serving_fleet_tokens_per_sec", stats["tokens_per_sec"],
+            "tokens/sec", [],
+            {"fleet": True, "replicas": 2, "b_max": b_max,
+             "requests": n_req, "n_new": n_new,
+             **({"quick": True} if quick else {}),
+             "prefix_share": 0.8,
+             "p50_ms": (None if stats["p50_ms"] is None
+                        else round(stats["p50_ms"], 2)),
+             "p99_ms": (None if stats["p99_ms"] is None
+                        else round(stats["p99_ms"], 2)),
+             "prefix_hit_rate": (None if stats["prefix_hit_rate"] is None
+                                 else round(stats["prefix_hit_rate"], 3)),
+             "spec_accept_rate": (None if stats["spec_accept_rate"] is None
+                                  else round(stats["spec_accept_rate"],
+                                             3)),
+             "outcomes": stats["outcomes"]})
+    finally:
+        router.close()
+
+
 WORKLOADS = {
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
@@ -1097,10 +1180,11 @@ WORKLOADS = {
 # schedulers (docs/SERVING.md): open-loop load through the
 # micro-batched Predictor and the continuous-batching decode engine.
 # Rows are marked "serving" and never pin as training baselines.
-SERVING_ORDER = ["serving_predictor", "serving_decode"]
+SERVING_ORDER = ["serving_predictor", "serving_decode", "serving_fleet"]
 SERVING_WORKLOADS = {
     "serving_predictor": bench_serving_predictor,
     "serving_decode": bench_serving_decode,
+    "serving_fleet": bench_serving_fleet,
 }
 WORKLOADS.update(SERVING_WORKLOADS)
 

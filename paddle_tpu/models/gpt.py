@@ -471,6 +471,164 @@ def build_decode_step(cfg=None, batch=1, max_len=None,
     return logits, cache_names
 
 
+def build_multi_token_decode_step(cfg=None, batch=1, steps=2,
+                                  max_len=None):
+    """S tokens per slot in ONE dispatch, against the decode caches.
+
+    The fixed-shape primitive the fleet tier composes twice
+    (serving/engine.py):
+
+    * **speculative verification** — the target model scores a slot's
+      current token plus its k draft tokens (S = k + 1) in one
+      dispatch; greedy acceptance walks the S logits rows.
+    * **suffix prefill after a prefix-cache hit** — a prompt whose
+      first L tokens were spliced from the prefix store prefills only
+      its S = P - L suffix (batch=1).
+
+    Feeds: ``token`` [B, S] int64 and ``pos`` [B, S] int64 where every
+    row MUST be contiguous ascending (``pos[b] = start_b + arange(S)``)
+    — the per-layer cache write is one vmapped slab update at
+    ``pos[:, 0]``, so non-contiguous rows would silently write the slab
+    at the wrong rows. The caller also guarantees
+    ``pos[b, -1] < max_len`` for every row: ``dynamic_update_slice``
+    CLAMPS an overflowing start and would shift the write window down
+    over valid rows (the engine degrades to plain single-token steps
+    near the cache end for exactly this reason).
+
+    Per-slot semantics match ``build_serving_decode_step``: query row
+    (b, s) sees cache rows ``<= pos[b, s]`` (later rows — including the
+    speculative K/V this very dispatch writes — are masked to exact
+    zeros), every op is row-local, and cache/parameter names are shared
+    with ``build_decode_step``. Attention is computed PER POSITION with
+    exactly the decode step's shapes (q folded [B, n_kv, g, Dh], one
+    M=g matmul against the n_kv cache, per-position visibility bias):
+    the S-wide GEMM variant is NOT bitwise the step's M=g form on CPU
+    (a GEMV reduces in a different order than a GEMM), and the fleet
+    tier's whole contract is that a verified/suffix-prefilled token
+    stream is bitwise ``generate``'s — so position s's logits are the
+    plain step's BY CONSTRUCTION, not by tolerance. S stays small in
+    both uses (k+1 drafts, the un-cached prompt suffix), so the op
+    count is bounded.
+
+    Returns (logits_var, cache_names); fetch logits [B, S, vocab]."""
+    cfg = cfg or base_config()
+    _check_cfg(cfg)
+    if max_len is None:
+        max_len = cfg["max_length"]
+    S = int(steps)
+    assert 0 < S <= max_len, (S, max_len)
+    use_rope = cfg.get("pos_emb", "learned") == "rope"
+    if not use_rope and max_len > cfg["max_length"]:
+        raise ValueError(
+            "max_len=%d exceeds the learned position table "
+            "(cfg['max_length']=%d) — raise max_length or use "
+            "pos_emb='rope'" % (max_len, cfg["max_length"]))
+    d_model, n_head = cfg["d_model"], cfg["n_head"]
+    d_head = d_model // n_head
+    n_kv, g = _kv_heads_of(cfg)
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("gpt_multi_decode")
+    token = layers.data("token", [S], dtype="int64")   # [B, S]
+    pos = layers.data("pos", [S], dtype="int64")       # [B, S]
+
+    # explicit [B, S, D] reshape: lookup_table squeezes trailing-1 id
+    # dims, so S=1 (a one-token suffix) would otherwise come out [B, D]
+    word = layers.reshape(
+        layers.embedding(token, [cfg["vocab"], d_model],
+                         param_attr=ParamAttr(name="gpt_word_emb")),
+        [-1, S, d_model])
+    if use_rope:
+        x = word                             # positions rotate q/k below
+    else:
+        posv = layers.reshape(
+            layers.embedding(pos, [cfg["max_length"], d_model],
+                             param_attr=ParamAttr(name="gpt_pos_emb")),
+            [-1, S, d_model])
+        x = layers.elementwise_add(word, posv)
+
+    # per-position [B, 1] position columns + the decode step's exact
+    # visibility bias per position: query (b, s) attends cache rows
+    # <= pos[b, s]; everything later — a neighbor's rows, this
+    # dispatch's own still-speculative writes — masks to an exact zero
+    # after softmax
+    ar = layers.reshape(layers.range(0, max_len, 1, "int64"),
+                        [1, max_len])
+    pos_cols, biases = [], []
+    for s in range(S):
+        ps = layers.slice(pos, axes=[1], starts=[s], ends=[s + 1])
+        pos_cols.append(ps)                              # [B, 1]
+        vis = layers.cast(layers.less_equal(ar, ps), "float32")
+        b_s = layers.scale(layers.elementwise_sub(
+            layers.fill_constant([1], "float32", 1.0), vis), scale=-1e9)
+        biases.append(layers.reshape(b_s, [-1, 1, 1, max_len]))
+
+    cache_names = []
+    for i in range(cfg["n_layer"]):
+        nm = "gpt_%d" % i
+        ck = helper.create_global_variable(
+            name=nm + "_cache_k", shape=(batch, n_kv, max_len, d_head))
+        cv = helper.create_global_variable(
+            name=nm + "_cache_v", shape=(batch, n_kv, max_len, d_head))
+        cache_names += [ck.name, cv.name]
+
+        h = _norm_of(cfg, x, nm + "_pre1")
+        q = layers.fc(h, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=ParamAttr(name=nm + "_att_q.w_0"))
+        k = layers.fc(h, n_kv * d_head, num_flatten_dims=2,
+                      bias_attr=False,
+                      param_attr=ParamAttr(name=nm + "_att_k.w_0"))
+        v = layers.fc(h, n_kv * d_head, num_flatten_dims=2,
+                      bias_attr=False,
+                      param_attr=ParamAttr(name=nm + "_att_v.w_0"))
+
+        def kv_heads(t):
+            t = layers.reshape(t, [-1, S, n_kv, d_head])
+            return layers.transpose(t, perm=[0, 2, 1, 3])  # [B,n_kv,S,Dh]
+
+        k, v = kv_heads(k), kv_heads(v)
+        if use_rope:
+            # [B, S] positions -> per-(row, step) angles broadcast over
+            # the kv-head axis (elementwise — bitwise the per-position
+            # rotation); the cache stores rotated keys
+            k = layers.rope(k, pos)
+        # ONE vmapped slab write per cache tensor at the per-row start
+        # (rows are contiguous by contract)
+        ck = layers.kv_cache_write(ck, k, pos_cols[0])
+        cv = layers.kv_cache_write(cv, v, pos_cols[0])
+        # attention per position, in the decode step's exact shapes:
+        # q_s folds to [B, n_kv, g, Dh] and batch-matmuls the n_kv
+        # cache directly — scores/softmax/ctx of position s are the
+        # single-token step's bit for bit (an S-wide GEMM would not be)
+        ctxs = []
+        for s in range(S):
+            q_s = layers.reshape(
+                layers.slice(q, axes=[1], starts=[s], ends=[s + 1]),
+                [-1, n_kv, g, d_head])
+            if use_rope:
+                q_s = layers.rope(q_s, pos_cols[s])
+            scores = layers.matmul(q_s, ck, transpose_y=True,
+                                   alpha=d_head ** -0.5)  # [B,n_kv,g,S']
+            scores = layers.elementwise_add(scores, biases[s])
+            w = layers.softmax(scores)
+            ctxs.append(layers.reshape(layers.matmul(w, cv),
+                                       [-1, 1, d_model]))
+        ctxv = ctxs[0] if S == 1 else layers.concat(ctxs, axis=1)
+        att = layers.fc(ctxv, d_model, num_flatten_dims=2,
+                        bias_attr=False,
+                        param_attr=ParamAttr(name=nm + "_att_o.w_0"))
+        x = layers.elementwise_add(x, att)
+
+        h2 = _norm_of(cfg, x, nm + "_pre2")
+        f = _ffn(h2, d_model, cfg["d_ff"], nm,
+                 act=cfg.get("ffn_act", "relu"))
+        x = layers.elementwise_add(x, f)
+
+    x = _final_norm(cfg, x)
+    logits = _lm_head(cfg, x)
+    return logits, cache_names
+
+
 def build_serving_decode_step(cfg=None, batch=1, max_len=None):
     """Continuous-batching decode step: ``build_decode_step`` with
     PER-SLOT positions. Feeds are token [B, 1] int64 (each slot's

@@ -181,10 +181,14 @@ SERVING_DEADLINE_EXPIRATIONS = REGISTRY.counter(
     "failed with DeadlineExpired at pop time, never dispatched")
 SERVING_REQUESTS = REGISTRY.counter(
     "paddle_serving_requests_total",
-    "Serving requests by terminal outcome", labels=("outcome",))
+    "Serving requests by terminal outcome and tenant. Cardinality is "
+    "bounded by contract: tenant ids are deployment configuration "
+    "(router quota keys; 'default' when unset), never caller free text",
+    labels=("outcome", "tenant"))
 for _o in ("ok", "rejected", "expired", "cancelled", "error"):
-    # pre-materialize the schema (same pattern as the RPC methods)
-    SERVING_REQUESTS.labels(outcome=_o)
+    # pre-materialize the schema (same pattern as the RPC methods);
+    # only the default tenant — real tenants appear as they submit
+    SERVING_REQUESTS.labels(outcome=_o, tenant="default")
 SERVING_REQUEST_SECONDS = REGISTRY.histogram(
     "paddle_serving_request_seconds",
     "End-to-end request latency (submit to completion), observed for "
@@ -236,8 +240,9 @@ SERVING_RETIRED = REGISTRY.counter(
     "slot frees immediately instead of idling until the batch drains")
 SERVING_DECODE_STEPS = REGISTRY.counter(
     "paddle_serving_decode_steps_total",
-    "Continuous-batching decode dispatches (each advances every active "
-    "slot by one token)")
+    "Continuous-batching PLAIN decode dispatches (each advances every "
+    "active slot by one token); speculative iterations count into "
+    "paddle_serving_spec_verify_steps_total instead")
 SERVING_TOKENS = REGISTRY.counter(
     "paddle_serving_tokens_total",
     "Tokens generated by the continuous-batching engine (prefill-"
@@ -250,6 +255,90 @@ SERVING_PREFILL_PROGRAMS = REGISTRY.counter(
     "paddle_serving_prefill_programs_total",
     "Distinct prompt lengths the engine compiled a prefill executable "
     "for — sustained growth = prompt-length churn; bucket prompts")
+
+# ------------------------------------------- serving: fleet tier
+# (serving/prefix.py, serving/router.py and the engine's speculative
+# decode — see docs/SERVING.md "The fleet tier")
+SERVING_PREFIX_HITS = REGISTRY.counter(
+    "paddle_serving_prefix_hits_total",
+    "Admissions whose prompt matched a stored prefix: the cached K/V "
+    "rows were spliced and only the suffix prefilled")
+SERVING_PREFIX_MISSES = REGISTRY.counter(
+    "paddle_serving_prefix_misses_total",
+    "Prefix-store lookups finding no usable stored prefix (full "
+    "prefill taken); only counted while a store is attached")
+SERVING_PREFIX_TOKENS_SAVED = REGISTRY.counter(
+    "paddle_serving_prefix_tokens_saved_total",
+    "Prompt tokens NOT prefilled because a stored prefix covered them "
+    "(sum of hit lengths) — the cache's work-avoidance in tokens")
+SERVING_PREFIX_INSERTS = REGISTRY.counter(
+    "paddle_serving_prefix_inserts_total",
+    "Prefixes stored (first sighting of a registered prefix boundary)")
+SERVING_PREFIX_EVICTIONS = REGISTRY.counter(
+    "paddle_serving_prefix_evictions_total",
+    "LRU evictions from the byte-capped prefix store — sustained "
+    "growth = the cap is smaller than the live shared-prefix set")
+SERVING_PREFIX_ENTRIES = REGISTRY.gauge(
+    "paddle_serving_prefix_entries",
+    "Prefixes currently resident in the store")
+SERVING_PREFIX_BYTES = REGISTRY.gauge(
+    "paddle_serving_prefix_bytes",
+    "Host bytes held by stored prefix K/V rows (capped by the store's "
+    "max_bytes)")
+SERVING_SPEC_PROPOSED = REGISTRY.counter(
+    "paddle_serving_spec_proposed_tokens_total",
+    "Draft tokens proposed by the speculative decoder (k per "
+    "speculative slot per verify step)")
+SERVING_SPEC_ACCEPTED = REGISTRY.counter(
+    "paddle_serving_spec_accepted_tokens_total",
+    "Draft tokens the target model's greedy verification accepted; "
+    "accepted/proposed is THE speculative win rate — at 0 the engine "
+    "pays draft cost for nothing, switch the draft model off")
+SERVING_SPEC_VERIFY_STEPS = REGISTRY.counter(
+    "paddle_serving_spec_verify_steps_total",
+    "Target-model verify dispatches (each scores k+1 positions per "
+    "slot in ONE dispatch; plain slots ride the same dispatch)")
+SERVING_SPEC_DRAFT_STEPS = REGISTRY.counter(
+    "paddle_serving_spec_draft_steps_total",
+    "Draft-model decode dispatches (k per verify step, plus the "
+    "mirror-advance step a plain iteration takes while speculative "
+    "slots are in the batch)")
+SERVING_SPEC_ACCEPT_RATE = REGISTRY.gauge(
+    "paddle_serving_spec_accept_rate",
+    "accepted/proposed draft-token ratio over the last completed "
+    "bench drive interval (set by the serving bench; 0 outside runs)")
+SERVING_ROUTER_ROUTED = REGISTRY.counter(
+    "paddle_serving_router_routed_total",
+    "Requests the router dispatched, by replica slot index (stable "
+    "across restarts — bounded by the replica count); re-admissions "
+    "count again at their new replica", labels=("replica",))
+SERVING_ROUTER_REJECTED = REGISTRY.counter(
+    "paddle_serving_router_rejected_total",
+    "Router admission rejections: 'quota' = the tenant's in-flight "
+    "cap, 'slo' = projected queue wait exceeded the request deadline "
+    "(reject-early: the caller hears no at submit, not after the "
+    "deadline burned in a queue), 'backpressure' = every healthy "
+    "replica's queue was full", labels=("reason",))
+for _r in ("quota", "slo", "backpressure"):
+    SERVING_ROUTER_REJECTED.labels(reason=_r)
+SERVING_ROUTER_READMITTED = REGISTRY.counter(
+    "paddle_serving_router_readmitted_total",
+    "In-flight requests re-admitted to a surviving replica after "
+    "their replica was drained (wedge/death) — generation restarts "
+    "from the prompt; outputs are unaffected (seeded sampling)")
+SERVING_ROUTER_RESTARTS = REGISTRY.counter(
+    "paddle_serving_router_replica_restarts_total",
+    "Replica engine rebuilds by replica slot index (drain + fresh "
+    "engine via the factory)", labels=("replica",))
+SERVING_ROUTER_HEALTHY = REGISTRY.gauge(
+    "paddle_serving_router_replicas_healthy",
+    "Replicas currently accepting work (started, scheduler alive, "
+    "not draining)")
+SERVING_ROUTER_PROJECTED_WAIT = REGISTRY.histogram(
+    "paddle_serving_router_projected_wait_seconds",
+    "The router's projected queue wait at admission (outstanding "
+    "tokens on the chosen replica / estimated token rate) — the "
+    "quantity the SLO reject-early check compares to the deadline")
 
 # ----------------------------------------------------------- resilience
 # (paddle_tpu/resilience/: fault injection, wedge watchdog, checkpoint-
@@ -487,13 +576,18 @@ TRACE_SITES = (
     # pipelined input (core/pipeline.py): fill-thread spans under the
     # loop context handed off explicitly by run_pipelined
     "pipeline.prefetch", "pipeline.const_lookup",
-    # serving (serving/queue.py, batcher.py, engine.py): one trace per
-    # request from submit to its single terminal done event
+    # serving (serving/queue.py, batcher.py, engine.py, router.py):
+    # one trace per request from submit to its single terminal done
+    # event; the router propagates the SAME trace across the replica
+    # hop, so a drained-and-readmitted request's story stays one trace
     "serving.request.submit", "serving.request.done",
     "serving.queue.wait", "serving.batch.dispatch",
     "serving.engine.admit", "serving.engine.prefill",
-    "serving.engine.splice", "serving.engine.step",
+    "serving.engine.suffix_prefill", "serving.engine.splice",
+    "serving.engine.step", "serving.engine.spec",
     "serving.engine.retire",
+    "serving.router.route", "serving.router.drain",
+    "serving.router.readmit",
     # rpc (distributed/rpc.py): client call spans; server events linked
     # to the calling trainer's trace via wire metadata
     "rpc.client", "rpc.server.recv", "rpc.server.get_var",

@@ -23,20 +23,40 @@ batch instead:
   (``paddle_serving_slots_retired_total``); the next queued request is
   admitted into it while the rest of the batch keeps decoding.
 
+Two fleet-tier levers ride the same machinery (docs/SERVING.md "The
+fleet tier"):
+
+* **Prefix/KV-cache reuse** — with a :class:`PrefixStore` attached, a
+  prompt whose head matches a stored prefix splices the cached K/V
+  rows (serving/prefix.py) and prefills only its suffix through ONE
+  ``gpt.build_multi_token_decode_step`` dispatch; shared system
+  prompts prefill once per fleet, not once per request.
+* **Speculative decoding** — with a draft model attached
+  (``draft_cfg``/``draft_params``/``spec_k``), greedy requests draft k
+  tokens through the draft's own fixed-shape decode executable and the
+  target verifies all k in ONE multi-token dispatch; accepted drafts
+  advance the slot several tokens per target dispatch. Verification is
+  greedy-exact, so outputs stay bitwise ``generate()``'s; speculative
+  and plain (sampled) rows coexist in one batch — plain slots ride the
+  verify dispatch using only its first position.
+
 Requests enter through a bounded ``RequestQueue`` (backpressure,
 deadlines over queue time, cancellation — serving/queue.py). Sampling
 is host-side and per-request (its own seeded RandomState), so a
 request's output is bitwise what ``generate()`` would produce for it
-alone — tests/test_serving.py pins that parity. Occupancy telemetry:
+alone — tests/test_serving.py and tests/test_serving_fleet.py pin that
+parity with the fleet levers on and off. Occupancy telemetry:
 ``paddle_serving_slot_occupancy_ratio`` per decode step,
-``paddle_serving_slots_active``, tokens/steps counters
+``paddle_serving_slots_active``, tokens/steps/spec/prefix counters
 (docs/SERVING.md).
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
-from typing import Dict, Optional
+import time
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -46,14 +66,20 @@ from .queue import RequestQueue
 __all__ = ["DecodeEngine"]
 
 
+@contextlib.contextmanager
+def _null_mark(site, compiling):
+    """Busy-marker no-op for lanes without a supervising engine."""
+    yield
+
+
 class _Slot:
     """One live sequence bound to a cache row."""
 
     __slots__ = ("request", "tokens", "target_len", "eos_id",
-                 "temperature", "top_k", "rng")
+                 "temperature", "top_k", "rng", "spec")
 
     def __init__(self, request, prompt, n_new, eos_id, temperature,
-                 top_k, seed):
+                 top_k, seed, spec=False):
         self.request = request
         self.tokens = [int(t) for t in prompt]
         self.target_len = len(prompt) + int(n_new)
@@ -61,6 +87,10 @@ class _Slot:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.rng = np.random.RandomState(seed)
+        # speculative slots are GREEDY requests while a draft lane is
+        # attached: greedy verification is exact, sampled requests
+        # take plain per-token steps in the same batch
+        self.spec = bool(spec) and self.temperature == 0
 
     def sample(self, logits_row) -> int:
         """THE sampler generate() uses, applied to this slot's row with
@@ -76,6 +106,253 @@ class _Slot:
                 or (self.eos_id is not None and last_token == self.eos_id))
 
 
+class _Lane:
+    """One model's compiled decode surface: the fixed-``b_max``
+    per-slot decode executable, cached per-length prefill and
+    multi-token programs, and the donated cache splice. The engine
+    holds one lane for the target model and, under speculative
+    decoding, a second for the draft — slot i of the draft lane
+    mirrors slot i of the target."""
+
+    def __init__(self, fluid, exe, cfg, b_max, max_len, params,
+                 scope_guard, gpt, mark=None):
+        from ..core.scope import Scope
+
+        self._fluid, self._exe, self._gpt = fluid, exe, gpt
+        self._scope_guard = scope_guard
+        self._mark = mark if mark is not None else _null_mark
+        self._warm: set = set()   # program ids already dispatched once
+        self.cfg = cfg
+        self.b_max, self.max_len = b_max, max_len
+        self.scope = Scope()
+        self._prefill_scope = Scope()
+        self._prefill: Dict[int, tuple] = {}   # P -> (prog, logits_var)
+        self._suffix: Dict[int, tuple] = {}    # S -> (prog, logits_var)
+        self._multi: Dict[int, tuple] = {}     # S -> (prog, logits_var)
+        self._decode_prog = fluid.Program()
+        dec_start = fluid.Program()
+        with scope_guard(self.scope):
+            with fluid.program_guard(self._decode_prog, dec_start):
+                self._logits, self.cache_names = \
+                    gpt.build_serving_decode_step(
+                        cfg, batch=b_max, max_len=max_len)
+            exe.run(dec_start, scope=self.scope)
+            for n, v in (params or {}).items():
+                if self.scope.find_var(n) is not None:
+                    self.scope.set_var(n, v)
+        import jax
+
+        def _splice(bigs, smalls, idx):
+            return [jax.lax.dynamic_update_slice(
+                        b, s.astype(b.dtype), (idx, 0, 0, 0))
+                    for b, s in zip(bigs, smalls)]
+
+        # one compiled dispatch splices a prefilled slot into ALL the
+        # big caches; donating them makes the update in-place on device
+        self._splice = jax.jit(_splice, donate_argnums=0)
+
+        def _prefix_splice(smalls, rows):
+            return [jax.lax.dynamic_update_slice(
+                        s, r.astype(s.dtype), (0, 0, 0, 0))
+                    for s, r in zip(smalls, rows)]
+
+        # same trick on the prefill-scope caches: one donated dispatch
+        # writes a stored prefix's rows before the suffix prefill reads
+        # them (recompiled per distinct prefix length, like the suffix
+        # programs themselves)
+        self._prefix_splice = jax.jit(_prefix_splice, donate_argnums=0)
+
+    # ---------------------------------------------------------- dispatch
+    def _cold(self, prog) -> bool:
+        """True on a program's FIRST dispatch through this lane (jax
+        trace + XLA compile ride it) — the busy marker's
+        compiling-grace signal for replica supervision."""
+        if id(prog) in self._warm:
+            return False
+        self._warm.add(id(prog))
+        return True
+
+    def decode(self, token, pos):
+        """One plain per-slot decode step; logits [B, 1, vocab]."""
+        with self._mark("decode", self._cold(self._decode_prog)):
+            with self._scope_guard(self.scope):
+                (logits,) = self._exe.run(
+                    self._decode_prog, feed={"token": token, "pos": pos},
+                    fetch_list=[self._logits], scope=self.scope)
+        return logits
+
+    def multi_decode(self, token, pos):
+        """One multi-token step over the big caches (speculative
+        verification); logits [B, S, vocab]. ``pos`` rows must be
+        contiguous ascending and in-range — the scheduler's fit
+        predicate guarantees it."""
+        prog, logits_var = self._multi_program(token.shape[1])
+        with self._mark("verify", self._cold(prog)):
+            with self._scope_guard(self.scope):
+                (logits,) = self._exe.run(
+                    prog, feed={"token": token, "pos": pos},
+                    fetch_list=[logits_var], scope=self.scope)
+        return logits
+
+    # ----------------------------------------------------------- prefill
+    def prefill_insert(self, slot_idx, prompt, prefix_store=None,
+                       prefix_len=None):
+        """Admission prefill: fill the prefill scope's batch=1 cache
+        rows for the whole prompt — via one full-prompt dispatch, or,
+        on a prefix-store hit, a donated splice of the stored rows plus
+        one suffix dispatch — then splice the rows into the big caches
+        at ``slot_idx`` (ONE jitted donated dispatch for all 2*n_layer
+        tensors). Registers ``prompt[:prefix_len]`` with the store on
+        first sighting. Returns the last prompt position's logits row
+        (the caller samples the first token from it)."""
+        import jax.numpy as jnp
+
+        P = prompt.shape[0]
+        hit = prefix_store.lookup(prompt) if prefix_store is not None \
+            else None
+        if hit is not None:
+            L, rows = hit
+            with _tr.trace_span("serving.engine.suffix_prefill",
+                                prompt_len=P, prefix_len=L):
+                with self._scope_guard(self._prefill_scope):
+                    # the suffix program must exist BEFORE the splice:
+                    # its (scratch-scope) startup materializes the
+                    # prefill-scope caches on first use, and the
+                    # spliced rows must land in the live arrays after
+                    prog, logits_var = self._suffix_program(P - L)
+                    smalls = [jnp.asarray(self._prefill_scope.find_var(n))
+                              for n in self.cache_names]
+                    for n, out in zip(
+                            self.cache_names,
+                            self._prefix_splice(
+                                smalls, [jnp.asarray(r) for r in rows])):
+                        self._prefill_scope.set_var(n, out)
+                    pos = (L + np.arange(P - L,
+                                         dtype="int64"))[None, :]
+                    (full,) = self._exe.run(
+                        prog, feed={"token": prompt[None, L:],
+                                    "pos": pos},
+                        fetch_list=[logits_var],
+                        scope=self._prefill_scope)
+            last = full[0, P - L - 1]
+        else:
+            prog, logits_var = self._prefill_program(P)
+            with _tr.trace_span("serving.engine.prefill", prompt_len=P):
+                with self._scope_guard(self._prefill_scope):
+                    (full,) = self._exe.run(
+                        prog, feed={"tokens": prompt[None, :]},
+                        fetch_list=[logits_var],
+                        scope=self._prefill_scope)
+            last = full[0, P - 1]
+        if prefix_store is not None and prefix_len:
+            key = prompt[:prefix_len]
+            if not prefix_store.contains(key):
+                prefix_store.insert(
+                    key,
+                    [np.asarray(self._prefill_scope.find_var(n))
+                     [:, :, :prefix_len]
+                     for n in self.cache_names])
+        with _tr.trace_span("serving.engine.splice", slot=slot_idx):
+            bigs = [jnp.asarray(self.scope.find_var(n))
+                    for n in self.cache_names]
+            smalls = [jnp.asarray(self._prefill_scope.find_var(n))
+                      for n in self.cache_names]
+            for n, out in zip(self.cache_names,
+                              self._splice(bigs, smalls, slot_idx)):
+                self.scope.set_var(n, out)
+        return last
+
+    # ---------------------------------------------------------- programs
+    def _prefill_program(self, P: int):
+        """Batch=1 prefill executable for prompt length P, cached. All
+        P's share ONE prefill scope: the [1, n_kv, max_len, Dh] caches
+        have the same shape for every P, and weights are (re)copied
+        from the engine scope after each new program's startup."""
+        hit = self._prefill.get(P)
+        if hit is not None:
+            return hit
+        from ..observe.families import SERVING_PREFILL_PROGRAMS
+
+        fluid = self._fluid
+        prog, start = fluid.Program(), fluid.Program()
+        with self._scope_guard(self._prefill_scope):
+            with fluid.program_guard(prog, start):
+                logits_var, cache_names = self._gpt.build_prefill_step(
+                    self.cfg, batch=1, prompt_len=P, max_len=self.max_len)
+            self._exe.run(start, scope=self._prefill_scope)
+            self._share_weights(prog, skip={"tokens"})
+        SERVING_PREFILL_PROGRAMS.inc()
+        self._prefill[P] = (prog, logits_var)
+        return self._prefill[P]
+
+    def _suffix_program(self, S: int):
+        """Batch=1 multi-token executable for suffix length S, cached
+        per S (the prefix hit's un-cached tail). Runs in the SAME
+        prefill scope as the full-prompt programs — the splice path is
+        identical downstream. The engine's weights are shared in
+        EXPLICITLY: a fresh engine whose first admission hits a shared
+        prefix store (replica N of a fleet, a restarted replica) has
+        never built a full-prefill program, so the scratch-startup
+        copy in _build_multi would otherwise leave freshly-initialized
+        weights in the prefill scope and silently break the
+        bitwise-generate() contract."""
+        hit = self._suffix.get(S)
+        if hit is not None:
+            return hit
+        from ..observe.families import SERVING_PREFILL_PROGRAMS
+
+        prog, logits_var = self._build_multi(1, S, self._prefill_scope)
+        self._share_weights(prog, skip={"token", "pos"})
+        SERVING_PREFILL_PROGRAMS.inc()
+        self._suffix[S] = (prog, logits_var)
+        return self._suffix[S]
+
+    def _multi_program(self, S: int):
+        """Batch=b_max multi-token executable (speculative verify),
+        cached per S, sharing the ENGINE scope's live caches and
+        weights."""
+        hit = self._multi.get(S)
+        if hit is not None:
+            return hit
+        self._multi[S] = self._build_multi(self.b_max, S, self.scope)
+        return self._multi[S]
+
+    def _build_multi(self, batch, S, scope):
+        """Build a multi-token program against ``scope``, initializing
+        ONLY its program-private vars (the unnamed fc biases a fresh
+        build mints): its startup runs in a scratch scope and the
+        missing vars are copied over — running it in ``scope`` directly
+        would re-initialize live weights and zero the caches."""
+        from ..core.scope import Scope
+
+        fluid = self._fluid
+        prog, start = fluid.Program(), fluid.Program()
+        with self._scope_guard(scope):
+            with fluid.program_guard(prog, start):
+                logits_var, _ = self._gpt.build_multi_token_decode_step(
+                    self.cfg, batch=batch, steps=S, max_len=self.max_len)
+        scratch = Scope()
+        with self._scope_guard(scratch):
+            self._exe.run(start, scope=scratch)
+        for n in prog.global_block().vars:
+            if scope.find_var(n) is None \
+                    and scratch.find_var(n) is not None:
+                scope.set_var(n, np.asarray(scratch.find_var(n)))
+        return prog, logits_var
+
+    def _share_weights(self, prog, skip):
+        """Point the prefill scope at the engine scope's weight ARRAYS
+        by name (cheap reference copies); never the caches — their
+        batch dim differs."""
+        skip = set(self.cache_names) | set(skip)
+        for n in prog.global_block().vars:
+            if n in skip:
+                continue
+            v = self.scope.find_var(n)
+            if v is not None:
+                self._prefill_scope.set_var(n, v)
+
+
 class DecodeEngine:
     """Continuous-batching scheduler over one ``b_max`` decode
     executable.
@@ -89,70 +366,93 @@ class DecodeEngine:
     bound QUEUE time; once a sequence holds a slot it runs to
     completion. ``start()`` launches the scheduler thread; ``stop()``
     drains nothing — in-flight and queued requests fail with
-    ``Cancelled``."""
+    ``Cancelled``.
+
+    Fleet-tier knobs (both default off; docs/SERVING.md):
+
+    * ``prefix_store`` (a ``serving.PrefixStore``, shareable across
+      replicas of one model) or ``prefix_cache_bytes`` (build a
+      private store) enable prefix/KV-cache reuse; callers mark the
+      reusable boundary per request via ``submit(prefix_len=...)``.
+    * ``draft_cfg``/``draft_params`` + ``spec_k >= 1`` enable
+      speculative decoding for greedy requests: the draft model drafts
+      ``spec_k`` tokens per iteration, the target verifies them in one
+      multi-token dispatch. The draft lane shares ``b_max``/``max_len``
+      so its slots mirror the target's.
+    """
 
     def __init__(self, cfg, params: Optional[Dict[str, np.ndarray]] = None,
                  b_max: int = 4, max_len: Optional[int] = None,
                  queue_capacity: int = 64, eos_id: Optional[int] = None,
-                 place=None):
+                 place=None, prefix_store=None, prefix_cache_bytes: int = 0,
+                 draft_cfg=None,
+                 draft_params: Optional[Dict[str, np.ndarray]] = None,
+                 spec_k: int = 0):
         import paddle_tpu as fluid
-        from ..core.scope import Scope, scope_guard
         from ..models import gpt
+        from ..core.scope import scope_guard
+        from .prefix import PrefixStore
 
         if b_max < 1:
             raise ValueError("b_max must be >= 1")
+        if spec_k < 0:
+            raise ValueError("spec_k must be >= 0; got %r" % (spec_k,))
+        if spec_k and draft_cfg is None:
+            raise ValueError(
+                "spec_k=%d needs a draft model (draft_cfg=...) to "
+                "propose tokens" % spec_k)
         self.cfg = dict(cfg) if cfg else gpt.base_config()
         self.b_max = b_max
         self.max_len = (self.cfg["max_length"] if max_len is None
                         else int(max_len))
         self.eos_id = eos_id
-        self._params = dict(params) if params else {}
-        self._gpt = gpt
-        self._fluid = fluid
-        self._scope_guard = scope_guard
-        self._scope = Scope()
-        self._prefill_scope = Scope()
-        self._prefill: Dict[int, tuple] = {}   # P -> (prog, logits_var)
         self._exe = fluid.Executor(place if place is not None
                                    else fluid.TPUPlace())
-        self._decode_prog = fluid.Program()
-        dec_start = fluid.Program()
-        with scope_guard(self._scope):
-            with fluid.program_guard(self._decode_prog, dec_start):
-                self._logits, self._cache_names = \
-                    gpt.build_serving_decode_step(
-                        self.cfg, batch=b_max, max_len=self.max_len)
-            self._exe.run(dec_start, scope=self._scope)
-            for n, v in self._params.items():
-                if self._scope.find_var(n) is not None:
-                    self._scope.set_var(n, v)
-        import jax
-
-        def _splice(bigs, smalls, idx):
-            return [jax.lax.dynamic_update_slice(
-                        b, s.astype(b.dtype), (idx, 0, 0, 0))
-                    for b, s in zip(bigs, smalls)]
-
-        # one compiled dispatch splices a prefilled slot into ALL the
-        # big caches; donating them makes the update in-place on device
-        self._splice = jax.jit(_splice, donate_argnums=0)
+        # busy-state stack for replica supervision (scheduler thread
+        # writes, the router's monitor reads): a frame marked
+        # compiling=True buys the engine the router's compile grace —
+        # the Watchdog's wedge-vs-slow-compile distinction, replica-local
+        self._busy_frames: list = []
+        self._lane = _Lane(fluid, self._exe, self.cfg, b_max,
+                           self.max_len, params, scope_guard, gpt,
+                           mark=self._busy_mark)
+        self.spec_k = int(spec_k)
+        self._draft = None
+        if draft_cfg is not None and self.spec_k >= 1:
+            self._draft = _Lane(fluid, self._exe, dict(draft_cfg), b_max,
+                                self.max_len, draft_params, scope_guard,
+                                gpt, mark=self._busy_mark)
+        if prefix_store is None and prefix_cache_bytes > 0:
+            prefix_store = PrefixStore(prefix_cache_bytes)
+        self.prefix_store = prefix_store
         self.queue = RequestQueue(queue_capacity)
         self._slots: list = [None] * b_max
         self._n_active = 0
+        self._gauge_contrib = 0
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._loop,
                                         name="DecodeEngine", daemon=True)
         self._started = False
+        # scheduler-progress stamp for replica supervision: the router
+        # declares this engine wedged when it holds active slots and
+        # the stamp goes stale (serving/router.py)
+        self.last_progress = time.monotonic()
 
     # ------------------------------------------------------------ caller
     def submit(self, prompt_ids, n_new: int, eos_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-               deadline_s: Optional[float] = None):
+               deadline_s: Optional[float] = None, tenant: str = "default",
+               prefix_len: Optional[int] = None, trace_ctx=None,
+               report: bool = True):
         """Enqueue one generation request (thread-safe). ``prompt_ids``
         is a 1-D (or [1, P]) int array; raises ``QueueFull`` under
         backpressure, ``ValueError`` on a budget that overruns the
-        cache (the same check as ``generate``)."""
+        cache (the same check as ``generate``). ``prefix_len`` marks
+        the prompt's reusable head (a shared system prompt) for the
+        prefix store — ignored without one. ``tenant`` labels the
+        request's terminal outcome; ``trace_ctx``/``report`` are the
+        router's propagation knobs (serving/queue.py)."""
         if self._error is not None:
             raise RuntimeError("DecodeEngine failed") from self._error
         prompt = np.asarray(prompt_ids, dtype="int64").reshape(-1)
@@ -169,11 +469,43 @@ class DecodeEngine:
         if temperature < 0:
             raise ValueError("temperature must be >= 0; got %r"
                              % (temperature,))
+        if prefix_len is not None and not 0 < prefix_len <= P:
+            raise ValueError(
+                "prefix_len=%r must be in [1, prompt length %d]"
+                % (prefix_len, P))
         payload = dict(prompt=prompt, n_new=int(n_new),
                        eos_id=self.eos_id if eos_id is None else eos_id,
                        temperature=float(temperature), top_k=int(top_k),
-                       seed=int(seed))
-        return self.queue.submit(payload, deadline_s=deadline_s)
+                       seed=int(seed),
+                       prefix_len=int(prefix_len) if prefix_len else None)
+        return self.queue.submit(payload, deadline_s=deadline_s,
+                                 tenant=tenant, trace_ctx=trace_ctx,
+                                 report=report)
+
+    def alive(self) -> bool:
+        """Health probe for replica supervision: started, scheduler
+        thread running, no terminal error."""
+        return (self._started and self._error is None
+                and self._thread.is_alive())
+
+    @contextlib.contextmanager
+    def _busy_mark(self, site, compiling):
+        self._busy_frames.append((site, bool(compiling),
+                                  time.monotonic()))
+        try:
+            yield
+        finally:
+            self._busy_frames.pop()
+            self.last_progress = time.monotonic()
+
+    def busy_compiling(self) -> bool:
+        """True while the scheduler thread is inside work that may
+        legitimately take seconds (program build, first-signature
+        dispatch, splice jit) — the router judges a stale progress
+        stamp against its compile grace instead of the stall deadline
+        then (serving/router.py)."""
+        frames = list(self._busy_frames)
+        return any(f[1] for f in frames)
 
     def start(self) -> "DecodeEngine":
         if not self._started:
@@ -184,20 +516,18 @@ class DecodeEngine:
     def stop(self, timeout: float = 10.0) -> None:
         """Stop the scheduler. Queued requests fail with ``Cancelled``;
         sequences mid-generation fail with ``Cancelled`` too (their
-        partial output is dropped). Idempotent."""
+        partial output is dropped). Idempotent. A shorter ``timeout``
+        is the router's drain knob: a wedged scheduler thread is
+        abandoned after it (daemon — it dies with the process) and its
+        slot requests are failed here so the router can re-admit them
+        immediately."""
         from .queue import Cancelled
 
         self._stop.set()
         self.queue.close()
         if self._started:
             self._thread.join(timeout=timeout)
-        for i, slot in enumerate(self._slots):
-            if slot is not None:
-                slot.request.set_exception(
-                    Cancelled("engine stopped mid-generation"))
-                self._slots[i] = None
-        self._n_active = 0
-        self._set_active_gauge()
+        self._fail_slots(Cancelled("engine stopped mid-generation"))
 
     def __enter__(self) -> "DecodeEngine":
         return self.start()
@@ -216,6 +546,7 @@ class DecodeEngine:
         self._loop_trace = _tr.new_trace() if _tr.trace_enabled() else None
         try:
             while not self._stop.is_set():
+                self.last_progress = time.monotonic()
                 # admit into free slots at the step boundary; block on
                 # the queue only when the whole batch is idle
                 self._admit(block=self._n_active == 0)
@@ -223,18 +554,36 @@ class DecodeEngine:
                     return
                 if self._n_active == 0:
                     continue
-                self._decode_step()
+                self._step()
         except BaseException as exc:  # noqa: BLE001 — fail every caller loudly
             self._error = exc
-            for i, slot in enumerate(self._slots):
-                if slot is not None:
-                    slot.request.set_exception(exc)
-                    self._slots[i] = None
-            self._n_active = 0
-            self._set_active_gauge()  # a dead engine holds no live slots
+            self._fail_slots(exc)  # a dead engine holds no live slots
             self.queue.close()  # pending requests fail as Cancelled
-            if not isinstance(exc, Cancelled):
+            if not isinstance(exc, Cancelled) and not self._stop.is_set():
+                # a stop-requested teardown (router drain) already
+                # failed the slots; re-raising into a thread nobody
+                # joins would only spray a traceback
                 raise
+        finally:
+            if self._stop.is_set():
+                from .queue import Cancelled as _C
+
+                # a slot admitted WHILE stop() was sweeping (this
+                # thread was mid-_admit_one past the join timeout)
+                # would otherwise strand its caller: nobody steps it
+                # and stop's sweep already ran. The admitting thread
+                # sweeps once more on its way out, so every admitted
+                # request reaches a terminal state no matter how the
+                # teardown interleaves.
+                self._fail_slots(_C("engine stopped mid-generation"))
+
+    def _fail_slots(self, exc: BaseException) -> None:
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                slot.request.set_exception(exc)
+                self._slots[i] = None
+        self._n_active = 0
+        self._set_active_gauge()
 
     def _admit(self, block: bool) -> None:
         while self._n_active < self.b_max and not self._stop.is_set():
@@ -248,7 +597,12 @@ class DecodeEngine:
                 # the pop already admitted req (queue.close can't cancel
                 # it) but it isn't in a slot yet — fail it HERE or its
                 # caller blocks in result() forever, then let the loop's
-                # error path fail everyone else
+                # error path fail everyone else. _error is set BEFORE
+                # the request fails: its done-callback may be the
+                # router's, which must see a dead engine (alive() False)
+                # to re-admit instead of surfacing the replica's fault
+                # to the caller
+                self._error = exc
                 req.set_exception(exc)
                 raise
             block = False  # drain without blocking once something runs
@@ -258,13 +612,29 @@ class DecodeEngine:
 
         p = req.payload
         slot = _Slot(req, p["prompt"], p["n_new"], p["eos_id"],
-                     p["temperature"], p["top_k"], p["seed"])
+                     p["temperature"], p["top_k"], p["seed"],
+                     spec=self._draft is not None)
         # admission runs under the REQUEST's trace (explicit hand-off
         # from the caller thread via req.trace): prefill + splice child
-        # spans attribute the one-time admission cost to this request
-        with _tr.trace_span("serving.engine.admit", ctx=req.trace,
-                            slot=slot_idx, prompt_len=len(p["prompt"])):
-            first = self._prefill_insert(slot_idx, p["prompt"], slot)
+        # spans attribute the one-time admission cost to this request.
+        # The busy frame is compiling-class: admission may build and
+        # compile new prefill/suffix programs and jit splices — the
+        # router must judge it against its compile grace
+        with self._busy_mark("admit", True):
+            with _tr.trace_span("serving.engine.admit", ctx=req.trace,
+                                slot=slot_idx,
+                                prompt_len=len(p["prompt"])):
+                last = self._lane.prefill_insert(
+                    slot_idx, p["prompt"],
+                    prefix_store=self.prefix_store,
+                    prefix_len=p.get("prefix_len"))
+                first = slot.sample(last)
+                if slot.spec and not slot.finished(first):
+                    # mirror the prompt into the draft lane's slot so
+                    # drafting starts cache-aligned with the target
+                    # (the draft never consults the prefix store: its
+                    # rows would be a different model's)
+                    self._draft.prefill_insert(slot_idx, p["prompt"])
         SERVING_ADMITTED.inc()
         SERVING_TOKENS.inc()
         slot.tokens.append(first)
@@ -275,79 +645,36 @@ class DecodeEngine:
         self._n_active += 1
         self._set_active_gauge()
 
-    def _prefill_insert(self, slot_idx: int, prompt, slot) -> int:
-        """One prefill dispatch (batch=1, its own scope), then splice
-        the slot's cache rows into the big caches — ONE jitted dispatch
-        for all 2*n_layer tensors, with the big caches donated so the
-        update is in-place on device (per-tensor eager updates cost
-        2*n_layer dispatches plus a full cache copy each, which at
-        high admission rates rivals the decode steps themselves).
-        Returns the first sampled token (from the last prompt
-        position's logits)."""
-        import jax.numpy as jnp
+    # ------------------------------------------------------------- steps
+    def _step(self) -> None:
+        from ..observe.families import SERVING_OCCUPANCY
 
-        P = prompt.shape[0]
-        prog, logits_var = self._prefill_program(P)
-        with _tr.trace_span("serving.engine.prefill", prompt_len=P):
-            with self._scope_guard(self._prefill_scope):
-                (full,) = self._exe.run(
-                    prog, feed={"tokens": prompt[None, :]},
-                    fetch_list=[logits_var], scope=self._prefill_scope)
-        with _tr.trace_span("serving.engine.splice", slot=slot_idx):
-            bigs = [jnp.asarray(self._scope.find_var(n))
-                    for n in self._cache_names]
-            smalls = [jnp.asarray(self._prefill_scope.find_var(n))
-                      for n in self._cache_names]
-            for n, out in zip(self._cache_names,
-                              self._splice(bigs, smalls, slot_idx)):
-                self._scope.set_var(n, out)
-        return slot.sample(full[0, P - 1])
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        SERVING_OCCUPANCY.observe(len(active) / float(self.b_max))
+        self.last_progress = time.monotonic()
+        spec_slots = [i for i in active if self._slots[i].spec]
+        # a speculative iteration writes k+1 cache rows per slot; any
+        # row that would clamp past max_len (corrupting valid rows —
+        # dynamic_update_slice shifts an overflowing window DOWN) forces
+        # the whole batch onto a plain step for this iteration
+        if spec_slots and all(
+                len(self._slots[i].tokens) + self.spec_k <= self.max_len
+                for i in active):
+            self._spec_step(active, spec_slots)
+        else:
+            self._plain_step(active,
+                             advance_draft=bool(spec_slots))
 
-    def _prefill_program(self, P: int):
-        """Batch=1 prefill executable for prompt length P, cached. All
-        P's share ONE prefill scope: the [1, n_kv, max_len, Dh] caches
-        have the same shape for every P, and weights are (re)copied
-        from the engine scope after each new program's startup."""
-        hit = self._prefill.get(P)
-        if hit is not None:
-            return hit
-        from ..observe.families import SERVING_PREFILL_PROGRAMS
-
-        fluid = self._fluid
-        prog, start = fluid.Program(), fluid.Program()
-        with self._scope_guard(self._prefill_scope):
-            with fluid.program_guard(prog, start):
-                logits_var, cache_names = self._gpt.build_prefill_step(
-                    self.cfg, batch=1, prompt_len=P, max_len=self.max_len)
-            self._exe.run(start, scope=self._prefill_scope)
-            # share the engine's weight ARRAYS by name (cheap reference
-            # copies); never the caches — their batch dim differs
-            skip = set(cache_names) | {"tokens"}
-            for n in prog.global_block().vars:
-                if n in skip:
-                    continue
-                v = self._scope.find_var(n)
-                if v is not None:
-                    self._prefill_scope.set_var(n, v)
-        SERVING_PREFILL_PROGRAMS.inc()
-        self._prefill[P] = (prog, logits_var)
-        return self._prefill[P]
-
-    def _decode_step(self) -> None:
-        from ..observe.families import (SERVING_DECODE_STEPS,
-                                        SERVING_OCCUPANCY, SERVING_TOKENS)
-
+    def _feeds(self, active):
         token = np.zeros((self.b_max, 1), dtype="int64")
         pos = np.zeros((self.b_max, 1), dtype="int64")
-        active = []
-        for i, slot in enumerate(self._slots):
-            if slot is None:
-                continue  # free slot: token 0 at pos 0 writes garbage
-                #           into a row nobody reads (masked, and the
-                #           next prefill-insert overwrites it)
-            active.append(i)
+        for i in active:
+            slot = self._slots[i]
             token[i, 0] = slot.tokens[-1]
             pos[i, 0] = len(slot.tokens) - 1
+        return token, pos
+
+    def _step_span(self, site, active):
         # one span per continuous-batching step under the engine thread;
         # "traces" lists every rider's trace id so a request's share of
         # the batched decode time is attributable post-hoc (the span is
@@ -355,20 +682,32 @@ class DecodeEngine:
         # attached BEFORE entering: the ring copies attrs per event, so
         # only enter-time keys ride the B event (and an unfinished step
         # in a wedge dump must still name its riders)
-        sp = _tr.trace_span("serving.engine.step",
-                            ctx=getattr(self, "_loop_trace", None))
+        sp = _tr.trace_span(site, ctx=getattr(self, "_loop_trace", None))
         if sp.attrs is not None:
             sp.attrs["active"] = len(active)
             sp.attrs["traces"] = [
                 self._slots[i].request.trace.trace_id for i in active
                 if self._slots[i].request.trace is not None]
-        with sp:
-            with self._scope_guard(self._scope):
-                (logits,) = self._exe.run(
-                    self._decode_prog, feed={"token": token, "pos": pos},
-                    fetch_list=[self._logits], scope=self._scope)
+        return sp
+
+    def _plain_step(self, active, advance_draft=False) -> None:
+        from ..observe.families import (SERVING_DECODE_STEPS,
+                                        SERVING_SPEC_DRAFT_STEPS,
+                                        SERVING_TOKENS)
+
+        token, pos = self._feeds(active)
+        # free slots keep token 0 at pos 0: the write lands in a row
+        # nobody reads (masked, and the next prefill-insert overwrites)
+        with self._step_span("serving.engine.step", active):
+            logits = self._lane.decode(token, pos)
+            if advance_draft and self._draft is not None:
+                # keep the draft lane's caches mirror-aligned through
+                # plain iterations: a skipped position would leave a
+                # never-written garbage row in every later draft's
+                # visible window, silently cratering acceptance
+                self._draft.decode(token, pos)
+                SERVING_SPEC_DRAFT_STEPS.inc()
             SERVING_DECODE_STEPS.inc()
-            SERVING_OCCUPANCY.observe(len(active) / float(self.b_max))
             SERVING_TOKENS.inc(len(active))
             for i in active:
                 slot = self._slots[i]
@@ -378,6 +717,99 @@ class DecodeEngine:
                     self._slots[i] = None
                     self._n_active -= 1
                     self._retire(i, slot)
+            self._set_active_gauge()
+
+    def _spec_step(self, active, spec_slots) -> None:
+        """One speculative iteration: k greedy draft steps through the
+        draft lane's fixed-shape decode executable, then ONE target
+        verify dispatch scoring k+1 positions per slot. Greedy
+        verification accepts the longest draft prefix that matches the
+        target's own argmax chain — every emitted token equals what the
+        plain step would have produced, bit for bit (the verify
+        program's per-position attention IS the plain step's). Plain
+        (sampled) slots ride the verify dispatch and use only its first
+        position; their extra rows are masked garbage the next real
+        write overwrites."""
+        from ..models.gpt import sample_token
+        from ..observe.families import (SERVING_SPEC_ACCEPTED,
+                                        SERVING_SPEC_DRAFT_STEPS,
+                                        SERVING_SPEC_PROPOSED,
+                                        SERVING_SPEC_VERIFY_STEPS,
+                                        SERVING_TOKENS)
+
+        k = self.spec_k
+        sp = self._step_span("serving.engine.spec", active)
+        if sp.attrs is not None:
+            sp.attrs["spec_slots"] = len(spec_slots)
+            sp.attrs["k"] = k
+        with sp:
+            # --- draft phase: k lockstep draft-lane steps; non-spec
+            # rows re-feed their real (token, pos) every round — the
+            # repeated write is idempotent and keeps the feeds simple
+            token, pos = self._feeds(active)
+            drafts: Dict[int, List[int]] = {i: [] for i in spec_slots}
+            greedy = np.random.RandomState(0)  # unused at temperature 0
+            for _ in range(k):
+                logits = self._draft.decode(token, pos)
+                SERVING_SPEC_DRAFT_STEPS.inc()
+                for i in spec_slots:
+                    d = sample_token(logits[i, 0], greedy)
+                    drafts[i].append(d)
+                    token[i, 0] = d
+                    pos[i, 0] += 1
+            # --- verify phase: one multi-token target dispatch
+            vtok = np.zeros((self.b_max, k + 1), dtype="int64")
+            vpos = np.stack([np.arange(k + 1, dtype="int64")]
+                            * self.b_max)
+            for i in active:
+                slot = self._slots[i]
+                p0 = len(slot.tokens) - 1
+                vpos[i] += p0
+                vtok[i, 0] = slot.tokens[-1]
+                if i in drafts:
+                    vtok[i, 1:] = drafts[i]
+            logits = self._lane.multi_decode(vtok, vpos)
+            SERVING_SPEC_VERIFY_STEPS.inc()
+            SERVING_SPEC_PROPOSED.inc(k * len(spec_slots))
+            appended = 0
+            for i in active:
+                slot = self._slots[i]
+                if i not in drafts:
+                    # plain rider: position 0 IS its plain step
+                    tok = slot.sample(logits[i, 0])
+                    slot.tokens.append(tok)
+                    appended += 1
+                    if slot.finished(tok):
+                        self._slots[i] = None
+                        self._n_active -= 1
+                        self._retire(i, slot)
+                    continue
+                accepted = 0
+                for s in range(k + 1):
+                    # row s is valid iff every draft before it matched
+                    # the target's argmax chain — walked in order, so
+                    # reaching s proves it
+                    tok = slot.sample(logits[i, s])
+                    slot.tokens.append(tok)
+                    appended += 1
+                    matched = s < k and tok == drafts[i][s]
+                    if matched:
+                        # count BEFORE the finished-break: a drafted
+                        # EOS / final-budget token the verification
+                        # confirmed is an acceptance, not a drop —
+                        # accept_rate is THE switch-the-draft-off
+                        # signal and must not systematically undercount
+                        # request tails
+                        accepted += 1
+                    if slot.finished(tok):
+                        self._slots[i] = None
+                        self._n_active -= 1
+                        self._retire(i, slot)
+                        break
+                    if s < k and not matched:
+                        break  # mismatch: the draft chain is dead
+                SERVING_SPEC_ACCEPTED.inc(accepted)
+            SERVING_TOKENS.inc(appended)
             self._set_active_gauge()
 
     def _retire(self, slot_idx: int, slot: _Slot) -> None:
@@ -392,4 +824,10 @@ class DecodeEngine:
     def _set_active_gauge(self) -> None:
         from ..observe.families import SERVING_SLOTS_ACTIVE
 
-        SERVING_SLOTS_ACTIVE.set(self._n_active)
+        # additive, not set(): N router replicas share the process-wide
+        # gauge, so each engine contributes its delta and the gauge
+        # reads the fleet total
+        delta = self._n_active - self._gauge_contrib
+        if delta:
+            SERVING_SLOTS_ACTIVE.inc(delta)
+            self._gauge_contrib = self._n_active

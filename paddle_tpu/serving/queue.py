@@ -68,31 +68,48 @@ class ServingRequest:
     """
 
     __slots__ = ("payload", "rows", "submitted_at", "deadline", "trace",
-                 "_lock", "_event", "_state", "_value", "_exc")
+                 "tenant", "report", "_lock", "_event", "_state",
+                 "_value", "_exc", "_callbacks", "_finished")
 
     def __init__(self, payload: Any, deadline_s: Optional[float] = None,
-                 rows: int = 1):
+                 rows: int = 1, tenant: str = "default",
+                 trace_ctx=None, report: bool = True):
         if deadline_s is not None and deadline_s < 0:
             raise ValueError("deadline_s must be >= 0; got %r"
                              % (deadline_s,))
         self.payload = payload
         self.rows = int(rows)
+        self.tenant = str(tenant)
+        # report=False marks an INTERNAL attempt (the router re-submits
+        # one logical request to engine replicas): it skips the
+        # requests_total count and the submit/done trace events so the
+        # caller-facing request stays the ONE reporting identity — the
+        # exactly-once terminal-outcome invariant is per logical
+        # request, not per attempt
+        self.report = bool(report)
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + deadline_s
                          if deadline_s is not None else None)
         # one trace per request, born at submit and pinned on the object
         # — the explicit hand-off that lets the batcher/engine scheduler
-        # threads link their spans back to this caller's request
-        self.trace = _tr.new_trace() if _tr.trace_enabled() else None
-        if self.trace is not None:
-            _tr.trace_event("serving.request.submit", ctx=self.trace,
-                            rows=self.rows,
-                            deadline_s=deadline_s)
+        # threads link their spans back to this caller's request. A
+        # caller-provided trace_ctx (the router's hop propagation) is
+        # adopted instead of minting a second identity.
+        if trace_ctx is not None:
+            self.trace = trace_ctx
+        else:
+            self.trace = _tr.new_trace() if _tr.trace_enabled() else None
+            if self.trace is not None and self.report:
+                _tr.trace_event("serving.request.submit", ctx=self.trace,
+                                rows=self.rows, tenant=self.tenant,
+                                deadline_s=deadline_s)
         self._lock = threading.Lock()
         self._event = threading.Event()
         self._state = _PENDING
         self._value = None
         self._exc: Optional[BaseException] = None
+        self._callbacks: list = []
+        self._finished = False
 
     # ------------------------------------------------------------ caller
     def cancel(self) -> bool:
@@ -161,8 +178,9 @@ class ServingRequest:
                 return  # cancel/expire already won
             self._state = _DONE
             self._value = value
-        SERVING_REQUEST_SECONDS.observe(
-            time.monotonic() - self.submitted_at)
+        if self.report:
+            SERVING_REQUEST_SECONDS.observe(
+                time.monotonic() - self.submitted_at)
         self._finish("ok")
 
     def set_exception(self, exc: BaseException) -> None:
@@ -173,21 +191,74 @@ class ServingRequest:
             self._exc = exc
         # a scheduler cancelling admitted work (engine stop, batcher
         # shutdown) is a cancellation, not an error — routine shutdowns
-        # must not read as error-rate spikes
+        # must not read as error-rate spikes; a deadline surfacing
+        # through the router hop is an expiry, same contract
         self._finish("cancelled" if isinstance(exc, Cancelled)
+                     else "expired" if isinstance(exc, DeadlineExpired)
                      else "error")
+
+    def add_done_callback(self, fn) -> None:
+        """Call ``fn(self)`` once the request reaches its terminal
+        state (immediately if already done). Callbacks run on whatever
+        thread finishes the request, BEFORE ``result()`` waiters wake —
+        so a router's bookkeeping (quota release, completion
+        forwarding) is durable by the time the caller observes the
+        outcome. Keep them cheap and non-blocking; exceptions are
+        swallowed (a broken observer must not corrupt the scheduler
+        thread that finished the request)."""
+        run_now = False
+        with self._lock:
+            if self._finished:
+                run_now = True
+            else:
+                self._callbacks.append(fn)
+        if run_now:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — observer must not kill caller
+                pass
+
+    def _reject(self, exc: BaseException) -> None:
+        """Terminal-ize a stillborn request as outcome=rejected — the
+        shared path for admission-time rejection (queue full, router
+        quota/SLO), keeping the one-terminal-outcome invariant over
+        every path."""
+        with self._lock:
+            if self._state is _DONE:
+                return
+            self._state = _DONE
+            self._exc = exc
+        self._finish("rejected")
 
     def _finish(self, outcome: str) -> None:
         from ..observe.families import SERVING_REQUESTS
 
-        SERVING_REQUESTS.labels(outcome=outcome).inc()
-        # the ONE terminal trace event per request — every terminal path
-        # (ok / expired / cancelled / error, plus submit-time rejection
-        # in RequestQueue.submit) funnels through here exactly once,
-        # mirroring the requests_total{outcome} invariant
-        if self.trace is not None:
-            _tr.trace_event("serving.request.done", ctx=self.trace,
-                            outcome=outcome)
+        if self.report:
+            # bounded cardinality contract: tenant ids are a deployment
+            # configuration (quota keys), not caller-controlled free
+            # text — docs/SERVING.md
+            SERVING_REQUESTS.labels(outcome=outcome,
+                                    tenant=self.tenant).inc()
+            # the ONE terminal trace event per request — every terminal
+            # path (ok / expired / cancelled / error, plus submit-time
+            # rejection in RequestQueue.submit and the router's
+            # quota/SLO rejections) funnels through here exactly once,
+            # mirroring the requests_total{outcome} invariant
+            if self.trace is not None:
+                _tr.trace_event("serving.request.done", ctx=self.trace,
+                                outcome=outcome)
+        # callbacks BEFORE the event: result() waiters must observe a
+        # world where the callbacks' bookkeeping already happened.
+        # Terminal state (_value/_exc) is set by every caller before
+        # _finish, so callbacks may read it directly.
+        with self._lock:
+            self._finished = True
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — observer must not kill scheduler
+                pass
         self._event.set()
 
 
@@ -210,11 +281,15 @@ class RequestQueue:
             return len(self._q)
 
     def submit(self, payload: Any, deadline_s: Optional[float] = None,
-               rows: int = 1) -> ServingRequest:
+               rows: int = 1, tenant: str = "default", trace_ctx=None,
+               report: bool = True) -> ServingRequest:
         """Enqueue and return the request future. Raises ``QueueFull``
         when the queue is at capacity (the rejection is counted — an
         overloaded server must be visible, not silent) and
-        ``RuntimeError`` after ``close()``."""
+        ``RuntimeError`` after ``close()``. ``tenant`` labels the
+        request's terminal outcome; ``trace_ctx``/``report`` are the
+        router's hop-propagation and attempt-demotion knobs (see
+        ``ServingRequest``)."""
         from ..observe.families import (SERVING_QUEUE_DEPTH,
                                         SERVING_QUEUE_REJECTED)
 
@@ -225,19 +300,18 @@ class RequestQueue:
             # and no done event would break the exactly-once invariant
             if self._closed:
                 raise RuntimeError("RequestQueue is closed")
-            req = ServingRequest(payload, deadline_s=deadline_s, rows=rows)
+            req = ServingRequest(payload, deadline_s=deadline_s, rows=rows,
+                                 tenant=tenant, trace_ctx=trace_ctx,
+                                 report=report)
             if len(self._q) >= self.capacity:
                 SERVING_QUEUE_REJECTED.inc()
                 exc = QueueFull(
                     "admission queue full (capacity %d); retry with "
                     "backoff or raise capacity" % self.capacity)
-                # terminal-ize the stillborn request through _finish so
-                # the one-terminal-outcome invariant (metric AND trace
-                # event) covers rejection like every other path
-                with req._lock:
-                    req._state = _DONE
-                    req._exc = exc
-                req._finish("rejected")
+                # terminal-ize the stillborn request so the one-
+                # terminal-outcome invariant (metric AND trace event)
+                # covers rejection like every other path
+                req._reject(exc)
                 raise exc
             self._q.append(req)
             SERVING_QUEUE_DEPTH.set(len(self._q))

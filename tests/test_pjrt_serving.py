@@ -19,6 +19,15 @@ import subprocess
 import numpy as np
 import pytest
 
+import jax
+
+# jax-version quarantine (ISSUE 10): the artifact format IS jax.export
+# serialization — without the module these tests have nothing to test
+needs_jax_export = pytest.mark.skipif(
+    not hasattr(jax, "export"),
+    reason="quarantined: this jax has no jax.export (the serving "
+           "artifact format is jax.export serialization)")
+
 import paddle_tpu as fluid
 from paddle_tpu.core.scope import Scope, scope_guard
 
@@ -43,6 +52,7 @@ def _save_model(tmp_path):
     return mdl
 
 
+@needs_jax_export
 def test_artifact_roundtrip_matches_predictor(tmp_path):
     from paddle_tpu.inference import AnalysisConfig, Predictor
     from paddle_tpu.inference.export_serving import (
@@ -65,6 +75,7 @@ def test_artifact_roundtrip_matches_predictor(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
 
 
+@needs_jax_export
 def test_c_manifest_is_fscanf_parseable(tmp_path):
     from paddle_tpu.inference.export_serving import save_serving_artifact
 
@@ -182,6 +193,7 @@ def test_pds_load_and_run_on_real_plugin(tmp_path):
     lib.pds_destroy(ctypes.c_void_p(h))
 
 
+@needs_jax_export
 def test_int8_calibrated_model_exports_to_artifact(tmp_path):
     """Deployment completeness: a post-training int8-calibrated model
     (contrib.int8_inference.Calibrator.save_int8_model) exports through

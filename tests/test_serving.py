@@ -420,7 +420,7 @@ def test_engine_admission_failure_fails_the_popped_request():
     def boom(P):
         raise RuntimeError("prefill exploded")
 
-    eng._prefill_program = boom
+    eng._lane._prefill_program = boom
     eng.start()
     r = eng.submit(np.array([1, 2, 3], dtype="int64"), 4)
     with pytest.raises(RuntimeError, match="prefill exploded"):

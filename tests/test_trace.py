@@ -357,7 +357,7 @@ def test_engine_admission_error_emits_one_terminal_error_event():
     def boom(P):
         raise RuntimeError("prefill exploded")
 
-    eng._prefill_program = boom
+    eng._lane._prefill_program = boom
     eng.start()
     r = eng.submit(np.array([1, 2, 3], dtype="int64"), 4)
     with pytest.raises(RuntimeError, match="prefill exploded"):

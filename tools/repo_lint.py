@@ -51,10 +51,14 @@ from typing import Dict, List, Set
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # directories whose bare excepts are load-bearing bugs (the fault/serving
-# planes must never absorb KeyboardInterrupt/SystemExit)
+# planes must never absorb KeyboardInterrupt/SystemExit). Every serving/
+# module — including the fleet tier's prefix store and router — rides the
+# directory entry; the load driver is the serving plane's test harness
+# and holds the same contract.
 BARE_EXCEPT_PATHS = (
     os.path.join("paddle_tpu", "resilience"),
     os.path.join("paddle_tpu", "serving"),
+    os.path.join("tools", "serving_load.py"),
 )
 
 FAMILIES_FILE = os.path.join("paddle_tpu", "observe", "families.py")
