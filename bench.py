@@ -1165,6 +1165,66 @@ def bench_serving_fleet(amp, quick, uses_flash=False):
         router.close()
 
 
+def bench_elastic(amp, quick, uses_flash=False):
+    """Elastic-training chaos row: an N-trainer local PS job loses one
+    trainer mid-epoch (FaultPlan crash on its heartbeat site), the
+    supervisor evicts it and reshards deterministically from the latest
+    manifest, and the job still completes. The row reports end-to-end
+    steps/sec THROUGH the failure plus the reshard cost — the number
+    that says what a lost trainer costs in wall time, not just that
+    recovery happened. Workers always run on CPU subprocesses (N
+    processes cannot share one TPU), so the row is marked "elastic"
+    and platform cpu: pin_baselines never compares it with training
+    baselines."""
+    import tempfile
+
+    from paddle_tpu.resilience.elastic import ElasticJobSupervisor
+
+    trainers = 2 if quick else 3
+    steps = 6 if quick else 12
+    kill_step = 3 if quick else 5
+    workdir = tempfile.mkdtemp(prefix="bench_elastic_")
+    _log("elastic: %d trainers, %d steps, kill trainer 1 at step %d"
+         % (trainers, steps, kill_step))
+    sup = ElasticJobSupervisor(
+        workdir, trainers=trainers, steps_per_epoch=steps,
+        checkpoint_every=2, lease_s=30.0,
+        worker_env={1: {"PADDLE_TPU_FAULT_PLAN":
+                        "trainer.heartbeat@%d:crash" % (kill_step + 1)}})
+    t0 = time.perf_counter()
+    with _beacon("elastic", "chaos job"):
+        res = sup.run(timeout_s=420.0)
+    wall = time.perf_counter() - t0
+    if not res.completed:
+        # keep the workdir: logs/, timeline.jsonl and telemetry/ are
+        # exactly the forensics a failed chaos row needs
+        raise RuntimeError("elastic chaos job failed: %r (artifacts "
+                           "kept in %s)" % (res, workdir))
+    import shutil
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    rec = {
+        "metric": "elastic_chaos_steps_per_sec",
+        "platform": "cpu",  # worker subprocesses are CPU by design
+        "elastic": True,
+        "value": round(res.final_step / wall, 3),
+        "unit": "steps/sec",
+        "vs_baseline": 1.0,
+        "tflops_per_sec": None,
+        "mfu": None,
+        "trainers": trainers,
+        "steps": steps,
+        "generations": res.generations,
+        "evictions": res.evictions,
+        "reshard_seconds": round(sum(r.get("seconds", 0.0)
+                                     for r in res.reshards), 3),
+        "wall_seconds": round(wall, 1),
+        **({"quick": True} if quick else {}),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 WORKLOADS = {
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
@@ -1189,8 +1249,20 @@ SERVING_WORKLOADS = {
 WORKLOADS.update(SERVING_WORKLOADS)
 
 
+# PADDLE_TPU_BENCH_ELASTIC=1 swaps the workload list for the elastic
+# chaos workload (docs/RESILIENCE.md "Elastic jobs"). Rows are marked
+# "elastic" and never pin as training baselines.
+ELASTIC_ORDER = ["elastic"]
+ELASTIC_WORKLOADS = {"elastic": bench_elastic}
+WORKLOADS.update(ELASTIC_WORKLOADS)
+
+
 def _serving_mode():
     return os.environ.get("PADDLE_TPU_BENCH_SERVING", "0") != "0"
+
+
+def _elastic_mode():
+    return os.environ.get("PADDLE_TPU_BENCH_ELASTIC", "0") != "0"
 
 # Safe (no custom-kernel) workloads first: if the tunnel wedges or a
 # Pallas compile hangs partway through, the rows already printed stand.
@@ -1208,8 +1280,9 @@ ATTENTION_SEQ = {"transformer": 128, "transformer_long": 1024,
                  "bert": 128, "gpt_causal": 1024}
 ATTENTION_WORKLOADS = frozenset(ATTENTION_SEQ)
 
-assert set(ORDER) | set(SERVING_ORDER) == set(WORKLOADS), \
-    "ORDER/SERVING_ORDER out of sync with WORKLOADS"
+assert set(ORDER) | set(SERVING_ORDER) | set(ELASTIC_ORDER) \
+    == set(WORKLOADS), \
+    "ORDER/SERVING_ORDER/ELASTIC_ORDER out of sync with WORKLOADS"
 
 
 def _probe_backend(timeout_s=None, attempts=None, probe_fn=None):
@@ -1466,9 +1539,10 @@ def main():
         _dump_telemetry("probe")
         return 0
 
-    # PADDLE_TPU_BENCH_SERVING=1 swaps the default workload list for the
-    # serving schedulers; --only still picks any single workload by name
-    default_order = SERVING_ORDER if _serving_mode() else ORDER
+    # PADDLE_TPU_BENCH_SERVING=1 / PADDLE_TPU_BENCH_ELASTIC=1 swap the
+    # default workload list; --only still picks any single workload
+    default_order = (ELASTIC_ORDER if _elastic_mode()
+                     else SERVING_ORDER if _serving_mode() else ORDER)
     if args.worker:
         return _run_worker(args.worker, not args.fp32, args.quick)
     if args.in_process:

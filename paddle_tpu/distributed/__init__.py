@@ -14,7 +14,24 @@ Two paths, mirroring the reference (SURVEY §2.9):
 """
 
 from ..parallel.env import ParallelEnv, init_parallel_env  # noqa: F401
-from .rpc import RPCClient, RPCServer, SelectedRows  # noqa: F401
+from .membership import (  # noqa: F401
+    HeartbeatSender,
+    MembershipServer,
+    MembershipView,
+    TrainerLease,
+    make_world,
+    reshard,
+    shard_assignment,
+    world_from_manifest,
+)
+from .rpc import (  # noqa: F401
+    PeerGoneError,
+    RPCClient,
+    RPCError,
+    RPCServer,
+    SelectedRows,
+    compress_mode,
+)
 from .transpiler import (  # noqa: F401
     DistributeTranspiler,
     DistributeTranspilerConfig,

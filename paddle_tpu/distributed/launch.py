@@ -10,6 +10,16 @@ Usage:
     python -m paddle_tpu.distributed.launch --nproc 2 train.py --args...
     python -m paddle_tpu.distributed.launch --pservers 127.0.0.1:6170 \
         --trainers 2 --role all train.py        # PS cluster on localhost
+    python -m paddle_tpu.distributed.launch --elastic --trainers 3 \
+        --elastic_steps 20 --elastic_workdir /tmp/job   # elastic PS job
+
+``--elastic`` hands the whole job to
+:class:`paddle_tpu.resilience.elastic.ElasticJobSupervisor` instead of
+spawning ``script`` directly: trainers join/leave mid-run under
+membership leases, and every membership change reshards
+deterministically from the latest checkpoint manifest (docs/
+RESILIENCE.md "Elastic jobs"). The worker program comes from
+``--elastic_builder module:fn`` (default: the built-in demo model).
 """
 
 from __future__ import annotations
@@ -37,9 +47,29 @@ def _parse_args(argv):
                    choices=["trainer", "pserver", "all"],
                    help="PS mode: which role(s) this host launches")
     p.add_argument("--sync_mode", type=int, default=1)
-    p.add_argument("script")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic PS mode: membership-supervised "
+                        "trainers with deterministic reshard "
+                        "(resilience.elastic)")
+    p.add_argument("--elastic_steps", type=int, default=20,
+                   help="elastic mode: global batches per epoch")
+    p.add_argument("--elastic_workdir", default=None,
+                   help="elastic mode: job state dir (checkpoints, "
+                        "timeline, telemetry); default a temp dir")
+    p.add_argument("--elastic_builder", default=None,
+                   help="elastic mode: module:fn worker program "
+                        "builder (default: the built-in demo model)")
+    p.add_argument("script", nargs="?", default=None)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if not args.elastic and args.script is None:
+        p.error("script is required (unless --elastic)")
+    if args.elastic and args.script is not None:
+        # refusing beats silently training the demo model instead of
+        # the user's script
+        p.error("--elastic takes no script: elastic workers build "
+                "their program from --elastic_builder module:fn")
+    return args
 
 
 def _spawn(script, script_args, env):
@@ -52,6 +82,21 @@ def _spawn(script, script_args, env):
 def launch(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     procs = []
+
+    if args.elastic:
+        import tempfile
+
+        from ..resilience.elastic import ElasticJobSupervisor
+
+        workdir = args.elastic_workdir or tempfile.mkdtemp(
+            prefix="paddle_elastic_")
+        sup = ElasticJobSupervisor(
+            workdir, trainers=args.trainers,
+            steps_per_epoch=args.elastic_steps,
+            builder=args.elastic_builder)
+        res = sup.run()
+        print("elastic job: %r (workdir %s)" % (res, workdir))
+        sys.exit(0 if res.completed else 1)
 
     if args.pservers:
         trainer_eps = ",".join(

@@ -118,20 +118,27 @@ def run_pserver_loop(attrs: Dict, scope: Scope, executor=None):
             received = server.wait_grads()
             if not received and server.active_trainers <= 0:
                 break
-            dense: Dict[str, List[np.ndarray]] = defaultdict(list)
-            sparse: Dict[str, List[SelectedRows]] = defaultdict(list)
-            for name, val, _tid in received:
+            dense: Dict[str, List] = defaultdict(list)
+            sparse: Dict[str, List] = defaultdict(list)
+            for name, val, tid in received:
                 if name in param_blocks:
                     # init push: direct assignment (RequestSendHandler's
                     # non-grad var branch)
                     scope.set_var(name, val)
                 elif isinstance(val, SelectedRows):
-                    sparse[name].append(val)
+                    sparse[name].append((tid, val))
                 else:
-                    dense[name].append(val)
+                    dense[name].append((tid, val))
+            # aggregate in TRAINER-ID order, not arrival order: float
+            # reduction is order-sensitive, and the elastic tier's
+            # bitwise reshard contract (docs/RESILIENCE.md) needs two
+            # runs of the same world to sum the same way every cycle
             if dense:
-                feed = {g: np.mean(vs, axis=0, dtype=vs[0].dtype)
-                        for g, vs in dense.items()}
+                feed = {}
+                for g, tagged in dense.items():
+                    vs = [v for _t, v in sorted(tagged,
+                                                key=lambda p: p[0])]
+                    feed[g] = np.mean(vs, axis=0, dtype=vs[0].dtype)
                 if len(feed) < n_dense:
                     # memoize per feed-set: a fresh clone per cycle would
                     # miss the Executor compile cache (keyed by program id)
@@ -143,10 +150,11 @@ def run_pserver_loop(attrs: Dict, scope: Scope, executor=None):
                 else:
                     run_prog = opt_prog
                 exe.run(run_prog, feed=feed, fetch_list=[], scope=scope)
-            for gname, gs in sparse.items():
+            for gname, tagged in sparse.items():
                 pname = grad_to_param.get(gname)
                 if pname is None:
                     continue
+                gs = [v for _t, v in sorted(tagged, key=lambda p: p[0])]
                 spec = param_blocks[pname]
                 lr = float(np.asarray(scope.find_var(spec["lr"]))[0])
                 table = np.asarray(scope.find_var(pname))

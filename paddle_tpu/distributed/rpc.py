@@ -24,6 +24,8 @@ from ..resilience.backoff import backoff_delay, millis_env
 from ..resilience.faults import fault_point
 from ..observe import trace as _tr
 from ..observe.families import (RPC_BYTES_RECV, RPC_BYTES_SENT, RPC_CALLS,
+                                RPC_COMPRESS_BYTES_SAVED,
+                                RPC_COMPRESSED_VARS,
                                 RPC_DEADLINE_EXPIRATIONS, RPC_ERRORS,
                                 RPC_RETRIES, RPC_SECONDS,
                                 RPC_SERVER_REQUESTS)
@@ -52,8 +54,77 @@ def _split_wire(name: str):
         return name, None
     return name[:sep], name[sep + 1:]
 
-__all__ = ["RPCClient", "RPCServer", "RPCError", "SelectedRows",
-           "parse_endpoint"]
+
+# wire-encoding marker for the gradient-compression hook: a compressed
+# send_var's name carries "\x1ebf16" BEFORE any trace metadata. 0x1e
+# (ASCII record separator) cannot appear in var names; the marker never
+# reaches the C store-lookup path (compression applies only to
+# trainer->server sends, whose names pass through the transport opaque
+# and are decoded Python-side in ``_batch_read``). Absent marker = the
+# exact pre-compression wire bytes, so mixed peers interoperate.
+_ENC_SEP = "\x1e"
+ENV_COMPRESS = "PADDLE_TPU_RPC_COMPRESS"
+
+__all__ = ["RPCClient", "RPCServer", "RPCError", "PeerGoneError",
+           "SelectedRows", "parse_endpoint", "compress_mode"]
+
+
+def compress_mode() -> Optional[str]:
+    """The active wire-compression codec for gradient sends, or None.
+    ``PADDLE_TPU_RPC_COMPRESS=bf16`` enables fp32->bf16 encoding
+    (decoded back to fp32 on receipt — relative error <= 2^-8, bounded
+    by test); anything else (including the default, unset) is off."""
+    import os as _os
+
+    mode = _os.environ.get(ENV_COMPRESS, "").strip().lower()
+    return mode if mode == "bf16" else None
+
+
+def _bf16_dtype():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _encode_payload(name: str, value, mode: Optional[str]):
+    """(wire_name, wire_value): bf16-encode an fp32 payload when the
+    codec asks for it, marking the name so the receiver decodes."""
+    if mode != "bf16":
+        return name, value
+    if isinstance(value, SelectedRows):
+        if value.values.dtype != np.float32:
+            return name, value
+        enc = SelectedRows(value.rows,
+                           value.values.astype(_bf16_dtype()),
+                           height=value.height)
+        saved = value.values.nbytes - enc.values.nbytes
+    else:
+        arr = np.asarray(value)
+        if arr.dtype != np.float32:
+            return name, value
+        enc = arr.astype(_bf16_dtype())
+        saved = arr.nbytes - enc.nbytes
+    RPC_COMPRESSED_VARS.inc()
+    RPC_COMPRESS_BYTES_SAVED.inc(saved)
+    return name + _ENC_SEP + "bf16", enc
+
+
+def _decode_payload(name: str, arr):
+    """Inverse of ``_encode_payload``: strip the marker and cast the
+    payload back to fp32 so consumers never see the wire dtype."""
+    sep = name.find(_ENC_SEP)
+    if sep < 0:
+        return name, arr
+    codec = name[sep + 1:]
+    name = name[:sep]
+    if codec == "bf16":
+        if isinstance(arr, SelectedRows):
+            arr = SelectedRows(arr.rows,
+                               np.asarray(arr.values).astype(np.float32),
+                               height=arr.height)
+        else:
+            arr = np.asarray(arr).astype(np.float32)
+    return name, arr
 
 
 def _deadline_seconds() -> float:
@@ -138,6 +209,31 @@ class RPCError(RuntimeError):
         if detail:
             msg += " — " + detail
         super().__init__(msg)
+
+
+class PeerGoneError(RPCError):
+    """The endpoint VANISHED: after the native call failed, nothing is
+    accepting TCP connections at the peer's address (checked with a
+    direct bounded probe). Raised by ``get_var``/``send_var`` so a
+    supervisor can tell a dead peer (tear the world down, reshard) from
+    a transient failure against a live server (retry in place) — an
+    init-race miss or a torn frame with the peer still listening stays
+    a plain :class:`RPCError`."""
+
+
+def _peer_alive(endpoint: str, timeout_s: float = 2.0) -> bool:
+    """Is anything accepting TCP connections at ``endpoint``? The
+    classification probe behind :class:`PeerGoneError` — independent of
+    the native client's connection state (a dead fd inside the C client
+    fails fast without ever re-probing the peer)."""
+    import socket as _socket
+
+    try:
+        with _socket.create_connection(parse_endpoint(endpoint),
+                                       timeout=max(timeout_s, 0.1)):
+            return True
+    except OSError:
+        return False
 
 
 def parse_endpoint(ep: str) -> Tuple[str, int]:
@@ -281,6 +377,7 @@ def _batch_read(lib, b, emit_site: Optional[str] = None
         else:
             arr = flat.reshape(shape).copy()
         trainer = lib.ps_batch_trainer(b, i)
+        name, arr = _decode_payload(name, arr)
         if emit_site is not None and meta is not None:
             ctx = _tr.from_wire(meta)
             if ctx is not None:
@@ -398,10 +495,14 @@ class RPCServer:
             self._lib.ps_server_stop(self._h)
 
     def close(self):
-        if self._h:
-            self._lib.ps_server_stop(self._h)
-            self._lib.ps_server_destroy(self._h)
-            self._h = None
+        """Stop and free the native server. Idempotent: the handle is
+        detached FIRST, so a double close (or a close racing another
+        closer — supervisor teardown paths overlap) is a no-op instead
+        of a second ``ps_server_destroy`` on a freed pointer."""
+        h, self._h = self._h, None
+        if h:
+            self._lib.ps_server_stop(h)
+            self._lib.ps_server_destroy(h)
 
 
 class RPCClient:
@@ -422,9 +523,15 @@ class RPCClient:
                 raise RPCError("connect", self.endpoint)
             return ok
 
-    def send_var(self, name: str, value) -> None:
+    def send_var(self, name: str, value,
+                 compress: Optional[str] = None) -> None:
+        """Push one var. ``compress`` ("bf16" or None) is the gradient-
+        compression hook: callers opt grads in (ops/distributed_ops.py
+        consults :func:`compress_mode` for ``@GRAD`` sends); params and
+        non-fp32 payloads always travel verbatim."""
         with _rpc_call("send_var"):
             fault_point("rpc.send")
+            wire, value = _encode_payload(name, value, compress)
             if isinstance(value, SelectedRows):
                 rows, vals, height = value.rows, value.values, value.height
                 dims = (height if height >= 0 else len(rows),) + vals.shape[1:]
@@ -435,11 +542,19 @@ class RPCClient:
                 dims, nrows, rows_ptr = vals.shape, -1, None
             vals = _contig(vals)
             ok = self._lib.ps_client_send_var(
-                self._h, _wire_name(name).encode(), _DTYPES[vals.dtype],
+                self._h, _wire_name(wire).encode(), _DTYPES[vals.dtype],
                 len(dims), _dims_ptr(dims), nrows, rows_ptr,
                 vals.ctypes.data_as(ctypes.c_void_p), vals.nbytes)
             if not ok:
-                raise RPCError("send_var(%s)" % name, self.endpoint)
+                # dead-peer vs transient: probe the endpoint directly
+                # (the native client's own fd state can't be trusted —
+                # a dropped connection fails fast without re-probing)
+                if not _peer_alive(self.endpoint):
+                    raise PeerGoneError("send_var(%s)" % name,
+                                        self.endpoint)
+                raise RPCError("send_var(%s)" % name, self.endpoint,
+                               "transport error against a reachable "
+                               "peer (torn frame / mid-call drop)")
             RPC_BYTES_SENT.inc(_payload_nbytes(value))
 
     def get_var(self, name: str, retries: int = 50) -> np.ndarray:
@@ -474,6 +589,11 @@ class RPCClient:
                     break
                 time.sleep(min(backoff_delay(attempt, base_s, cap_s),
                                remaining))
+            if not _peer_alive(self.endpoint):
+                # nothing is listening there: the endpoint is gone —
+                # a live server answering misses (init race) stays a
+                # plain RPCError below
+                raise PeerGoneError("get_var(%s)" % name, self.endpoint)
             raise RPCError("get_var(%s)" % name, self.endpoint,
                            "or the variable was never pushed (init race)")
 

@@ -427,6 +427,33 @@ class DistributeTranspiler:
                           {"endpoints": self.pserver_endpoints,
                            "__op_role__": "dist"})
 
+    def get_trainer_push_program(self) -> Program:
+        """Init-parity push WITHOUT initializers: push the params
+        already sitting in this trainer's scope to the pservers and
+        pull them back (one barrier cycle). Run by an elastic job's
+        rank 0 after a checkpoint restore, paired with every other
+        rank's :meth:`get_trainer_recovery_program` — a fresh pserver
+        generation is seeded with the manifest's exact bytes instead
+        of replayed initializer RNG. Sparse distributed tables are NOT
+        pushed (they never live in trainer scope); a restarted pserver
+        recovers them from its shard snapshot
+        (PADDLE_TPU_PS_RECOVER_DIR)."""
+        prog = Program()
+        blk = prog.global_block()
+        for pname, info in self.param_infos.items():
+            blk.create_var(name=pname, shape=info["var"].shape,
+                           dtype=info["var"].dtype, persistable=True,
+                           stop_gradient=True)
+        self._append_sendrecv(
+            prog,
+            per_param_src={p: p for p in self.param_infos},
+            wire_of=lambda vb: vb.block_name,
+            recv_into_param=True,
+            barrier=self.sync_mode,
+        )
+        prog._bump()
+        return prog
+
     def get_trainer_recovery_program(self) -> Program:
         """Crash-recovery pull: re-fetch every param block from the
         pservers into the local scope WITHOUT pushing local state —

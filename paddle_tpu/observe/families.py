@@ -127,6 +127,14 @@ RPC_SECONDS = REGISTRY.histogram(
 RPC_SERVER_REQUESTS = REGISTRY.counter(
     "paddle_rpc_server_requests_total",
     "RPCServer-side operations", labels=("method",))
+RPC_COMPRESS_BYTES_SAVED = REGISTRY.counter(
+    "paddle_rpc_client_compress_bytes_saved_total",
+    "Wire bytes avoided by the gradient-compression hook "
+    "(PADDLE_TPU_RPC_COMPRESS=bf16: fp32 grads travel as bf16 and are "
+    "decoded back on receipt); 0 while compression is off (default)")
+RPC_COMPRESSED_VARS = REGISTRY.counter(
+    "paddle_rpc_client_compressed_vars_total",
+    "send_var payloads that traveled bf16-encoded")
 
 _RPC_METHODS = ("connect", "send_var", "get_var", "prefetch",
                 "send_barrier", "fetch_barrier", "send_complete")
@@ -349,7 +357,8 @@ RESILIENCE_FAULTS_INJECTED = REGISTRY.counter(
     "site and mode — chaos tests assert on these instead of trusting "
     "the injection happened", labels=("site", "mode"))
 FAULT_SITES = ("executor.dispatch", "device_put", "rpc.send",
-               "reader.next", "checkpoint.write")
+               "reader.next", "checkpoint.write",
+               "trainer.heartbeat", "membership.join")
 for _site in FAULT_SITES:
     for _mode in ("raise", "delay", "wedge", "crash"):
         # pre-materialize the full site x mode schema (schema-is-the-
@@ -408,6 +417,64 @@ RESILIENCE_ORPHANS_CLEANED = REGISTRY.counter(
     "paddle_resilience_checkpoint_orphans_cleaned_total",
     "Stale checkpoint staging (.tmp) files left by DEAD writer "
     "processes, removed by a later save to the same path")
+RESILIENCE_RESTARTS = REGISTRY.counter(
+    "paddle_resilience_restarts_total",
+    "resilient_train_loop retry-loop restarts by the exception class "
+    "being retried ('other' folds anything outside the pre-declared "
+    "set) — the flight recorder has the traceback, this has the rate",
+    labels=("cause",))
+RESTART_CAUSES = ("InjectedFault", "RPCError", "PeerGoneError", "other")
+for _c in RESTART_CAUSES:
+    RESILIENCE_RESTARTS.labels(cause=_c)
+
+# -------------------------------------------------------------- elastic
+# (resilience/elastic.py + distributed/membership.py: elastic multi-host
+# training — membership, lease eviction, deterministic reshard-from-
+# manifest. See docs/RESILIENCE.md "Elastic jobs".)
+ELASTIC_EVENTS = REGISTRY.counter(
+    "paddle_elastic_membership_events_total",
+    "Trainer membership transitions seen by the registry: 'join' = "
+    "first heartbeat of an unknown trainer, 'rejoin' = heartbeat from "
+    "a previously evicted/left trainer, 'leave' = graceful goodbye, "
+    "'evict' = lease expired or the worker process died",
+    labels=("event",))
+for _e in ("join", "rejoin", "leave", "evict"):
+    ELASTIC_EVENTS.labels(event=_e)
+ELASTIC_TRAINERS_ACTIVE = REGISTRY.gauge(
+    "paddle_elastic_trainers_active",
+    "Trainers currently holding a live (unexpired) membership lease")
+ELASTIC_GENERATION = REGISTRY.gauge(
+    "paddle_elastic_generation",
+    "The elastic job's current generation (bumps on every reshard; a "
+    "long-running job sitting at 0 never lost or gained a trainer)")
+ELASTIC_HEARTBEATS = REGISTRY.counter(
+    "paddle_elastic_heartbeats_total",
+    "Trainer heartbeats drained by the membership registry")
+ELASTIC_RESHARDS = REGISTRY.counter(
+    "paddle_elastic_reshards_total",
+    "Deterministic reshard-from-manifest executions, by the membership "
+    "change that forced them", labels=("cause",))
+for _c in ("evict", "join", "leave"):
+    ELASTIC_RESHARDS.labels(cause=_c)
+ELASTIC_RESHARD_SECONDS = REGISTRY.histogram(
+    "paddle_elastic_reshard_seconds",
+    "Wall time of one reshard's teardown phase: stopping the old "
+    "generation's workers + archiving the checkpoint state it resumes "
+    "from. The next generation's spawn/compile cost shows up as the "
+    "gap to its first heartbeat in the job timeline, not here")
+ELASTIC_JOINS_DROPPED = REGISTRY.counter(
+    "paddle_elastic_joins_dropped_total",
+    "Join/rejoin announcements dropped by an armed membership.join "
+    "fault (partition simulation) — the trainer's next heartbeat "
+    "retries the join")
+ELASTIC_WORLD_FALLBACKS = REGISTRY.counter(
+    "paddle_elastic_manifest_world_fallbacks_total",
+    "Manifests whose 'world' section could not be used: 'missing' = "
+    "pre-elastic manifest loaded as a single-trainer world, "
+    "'malformed' = unusable section degraded to a fresh-start world "
+    "(counted, never a crash)", labels=("kind",))
+for _k in ("missing", "malformed"):
+    ELASTIC_WORLD_FALLBACKS.labels(kind=_k)
 
 # ------------------------------------------------------------- analysis
 # (paddle_tpu/analysis/: static program verifier — see docs/ANALYSIS.md)
@@ -594,6 +661,10 @@ TRACE_SITES = (
     # resilience (resilience/faults.py, watchdog.py): the events that
     # explain a flight-recorder dump's final moments
     "resilience.fault", "resilience.wedge",
+    # elastic jobs (resilience/elastic.py, distributed/membership.py):
+    # membership transitions, per-generation spans and the reshard span
+    # — the story of who left/joined and what the job did about it
+    "elastic.membership", "elastic.generation", "elastic.reshard",
     # optimizer (core/passes): one pipeline span per optimized program,
     # one child span per applied pass — optimization cost shows up in
     # the flight recorder next to the compile it feeds

@@ -68,13 +68,26 @@ def _ordered_cb(fn, result_spec, *args):
     return jax.experimental.io_callback(fn, result_spec, *args, ordered=True)
 
 
+def _grad_compress(wire_name: str):
+    """The gradient-compression hook: only ``@GRAD``-named sends opt
+    into the PADDLE_TPU_RPC_COMPRESS codec — params and barriers always
+    travel verbatim (a bf16 init push would corrupt the weights the
+    cycle is supposed to agree on)."""
+    if "@GRAD" not in wire_name:
+        return None
+    from ..distributed.rpc import compress_mode
+
+    return compress_mode()
+
+
 @register_op("send", no_grad=True)
 def _send(ctx, ins, attrs):
     endpoint = attrs["endpoint"]
     wire_name = attrs["var_name"]
 
     def cb(x):
-        client_for(endpoint).send_var(wire_name, np.asarray(x))
+        client_for(endpoint).send_var(wire_name, np.asarray(x),
+                                      compress=_grad_compress(wire_name))
         return np.int32(0)
 
     flag = _ordered_cb(cb, _FLAG, ins["X"][0])
@@ -100,7 +113,8 @@ def _send_sparse(ctx, ins, attrs):
             # zero their grad so the pad embedding doesn't drift
             values = np.where((rows == pad)[:, None], 0, values)
         client_for(endpoint).send_var(
-            wire_name, SelectedRows(rows, values, height=height))
+            wire_name, SelectedRows(rows, values, height=height),
+            compress=_grad_compress(wire_name))
         return np.int32(0)
 
     flag = _ordered_cb(cb, _FLAG, ins["Rows"][0], ins["Values"][0])

@@ -28,6 +28,8 @@ chaos tests assert on telemetry. See docs/RESILIENCE.md.
 """
 
 from .backoff import backoff_delay, millis_env  # noqa: F401
+from .elastic import (ElasticJobResult, ElasticJobSupervisor,  # noqa: F401
+                      demo_builder, demo_feed)
 from .faults import (FaultPlan, FaultSpec, InjectedFault,  # noqa: F401
                      active_plan, fault_point)
 from .supervisor import (MANIFEST_NAME, SupervisorResult,  # noqa: F401
@@ -43,5 +45,7 @@ __all__ = [
     "run_with_deadline",
     "resilient_train_loop", "SupervisorResult", "read_manifest",
     "write_manifest", "latest_checkpoint_dir", "MANIFEST_NAME",
+    "ElasticJobSupervisor", "ElasticJobResult", "demo_builder",
+    "demo_feed",
     "backoff_delay", "millis_env",
 ]

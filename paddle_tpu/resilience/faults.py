@@ -22,6 +22,14 @@ site                      fires
 ``checkpoint.write``      once per ``tensor_store.save_tensors``, BETWEEN
                           the staged tmp-file write and the atomic rename
                           (the exact crash window a torn checkpoint needs)
+``trainer.heartbeat``     once per elastic-trainer heartbeat
+                          (``membership.HeartbeatSender.beat`` — one at
+                          join, then one per resolved step; ``crash`` here
+                          is THE way to kill trainer k at step s)
+``membership.join``       once per join/rejoin the membership registry
+                          processes (supervisor side; ``raise`` simulates
+                          a partitioned join — the announcement is dropped
+                          and the trainer's next heartbeat retries)
 ========================  ====================================================
 
 Each armed spec picks a **trigger** (explicit 1-based occurrence

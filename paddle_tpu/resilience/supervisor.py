@@ -132,15 +132,36 @@ class _Checkpointer:
     never points at an in-flight file."""
 
     def __init__(self, checkpoint_dir: str, keep_last: int,
-                 on_written=None):
+                 on_written=None, manifest_extra=None):
         if keep_last < 1:
             raise ValueError("keep_last must be >= 1, got %d" % keep_last)
         self.dir = checkpoint_dir
         self.keep_last = keep_last
         self._on_written = on_written  # called per finalized manifest
+        # extra manifest payload (the elastic tier's `world` section):
+        # a dict merged verbatim, or a callable(step, epoch,
+        # batch_in_epoch) -> dict evaluated at each checkpoint
+        self._manifest_extra = manifest_extra
         man = read_manifest(checkpoint_dir)
         self._retained = list(man["retained"]) if man else []
         self._pending = None  # (AsyncCheckpoint, manifest-entry meta)
+
+    _RESERVED_KEYS = frozenset((
+        "latest", "step", "epoch", "batch_in_epoch", "completed",
+        "var_names", "version", "retained", "unix_time"))
+
+    def _extra(self, step, epoch, batch_in_epoch) -> dict:
+        extra = self._manifest_extra
+        if extra is None:
+            return {}
+        if callable(extra):
+            extra = extra(step, epoch, batch_in_epoch)
+        bad = self._RESERVED_KEYS.intersection(extra or ())
+        if bad:
+            raise ValueError(
+                "manifest_extra may not override reserved manifest "
+                "keys %s" % sorted(bad))
+        return dict(extra or {})
 
     def checkpoint(self, exe, program, scope, step: int, epoch: int,
                    batch_in_epoch: int, completed: bool = False) -> None:
@@ -157,11 +178,13 @@ class _Checkpointer:
         handle = save_persistables_async(
             exe, os.path.join(self.dir, name), program, scope=scope,
             extra_vars=(RNG_VAR,))
-        self._pending = (handle, {
+        meta = {
             "latest": name, "step": step, "epoch": epoch,
             "batch_in_epoch": batch_in_epoch, "completed": completed,
             "var_names": names,
-        })
+        }
+        meta.update(self._extra(step, epoch, batch_in_epoch))
+        self._pending = (handle, meta)
         RESILIENCE_CHECKPOINT_SECONDS.observe(time.perf_counter() - t0)
 
     def finalize(self) -> None:
@@ -264,6 +287,8 @@ def resilient_train_loop(
     max_in_flight: int = 2,
     return_numpy: bool = True,
     resume: bool = True,
+    manifest_extra=None,
+    resume_program=None,
 ) -> SupervisorResult:
     """Drive ``epochs`` passes of ``reader`` through the pipelined
     executor under checkpoint-restart supervision (module doc above).
@@ -276,20 +301,30 @@ def resilient_train_loop(
     pass a constructed ``watchdog``); a wedge that surfaces as a
     retryable exception is then recovered like any transient fault.
     ``resume=False`` ignores an existing manifest (fresh run that will
-    OVERWRITE it at the first checkpoint)."""
+    OVERWRITE it at the first checkpoint). ``checkpoint_every=0`` makes
+    the loop READ-ONLY against ``checkpoint_dir``: it restores and
+    fast-forwards from an existing manifest but never writes one — the
+    mode an elastic job's non-zero ranks run in, sharing rank 0's
+    manifest. ``manifest_extra`` (dict, or callable(step, epoch,
+    batch_in_epoch) -> dict) merges extra sections into every written
+    manifest (the elastic tier's ``world`` section rides this).
+    ``resume_program`` runs right after ANY successful manifest restore
+    (initial entry and in-call recovery) — e.g. re-publishing restored
+    params to parameter servers before training resumes."""
     from ..core.executor import RNG_VAR, Executor
     from ..core.scope import global_scope
     from ..observe.families import (RESILIENCE_BACKOFF_SECONDS,
-                                    RESILIENCE_RECOVERIES)
+                                    RESILIENCE_RECOVERIES,
+                                    RESILIENCE_RESTARTS, RESTART_CAUSES)
 
     if not callable(reader):
         raise TypeError(
             "resilient_train_loop needs reader to be a zero-arg callable "
             "returning a fresh iterator (resume and epochs re-iterate "
             "it); got %r" % type(reader).__name__)
-    if checkpoint_every < 1:
-        raise ValueError("checkpoint_every must be >= 1, got %d"
-                         % checkpoint_every)
+    if checkpoint_every < 0:
+        raise ValueError("checkpoint_every must be >= 0 (0 = read-only, "
+                         "never checkpoint), got %d" % checkpoint_every)
     scope = scope if scope is not None else global_scope()
     if place is None and executor is not None:
         place = executor.place
@@ -300,6 +335,8 @@ def resilient_train_loop(
     man = read_manifest(checkpoint_dir) if resume else None
     if man is not None:
         _restore(checkpoint_dir, man, scope)
+        if resume_program is not None:
+            exe.run(resume_program, scope=scope)
         pos = (man["step"], man["epoch"], man["batch_in_epoch"])
         result.resumed_from = man["step"]
     else:
@@ -337,6 +374,8 @@ def resilient_train_loop(
             if (resume or own_manifest[0]) else None
         if man is not None:
             _restore(checkpoint_dir, man, scope)
+            if resume_program is not None:
+                exe.run(resume_program, scope=scope)
             pos = (man["step"], man["epoch"], man["batch_in_epoch"])
             RESILIENCE_RECOVERIES.labels(kind="resume").inc()
         else:
@@ -363,11 +402,19 @@ def resilient_train_loop(
                     exe, program, reader, fetch_list, scope, pos, epochs,
                     checkpoint_every, keep_last, checkpoint_dir, on_step,
                     max_in_flight, return_numpy,
-                    lambda: own_manifest.__setitem__(0, True))
+                    lambda: own_manifest.__setitem__(0, True),
+                    manifest_extra)
                 result.last, result.steps = last, steps
                 break
             except retryable as e:
                 result.restarts += 1
+                # the cause was previously only visible in the flight
+                # recorder; the counter makes the restart RATE and its
+                # dominant exception class a dashboard quantity
+                cause = type(e).__name__
+                if cause not in RESTART_CAUSES:
+                    cause = "other"
+                RESILIENCE_RESTARTS.labels(cause=cause).inc()
                 if result.restarts > max_restarts:
                     raise
                 delay = backoff_delay(result.restarts - 1, backoff_base_s,
@@ -385,13 +432,18 @@ def resilient_train_loop(
 
 def _attempt(exe, program, reader, fetch_list, scope, pos, epochs,
              checkpoint_every, keep_last, checkpoint_dir, on_step,
-             max_in_flight, return_numpy, on_written=None):
+             max_in_flight, return_numpy, on_written=None,
+             manifest_extra=None):
     """One uninterrupted run from ``pos`` to the end of the last epoch.
-    Raises on the first fault; the caller decides whether to recover."""
+    Raises on the first fault; the caller decides whether to recover.
+    ``checkpoint_every=0``: read-only — no checkpointer is even built,
+    so the shared manifest dir is never written."""
     from ..observe.families import RESILIENCE_FF_BATCHES
 
     step, e0, b0 = pos
-    ck = _Checkpointer(checkpoint_dir, keep_last, on_written=on_written)
+    ck = _Checkpointer(checkpoint_dir, keep_last, on_written=on_written,
+                       manifest_extra=manifest_extra) \
+        if checkpoint_every else None
     pending = deque()
     last = [None]
 
@@ -427,7 +479,7 @@ def _attempt(exe, program, reader, fetch_list, scope, pos, epochs,
                 pending.append((step, h))
                 if len(pending) > max_in_flight:
                     resolve(pending.popleft())
-                if step % checkpoint_every == 0:
+                if ck is not None and step % checkpoint_every == 0:
                     # drain BEFORE checkpointing: once this manifest is
                     # finalized, a later fault resumes past these steps
                     # and a handle still pending here would never get
@@ -447,13 +499,15 @@ def _attempt(exe, program, reader, fetch_list, scope, pos, epochs,
         # final checkpoint: epoch == epochs / batch 0 means "nothing left
         # to replay" — resuming a completed run restores state and
         # trains zero further steps
-        ck.checkpoint(exe, program, scope, step, epochs, 0,
-                      completed=True)
-        ck.finalize()
+        if ck is not None:
+            ck.checkpoint(exe, program, scope, step, epochs, 0,
+                          completed=True)
+            ck.finalize()
         return last[0], step
     except BaseException:
         # in-flight fetch handles are dropped (their steps replay after
         # recovery); an in-flight checkpoint of an EARLIER step is still
         # worth finalizing — best-effort, never masks the real fault
-        ck.abandon()
+        if ck is not None:
+            ck.abandon()
         raise

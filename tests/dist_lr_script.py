@@ -99,10 +99,12 @@ def main():
         except Exception as exc:
             # RPC failures surface from inside the compiled step's
             # io_callbacks wrapped in XLA runtime errors, so match on
-            # the named RPCError text rather than the exception type;
-            # anything else (feed shape, NaN guard, a genuine bug) is
-            # NOT retryable and must propagate as the real traceback
-            if not retry or "RPCError" not in repr(exc):
+            # the named RPCError/PeerGoneError text rather than the
+            # exception type; anything else (feed shape, NaN guard, a
+            # genuine bug) is NOT retryable and must propagate as the
+            # real traceback
+            if not retry or ("RPCError" not in repr(exc)
+                             and "PeerGoneError" not in repr(exc)):
                 raise
             consecutive_failures += 1
             if consecutive_failures > 20:

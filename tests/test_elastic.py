@@ -1,0 +1,688 @@
+"""Elastic multi-host training: membership leases, deterministic
+reshard-from-manifest, chaos-proof convergence (docs/RESILIENCE.md
+"Elastic jobs").
+
+Fast tier: membership/reshard/world-compat units, the typed RPC
+dead-peer error, the bf16 gradient-compression hook, the restarts
+counter, read-only checkpointing, and one REAL (subprocess) elastic
+demo job with a mid-epoch kill. Slow tier: the two acceptance chaos
+runs — eviction with bitwise parity against a fresh job on the
+surviving world, and rejoin with exactly-once shard accounting."""
+
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+from paddle_tpu.core.scope import Scope, scope_guard
+from paddle_tpu.distributed import membership as mb
+from paddle_tpu.distributed.rpc import (PeerGoneError, RPCClient,
+                                        RPCError, RPCServer)
+from paddle_tpu.resilience import (FaultPlan, InjectedFault,
+                                   read_manifest, resilient_train_loop)
+from paddle_tpu.resilience.elastic import ElasticJobSupervisor
+from paddle_tpu.resilience.supervisor import write_manifest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+def _value(name, **labels):
+    fam = observe.get_metric(name)
+    return fam.labels(**labels).value if labels else fam.value
+
+
+# ========================================================== membership
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_membership_join_beat_evict_rejoin_lifecycle():
+    clock = _Clock()
+    events = []
+    view = mb.MembershipView(
+        lease_s=5.0, clock=clock,
+        on_event=lambda ev, tid, **info: events.append((ev, tid)))
+    j0 = _value("paddle_elastic_membership_events_total", event="join")
+    e0 = _value("paddle_elastic_membership_events_total", event="evict")
+    r0 = _value("paddle_elastic_membership_events_total", event="rejoin")
+
+    assert view.heartbeat(0, step=1) == "join"
+    assert view.heartbeat(1, step=1) == "join"
+    assert view.heartbeat(0, step=2) is None  # routine beat: no event
+    assert view.active_trainers() == [0, 1]
+    v1 = view.version
+
+    # trainer 1 stops beating; trainer 0 keeps its lease fresh
+    clock.t += 4.0
+    view.heartbeat(0, step=3)
+    assert view.sweep() == []          # 4s < lease 5s: nobody expires
+    clock.t += 4.0
+    assert view.sweep() == [1]         # 8s > 5s: trainer 1 evicted
+    assert view.active_trainers() == [0]
+    assert view.version > v1
+    assert view.sweep() == []          # idempotent: no double-evict
+    assert view.evict(1) is False      # already gone
+
+    # the evicted trainer comes back
+    assert view.heartbeat(1, step=9) == "rejoin"
+    assert view.active_trainers() == [0, 1]
+    assert view.leave(1) is True and view.leave(1) is False
+
+    assert events == [("join", 0), ("join", 1), ("evict", 1),
+                      ("rejoin", 1), ("leave", 1)]
+    assert _value("paddle_elastic_membership_events_total",
+                  event="join") == j0 + 2
+    assert _value("paddle_elastic_membership_events_total",
+                  event="evict") == e0 + 1
+    assert _value("paddle_elastic_membership_events_total",
+                  event="rejoin") == r0 + 1
+    snap = view.snapshot()
+    assert snap["trainers"][0]["alive"] and snap["trainers"][0]["step"] == 3
+
+
+def test_membership_join_partition_fault_drops_and_retries():
+    """An armed membership.join fault simulates a partitioned join: the
+    announcement is dropped (counted), the trainer stays unknown, and
+    its NEXT heartbeat succeeds."""
+    view = mb.MembershipView(lease_s=5.0)
+    d0 = _value("paddle_elastic_joins_dropped_total")
+    with FaultPlan().arm("membership.join", steps=(1,)):
+        assert view.heartbeat(7) is None          # dropped
+        assert view.active_trainers() == []
+        assert view.heartbeat(7) == "join"        # retry lands
+    assert view.active_trainers() == [7]
+    assert _value("paddle_elastic_joins_dropped_total") == d0 + 1
+
+
+def test_membership_server_transport_end_to_end():
+    """Heartbeats ride the real RPC wire into the async-mode server;
+    active_trainers() is the lease view, not the socket count."""
+    ms = mb.MembershipServer(lease_s=30.0)
+    try:
+        hb = mb.HeartbeatSender(ms.endpoint, tid=3, generation=1)
+        hb.beat(0)
+        hb.beat(1)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not ms.active_trainers():
+            ms.poll(0.05)
+        assert ms.active_trainers() == [3]
+        lease = ms.view.lease(3)
+        assert lease.step == 1 and lease.generation == 1
+        hb.leave()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and ms.active_trainers():
+            ms.poll(0.05)
+        assert ms.active_trainers() == []
+        hb.close()
+    finally:
+        ms.close()
+
+
+# ======================================================== reshard math
+def test_shard_assignment_pure_covering_balanced():
+    a = mb.shard_assignment(6, [4, 0, 2])
+    assert a == mb.shard_assignment(6, [0, 2, 4])  # order-insensitive
+    covered = sorted(s for shards in a.values() for s in shards)
+    assert covered == list(range(6))
+    sizes = [len(v) for v in a.values()]
+    assert max(sizes) - min(sizes) <= 1
+    # more trainers than shards: someone legally holds zero
+    a2 = mb.shard_assignment(1, [0, 1])
+    assert a2 == {0: [0], 1: []}
+    with pytest.raises(ValueError):
+        mb.shard_assignment(3, [])
+
+
+def test_reshard_is_pure_and_carries_cursors():
+    w = mb.make_world(4, [0, 1, 2], cursors={0: 5, 1: 5, 2: 5, 3: 5},
+                      epoch=2)
+    r1 = mb.reshard(w, [0, 2])
+    r2 = mb.reshard(w, [0, 2])
+    assert r1 == r2  # pure
+    assert r1["num_shards"] == 4 and r1["trainers"] == [0, 2]
+    assert r1["cursors"] == {"0": 5, "1": 5, "2": 5, "3": 5}
+    assert r1["epoch"] == 2
+    covered = sorted(s for sh in r1["assignment"].values() for s in sh)
+    assert covered == [0, 1, 2, 3]
+    # growing the world back re-deals the same shards
+    r3 = mb.reshard(r1, [0, 1, 2])
+    assert r3["assignment"] == w["assignment"]
+
+
+def test_world_from_manifest_compat(tmp_path):
+    m0 = _value("paddle_elastic_manifest_world_fallbacks_total",
+                kind="missing")
+    b0 = _value("paddle_elastic_manifest_world_fallbacks_total",
+                kind="malformed")
+    # no manifest at all
+    assert mb.world_from_manifest(None) == (None, None)
+    # pre-elastic manifest: loads as a SINGLE-TRAINER world that
+    # resumes from the recorded batch cursor
+    man = {"latest": "step_00000004", "step": 4, "epoch": 1,
+           "batch_in_epoch": 4, "var_names": [], "completed": False}
+    world, fb = mb.world_from_manifest(man)
+    assert fb == "missing"
+    assert world["num_trainers"] == 1 and world["trainers"] == [0]
+    assert world["num_shards"] == 1 and world["cursors"] == {"0": 4}
+    assert world["epoch"] == 1
+    assert _value("paddle_elastic_manifest_world_fallbacks_total",
+                  kind="missing") == m0 + 1
+    # malformed sections degrade (counted), never crash
+    for bad in ("junk", 7, {"num_shards": 2},
+                {"num_shards": 0, "trainers": [0], "assignment": {}},
+                {"num_shards": 2, "trainers": [],
+                 "assignment": {"0": [0, 1]}},
+                {"num_shards": 2, "trainers": [0],
+                 "assignment": {"0": [0]}},       # shard 1 uncovered
+                {"num_shards": 2, "trainers": [0],
+                 "assignment": {"0": ["x", 1]}},
+                {"num_shards": 1, "trainers": [0],
+                 "assignment": {"0": [0]}, "cursors": "oops"},
+                {"num_shards": 1, "trainers": [0],
+                 "assignment": {"0": [0]}, "cursors": {"0": "x"}},
+                {"num_shards": 1, "trainers": [0],
+                 "assignment": {"0": [0]}, "epoch": "later"}):
+        world, fb = mb.world_from_manifest(dict(man, world=bad))
+        assert world is None and fb == "malformed", bad
+    assert _value("paddle_elastic_manifest_world_fallbacks_total",
+                  kind="malformed") == b0 + 10
+    # a valid section rides through untouched
+    good = mb.make_world(3, [0, 1, 2])
+    assert mb.world_from_manifest(dict(man, world=good)) == (good, None)
+    # write_manifest/read_manifest round-trip the section byte-true
+    d = str(tmp_path)
+    write_manifest(d, dict(man, world=good, retained=[], version=1))
+    assert read_manifest(d)["world"] == good
+
+
+# ============================================== rpc: typed dead peer
+# The native transport caches PADDLE_TPU_RPC_DEADLINE_MS in a
+# process-static on first use, so a short-deadline scenario must run in
+# a subprocess with the env set BEFORE any client exists.
+_PEER_GONE_SCRIPT = r"""
+import socket, time
+import numpy as np
+from paddle_tpu.distributed.rpc import (PeerGoneError, RPCClient,
+                                        RPCError, RPCServer)
+
+# 1) endpoint that never came up: the FIRST call burns the reconnect
+#    deadline -> typed dead-peer error, fast
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+c = RPCClient("127.0.0.1:%d" % port, trainer_id=0)
+t0 = time.monotonic()
+try:
+    c.get_var("w", retries=2)
+    raise SystemExit("get_var against nothing succeeded?!")
+except PeerGoneError as e:
+    assert isinstance(e, RPCError)
+    assert "unreachable" in str(e)
+assert time.monotonic() - t0 < 10.0, "not deadline-bounded"
+c.close()
+
+# 2) peer vanishes MID-conversation: the in-flight call fails fast as
+#    a transient RPCError; the follow-up reconnect burns the deadline
+#    and names the peer gone
+srv = RPCServer(port=0, num_trainers=1, sync=False)
+srv.start()
+srv.set_var("w", np.ones((3,), np.float32))
+c = RPCClient("127.0.0.1:%d" % srv.port, trainer_id=0)
+assert np.array_equal(c.get_var("w"), np.ones((3,), np.float32))
+srv.close()
+saw = []
+for _ in range(2):
+    try:
+        c.send_var("w", np.zeros((3,), np.float32))
+        raise SystemExit("send to a dead peer succeeded?!")
+    except PeerGoneError:
+        saw.append("gone")
+    except RPCError:
+        saw.append("transient")
+assert "gone" in saw, saw          # the peer ends up NAMED dead
+c.close()
+print("PEER_GONE_OK", saw)
+"""
+
+
+def test_peer_gone_error_typed_subprocess():
+    import subprocess
+
+    env = dict(os.environ)
+    env.update({"PADDLE_TPU_RPC_DEADLINE_MS": "400",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": ROOT + os.pathsep + env.get("PYTHONPATH",
+                                                          "")})
+    out = subprocess.run(
+        [sys.executable, "-c", _PEER_GONE_SCRIPT], env=env,
+        capture_output=True, timeout=120)
+    text = out.stdout.decode() + out.stderr.decode()
+    assert out.returncode == 0, text
+    assert "PEER_GONE_OK" in text, text
+
+
+def test_get_var_missing_on_live_server_stays_plain_rpcerror(monkeypatch):
+    """A live server answering 'not found' is an init race, NOT a dead
+    peer — the typed error must not misfire."""
+    monkeypatch.setenv("PADDLE_TPU_RPC_DEADLINE_MS", "300")
+    monkeypatch.setenv("PADDLE_TPU_RPC_RETRY_BASE_MS", "5")
+    monkeypatch.setenv("PADDLE_TPU_RPC_RETRY_CAP_MS", "20")
+    srv = RPCServer(port=0, num_trainers=1, sync=False)
+    srv.start()
+    try:
+        c = RPCClient("127.0.0.1:%d" % srv.port, trainer_id=0)
+        with pytest.raises(RPCError) as e:
+            c.get_var("never_pushed", retries=3)
+        assert not isinstance(e.value, PeerGoneError)
+        assert "never pushed" in str(e.value)
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_rpc_server_close_is_idempotent():
+    srv = RPCServer(port=0, num_trainers=1, sync=False)
+    srv.start()
+    srv.close()
+    srv.close()   # double close: no C teardown trip
+    srv.stop()    # stop after close: no-op
+    srv.close()
+
+
+# ===================================== rpc: bf16 wire compression hook
+def _roundtrip_send(value, compress=None):
+    """Push `value` through a REAL server (async mode) and return what
+    the Python side decodes."""
+    srv = RPCServer(port=0, num_trainers=1, sync=False)
+    srv.start()
+    try:
+        c = RPCClient("127.0.0.1:%d" % srv.port, trainer_id=0)
+        c.send_var("g@GRAD", value, compress=compress)
+        item = None
+        deadline = time.monotonic() + 10.0
+        while item is None and time.monotonic() < deadline:
+            item = srv.pop_async(timeout_ms=100)
+        assert item is not None, "send never arrived"
+        name, arr, _tid = item
+        assert name == "g@GRAD"  # marker stripped before consumers
+        c.close()
+        return arr
+    finally:
+        srv.close()
+
+
+def test_compression_off_by_default_is_bitwise():
+    from paddle_tpu.distributed.rpc import compress_mode
+
+    assert compress_mode() is None  # default: off
+    x = np.random.RandomState(0).randn(64, 9).astype(np.float32)
+    out = _roundtrip_send(x, compress=None)
+    assert out.dtype == np.float32
+    assert out.tobytes() == x.tobytes()
+
+
+def test_bf16_compression_error_bounded_and_counted():
+    s0 = _value("paddle_rpc_client_compress_bytes_saved_total")
+    v0 = _value("paddle_rpc_client_compressed_vars_total")
+    x = (np.random.RandomState(1).randn(128, 17) * 3).astype(np.float32)
+    out = _roundtrip_send(x, compress="bf16")
+    assert out.dtype == np.float32 and out.shape == x.shape
+    # bf16 keeps 8 mantissa bits: relative error <= 2^-8 per element
+    np.testing.assert_allclose(out, x, rtol=2.0 ** -8, atol=1e-30)
+    assert out.tobytes() != x.tobytes()  # it really traveled lossy
+    assert _value("paddle_rpc_client_compress_bytes_saved_total") \
+        == s0 + x.nbytes // 2  # bf16 halves the payload
+    assert _value("paddle_rpc_client_compressed_vars_total") == v0 + 1
+    # non-f32 payloads never compress (ids, int64 cursors)
+    ids = np.arange(12, dtype=np.int64)
+    got = _roundtrip_send(ids, compress="bf16")
+    assert got.dtype == np.int64 and got.tobytes() == ids.tobytes()
+
+
+def test_bf16_compression_sparse_selected_rows():
+    from paddle_tpu.distributed.rpc import SelectedRows
+
+    rows = np.array([1, 4, 7], dtype=np.int64)
+    vals = (np.random.RandomState(2).randn(3, 8) * 2).astype(np.float32)
+    out = _roundtrip_send(SelectedRows(rows, vals, height=10),
+                          compress="bf16")
+    assert isinstance(out, SelectedRows)
+    np.testing.assert_array_equal(out.rows, rows)
+    assert out.values.dtype == np.float32
+    np.testing.assert_allclose(out.values, vals, rtol=2.0 ** -8,
+                               atol=1e-30)
+    assert out.height == 10
+
+
+def test_grad_compress_gate_only_targets_grads(monkeypatch):
+    from paddle_tpu.ops.distributed_ops import _grad_compress
+
+    monkeypatch.setenv("PADDLE_TPU_RPC_COMPRESS", "bf16")
+    assert _grad_compress("fc_w@GRAD") == "bf16"
+    assert _grad_compress("fc_w@GRAD.block0") == "bf16"
+    assert _grad_compress("fc_w") is None          # init param push
+    monkeypatch.delenv("PADDLE_TPU_RPC_COMPRESS")
+    assert _grad_compress("fc_w@GRAD") is None     # off by default
+
+
+# ================================= supervisor.py satellite extensions
+def _build(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square(pred - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    main.random_seed = seed
+    startup.random_seed = seed
+    return main, startup, loss
+
+
+def _batches(n):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.randn(8, 4).astype(np.float32),
+             "y": rng.randn(8, 1).astype(np.float32)} for _ in range(n)]
+
+
+def test_restart_cause_counter(tmp_path):
+    c0 = _value("paddle_resilience_restarts_total", cause="InjectedFault")
+    o0 = _value("paddle_resilience_restarts_total", cause="other")
+    main, startup, loss = _build()
+    scope = Scope()
+    with scope_guard(scope):
+        with FaultPlan().arm("executor.dispatch", steps=(3,)):
+            r = resilient_train_loop(
+                main, lambda: iter(_batches(4)), [loss], scope=scope,
+                checkpoint_dir=str(tmp_path / "ck"),
+                startup_program=startup, checkpoint_every=2,
+                max_restarts=1, backoff_base_s=0.001,
+                backoff_cap_s=0.01)
+    assert r.steps == 4 and r.restarts == 1
+    assert _value("paddle_resilience_restarts_total",
+                  cause="InjectedFault") == c0 + 1
+    assert _value("paddle_resilience_restarts_total",
+                  cause="other") == o0
+    # causes outside the pre-declared schema fold into "other"
+    class WeirdFault(Exception):
+        pass
+
+    def explode(step, values):
+        raise WeirdFault("nope")
+
+    scope2 = Scope()
+    with scope_guard(scope2):
+        with pytest.raises(WeirdFault):
+            resilient_train_loop(
+                main, lambda: iter(_batches(2)), [loss], scope=scope2,
+                checkpoint_dir=str(tmp_path / "ck2"),
+                startup_program=startup, checkpoint_every=2,
+                retryable=(WeirdFault,), max_restarts=0,
+                on_step=explode)
+    assert _value("paddle_resilience_restarts_total",
+                  cause="other") == o0 + 1
+
+
+def test_checkpoint_every_zero_is_read_only(tmp_path):
+    main, startup, loss = _build()
+    d = str(tmp_path / "ck")
+    # writer run: produces the manifest
+    scope = Scope()
+    with scope_guard(scope):
+        resilient_train_loop(
+            main, lambda: iter(_batches(4)), [loss], scope=scope,
+            checkpoint_dir=d, startup_program=startup,
+            checkpoint_every=2, max_restarts=0)
+    man_before = read_manifest(d)
+    assert man_before["completed"]
+    # read-only run on a FRESH dir: trains fine, writes nothing
+    d2 = str(tmp_path / "ck_ro")
+    scope2 = Scope()
+    with scope_guard(scope2):
+        r = resilient_train_loop(
+            main, lambda: iter(_batches(4)), [loss], scope=scope2,
+            checkpoint_dir=d2, startup_program=startup,
+            checkpoint_every=0, max_restarts=0)
+    assert r.steps == 4
+    assert read_manifest(d2) is None and not os.path.exists(d2)
+    # read-only run against the WRITER's dir: resumes, never rewrites
+    scope3 = Scope()
+    with scope_guard(scope3):
+        r3 = resilient_train_loop(
+            main, lambda: iter(_batches(4)), [loss], scope=scope3,
+            checkpoint_dir=d, startup_program=startup,
+            checkpoint_every=0, max_restarts=0)
+    assert r3.resumed_from == 4
+    assert read_manifest(d) == man_before
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        resilient_train_loop(
+            main, lambda: iter(_batches(1)), [loss],
+            checkpoint_dir=d, checkpoint_every=-1)
+
+
+def test_manifest_extra_world_section(tmp_path):
+    main, startup, loss = _build()
+    d = str(tmp_path / "ck")
+    calls = []
+
+    def extra(step, epoch, batch):
+        calls.append((step, epoch, batch))
+        return {"world": mb.make_world(2, [0, 1],
+                                       cursors={0: batch, 1: batch},
+                                       epoch=epoch)}
+
+    scope = Scope()
+    with scope_guard(scope):
+        resilient_train_loop(
+            main, lambda: iter(_batches(4)), [loss], scope=scope,
+            checkpoint_dir=d, startup_program=startup,
+            checkpoint_every=2, max_restarts=0, manifest_extra=extra)
+    man = read_manifest(d)
+    assert calls, "manifest_extra never evaluated"
+    assert man["world"]["num_shards"] == 2
+    world, fb = mb.world_from_manifest(man)
+    assert fb is None and world["trainers"] == [0, 1]
+    # reserved keys are refused, not silently clobbered
+    with pytest.raises(ValueError, match="reserved"):
+        resilient_train_loop(
+            main, lambda: iter(_batches(2)), [loss], scope=Scope(),
+            checkpoint_dir=str(tmp_path / "ck2"),
+            startup_program=startup, checkpoint_every=1,
+            max_restarts=0, manifest_extra={"step": 999}, resume=False)
+
+
+# ============================================= elastic job (fast demo)
+def _read_timeline(workdir):
+    with open(os.path.join(workdir, "timeline.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_elastic_demo_kill_one_of_two(tmp_path):
+    """The demo CLI's machinery end to end (fast variant): a 2-trainer
+    job loses trainer 1 mid-epoch via FaultPlan crash, the supervisor
+    evicts + reshards from the manifest, the survivor finishes — and
+    the whole story is in the timeline sidecar + elastic counters."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import elastic_demo
+    finally:
+        sys.path.pop(0)
+    workdir = str(tmp_path / "job")
+    rc_args = ["--trainers", "2", "--steps", "5", "--kill", "1@3",
+               "--checkpoint-every", "2", "--workdir", workdir,
+               "--lease", "20", "--json"]
+    e0 = _value("paddle_elastic_membership_events_total", event="evict")
+    r0 = _value("paddle_elastic_reshards_total", cause="evict")
+    rc = elastic_demo.main(rc_args)
+    assert rc == 0
+    assert _value("paddle_elastic_membership_events_total",
+                  event="evict") == e0 + 1
+    assert _value("paddle_elastic_reshards_total", cause="evict") \
+        == r0 + 1
+    events = [ev["event"] for ev in _read_timeline(workdir)]
+    assert events.count("join") == 2
+    assert "evict" in events and "reshard" in events
+    assert events[-1] == "completed"
+    man = read_manifest(os.path.join(workdir, "checkpoints"))
+    assert man["completed"] and man["step"] == 5
+    # the manifest's world section records the SURVIVING world
+    world, fb = mb.world_from_manifest(man)
+    assert fb is None and world["trainers"] == [0]
+    assert world["num_shards"] == 2  # shards outlive their trainers
+    # the human renderer runs over the real sidecars
+    import io as _io
+
+    buf = _io.StringIO()
+    elastic_demo.print_timeline(workdir, out=buf)
+    text = buf.getvalue()
+    assert "reshard" in text and "paddle_elastic" in text
+    # telemetry sidecar carries the elastic families
+    with open(os.path.join(workdir, "telemetry.json")) as f:
+        snap = json.load(f)["metrics"]
+    assert "paddle_elastic_membership_events_total" in snap
+
+
+# ================================================= chaos (slow tier)
+def _final_blob(ckpt_dir):
+    from paddle_tpu.io import _load_blob
+
+    man = read_manifest(ckpt_dir)
+    _, data = _load_blob(os.path.join(ckpt_dir, man["latest"]), None)
+    return man, data
+
+
+@pytest.mark.slow
+def test_chaos_eviction_bitwise_parity(tmp_path):
+    """THE acceptance run: an N=3 job loses trainer 1 mid-epoch via
+    FaultPlan crash; eviction + reshard are visible in counters and
+    trace events; final dense params AND the RNG chain are bitwise
+    identical to a job started on the surviving world from the same
+    checkpoint."""
+    from paddle_tpu.observe import trace as _tr
+
+    e0 = _value("paddle_elastic_membership_events_total", event="evict")
+    r0 = _value("paddle_elastic_reshards_total", cause="evict")
+    chaos_dir = str(tmp_path / "chaos")
+    # kill trainer 1 during step 5's heartbeat (occurrence 6 = join +
+    # 5 step beats); checkpoint_every=2 -> the latest FINALIZED
+    # manifest at eviction is step 2 (step 4's write is still pending)
+    sup = ElasticJobSupervisor(
+        chaos_dir, trainers=3, steps_per_epoch=8, checkpoint_every=2,
+        lease_s=30.0,
+        worker_env={1: {"PADDLE_TPU_FAULT_PLAN":
+                        "trainer.heartbeat@6:crash"}})
+    res = sup.run(timeout_s=420.0)
+    assert res.completed, (res, res.timeline)
+    assert res.evictions == 1 and res.generations == 2
+    assert _value("paddle_elastic_membership_events_total",
+                  event="evict") == e0 + 1
+    assert _value("paddle_elastic_reshards_total", cause="evict") \
+        == r0 + 1
+    # the story is in the trace ring too (elastic.* sites)
+    sites = {e["site"] for e in _tr.recorder().events()}
+    assert "elastic.membership" in sites
+    assert "elastic.reshard" in sites
+    # the reshard resumed from a real checkpoint, with the world
+    # re-dealt over the survivors
+    reshard = [ev for ev in res.timeline if ev["event"] == "reshard"]
+    assert len(reshard) == 1 and reshard[0]["cause"] == "evict"
+    gen1 = [ev for ev in res.timeline
+            if ev["event"] == "generation_start"][1]
+    assert gen1["trainers"] == [0, 2] and gen1["resume_step"] == 2
+    covered = sorted(s for sh in gen1["assignment"].values()
+                     for s in sh)
+    assert covered == [0, 1, 2]
+
+    # ---- reference: a FRESH job on the surviving world {0, 2} from
+    # the archived reshard checkpoint
+    ref_dir = str(tmp_path / "ref")
+    shutil.copytree(os.path.join(chaos_dir, "reshard_g0"),
+                    os.path.join(ref_dir, "checkpoints"))
+    ref = ElasticJobSupervisor(
+        ref_dir, trainer_ids=[0, 2], steps_per_epoch=8,
+        checkpoint_every=2, lease_s=30.0)
+    rres = ref.run(timeout_s=420.0)
+    assert rres.completed and rres.evictions == 0
+
+    man1, d1 = _final_blob(os.path.join(chaos_dir, "checkpoints"))
+    man2, d2 = _final_blob(os.path.join(ref_dir, "checkpoints"))
+    assert man1["step"] == man2["step"] == 8
+    assert sorted(d1) == sorted(d2)
+    assert "@RNG_STATE@" in d1  # dropout: the RNG chain is REAL
+    for n in sorted(d1):
+        a, b = np.asarray(d1[n]), np.asarray(d2[n])
+        assert a.dtype == b.dtype and a.shape == b.shape, n
+        assert a.tobytes() == b.tobytes(), (
+            "var %r diverged between the chaos job and the surviving-"
+            "world reference run" % n)
+
+
+@pytest.mark.slow
+def test_chaos_rejoin_completes_epoch_exactly_once(tmp_path):
+    """Second acceptance variant: the killed trainer REJOINS after
+    eviction; the epoch completes with every data shard processed
+    exactly once under the manifest-accounting chain (each generation
+    resumes from the latest finalized cursor, earlier overrun is
+    replay-discarded; fast-forward telemetry proves the replays were
+    skipped, the manifest chain proves coverage)."""
+    workdir = str(tmp_path / "job")
+    steps = 10
+    sup = ElasticJobSupervisor(
+        workdir, trainers=3, steps_per_epoch=steps, checkpoint_every=2,
+        lease_s=30.0,
+        worker_env={1: {"PADDLE_TPU_FAULT_PLAN":
+                        "trainer.heartbeat@4:crash"}},
+        rejoin={1: 5})
+    res = sup.run(timeout_s=420.0)
+    assert res.completed, (res, res.timeline)
+    assert res.evictions == 1 and res.rejoins == 1
+    causes = [r["cause"] for r in res.reshards]
+    assert causes == ["evict", "join"]
+
+    man = read_manifest(os.path.join(workdir, "checkpoints"))
+    assert man["completed"] and man["step"] == steps
+    world, fb = mb.world_from_manifest(man)
+    assert fb is None
+    # the rejoined world finished the epoch at full strength
+    assert world["trainers"] == [0, 1, 2]
+
+    # ---- exactly-once accounting over the generation chain:
+    # generation g owns batches [resume_g, resume_{g+1}) — the replayed
+    # overrun beyond a generation's last finalized cursor is discarded
+    # by the next restore. Every shard is assigned in every
+    # generation's world, so the union covers each (shard, batch)
+    # exactly once.
+    gens = [ev for ev in res.timeline
+            if ev["event"] == "generation_start"]
+    resumes = [g["resume_step"] for g in gens] + [steps]
+    assert resumes[0] == 0 and resumes == sorted(resumes)
+    for g in gens:
+        covered = sorted(s for sh in g["assignment"].values()
+                         for s in sh)
+        assert covered == list(range(world["num_shards"]))
+    owned = []
+    for lo, hi in zip(resumes, resumes[1:]):
+        owned.extend(range(lo, hi))
+    assert sorted(set(owned)) == list(range(steps))  # full coverage
+    # at-least-once, replay-discarded: resumed generations fast-forward
+    # past the batches an earlier generation already checkpointed —
+    # visible in the workers' own telemetry sidecars
+    ff = 0.0
+    tdir = os.path.join(workdir, "telemetry")
+    for fn in os.listdir(tdir):
+        with open(os.path.join(tdir, fn)) as f:
+            snap = json.load(f)["metrics"]
+        fam = snap.get("paddle_resilience_fast_forward_batches_total")
+        if fam:
+            ff += sum(s.get("value", 0) for s in fam["samples"])
+    assert ff > 0, "no generation ever fast-forwarded a replayed batch"

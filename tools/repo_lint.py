@@ -54,11 +54,16 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # planes must never absorb KeyboardInterrupt/SystemExit). Every serving/
 # module — including the fleet tier's prefix store and router — rides the
 # directory entry; the load driver is the serving plane's test harness
-# and holds the same contract.
+# and holds the same contract. distributed/ joined with the elastic
+# tier: rpc.py/ps.py/membership.py sit under the same supervisor-kill
+# discipline as resilience/ (an absorbed SIGTERM would wedge a whole
+# generation teardown).
 BARE_EXCEPT_PATHS = (
     os.path.join("paddle_tpu", "resilience"),
     os.path.join("paddle_tpu", "serving"),
+    os.path.join("paddle_tpu", "distributed"),
     os.path.join("tools", "serving_load.py"),
+    os.path.join("tools", "elastic_demo.py"),
 )
 
 FAMILIES_FILE = os.path.join("paddle_tpu", "observe", "families.py")
