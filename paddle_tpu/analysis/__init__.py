@@ -18,19 +18,28 @@ See docs/ANALYSIS.md for the rule catalog and how to write a rule.
 """
 
 from . import shape_rules  # noqa: F401  (attaches the core rule set)
+from .dataflow import Dataflow  # noqa: F401
 from .infer import (Finding, InferContext, InferError,  # noqa: F401
                     ProgramVerifyError, infer_program_shapes,
                     validation_enabled, verify_program)
 from .lint import LINT_RULES, lint_program  # noqa: F401
+from .tv import (ProgramSnapshot, RewriteViolation,  # noqa: F401
+                 describe_rewrites, tv_enabled, validate_rewrite)
 
 __all__ = [
+    "Dataflow",
     "Finding",
     "InferContext",
     "InferError",
     "LINT_RULES",
+    "ProgramSnapshot",
     "ProgramVerifyError",
+    "RewriteViolation",
+    "describe_rewrites",
     "infer_program_shapes",
     "lint_program",
+    "tv_enabled",
+    "validate_rewrite",
     "validation_enabled",
     "verify_program",
 ]

@@ -1,0 +1,488 @@
+"""Whole-program dataflow analysis: THE hazard-query substrate for passes.
+
+PR 7/8 shipped only after review rounds caught six confirmed miscompiles
+— CSE write-versioning, copy-prop aliasing, materialize ordering, fusion
+read-after-write, optimizer-group reorder, fused-replay RAW — and every
+one was born the same way: a pass re-deriving its own ad-hoc hazard
+logic (write counts, write-between scans, last-write positions) over
+``core.program.op_effects``. This module computes the def-use facts ONCE
+per block and exposes them as queries, so a pass *asks* instead of
+re-implementing:
+
+* **write timelines** — per-name ordered write positions (an in-place
+  update like ``sgd ParamOut=param`` is a second write: two versions of
+  the same name at different program points);
+* **reaching definitions** — which write (op) a read at position ``p``
+  observes (``reaching_def``/``last_write_before``);
+* **liveness** — which writes are ever read before being overwritten
+  (``dead_stores``), and which ops feed a fetch/persistable root
+  (``dead_ops`` — the fetch-relative backward slice shared by the DCE
+  pass and the lint suite's advisory ``dead-op`` rule: ONE definition,
+  like ``op_effects`` itself);
+* **pinning** — names a pass must not rewire or re-splice (sub-block
+  reads resolve through the sub-block's parent CHAIN, control-flow
+  ``condition``/``__sub_bound__`` attrs);
+* **hazard queries** — ``can_remove(op)``, ``can_merge(a, b)``,
+  ``can_move(op, pos)``, ``writes_between(name, i, j)``,
+  ``last_write_before(name, pos)``, ``value_key(op)``.
+
+The facts describe the program AT CONSTRUCTION TIME (positions are
+pre-pass program positions); passes build one ``Dataflow`` per
+application and mutate the graph afterwards — which is exactly the
+discipline the historical miscompiles violated (reasoning about
+node-list adjacency after a rewrite instead of original positions).
+
+``analysis/tv.py`` (the per-pass translation validator) re-derives the
+same reaching-definition facts independently on the *after* program, so
+a pass that lies to itself cannot also fool the check.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core.program import Operator, Program, op_effects
+from ..core.registry import OPS, has_op
+
+__all__ = ["Dataflow", "Unfingerprintable", "attrs_fingerprint",
+           "fingerprint", "is_pure", "op_uses_rng"]
+
+
+class Unfingerprintable(Exception):
+    """Raised by ``fingerprint`` on attr values with no stable identity."""
+
+
+def fingerprint(value):
+    """Hashable, order-independent identity of an attr value (dicts and
+    lists normalized recursively). Raises ``Unfingerprintable`` for
+    anything that is not a plain scalar container — an op carrying a
+    callable attr has no safe structural identity and must not be
+    CSE'd."""
+    if isinstance(value, dict):
+        return ("d", tuple(sorted((k, fingerprint(v))
+                                  for k, v in value.items())))
+    if isinstance(value, (list, tuple)):
+        return ("l", tuple(fingerprint(v) for v in value))
+    if isinstance(value, (int, float, str, bool, type(None))):
+        return value
+    raise Unfingerprintable(repr(type(value)))
+
+
+def attrs_fingerprint(attrs: dict):
+    """Fingerprint of a whole attr dict (all keys; ``__op_role__`` is
+    included deliberately — merging a backward-role op into a forward
+    one would break the gradient-accumulation role partition)."""
+    return fingerprint(attrs)
+
+
+def op_uses_rng(program: Program, op) -> bool:
+    """True when lowering this op consumes the PRNG chain (directly or in
+    a sub-block) — the executor's needs_rng probe, shared here so no
+    pass ever removes or merges an RNG consumer."""
+    if not has_op(op.type):
+        return True  # unknown op: assume the worst
+    from ..core.registry import get_op
+
+    if get_op(op.type).uses_rng:
+        return True
+    sub = op.attrs.get("sub_block")
+    if isinstance(sub, int) and 0 <= sub < len(program.blocks):
+        return any(op_uses_rng(program, s) for s in program.block(sub).ops)
+    return False
+
+
+def is_pure(program: Program, op) -> bool:
+    """A pass may remove/merge this op without changing any surviving
+    op's value: registered, RNG-free, no control-flow body, no lowering
+    env access, and no side-effecting role (optimize/dist ops mutate
+    persistable state by contract)."""
+    if not has_op(op.type):
+        return False
+    if op.attrs.get("__op_role__") in ("optimize", "dist"):
+        return False
+    if "sub_block" in op.attrs:
+        return False
+    opdef = OPS.get(op.type)
+    if opdef is not None and opdef.needs_env:
+        return False
+    if op_uses_rng(program, op):
+        return False
+    return True
+
+
+def _var_of(program: Program, name: str):
+    v = program.global_block()._find_var_recursive(name)
+    if v is not None:
+        return v
+    for b in program.blocks:
+        if name in b.vars:
+            return b.vars[name]
+    return None
+
+
+class Dataflow:
+    """Write-versioned def-use facts of one program's global block.
+
+    Built once per pass application (O(ops) construction); every query
+    is a dict/bisect lookup. Positions are indices into the global
+    block's op list at construction time; ops are also addressable by
+    identity (``pos_of(op)``).
+
+    ``fetch_names`` anchor the fetch-relative queries (``can_remove``,
+    ``dead_ops``); ``scope`` resolves undeclared-but-scope-backed names
+    the way the executor's ``analyze_block`` does (they are persistable
+    write-back state, never droppable temps).
+    """
+
+    def __init__(self, program: Program, fetch_names: Sequence[str] = (),
+                 scope=None):
+        self.program = program
+        self.fetch: Set[str] = set(fetch_names or ())
+        self.scope = scope
+        block = program.global_block()
+        self.ops: List[Operator] = list(block.ops)
+        self._pos: Dict[int, int] = {id(op): i
+                                     for i, op in enumerate(self.ops)}
+        # (reads, writes) per position, sub-block effects attributed to
+        # their control-flow op (THE shared op_effects semantics)
+        self.reads: List[Tuple[str, ...]] = []
+        self.writes: List[Tuple[str, ...]] = []
+        self._write_pos: Dict[str, List[int]] = {}
+        self._read_pos: Dict[str, List[int]] = {}
+        for i, op in enumerate(self.ops):
+            r, w = op_effects(program, op)
+            self.reads.append(tuple(r))
+            self.writes.append(tuple(w))
+            for n in set(r):
+                self._read_pos.setdefault(n, []).append(i)
+            for n in w:  # duplicates kept: each is a distinct write
+                self._write_pos.setdefault(n, []).append(i)
+        self.pinned: Set[str] = self._pinned(program)
+        self._rng_cache: Dict[int, bool] = {}
+        self._pure_cache: Dict[int, bool] = {}
+        self._key_cache: Dict[int, object] = {}
+        self._dead_stores = None
+
+    # ------------------------------------------------------ basic facts
+    @staticmethod
+    def _pinned(program: Program) -> Set[str]:
+        """Names a pass must not rewire, rename, or re-splice: anything
+        referenced inside a sub-block, bound by a control-flow op
+        (``condition`` / ``__sub_bound__``), or read through a channel
+        the Graph's var edges do not model."""
+        pinned: Set[str] = set()
+        for block in program.blocks[1:]:
+            for op in block.ops:
+                pinned.update(op.input_names())
+                pinned.update(op.output_names())
+                Dataflow._pin_attrs(op, pinned)
+            pinned.update(block.vars)
+        for op in program.global_block().ops:
+            Dataflow._pin_attrs(op, pinned)
+        return pinned
+
+    @staticmethod
+    def _pin_attrs(op, pinned: Set[str]) -> None:
+        cond = op.attrs.get("condition")
+        if cond:
+            pinned.add(cond)
+        pinned.update(op.attrs.get("__sub_bound__", ()))
+
+    def pos_of(self, op) -> int:
+        """Construction-time position of ``op`` (KeyError if it was not
+        in the block when this analysis was built)."""
+        return self._pos[id(op)]
+
+    def contains(self, op) -> bool:
+        """Was ``op`` in the block when this analysis was built? (A
+        node inserted by a LATER rewrite is not — its position, and
+        therefore every hazard answer about it, is unknowable here.)"""
+        return id(op) in self._pos
+
+    def var_of(self, name: str):
+        return _var_of(self.program, name)
+
+    def uses_rng(self, op) -> bool:
+        k = id(op)
+        if k not in self._rng_cache:
+            self._rng_cache[k] = op_uses_rng(self.program, op)
+        return self._rng_cache[k]
+
+    def is_pure(self, op) -> bool:
+        k = id(op)
+        if k not in self._pure_cache:
+            self._pure_cache[k] = is_pure(self.program, op)
+        return self._pure_cache[k]
+
+    # -------------------------------------------------- write timelines
+    def write_count(self, name: str) -> int:
+        """Times ``name`` is written in the block (sub-block writes
+        attributed to their control-flow op)."""
+        return len(self._write_pos.get(name, ()))
+
+    def write_positions(self, name: str) -> Tuple[int, ...]:
+        return tuple(self._write_pos.get(name, ()))
+
+    def read_positions(self, name: str) -> Tuple[int, ...]:
+        return tuple(self._read_pos.get(name, ()))
+
+    def last_write_before(self, name: str, pos: int) -> Optional[int]:
+        """Position of the last write of ``name`` STRICTLY before
+        ``pos``, or None (the value is external: feed/scope/startup)."""
+        best = None
+        for w in self._write_pos.get(name, ()):
+            if w >= pos:
+                break
+            best = w
+        return best
+
+    def first_write_at_or_after(self, name: str, pos: int) -> Optional[int]:
+        for w in self._write_pos.get(name, ()):
+            if w >= pos:
+                return w
+        return None
+
+    def writes_between(self, name: str, i: int, j: int) -> Tuple[int, ...]:
+        """Write positions ``w`` of ``name`` with ``i < w <= j`` — the
+        window that matters when a read at slot ``i`` is evaluated at
+        slot ``j`` instead (fusion running a constituent at the chain
+        tail). Empty tuple = the move is write-hazard-free."""
+        return tuple(w for w in self._write_pos.get(name, ())
+                     if i < w <= j)
+
+    def reads_between(self, name: str, i: int, j: int) -> Tuple[int, ...]:
+        """Read positions ``r`` with ``i < r <= j`` (the dual window: a
+        WRITE moving from ``i`` to ``j`` must not jump these reads)."""
+        return tuple(r for r in self._read_pos.get(name, ())
+                     if i < r <= j)
+
+    def version_at(self, name: str, pos: int) -> int:
+        """Write version a read AT ``pos`` observes: the number of
+        writes strictly before ``pos`` (0 = the external value)."""
+        n = 0
+        for w in self._write_pos.get(name, ()):
+            if w >= pos:
+                break
+            n += 1
+        return n
+
+    def reaching_def(self, name: str, pos: int) -> Optional[Operator]:
+        """The op whose write of ``name`` a read at ``pos`` observes,
+        or None when the value is external (feed / scope / startup)."""
+        w = self.last_write_before(name, pos)
+        return None if w is None else self.ops[w]
+
+    # ----------------------------------------------------- hazard rules
+    def removable_output(self, name: str, ignore_fetch: bool = False) -> bool:
+        """May a pass make ``name`` stop being produced by its current
+        op? Requires: not fetched (unless ``ignore_fetch`` — folding
+        keeps a fetched name alive through the materialized constant),
+        not structurally pinned, declared non-persistable / non-data,
+        written exactly once (SSA-like) — and, mirroring the executor's
+        ``analyze_block`` classification, an UNDECLARED name living in
+        the run scope is persistable write-back state, never a droppable
+        temp."""
+        if not ignore_fetch and name in self.fetch:
+            return False
+        if name in self.pinned:
+            return False
+        if self.write_count(name) != 1:
+            return False
+        v = self.var_of(name)
+        if v is not None and (v.persistable or v.is_data):
+            return False
+        if v is None and self.scope is not None and self.scope.has_var(name):
+            return False
+        return True
+
+    def can_remove(self, op) -> bool:
+        """May a pass delete ``op`` entirely (its value re-derivable or
+        unused)? Pure, and every nonempty output droppable."""
+        if not self.is_pure(op):
+            return False
+        return all(self.removable_output(n)
+                   for n in op.output_names() if n)
+
+    def can_merge(self, a, b) -> bool:
+        """May ``b`` (the duplicate) merge onto ``a`` (the surviving
+        first occurrence)? Both pure, value-identical
+        (``value_key(a) == value_key(b)`` — inputs at the SAME write
+        version, so reads around an in-place update never merge),
+        ``b``'s outputs droppable, ``a``'s outputs stable (written
+        exactly once — a later rewrite of a target output would hand
+        rewired consumers the overwritten value), and every nonempty
+        output of ``b`` has a nonempty counterpart at the same
+        (slot, idx) of ``a``."""
+        ka, kb = self.value_key(a), self.value_key(b)
+        if ka is None or ka != kb:
+            return False
+        for slot, names in b.outputs.items():
+            anames = a.outputs.get(slot, [])
+            for i, n in enumerate(names):
+                if not n:
+                    continue
+                if i >= len(anames) or not anames[i]:
+                    return False
+                if not self.removable_output(n):
+                    return False
+        return all(self.write_count(n) == 1
+                   for n in a.output_names() if n)
+
+    def can_move(self, op, pos: int, ignore: Sequence[str] = ()) -> bool:
+        """May ``op`` execute at position ``pos`` instead of its own
+        slot with identical semantics? Checks BOTH hazard directions
+        over the move window: no read crosses a write of its name, and
+        no write crosses a read or another write of its name. RNG
+        consumers never move (reordering one shifts the key chain of
+        every later consumer).
+
+        ``ignore`` names are exempt from the hazard windows — a fused
+        chain moves its constituents TOGETHER, so its internally
+        threaded temps (produced and consumed inside the group) are not
+        hazards even though a lone-op move would trip on them."""
+        own = self.pos_of(op)
+        if pos == own:
+            return True
+        if self.uses_rng(op):
+            return False
+        skip = set(ignore)
+        # the exclusive lower bound keeps ``own`` itself out of both
+        # windows in either direction (forward: lo == own; backward:
+        # hi == own - 1), so the op's own effects are never hazards
+        lo, hi = (own, pos) if pos > own else (pos - 1, own - 1)
+        for n in self.reads[own]:
+            if n in skip:
+                continue
+            if self.writes_between(n, lo, hi):
+                return False
+        for n in self.writes[own]:
+            if n in skip:
+                continue
+            if self.writes_between(n, lo, hi):
+                return False
+            if self.reads_between(n, lo, hi):
+                return False
+        return True
+
+    def value_key(self, op):
+        """Value-numbering key: ``(type, attrs fingerprint, inputs at
+        their current write version)`` — None when the op is impure or
+        carries unfingerprintable attrs (no safe structural identity).
+        Two ops with equal keys provably compute the same value;
+        ``__op_role__`` rides the attrs fingerprint deliberately (the
+        gradient-accumulation partition must not merge across roles)."""
+        k = id(op)
+        if k in self._key_cache:  # CSE keys each op, then can_merge
+            return self._key_cache[k]  # re-asks for both sides
+        key = self._value_key(op)
+        self._key_cache[k] = key
+        return key
+
+    def _value_key(self, op):
+        if not self.is_pure(op):
+            return None
+        try:
+            fp = attrs_fingerprint(op.attrs)
+        except Unfingerprintable:
+            return None
+        pos = self._pos.get(id(op))
+        if pos is None:
+            return None
+        ins = tuple(sorted(
+            (slot, i, n, self.version_at(n, pos))
+            for slot, names in op.inputs.items()
+            for i, n in enumerate(names) if n))
+        return (op.type, fp, ins)
+
+    # ------------------------------------------------ liveness analyses
+    def dead_ops(self) -> List[int]:
+        """Positions of ops removable w.r.t. this analysis' fetch set:
+        the fetch-relative backward slice over ``op_effects`` keeps
+        every op that (transitively) feeds a fetch, writes persistable
+        or scope-backed state, carries a side-effecting role, owns a
+        control-flow body, or consumes RNG. THE single definition —
+        the DCE pass acts on it and the lint suite's advisory
+        ``dead-op`` rule reports it, so the two can never drift."""
+        needed = set(self.fetch)
+        dead: List[int] = []
+        for i in range(len(self.ops) - 1, -1, -1):
+            op = self.ops[i]
+            live = (op.attrs.get("__op_role__") in ("optimize", "dist")
+                    or not self.is_pure(op))
+            if not live:
+                for n in self.writes[i]:
+                    v = self.var_of(n)
+                    persist = (v is not None and v.persistable) or (
+                        v is None and self.scope is not None
+                        and self.scope.has_var(n))
+                    if n in needed or persist:
+                        live = True
+                        break
+            if live:
+                needed.update(self.reads[i])
+            else:
+                dead.append(i)
+        dead.reverse()
+        return dead
+
+    def dead_stores(self) -> List[Tuple[int, str]]:
+        """(position, name) pairs where a write is never read before
+        the next write of the same name (or the block's end) and is not
+        live-out (fetched / persistable / scope-backed / pinned): the
+        stored value is provably unobservable. Name-granular — an op
+        with one live and one dead output shows up here but not in
+        ``dead_ops``. Memoized: the dead-store and write-after-write
+        lint rules both consume it in one lint run."""
+        if self._dead_stores is not None:
+            return self._dead_stores
+        out: List[Tuple[int, str]] = []
+        for name, wpos in self._write_pos.items():
+            if name in self.fetch or name in self.pinned:
+                continue
+            v = self.var_of(name)
+            if v is not None and (v.persistable or v.is_data):
+                continue
+            if v is None and self.scope is not None \
+                    and self.scope.has_var(name):
+                continue
+            for k, w in enumerate(wpos):
+                nxt = wpos[k + 1] if k + 1 < len(wpos) else len(self.ops)
+                if not self.reads_between(name, w, nxt):
+                    out.append((w, name))
+        self._dead_stores = out
+        return out
+
+    def conditional_only_defs(self) -> List[Tuple[int, str]]:
+        """(read position, name) pairs where every definition reaching a
+        top-level read lives inside a CONDITIONAL sub-block (an op
+        carrying both ``sub_block`` and a ``Cond`` input / ``condition``
+        attr): on the branch not taken the name is uninitialized.
+        External values (feeds, scope state, persistables) are never
+        flagged — only temps whose sole writers are conditional."""
+        out: List[Tuple[int, str]] = []
+        for i in range(len(self.ops)):
+            for n in set(self.reads[i]):
+                v = self.var_of(n)
+                if v is not None and (v.persistable or v.is_data):
+                    continue
+                if self.scope is not None and self.scope.has_var(n):
+                    continue
+                w = self.last_write_before(n, i)
+                if w is None:
+                    continue  # external / undefined: other rules' turf
+                writer = self.ops[w]
+                if "sub_block" not in writer.attrs:
+                    continue
+                if not (writer.attrs.get("condition")
+                        or writer.inputs.get("Cond")):
+                    continue  # unconditional body (while runs >= 0 times
+                    #           but writes its carries; recurrent writes)
+                # conditional writer: is there ANY unconditional write
+                # of n before the read?
+                if any(
+                    "sub_block" not in self.ops[p].attrs
+                    for p in self._write_pos.get(n, ()) if p < i
+                ):
+                    continue
+                out.append((i, n))
+        return out

@@ -55,6 +55,10 @@ RULES = (
     "int64-narrowing",    # op materializes an int64 intermediate
     "grad-pairing",       # X@GRAD without X in the program
     "sub-block",          # control-flow sub-block wiring broken
+    # dataflow-engine-powered rules (analysis/dataflow.py liveness)
+    "dead-store",         # write never read before block end, not live-out
+    "write-after-write",  # non-persistable overwritten with no read between
+    "use-before-init",    # only conditional sub-block defs reach the read
 )
 
 

@@ -5,7 +5,9 @@ piecemeal in op->InferShape/OpDesc checks and the SSA-graph validity
 passes (multi_devices_graph_check_pass): def-before-use, fetch of
 undefined vars, unregistered op types, dead ops/vars, double-writes to
 persistables, int64 feed-boundary hazards, grad-var pairing, and
-control-flow sub-block wiring. Severities:
+control-flow sub-block wiring — plus the dataflow-engine-powered rules
+(dead-store, write-after-write, use-before-init) riding ONE shared
+``analysis.dataflow.Dataflow`` per lint run. Severities:
 
 * ``error``   — the program cannot lower correctly; Program.validate()
                 and prepare-time checking raise ProgramVerifyError.
@@ -25,6 +27,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..core.program import GRAD_SUFFIX, Block, Program, op_effects
 from ..core.registry import has_op
+from .dataflow import Dataflow
 from .infer import Finding, finding_for_op
 
 __all__ = ["LINT_RULES", "lint_program"]
@@ -154,30 +157,91 @@ def rule_dead_vars(program, ctx, findings):
 
 
 def rule_dead_ops(program, ctx, findings):
-    """With a fetch list: ops whose outputs reach no fetch target and no
-    persistable write (and carry no side-effecting role) are dead
-    w.r.t. this run (info — eval runs legitimately fetch a slice)."""
-    fetch_names = set(ctx.get("fetch_names") or ())
+    """With a fetch list: ops the optimizer's dead_op_elimination_pass
+    would remove are dead w.r.t. this run (info — eval runs
+    legitimately fetch a slice). THE slice is ``Dataflow.dead_ops``,
+    the SAME definition the DCE pass acts on — advisory report and
+    acting removal can never drift (an RNG consumer or control-flow
+    body the pass must keep for bitwise parity is not reported either,
+    since it provably survives optimization)."""
+    fetch_names = ctx.get("fetch_names") or ()
     if not fetch_names:
         return
     block = program.global_block()
-    needed = set(fetch_names)
-    for op in reversed(block.ops):
-        reads, writes = _op_reads_writes(program, op)
-        live = op.attrs.get("__op_role__") in ("optimize", "dist")
-        if not live:
-            for n in writes:
-                var = _var_of(program, block, n)
-                if n in needed or (var is not None and var.persistable):
-                    live = True
-                    break
-        if live:
-            needed.update(reads)
-        else:
-            findings.append(finding_for_op(
-                "dead-op", "info",
-                "contributes to no fetch target or persistable write "
-                "for this fetch list", block, op))
+    df = ctx.get("dataflow") or Dataflow(
+        program, fetch_names=fetch_names, scope=ctx.get("scope"))
+    for pos in df.dead_ops():
+        findings.append(finding_for_op(
+            "dead-op", "info",
+            "contributes to no fetch target or persistable write "
+            "for this fetch list (dead_op_elimination_pass removes it)",
+            block, df.ops[pos]))
+
+
+def rule_dead_stores(program, ctx, findings):
+    """A write never read before the next write of the same name (or
+    the block's end) and not live-out — fetched, persistable, scope-
+    backed or pinned — stores a provably unobservable value (info;
+    name-granular, so a multi-output op with one dead output shows up
+    here but not under dead-op). Powered by the dataflow engine's
+    liveness facts."""
+    block = program.global_block()
+    df = ctx.get("dataflow") or Dataflow(
+        program, fetch_names=ctx.get("fetch_names") or (),
+        scope=ctx.get("scope"))
+    for pos, name in df.dead_stores():
+        nxt = df.first_write_at_or_after(name, pos + 1)
+        if nxt is not None:
+            continue  # overwritten-without-read: write-after-write rule
+        findings.append(finding_for_op(
+            "dead-store", "info",
+            "writes %r, which nothing reads before the block ends "
+            "(and it is not fetched/persistable)" % name, block,
+            df.ops[pos], var=name))
+
+
+def rule_write_after_write(program, ctx, findings):
+    """Two writes to the same non-persistable name with no read between
+    them: the first write is dead (info — the persistable flavor is the
+    double-write warning). Powered by the dataflow engine's write
+    timelines."""
+    block = program.global_block()
+    df = ctx.get("dataflow") or Dataflow(
+        program, fetch_names=ctx.get("fetch_names") or (),
+        scope=ctx.get("scope"))
+    for pos, name in df.dead_stores():
+        nxt = df.first_write_at_or_after(name, pos + 1)
+        if nxt is None:
+            continue  # never rewritten: dead-store rule's turf
+        findings.append(finding_for_op(
+            "write-after-write", "info",
+            "writes %r, which op #%d overwrites with no read in "
+            "between (the first write is dead)" % (name, nxt), block,
+            df.ops[pos], var=name))
+
+
+def rule_use_before_init(program, ctx, findings):
+    """A top-level read whose EVERY reaching definition lives inside a
+    conditional sub-block: on the branch not taken the name is
+    uninitialized garbage (info — both-branches-write patterns assign
+    into pre-created vars and are not flagged because the pre-creating
+    write is unconditional). Powered by the dataflow engine's
+    sub-block-aware reaching definitions."""
+    block = program.global_block()
+    df = ctx.get("dataflow") or Dataflow(
+        program, fetch_names=ctx.get("fetch_names") or (),
+        scope=ctx.get("scope"))
+    seen = set()
+    for pos, name in df.conditional_only_defs():
+        if name in seen:
+            continue  # one finding per name: the fix is one write
+        seen.add(name)
+        findings.append(finding_for_op(
+            "use-before-init", "info",
+            "reads %r, whose only definition(s) before this point sit "
+            "inside conditional sub-block(s) — uninitialized on the "
+            "untaken branch (write it unconditionally first)" % name,
+            block, df.ops[pos], var=name))
 
 
 def rule_double_write(program, ctx, findings):
@@ -286,11 +350,20 @@ LINT_RULES = {
     "fetch-undefined": rule_fetch_undefined,
     "dead-var": rule_dead_vars,
     "dead-op": rule_dead_ops,
+    "dead-store": rule_dead_stores,
+    "write-after-write": rule_write_after_write,
+    "use-before-init": rule_use_before_init,
     "double-write": rule_double_write,
     "int64-boundaries": rule_int64_boundaries,
     "grad-pairing": rule_grad_pairing,
     "sub-block": rule_sub_blocks,
 }
+
+# rules that consult the dataflow engine: lint_program builds ONE
+# analysis and shares it through the ctx so a four-rule run costs one
+# O(ops) construction, not four
+_DATAFLOW_RULES = ("dead-op", "dead-store", "write-after-write",
+                   "use-before-init")
 
 
 def lint_program(program: Program, fetch_names: Sequence[str] = (),
@@ -299,8 +372,11 @@ def lint_program(program: Program, fetch_names: Sequence[str] = (),
     """Run the lint pass suite; returns (and appends to) ``findings``."""
     findings = findings if findings is not None else []
     ctx = {"fetch_names": list(fetch_names), "scope": scope}
-    for name, fn in LINT_RULES.items():
-        if rules is not None and name not in rules:
-            continue
-        fn(program, ctx, findings)
+    active = [name for name in LINT_RULES
+              if rules is None or name in rules]
+    if any(name in _DATAFLOW_RULES for name in active):
+        ctx["dataflow"] = Dataflow(program, fetch_names=fetch_names,
+                                   scope=scope)
+    for name in active:
+        LINT_RULES[name](program, ctx, findings)
     return findings

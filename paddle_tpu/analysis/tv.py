@@ -1,0 +1,516 @@
+"""Per-pass translation validation: machine-check each rewrite's log.
+
+The optimizer's existing safety net (verify-after-every-pass,
+core/passes) re-runs shape inference + the error-capable lint rules — it
+catches a pass that produces an *invalid* program, but not one that
+produces a *different valid* program (the shape of all six historical
+miscompiles: CSE write-versioning, copy-prop aliasing, materialize
+ordering, fusion read-after-write, optimizer-group reorder, fused-replay
+RAW). This module closes that gap with a translation validator in the
+classic sense (Pnueli/Necula): each structural pass emits a **rewrite
+log** — declared removals, merges, copy-forwards, fusions and constant
+materializations — and the validator statically proves the after-program
+equivalent to the before-program *modulo exactly those declarations*:
+
+* **accounting** — every op that vanished is declared, every op that
+  appeared is a declared replacement, and no declared rewrite touches an
+  RNG consumer (the bitwise contract's untouchables);
+* **ordering** — surviving ops keep their relative order (no pass
+  reorders; only declared replacements may occupy new slots);
+* **def-chain preservation** — for every surviving read, every new op's
+  external read, and every root value (fetch / pinned / persistable /
+  scope-backed), the reaching definition in the after-program must be
+  the *image under the declared rewrites* of the reaching definition in
+  the before-program. A read that now observes a different write — the
+  read-moved-past-write shape — or a root whose producer vanished
+  undeclared — the dropped-def shape — is a violation;
+* **merge equivalence** — a declared merge must be between ops that
+  provably compute the same value (same type, same attrs fingerprint,
+  inputs resolving to the same reaching definitions — *write-versioned*,
+  so reads around an in-place update never pass);
+* **replay hazards** — a fused op fetches its external inputs at ITS
+  slot (entry). A constituent read whose before-definition is another
+  constituent of the same group (undeclared as internally threaded)
+  would see the stale pre-group value: the fused-replay RAW shape.
+
+The reaching-definition facts are re-derived here from the before
+snapshot and a fresh :class:`~paddle_tpu.analysis.dataflow.Dataflow`
+over the after-program — independent of whatever analysis the pass used
+to justify itself, so a pass that fooled its own hazard check cannot
+also fool the validator.
+
+Run by the PassManager after each structural pass that declares a
+rewrite log (``Pass.rewrites``); violations raise ``OptimizerPassError``
+with op provenance. ``PADDLE_TPU_OPTIMIZE_TV=0`` opts out; the
+``optimizer.tv`` trace span and ``paddle_optimizer_tv_*`` families make
+the cost and the catches observable. ``tools/pass_fuzz.py`` drives it
+differentially over seeded random programs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..core.program import Program
+from .dataflow import Dataflow, Unfingerprintable, attrs_fingerprint
+
+__all__ = ["ProgramSnapshot", "RewriteViolation", "describe_rewrites",
+           "tv_enabled", "validate_rewrite"]
+
+
+def tv_enabled() -> bool:
+    """``PADDLE_TPU_OPTIMIZE_TV=0`` disables translation validation
+    (on by default wherever the pipeline runs)."""
+    return os.environ.get(
+        "PADDLE_TPU_OPTIMIZE_TV", "1").lower() not in ("0", "false", "off")
+
+
+class RewriteViolation:
+    """One translation-validation failure, carrying op provenance.
+
+    ``format()`` renders like a lint Finding so ``OptimizerPassError``
+    can list either kind."""
+
+    severity = "error"
+
+    def __init__(self, kind: str, message: str, op=None, var: str = ""):
+        self.rule = "tv-" + kind
+        self.kind = kind
+        self.message = message
+        self.op = op
+        self.op_type = getattr(op, "type", "")
+        self.var = var
+
+    def format(self) -> str:
+        where = ""
+        if self.op is not None:
+            bits = ["op %s" % self.op.type]
+            scope = getattr(self.op, "name_scope", "") or ""
+            if scope:
+                bits.append("scope %s" % scope)
+            site = getattr(self.op, "def_site", None)
+            if site:
+                bits.append("defined at %s" % site)
+            where = " (%s)" % "; ".join(bits)
+        return "[error] %s: %s%s" % (self.rule, self.message, where)
+
+    def __repr__(self):
+        return "RewriteViolation(%s)" % self.format()
+
+
+class ProgramSnapshot:
+    """Frozen def-use facts of a program's global block, taken BEFORE a
+    pass mutates it in place. The def-use facts ARE a
+    :class:`~paddle_tpu.analysis.dataflow.Dataflow` built at snapshot
+    time — the engine computes every fact eagerly at construction, so
+    they stay frozen through the pass's mutations and write-ordering
+    semantics have ONE definition (independence from the pass is
+    unaffected: the validator's facts come from its own instances, not
+    the pass's). The slot dicts are copied here because
+    ``rewire_input`` mutates the originals; Operator references stay
+    live (identity is how survivors are matched)."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        df = self._df = Dataflow(program)
+        self.ops = df.ops
+        self.pos: Dict[int, int] = df._pos
+        self.reads = df.reads
+        self.writes = df.writes
+        self.pinned: Set[str] = df.pinned
+        self.inputs: List[Dict[str, List[str]]] = [
+            {s: list(ns) for s, ns in op.inputs.items()}
+            for op in self.ops]
+        self.outputs: List[Dict[str, List[str]]] = [
+            {s: list(ns) for s, ns in op.outputs.items()}
+            for op in self.ops]
+
+    def last_write_before(self, name: str, pos: int) -> Optional[int]:
+        return self._df.last_write_before(name, pos)
+
+    def written_names(self):
+        return self._df._write_pos.keys()
+
+
+# rewrite-log record kinds a pass may emit (Pass.rewrites):
+#   {"kind": "remove", "op": op}
+#       op deleted; its values are unobservable afterwards (DCE, folded
+#       intermediates)
+#   {"kind": "forward", "op": copy_op, "name": dst}
+#       pure copy removed; consumers of dst now read the copy's source
+#       (resolved from the SNAPSHOT's inputs — the validator never
+#       trusts the pass's own idea of the source)
+#   {"kind": "merge", "op": dup, "into": target, "alias": {dn: tn}}
+#       dup removed; consumers of its outputs read target's via alias
+#   {"kind": "fuse", "ops": [constituents...], "into": new_op,
+#    "internal": {names threaded inside the replay}}
+#       constituents removed; new_op replays them in order, fetching
+#       every non-internal read at ITS OWN slot (entry semantics)
+#   {"kind": "materialize", "into": new_op, "name": out,
+#    "from": [removed producer ops]}
+#       constant folding's assign_value: the new op produces `out` in
+#       place of its removed producer(s)
+
+
+def _resolve_before(snap: ProgramSnapshot, forwards: Dict[int, dict],
+                    name: str, pos: int, _depth: int = 0):
+    """Value identity of ``name`` as observed by a read at ``pos`` in
+    the BEFORE program: ("ext", name) for external values, else
+    ("op", producer, name) — resolving *through* declared copy-forwards
+    via the snapshot's own input lists (never the pass's claim)."""
+    if _depth > len(snap.ops) + 1:  # cyclic forward declaration
+        return ("cycle", None, name)
+    w = snap.last_write_before(name, pos)
+    if w is None:
+        return ("ext", None, name)
+    op = snap.ops[w]
+    rec = forwards.get(id(op))
+    if rec is not None and rec.get("name") == name:
+        srcs = [n for ns in snap.inputs[w].values() for n in ns if n]
+        if len(srcs) == 1:
+            return _resolve_before(snap, forwards, srcs[0], w, _depth + 1)
+    return ("op", op, name)
+
+
+def validate_rewrite(before: ProgramSnapshot, program: Program,
+                     rewrites: Sequence[dict],
+                     fetch_names: Sequence[str] = (), scope=None,
+                     ) -> List[RewriteViolation]:
+    """Check ``program`` (the after-state) against ``before`` modulo the
+    declared ``rewrites``. Returns violations (empty = the rewrite is
+    proven dataflow-equivalent)."""
+    v: List[RewriteViolation] = []
+    after = Dataflow(program, fetch_names=fetch_names, scope=scope)
+
+    removed: Set[int] = set()
+    forwards: Dict[int, dict] = {}
+    merges: Dict[int, dict] = {}
+    fused: Dict[int, dict] = {}
+    mat_from: Dict[int, dict] = {}
+    new_ops: Dict[int, dict] = {}
+    for rec in rewrites or ():
+        kind = rec.get("kind")
+        if kind == "remove":
+            removed.add(id(rec["op"]))
+        elif kind == "forward":
+            forwards[id(rec["op"])] = rec
+            removed.add(id(rec["op"]))
+        elif kind == "merge":
+            merges[id(rec["op"])] = rec
+        elif kind == "fuse":
+            for c in rec["ops"]:
+                fused[id(c)] = rec
+            new_ops[id(rec["into"])] = rec
+        elif kind == "materialize":
+            for c in rec.get("from", ()):
+                mat_from[id(c)] = rec
+            new_ops[id(rec["into"])] = rec
+        else:
+            v.append(RewriteViolation(
+                "bad-log", "unknown rewrite record kind %r" % (kind,)))
+
+    def map_value(val):
+        """Image of a before-value under the declared rewrites:
+        ("op", x, n) -> its surviving producer, ("dead", x, n) when no
+        surviving op may observe it."""
+        kind, op, name = val
+        if kind != "op":
+            return val
+        seen = 0
+        while True:
+            seen += 1
+            if seen > len(before.ops) + 2:
+                return ("cycle", op, name)
+            oid = id(op)
+            if oid in merges:
+                rec = merges[oid]
+                name = rec.get("alias", {}).get(name, name)
+                op = rec["into"]
+                continue
+            if oid in fused:
+                rec = fused[oid]
+                if name in (rec["into"].output_names() or ()):
+                    return ("op", rec["into"], name)
+                return ("dead", op, name)  # swallowed internal temp
+            if oid in mat_from:
+                rec = mat_from[oid]
+                if name == rec.get("name"):
+                    return ("op", rec["into"], name)
+                return ("dead", op, name)
+            if oid in removed:
+                return ("dead", op, name)
+            return ("op", op, name)
+
+    def rb(name, pos):
+        return _resolve_before(before, forwards, name, pos)
+
+    def ra(name, pos):
+        d = after.reaching_def(name, pos)
+        return ("ext", None, name) if d is None else ("op", d, name)
+
+    def ident(val):
+        return (val[0], id(val[1]) if val[1] is not None else None, val[2])
+
+    # ---------------------------------------------------- 1. accounting
+    after_ids = {id(op) for op in after.ops}
+    for i, op in enumerate(before.ops):
+        oid = id(op)
+        if oid in after_ids:
+            if oid in removed or oid in merges or oid in fused:
+                v.append(RewriteViolation(
+                    "bad-log", "op declared rewritten but still present",
+                    op))
+            continue
+        if not (oid in removed or oid in merges or oid in fused):
+            v.append(RewriteViolation(
+                "undeclared-removal",
+                "op vanished without a rewrite-log record", op))
+    for op in after.ops:
+        if id(op) not in before.pos and id(op) not in new_ops:
+            v.append(RewriteViolation(
+                "undeclared-creation",
+                "op appeared without a rewrite-log record", op))
+    # the bitwise contract's untouchables: no declared rewrite may
+    # remove/merge/fuse an RNG consumer (reordering its ctx.next_rng()
+    # slot shifts every later consumer's randomness)
+    from .dataflow import op_uses_rng
+
+    for oid in set(removed) | set(merges) | set(fused):
+        pos = before.pos.get(oid)
+        if pos is None:
+            continue
+        op = before.ops[pos]
+        if op_uses_rng(before.program, op):
+            v.append(RewriteViolation(
+                "rng-rewritten",
+                "declared rewrite touches an RNG-consuming op", op))
+
+    # ------------------------------------------------------ 2. ordering
+    prev_after = -1
+    prev_op = None
+    for i, op in enumerate(before.ops):
+        if not after.contains(op):
+            continue
+        q = after.pos_of(op)
+        if q < prev_after:
+            v.append(RewriteViolation(
+                "reorder",
+                "surviving ops swapped relative order (undeclared "
+                "reordering vs %r)" % getattr(prev_op, "type", "?"), op))
+        else:
+            prev_after, prev_op = q, op
+
+    # ---------------------------------------------- 3. merge equivalence
+    for rec in merges.values():
+        dup, tgt = rec["op"], rec["into"]
+        dp, tp = before.pos.get(id(dup)), before.pos.get(id(tgt))
+        if dp is None or tp is None:
+            v.append(RewriteViolation(
+                "bad-log", "merge record references an unknown op", dup))
+            continue
+        if dup.type != tgt.type:
+            v.append(RewriteViolation(
+                "bad-merge", "merged ops have different types (%s vs %s)"
+                % (dup.type, tgt.type), dup))
+            continue
+        try:
+            if attrs_fingerprint(dup.attrs) != attrs_fingerprint(tgt.attrs):
+                v.append(RewriteViolation(
+                    "bad-merge", "merged ops have different attrs", dup))
+                continue
+        except Unfingerprintable:
+            v.append(RewriteViolation(
+                "bad-merge", "merged ops carry unfingerprintable attrs "
+                "(no structural identity)", dup))
+            continue
+        din, tin = before.inputs[dp], before.inputs[tp]
+        slots = set(din) | set(tin)
+        for slot in sorted(slots):
+            dn, tn = din.get(slot, []), tin.get(slot, [])
+            if len(dn) != len(tn):
+                v.append(RewriteViolation(
+                    "bad-merge", "merged ops disagree on input slot %r"
+                    % slot, dup))
+                continue
+            for i, (a, b) in enumerate(zip(dn, tn)):
+                if not a and not b:
+                    continue
+                va = ident(map_value(rb(a, dp))) if a else None
+                vb = ident(map_value(rb(b, tp))) if b else None
+                if va != vb:
+                    v.append(RewriteViolation(
+                        "bad-merge",
+                        "merged ops read DIFFERENT values at %s[%d] "
+                        "(%r@v? vs %r@v?): write-versioned inputs do "
+                        "not match" % (slot, i, a, b), dup, var=a or b))
+
+    # ------------------------------------- 4. surviving ops' def-chains
+    for i, op in enumerate(before.ops):
+        if not after.contains(op):
+            continue
+        q = after.pos_of(op)
+        bin_, bout = before.inputs[i], before.outputs[i]
+        ain = {s: list(ns) for s, ns in op.inputs.items()}
+        aout = {s: list(ns) for s, ns in op.outputs.items()}
+        if bout != aout:
+            v.append(RewriteViolation(
+                "outputs-changed",
+                "surviving op's outputs were rewritten", op))
+        for slot in sorted(set(bin_) | set(ain)):
+            bn, an = bin_.get(slot, []), ain.get(slot, [])
+            if len(bn) != len(an):
+                v.append(RewriteViolation(
+                    "inputs-changed",
+                    "surviving op's input slot %r changed arity" % slot,
+                    op))
+                continue
+            for k, (nb, na) in enumerate(zip(bn, an)):
+                if bool(nb) != bool(na):
+                    v.append(RewriteViolation(
+                        "inputs-changed",
+                        "surviving op's input %s[%d] appeared/vanished"
+                        % (slot, k), op))
+                    continue
+                if not nb:
+                    continue
+                expected = map_value(rb(nb, i))
+                actual = ra(na, q)
+                if expected[0] == "dead":
+                    v.append(RewriteViolation(
+                        "dropped-def",
+                        "op reads %r whose producer was removed with no "
+                        "surviving equivalent" % nb, op, var=nb))
+                    continue
+                if ident(expected) != ident(actual):
+                    v.append(RewriteViolation(
+                        "read-moved-past-write",
+                        "read of %r (slot %s[%d]) observes a different "
+                        "definition after the rewrite (expected %s of "
+                        "%r, sees %s of %r)"
+                        % (nb, slot, k,
+                           _dsc(expected), expected[2],
+                           _dsc(actual), actual[2]), op, var=nb))
+        # (sub-block BODY reads cannot drift: passes only mutate the
+        # global block and every sub-block-referenced name is pinned;
+        # the slot-wise checks above cover a control-flow op's own
+        # top-level inputs like conditional_block's Cond)
+
+    # ----------------------------------------- 5. new ops' replay reads
+    for rec in new_ops.values():
+        new_op = rec["into"]
+        q = after.pos_of(new_op) if after.contains(new_op) else None
+        if q is None:
+            v.append(RewriteViolation(
+                "bad-log", "declared replacement op is not in the "
+                "after-program", new_op))
+            continue
+        if rec.get("kind") == "materialize" or "name" in rec:
+            continue  # constant: no reads to validate
+        internal = set(rec.get("internal") or ())
+        declared_ext: Set[str] = set()
+        for c in rec["ops"]:
+            pc = before.pos.get(id(c))
+            if pc is None:
+                v.append(RewriteViolation(
+                    "bad-log", "fuse record references an unknown op", c))
+                continue
+            for n in set(before.reads[pc]):
+                if n in internal:
+                    continue
+                declared_ext.add(n)
+                expected = map_value(rb(n, pc))
+                if expected[0] == "op" and expected[1] is new_op:
+                    v.append(RewriteViolation(
+                        "replay-raw",
+                        "fused replay reads %r, which an earlier "
+                        "constituent of the SAME group writes — the "
+                        "entry-time fetch would see the stale value"
+                        % n, c, var=n))
+                    continue
+                if expected[0] == "dead":
+                    v.append(RewriteViolation(
+                        "dropped-def",
+                        "fused constituent reads %r whose producer was "
+                        "removed with no surviving equivalent" % n,
+                        c, var=n))
+                    continue
+                actual = ra(n, q)
+                if ident(expected) != ident(actual):
+                    v.append(RewriteViolation(
+                        "read-moved-past-write",
+                        "fused constituent's read of %r observes a "
+                        "different definition at the fused op's slot "
+                        "(expected %s, sees %s)"
+                        % (n, _dsc(expected), _dsc(actual)),
+                        c, var=n))
+        actual_reads = set(new_op.input_names())
+        if not actual_reads <= (declared_ext | internal):
+            v.append(RewriteViolation(
+                "bad-log",
+                "replacement op reads %s, which no constituent declared"
+                % sorted(actual_reads - declared_ext - internal), new_op))
+
+    # ------------------------------------------------- 6. root terminals
+    end_b = len(before.ops)
+    end_a = len(after.ops)
+    for name in sorted(before.written_names()):
+        var = after.var_of(name)
+        persist = (var is not None and var.persistable) or (
+            var is None and scope is not None and scope.has_var(name))
+        if not (name in (fetch_names or ()) or name in before.pinned
+                or persist):
+            continue
+        expected = map_value(rb(name, end_b))
+        actual = ra(name, end_a)
+        if expected[0] == "dead":
+            v.append(RewriteViolation(
+                "dropped-def",
+                "root value %r (fetch/pinned/persistable) lost its "
+                "defining op" % name, expected[1], var=name))
+        elif ident(expected) != ident(actual):
+            v.append(RewriteViolation(
+                "dropped-def",
+                "root value %r is now defined by a different op "
+                "(expected %s, sees %s)"
+                % (name, _dsc(expected), _dsc(actual)),
+                actual[1] or expected[1], var=name))
+    return v
+
+
+def _dsc(val) -> str:
+    kind, op, _name = val
+    if kind == "ext":
+        return "the external value"
+    if kind == "op":
+        return "op %s" % getattr(op, "type", "?")
+    return kind
+
+
+def describe_rewrites(rewrites: Sequence[dict]) -> List[str]:
+    """Human-readable rewrite log (the ``--validate`` CLIs print this)."""
+    out: List[str] = []
+    for rec in rewrites or ():
+        kind = rec.get("kind")
+        if kind == "remove":
+            out.append("remove %s" % rec["op"].type)
+        elif kind == "forward":
+            out.append("forward %s (copy %s dropped)"
+                       % (rec.get("name"), rec["op"].type))
+        elif kind == "merge":
+            out.append("merge %s -> first occurrence (%s)"
+                       % (rec["op"].type,
+                          ", ".join("%s=%s" % kv
+                                    for kv in sorted(
+                                        rec.get("alias", {}).items()))))
+        elif kind == "fuse":
+            out.append("fuse [%s] -> %s"
+                       % ("+".join(c.type for c in rec["ops"]),
+                          rec["into"].type))
+        elif kind == "materialize":
+            out.append("materialize %s <- folded [%s]"
+                       % (rec.get("name"),
+                          "+".join(c.type for c in rec.get("from", ()))))
+        else:
+            out.append("?? %r" % (kind,))
+    return out

@@ -378,9 +378,24 @@ class PatternMatcher:
 # ---------------------------------------------------------------- passes
 class Pass:
     """Graph transform (ir::Pass, pass.h:32). Subclass or register a
-    callable; apply returns the (possibly same) Graph."""
+    callable; apply returns the (possibly same) Graph.
+
+    A structural pass that wants translation validation (the
+    PassManager's per-pass equivalence gate, ``analysis/tv.py``) sets
+    ``self.rewrites`` in ``apply`` to its rewrite log — a list of
+    declared removals/merges/forwards/fusions/materializations (record
+    grammar documented at the top of ``analysis/tv.py``). A pass that
+    leaves ``rewrites`` as None is skipped by the validator (it still
+    rides the PassManager's shape re-verify); a pass that declares a
+    log is held to it — any undeclared structural change is an
+    ``OptimizerPassError``. A pass that can NEVER declare a log (an
+    attr-only rewrite like the AMP stamp) may set ``tv_exempt = True``
+    so the manager skips the pre-pass snapshot; an exempt pass that
+    emits a log anyway is a contract violation the manager rejects."""
 
     name = "pass"
+    rewrites = None  # None = no TV support; [] = declared no-op
+    tv_exempt = False  # True = attr-only, skip the pre-pass snapshot
 
     def apply(self, graph: Graph) -> Graph:
         raise NotImplementedError

@@ -26,12 +26,26 @@ amp_bf16_pass                            1   stamp bf16 policy onto the IR
 ====================================== ===== ==============================
 
 Safety: every pass preserves BITWISE semantics (RNG consumers are never
-removed, merged, or reordered), and the manager re-verifies shape/dtype
-invariants after every pass — a pass that breaks the program fails
-loudly with the pass name (``OptimizerPassError``) instead of
-miscompiling. ``paddle_optimizer_*`` observe families count programs,
-removed/folded/fused ops and per-pass seconds; ``optimizer.pipeline`` /
-``optimizer.pass`` trace spans put optimization in the flight recorder.
+removed, merged, or reordered), and the manager holds two independent
+gates after every structural pass — a pass that breaks the program
+fails loudly with the pass name (``OptimizerPassError``) instead of
+miscompiling:
+
+* **translation validation** (``analysis/tv.py``, on by default,
+  ``PADDLE_TPU_OPTIMIZE_TV=0`` opts out): the pass's declared rewrite
+  log is machine-checked against before/after reaching-definition
+  facts — undeclared removals/creations/reorderings, reads that moved
+  past a write, merges of non-equivalent values and dropped root defs
+  all fail here, *including rewrites that produce a different but
+  still-valid program* (the shape of every historical miscompile);
+* **re-verify** (``PADDLE_TPU_OPTIMIZE_VERIFY=0`` opts out): shape
+  inference + the error-capable lint rules, catching structurally
+  invalid output.
+
+``paddle_optimizer_*`` observe families count programs, removed/folded/
+fused ops, per-pass seconds and TV checks/violations;
+``optimizer.pipeline`` / ``optimizer.pass`` / ``optimizer.tv`` trace
+spans put optimization in the flight recorder.
 """
 
 from __future__ import annotations
@@ -52,6 +66,8 @@ __all__ = [
     "optimize_level",
     "optimize_program",
     "optimize_for_execution",
+    "tv_each_pass",
+    "verify_each_pass",
 ]
 
 # (pass name, minimum PADDLE_TPU_OPTIMIZE level). Order is load-bearing:
@@ -104,6 +120,15 @@ def verify_each_pass() -> bool:
             "0", "false", "off")
 
 
+def tv_each_pass() -> bool:
+    """``PADDLE_TPU_OPTIMIZE_TV=0`` disables per-pass translation
+    validation (on by default; like VERIFY it changes checking, never
+    output, so it is deliberately not part of ``config_key()``)."""
+    from ...analysis.tv import tv_enabled
+
+    return tv_enabled()
+
+
 class OptimizerPassError(RuntimeError):
     """An optimizing pass broke program invariants: the post-pass verify
     found error findings that were NOT present before the pipeline ran.
@@ -135,15 +160,19 @@ class PassManager:
 
     def __init__(self, level: Optional[int] = None,
                  fetch_names: Sequence[str] = (), scope=None,
-                 verify: Optional[bool] = None):
+                 verify: Optional[bool] = None,
+                 tv: Optional[bool] = None):
         self.level = optimize_level() if level is None else int(level)
         self.fetch_names = tuple(fetch_names or ())
         self.scope = scope
         self.verify = verify_each_pass() if verify is None else bool(verify)
+        self.tv = tv_each_pass() if tv is None else bool(tv)
+        self.rewrite_log: List[Dict] = []  # per-pass, for --validate
 
     def run(self, program: Program) -> List[Dict]:
         if self.level <= 0:
             return []
+        from ...analysis.tv import ProgramSnapshot
         from ...observe import trace as _tr
         from ...observe.families import (OPTIMIZER_OPS_IN,
                                          OPTIMIZER_OPS_OUT,
@@ -155,6 +184,7 @@ class PassManager:
         t_pipeline = time.perf_counter()
         baseline = self._error_sigs(program) if self.verify else None
         stats: List[Dict] = []
+        self.rewrite_log = []
         # trace_span returns a shared NOOP while tracing is off; this
         # runs once per plan-cache miss, so no hot-path guard needed
         with _tr.trace_span("optimizer.pipeline", level=self.level):
@@ -166,6 +196,13 @@ class PassManager:
                 p.fetch_names = frozenset(self.fetch_names)
                 p.scope = self.scope
                 before = len(program.global_block().ops)
+                # snapshot BEFORE the pass mutates the program in place
+                # (O(ops) — the translation validator checks the after-
+                # state against this, modulo the pass's rewrite log);
+                # tv_exempt passes (attr-only, never a log) skip the cost
+                snap = (ProgramSnapshot(program)
+                        if self.tv and not getattr(p, "tv_exempt", False)
+                        else None)
                 t0 = time.perf_counter()
                 with _tr.trace_span("optimizer.pass", **{"pass": name}):
                     graph = p.apply(Graph(program))
@@ -180,6 +217,23 @@ class PassManager:
                        "ops_after": after, "seconds": dt}
                 row.update(getattr(p, "stats", None) or {})
                 stats.append(row)
+                rewrites = getattr(p, "rewrites", None)
+                if rewrites:
+                    self.rewrite_log.append({"pass": name,
+                                             "rewrites": rewrites})
+                # translation validation: check the pass's declared
+                # rewrite log against before/after dataflow facts.
+                # Gated on the pass DECLARING a log (self.rewrites is
+                # not None) — a third-party pass with no declaration
+                # support still rides the shape re-verify below
+                if self.tv and rewrites is not None \
+                        and getattr(p, "changed", True):
+                    if snap is None:
+                        from ...analysis.tv import RewriteViolation
+                        raise OptimizerPassError(name, [RewriteViolation(
+                            "bad-log", "tv_exempt pass emitted a rewrite "
+                            "log (no pre-pass snapshot to check against)")])
+                    self._tv_check(name, snap, program, rewrites)
                 # re-verify only when the pass changed program structure
                 # (a no-op application cannot have broken anything, and
                 # the attr-only amp pass never alters the graph) — the
@@ -198,6 +252,26 @@ class PassManager:
             OPTIMIZER_SECONDS.observe(time.perf_counter() - t_pipeline)
             self._count_rewrites(stats)
         return stats
+
+    # ------------------------------------------ translation validation
+    def _tv_check(self, pass_name, snap, program, rewrites):
+        from ...analysis.tv import validate_rewrite
+        from ...observe import trace as _tr
+        from ...observe.families import (OPTIMIZER_TV_CHECKS,
+                                         OPTIMIZER_TV_SECONDS,
+                                         OPTIMIZER_TV_VIOLATIONS)
+
+        t0 = time.perf_counter()
+        with _tr.trace_span("optimizer.tv", **{"pass": pass_name}):
+            violations = validate_rewrite(
+                snap, program, rewrites,
+                fetch_names=self.fetch_names, scope=self.scope)
+        OPTIMIZER_TV_CHECKS.labels(**{"pass": pass_name}).inc()
+        OPTIMIZER_TV_SECONDS.observe(time.perf_counter() - t0)
+        if violations:
+            OPTIMIZER_TV_VIOLATIONS.labels(
+                **{"pass": pass_name}).inc(len(violations))
+            raise OptimizerPassError(pass_name, violations)
 
     # ------------------------------------------------------ verification
     def _error_sigs(self, program):
@@ -257,22 +331,28 @@ class PassManager:
 
 def optimize_program(program: Program, fetch_list=None, scope=None,
                      level: Optional[int] = None,
-                     verify: Optional[bool] = None):
+                     verify: Optional[bool] = None,
+                     tv: Optional[bool] = None,
+                     return_manager: bool = False):
     """Clone ``program``, run the leveled pipeline on the clone, and
     return ``(optimized_clone, per_pass_stats)``. The input program is
     never mutated; at level 0 the INPUT program itself is returned with
     empty stats (no clone — the bypass really is a bypass), so only
     treat the result as a scratch copy when the level is > 0.
-    ``fetch_list`` takes names or Variables."""
+    ``fetch_list`` takes names or Variables; ``tv`` overrides the
+    ``PADDLE_TPU_OPTIMIZE_TV`` default. ``return_manager=True`` appends
+    the ``PassManager`` to the tuple so callers can read its
+    ``rewrite_log`` without re-implementing the clone/bypass contract
+    (the ``--validate`` CLIs)."""
     names = [v if isinstance(v, str) else v.name
              for v in (fetch_list or [])]
     mgr = PassManager(level=level, fetch_names=names, scope=scope,
-                      verify=verify)
+                      verify=verify, tv=tv)
     if mgr.level <= 0:
-        return program, []
+        return (program, [], mgr) if return_manager else (program, [])
     clone = program.clone()
     stats = mgr.run(clone)
-    return clone, stats
+    return (clone, stats, mgr) if return_manager else (clone, stats)
 
 
 def optimize_for_execution(program: Program, fetch_names: Sequence[str],

@@ -28,6 +28,7 @@ class AmpBf16Pass(Pass):
 
     fetch_names = frozenset()
     scope = None
+    tv_exempt = True  # attr-only: never emits a rewrite log
 
     def apply(self, graph: Graph) -> Graph:
         program = graph.program

@@ -1,11 +1,18 @@
-"""Shared analyses for the optimizing passes (core/passes/).
+"""Shared vocabulary + predicates for the optimizing passes (core/passes/).
 
 Every pass that removes, merges, or replaces ops must answer the same
 three questions — *is this name rewireable*, *is this op repeatable*,
 and *does this op consume RNG* — and they must answer them identically,
 or two passes can disagree about what is safe and corrupt a program
-between verifies. The answers live here, built on the ONE shared
-read/write definition (``core.program.op_effects``).
+between verifies. Since PR 12 the answers live in the dataflow engine
+(``paddle_tpu/analysis/dataflow.py``): each pass builds ONE
+:class:`~paddle_tpu.analysis.dataflow.Dataflow` per application and
+routes every hazard decision (write counts, write-between windows,
+last-write positions, value keys, removability) through its queries —
+no pass re-derives those facts locally. This module keeps what is NOT
+dataflow: the shared elementwise vocabulary and tiny structural helpers,
+plus re-exports of the purity/fingerprint predicates (now defined next
+to the engine) so existing importers keep working.
 
 The invariant every helper serves: an optimized program must produce
 BITWISE-identical results to the unoptimized one (given the same seed).
@@ -16,10 +23,24 @@ removing or reordering one changes every later op's randomness.
 
 from __future__ import annotations
 
-from typing import Dict, Set
+from ..program import Program
 
-from ..program import Program, op_effects
-from ..registry import OPS, has_op
+# THE shared definitions live in analysis/dataflow.py and are
+# re-exported here LAZILY (PEP 562): core.passes is imported while
+# paddle_tpu's op registry is still filling, and analysis/shape_rules
+# must only load after every op is registered — so the bridge resolves
+# at first attribute access (pass apply time), never at import time.
+_DATAFLOW_NAMES = ("Dataflow", "Unfingerprintable", "attrs_fingerprint",
+                   "fingerprint", "is_pure", "op_uses_rng")
+
+
+def __getattr__(name):
+    if name in _DATAFLOW_NAMES:
+        from ...analysis import dataflow
+
+        return getattr(dataflow, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
 
 # THE shared elementwise vocabulary: unary activation/elementwise ops
 # (single tensor in/out) and paddle's broadcasted binary family. Fold
@@ -40,108 +61,6 @@ ELEMENTWISE_BINARY = frozenset({
 })
 
 
-def write_counts(program: Program) -> Dict[str, int]:
-    """Times each name is written by the global block's ops (sub-block
-    writes attributed to their control-flow op). Passes require
-    ``write_counts[name] == 1`` before treating a name as SSA-like —
-    in-place updates (``sgd ParamOut=param``) make a second write mean
-    "different value at different program points"."""
-    counts: Dict[str, int] = {}
-    for op in program.global_block().ops:
-        for n in op_effects(program, op)[1]:
-            counts[n] = counts.get(n, 0) + 1
-    return counts
-
-
-def pinned_names(program: Program) -> Set[str]:
-    """Names a pass must not rewire, rename, or re-splice: anything
-    referenced inside a sub-block, bound by a control-flow op
-    (``condition`` / ``__sub_bound__``), or read by an op through a
-    channel the Graph's var edges do not model. The Graph only wires
-    top-level ``input_names()``; a sub-block read is invisible to it,
-    so ``Graph.materialize`` could splice a replacement AFTER the
-    control-flow op that needs it."""
-    pinned: Set[str] = set()
-    for block in program.blocks[1:]:
-        for op in block.ops:
-            pinned.update(op.input_names())
-            pinned.update(op.output_names())
-            _pin_attrs(op, pinned)
-        pinned.update(block.vars)
-    for op in program.global_block().ops:
-        _pin_attrs(op, pinned)
-    return pinned
-
-
-def _pin_attrs(op, pinned: Set[str]) -> None:
-    cond = op.attrs.get("condition")
-    if cond:
-        pinned.add(cond)
-    pinned.update(op.attrs.get("__sub_bound__", ()))
-
-
-def op_uses_rng(program: Program, op) -> bool:
-    """True when lowering this op consumes the PRNG chain (directly or in
-    a sub-block) — the executor's needs_rng probe, shared here so no
-    pass ever removes or merges an RNG consumer."""
-    if not has_op(op.type):
-        return True  # unknown op: assume the worst
-    from ..registry import get_op
-
-    if get_op(op.type).uses_rng:
-        return True
-    sub = op.attrs.get("sub_block")
-    if isinstance(sub, int) and 0 <= sub < len(program.blocks):
-        return any(op_uses_rng(program, s) for s in program.block(sub).ops)
-    return False
-
-
-def is_pure(program: Program, op) -> bool:
-    """A pass may remove/merge this op without changing any surviving
-    op's value: registered, RNG-free, no control-flow body, no lowering
-    env access, and no side-effecting role (optimize/dist ops mutate
-    persistable state by contract)."""
-    if not has_op(op.type):
-        return False
-    if op.attrs.get("__op_role__") in ("optimize", "dist"):
-        return False
-    if "sub_block" in op.attrs:
-        return False
-    opdef = OPS.get(op.type)
-    if opdef is not None and opdef.needs_env:
-        return False
-    if op_uses_rng(program, op):
-        return False
-    return True
-
-
-class Unfingerprintable(Exception):
-    """Raised by ``fingerprint`` on attr values with no stable identity."""
-
-
-def fingerprint(value):
-    """Hashable, order-independent identity of an attr value (dicts and
-    lists normalized recursively). Raises ``Unfingerprintable`` for
-    anything that is not a plain scalar container — an op carrying a
-    callable attr has no safe structural identity and must not be
-    CSE'd."""
-    if isinstance(value, dict):
-        return ("d", tuple(sorted((k, fingerprint(v))
-                                  for k, v in value.items())))
-    if isinstance(value, (list, tuple)):
-        return ("l", tuple(fingerprint(v) for v in value))
-    if isinstance(value, (int, float, str, bool, type(None))):
-        return value
-    raise Unfingerprintable(repr(type(value)))
-
-
-def attrs_fingerprint(attrs: dict):
-    """Fingerprint of a whole attr dict (all keys; ``__op_role__`` is
-    included deliberately — merging a backward-role op into a forward
-    one would break the gradient-accumulation role partition)."""
-    return fingerprint(attrs)
-
-
 def single_output_name(op):
     """The op's only nonempty output name, or None when it has zero or
     several (fusion/folding chains thread exactly one value)."""
@@ -157,25 +76,3 @@ def var_of(program: Program, name: str):
         if name in b.vars:
             return b.vars[name]
     return None
-
-
-def removable_output(program: Program, name: str, fetch: Set[str],
-                     pinned: Set[str], counts: Dict[str, int],
-                     scope=None) -> bool:
-    """May a pass make this name stop being produced by its current op?
-    Requires: not fetched, not structurally pinned, declared (or
-    undeclared temp) non-persistable / non-data, written exactly once
-    (SSA-like) — and, mirroring the executor's ``analyze_block``
-    classification, an UNDECLARED name living in the run scope is
-    persistable state (its write is written back after the step), never
-    a droppable temp."""
-    if name in fetch or name in pinned:
-        return False
-    if counts.get(name, 0) != 1:
-        return False
-    v = var_of(program, name)
-    if v is not None and (v.persistable or v.is_data):
-        return False
-    if v is None and scope is not None and scope.has_var(name):
-        return False
-    return True

@@ -4,29 +4,30 @@ Pure copies (``assign``/``share_data``) enter programs through user
 code, the transpilers, and grad materialization on renamed
 contributions; copy propagation rewires each copy's consumers to the
 source and drops it — which also normalizes names so CSE sees through
-copies. CSE then value-numbers the surviving ops — key = (type, attrs,
-input names AT THEIR CURRENT WRITE VERSION) — and rewires duplicates
+copies. CSE then value-numbers the surviving ops and rewires duplicates
 onto the first occurrence. Both are bitwise no-ops by construction: a
 consumer reads the identical value through a different name.
 
-Versioned inputs are what make this safe on a non-SSA program: an op
-reading ``param`` before and after ``sgd ParamOut=param`` sees two
-different versions, so the two reads never merge.
+Every hazard decision routes through the dataflow engine
+(``analysis/dataflow.py``), built ONCE per pass application:
+``value_key`` keys inputs AT THEIR CURRENT WRITE VERSION (an op reading
+``param`` before and after ``sgd ParamOut=param`` sees two different
+versions, so the two reads never merge), ``can_merge`` holds the
+droppable-duplicate + stable-target rules, and the copy-prop snapshot
+guard is a ``first_write_at_or_after`` query. Each pass also emits a
+**rewrite log** (``self.rewrites``) the translation validator
+(``analysis/tv.py``) checks after the pass runs.
 """
 
 from __future__ import annotations
 
-from typing import Dict
-
 from ..ir import Graph, Pass, register_pass
-from ..program import op_effects
-from .common import (Unfingerprintable, attrs_fingerprint, is_pure,
-                     pinned_names, removable_output, var_of, write_counts)
+from .common import var_of
 
 COPY_OPS = ("assign", "share_data")
 
 
-def _rewire_consumers(graph: Graph, node, alias: Dict[str, str]):
+def _rewire_consumers(graph: Graph, node, alias):
     """Point every consumer of ``node``'s output vars at the alias
     target, updating Operator slots and graph edges."""
     for vn in list(node.outputs):
@@ -49,35 +50,31 @@ class CopyPropagationPass(Pass):
 
     fetch_names = frozenset()
     scope = None
+    # knock-out seam for tools/pass_fuzz.py: False re-creates the PR 7
+    # copy-prop aliasing miscompile (snapshot copies dropped) so the
+    # corpus can prove the validator catches it. NEVER ship False.
+    snapshot_guard = True
 
     def apply(self, graph: Graph) -> Graph:
+        from .common import Dataflow
+
         program = graph.program
-        counts = write_counts(program)
-        pinned = pinned_names(program)
-        fetch = set(self.fetch_names or ())
-        # last write position per name (program order): a copy is only
-        # droppable when NOTHING writes its source at-or-after the copy
-        # — a later in-place update (sgd ParamOut=param is a single
-        # write, so a count check alone misses it) would make rewired
-        # consumers read the updated value instead of the snapshot
-        last_write = {}
-        for i, n_node in enumerate(graph.op_nodes):
-            for n in op_effects(program, n_node.op)[1]:
-                last_write[n] = i
+        df = Dataflow(program, fetch_names=self.fetch_names,
+                      scope=self.scope)
+        self.rewrites = []
         removed = 0
-        for pos, node in enumerate(list(graph.op_nodes)):
+        for node in list(graph.op_nodes):
             op = node.op
-            if op.type not in COPY_OPS or not is_pure(program, op):
+            if op.type not in COPY_OPS or not df.is_pure(op):
                 continue
             srcs = [n for n in op.input_names() if n]
             dsts = [n for n in op.output_names() if n]
             if len(srcs) != 1 or len(dsts) != 1 or srcs[0] == dsts[0]:
                 continue
             src, dst = srcs[0], dsts[0]
-            if not removable_output(program, dst, fetch, pinned,
-                                    counts, scope=self.scope):
+            if not df.removable_output(dst):
                 continue
-            if last_write.get(src, -1) >= pos:
+            if not self._source_stable(df, src, df.pos_of(op)):
                 continue  # source (re)written at/after the copy:
                 #           dst is a SNAPSHOT, not an alias
             sv = var_of(program, src)
@@ -87,38 +84,52 @@ class CopyPropagationPass(Pass):
                 continue  # assign doubles as a cast only via declared dtype
             _rewire_consumers(graph, node, {dst: src})
             graph.remove_op_node(node)
+            self.rewrites.append({"kind": "forward", "op": op,
+                                  "name": dst})
             removed += 1
         self.stats = {"copies_removed": removed}
         self.changed = removed > 0
         return graph
 
+    def _source_stable(self, df: Dataflow, src: str, pos: int) -> bool:
+        """A copy is only droppable when NOTHING writes its source
+        at-or-after the copy — a later in-place update (``sgd
+        ParamOut=param`` is a single write, so a count check alone
+        misses it) would make rewired consumers read the updated value
+        instead of the snapshot."""
+        if not self.snapshot_guard:
+            return True  # knock-out seam (see class attr)
+        return df.first_write_at_or_after(src, pos) is None
+
 
 @register_pass("common_subexpression_elimination_pass")
 class CommonSubexpressionEliminationPass(Pass):
-    """Merge ops that provably compute the same value: identical type,
-    attrs, and input names at identical write versions; duplicates are
-    removed and their consumers rewired onto the first occurrence."""
+    """Merge ops that provably compute the same value: identical
+    ``Dataflow.value_key`` (type, attrs, input names at identical write
+    versions); duplicates are removed and their consumers rewired onto
+    the first occurrence."""
 
     fetch_names = frozenset()
     scope = None
+    # knock-out seam for tools/pass_fuzz.py: False re-creates the PR 7
+    # write-versioning miscompile so the corpus can prove the validator
+    # catches it. NEVER ship False.
+    versioned = True
 
     def apply(self, graph: Graph) -> Graph:
+        from .common import Dataflow
+
         program = graph.program
-        counts = write_counts(program)
-        pinned = pinned_names(program)
-        fetch = set(self.fetch_names or ())
-        version: Dict[str, int] = {}
-        seen: Dict[tuple, object] = {}  # key -> first op node
+        df = Dataflow(program, fetch_names=self.fetch_names,
+                      scope=self.scope)
+        seen = {}  # value key -> first op node
+        self.rewrites = []
         removed = 0
         for node in list(graph.op_nodes):
             op = node.op
-            reads, writes = op_effects(program, op)
-            key = None
-            if is_pure(program, op):
-                key = self._key(op, version)
+            key = self._key(df, op)
             if key is not None and key in seen and \
-                    self._mergeable(program, node, seen[key], fetch,
-                                    pinned, counts, self.scope):
+                    self._merge_ok(df, seen[key].op, op):
                 first = seen[key]
                 alias = {}
                 for slot, names in op.outputs.items():
@@ -128,87 +139,77 @@ class CommonSubexpressionEliminationPass(Pass):
                             alias[n] = fnames[i]
                 _rewire_consumers(graph, node, alias)
                 graph.remove_op_node(node)
+                self.rewrites.append({"kind": "merge", "op": op,
+                                      "into": first.op, "alias": alias})
                 removed += 1
                 continue  # removed: contributes no writes
             if key is not None and key not in seen and all(
-                    counts.get(n, 0) == 1 for n in op.output_names()
+                    df.write_count(n) == 1 for n in op.output_names()
                     if n):
                 # only a merge TARGET whose outputs are written exactly
                 # once (by this op) is stable for the rest of the block
                 # — a later rewrite of an output name would hand rewired
                 # consumers the overwritten value, not this op's
                 seen[key] = node
-            for n in writes:
-                version[n] = version.get(n, 0) + 1
         self.stats = {"cse_removed": removed}
         self.changed = removed > 0
         return graph
 
-    @staticmethod
-    def _key(op, version):
-        try:
-            ins = tuple(sorted(
-                (slot, i, n, version.get(n, 0))
-                for slot, names in op.inputs.items()
-                for i, n in enumerate(names) if n))
-            return (op.type, attrs_fingerprint(op.attrs), ins)
-        except Unfingerprintable:
-            return None
+    def _key(self, df: Dataflow, op):
+        key = df.value_key(op)
+        if key is None or self.versioned:
+            return key
+        # version-blind key (knock-out seam only — see class attr)
+        return (key[0], key[1],
+                tuple((s, i, n, 0) for s, i, n, _v in key[2]))
 
-    @staticmethod
-    def _mergeable(program, dup, first, fetch, pinned, counts, scope):
-        """Every nonempty output of ``dup`` must be droppable AND have a
-        nonempty counterpart at the same (slot, idx) of ``first``."""
-        for slot, names in dup.op.outputs.items():
-            fnames = first.op.outputs.get(slot, [])
+    def _merge_ok(self, df: Dataflow, first, dup) -> bool:
+        if self.versioned:
+            return df.can_merge(first, dup)
+        # knock-out seam: structural checks only, value equality blinded
+        # (the PR 7 write-versioning miscompile, resurrected on purpose
+        # for the fuzzer corpus)
+        for slot, names in dup.outputs.items():
+            fnames = first.outputs.get(slot, [])
             for i, n in enumerate(names):
                 if not n:
                     continue
                 if i >= len(fnames) or not fnames[i]:
                     return False
-                if not removable_output(program, n, fetch, pinned,
-                                        counts, scope=scope):
+                if not df.removable_output(n):
                     return False
-        return True
+        return all(df.write_count(n) == 1
+                   for n in first.output_names() if n)
 
 
 @register_pass("dead_op_elimination_pass")
 class DeadOpEliminationPass(Pass):
-    """Fetch-relative dead-op elimination over the shared ``op_effects``
-    semantics: a backward slice from the fetch targets keeps every op
-    that (transitively) feeds a fetch, writes persistable/scope state,
-    carries a side-effecting role (optimize/dist), owns a control-flow
-    body, or consumes RNG (removing an RNG consumer would shift the key
+    """Fetch-relative dead-op elimination acting on the shared
+    ``Dataflow.dead_ops`` backward slice: every op that (transitively)
+    feeds a fetch, writes persistable/scope state, carries a
+    side-effecting role (optimize/dist), owns a control-flow body, or
+    consumes RNG stays (removing an RNG consumer would shift the key
     chain for every later op — bitwise parity forbids it). Everything
-    else is removed. This is the acting counterpart of the lint suite's
-    advisory ``dead-op`` rule (analysis/lint.py)."""
+    else is removed. The lint suite's advisory ``dead-op`` rule
+    (analysis/lint.py) reports the SAME slice."""
 
     fetch_names = frozenset()
     scope = None
 
     def apply(self, graph: Graph) -> Graph:
+        from .common import Dataflow
+
         program = graph.program
-        needed = set(self.fetch_names or ())
-        scope = self.scope
+        df = Dataflow(program, fetch_names=self.fetch_names,
+                      scope=self.scope)
+        self.rewrites = []
+        dead = set(df.dead_ops())
         removed = 0
-        for node in reversed(list(graph.op_nodes)):
-            op = node.op
-            reads, writes = op_effects(program, op)
-            live = (op.attrs.get("__op_role__") in ("optimize", "dist")
-                    or not is_pure(program, op))
-            if not live:
-                for n in writes:
-                    v = var_of(program, n)
-                    persist = (v is not None and v.persistable) or (
-                        v is None and scope is not None
-                        and scope.has_var(n))
-                    if n in needed or persist:
-                        live = True
-                        break
-            if live:
-                needed.update(reads)
-            else:
+        for node in list(graph.op_nodes):
+            pos = df.pos_of(node.op)
+            if pos in dead:
                 graph.remove_op_node(node)
+                self.rewrites.append({"kind": "remove", "op": node.op})
                 removed += 1
         self.stats = {"dce_removed": removed}
         self.changed = removed > 0

@@ -31,9 +31,8 @@ import numpy as np
 from ..ir import Graph, Pass, register_pass
 from ..lowering import LowerContext
 from ..registry import get_op
-from .common import (ELEMENTWISE_BINARY, ELEMENTWISE_UNARY, is_pure,
-                     pinned_names, removable_output, single_output_name,
-                     write_counts)
+from .common import (ELEMENTWISE_BINARY, ELEMENTWISE_UNARY,
+                     single_output_name)
 
 # op types worth evaluating at optimize time: the shared elementwise
 # vocabulary plus attr-only constant sources and deterministic
@@ -70,29 +69,32 @@ class ConstantFoldingPass(Pass):
     scope = None
 
     def apply(self, graph: Graph) -> Graph:
+        from .common import Dataflow
+
         program = graph.program
         amp = bool(getattr(program, "amp", False))
-        counts = write_counts(program)
-        pinned = pinned_names(program)
+        df = Dataflow(program, fetch_names=self.fetch_names,
+                      scope=self.scope)
         fetch = set(self.fetch_names or ())
         cap = fold_max_elems()
+        self.rewrites = []
 
         const_env: Dict[str, np.ndarray] = {}
         foldable = []  # op nodes, program order
         for node in graph.op_nodes:
             op = node.op
-            if op.type not in FOLDABLE_OPS or not is_pure(program, op):
+            if op.type not in FOLDABLE_OPS or not df.is_pure(op):
                 continue
             in_names = [n for n in op.input_names() if n]
             if any(n not in const_env for n in in_names):
                 continue
             out = single_output_name(op)
             # fetched outputs ARE still foldable (the assign_value keeps
-            # the name alive), so check removability with an EMPTY fetch
-            # set — same predicate as everyone else, minus that one guard
-            if out is None or not removable_output(
-                    program, out, set(), pinned, counts,
-                    scope=self.scope):
+            # the name alive), so check removability with the fetch
+            # guard waived — same engine predicate as everyone else,
+            # minus that one rule
+            if out is None or not df.removable_output(
+                    out, ignore_fetch=True):
                 continue
             val = self._evaluate(op, const_env, amp)
             if val is None or val.size > cap:
@@ -125,15 +127,20 @@ class ConstantFoldingPass(Pass):
 
         for node in foldable:
             graph.remove_op_node(node)
+            self.rewrites.append({"kind": "remove", "op": node.op})
         for name in sorted(need):
             val = const_env[name]
-            graph.insert_op_node(
+            srcs = [n.op for n in foldable
+                    if single_output_name(n.op) == name]
+            new_node = graph.insert_op_node(
                 "assign_value", {}, {"Out": [name]},
                 attrs={"values": np.asarray(val).ravel().tolist(),
                        "shape": list(val.shape),
                        "dtype": str(val.dtype)},
-                provenance_from=[n.op for n in foldable
-                                 if single_output_name(n.op) == name])
+                provenance_from=srcs)
+            self.rewrites.append({"kind": "materialize",
+                                  "into": new_node.op, "name": name,
+                                  "from": srcs})
         self.stats = {"folded": len(foldable), "materialized": len(need)}
         self.changed = True
         return graph

@@ -14,7 +14,10 @@ Chains never cross an RNG consumer, a role boundary (forward vs
 backward matters to the gradient-accumulation partition), a fetch, or a
 var that is multiply-written / read from a sub-block. Gradient ops
 (``<unary>_grad``) fuse too — their synthesized lowerings are ordinary
-pure functions of their slots.
+pure functions of their slots. The chain-safety rule — the fused op
+runs at the chain TAIL's slot, so every constituent must be movable
+there — is a ``Dataflow.can_move`` query; each fused chain is declared
+in the pass's rewrite log for the translation validator.
 """
 
 from __future__ import annotations
@@ -22,11 +25,8 @@ from __future__ import annotations
 from typing import Dict, List
 
 from ..ir import Graph, Node, Pass, PatternMatcher, register_pass
-from ..program import op_effects
 from .common import (ELEMENTWISE_BINARY, ELEMENTWISE_UNARY,
-                     Unfingerprintable, attrs_fingerprint, is_pure,
-                     pinned_names, removable_output, single_output_name,
-                     write_counts)
+                     single_output_name)
 
 # the shared elementwise vocabulary (common.py): unary ops' forward AND
 # synthesized grad lower to single-tensor-in/single-tensor-out bodies
@@ -48,19 +48,27 @@ class FuseElementwisePass(Pass):
 
     fetch_names = frozenset()
     scope = None
+    # knock-out seam for tools/pass_fuzz.py: False re-creates the PR 7
+    # round-4 read-after-write miscompile (a constituent's external read
+    # moved past an in-place update) so the corpus can prove the
+    # validator catches it. NEVER ship False.
+    move_guard = True
 
     def apply(self, graph: Graph) -> Graph:
+        from .common import (Dataflow, Unfingerprintable,
+                             attrs_fingerprint)
+
         program = graph.program
-        counts = write_counts(program)
-        pinned = pinned_names(program)
-        fetch = set(self.fetch_names or ())
+        df = Dataflow(program, fetch_names=self.fetch_names,
+                      scope=self.scope)
+        self.rewrites = []
 
         def fusable(node: Node) -> bool:
             op = node.op
-            if not fusable_op_type(op.type) or not is_pure(program, op):
+            if not fusable_op_type(op.type) or not df.is_pure(op):
                 return False
             out = single_output_name(op)
-            if out is None or counts.get(out, 0) != 1:
+            if out is None or df.write_count(out) != 1:
                 return False
             try:
                 # the fused descriptor must round-trip these attrs
@@ -74,9 +82,7 @@ class FuseElementwisePass(Pass):
             # and a name nothing else (fetches, sub-blocks, reruns)
             # needs once the chain swallows it
             return (len(vn.inputs) == 1 and len(vn.outputs) == 1
-                    and removable_output(program, vn.name, fetch,
-                                         pinned, counts,
-                                         scope=self.scope))
+                    and df.removable_output(vn.name))
 
         pm = PatternMatcher()
         prod = pm.new_op("producer", pred=fusable)
@@ -102,27 +108,18 @@ class FuseElementwisePass(Pass):
             nxt[id(a)] = b
             prev[id(b)] = a
 
-        # write positions per name (program order): the fused op runs at
-        # the chain TAIL's slot, so every constituent's external read is
-        # effectively moved from its own slot to the tail's — that move
-        # is only sound when nothing writes the read name in between
-        write_pos: Dict[str, List[int]] = {}
-        for i, n_node in enumerate(graph.op_nodes):
-            for n in op_effects(program, n_node.op)[1]:
-                write_pos.setdefault(n, []).append(i)
-
         def chain_safe(chain: List[Node]) -> bool:
-            p_tail = order[id(chain[-1])]
+            # the fused op runs at the chain TAIL's slot: every
+            # constituent's reads are effectively MOVED there, which is
+            # exactly the engine's can_move hazard (internal links are
+            # single-producer/consumer temps can_move also accepts —
+            # nothing else writes them)
+            if not self.move_guard:
+                return True  # knock-out seam (see class attr)
+            p_tail = df.pos_of(chain[-1].op)
             internal = {single_output_name(n.op) for n in chain[:-1]}
-            for cnode in chain:
-                p_i = order[id(cnode)]
-                for n in cnode.op.input_names():
-                    if not n or n in internal:
-                        continue
-                    if any(p_i < w <= p_tail for w in
-                           write_pos.get(n, ())):
-                        return False  # read would move past a write
-            return True
+            return all(df.can_move(n.op, p_tail, ignore=internal)
+                       for n in chain)
 
         fused = 0
         removed = 0
@@ -134,7 +131,11 @@ class FuseElementwisePass(Pass):
                 chain.append(nxt[id(chain[-1])])
             if len(chain) < 2 or not chain_safe(chain):
                 continue
-            self._fuse_chain(graph, chain)
+            new_node, internal = self._fuse_chain(graph, chain)
+            self.rewrites.append({"kind": "fuse",
+                                  "ops": [n.op for n in chain],
+                                  "into": new_node.op,
+                                  "internal": internal})
             fused += 1
             removed += len(chain) - 1
         self.stats = {"chains_fused": fused, "ops_fused_away": removed}
@@ -176,6 +177,7 @@ class FuseElementwisePass(Pass):
             attrs["__op_role__"] = role
         for node in chain:
             graph.remove_op_node(node)
-        graph.insert_op_node(
+        new_node = graph.insert_op_node(
             "fused_elementwise", {"X": list(ext)}, {"Out": [final_out]},
             attrs=attrs, provenance_from=[n.op for n in chain])
+        return new_node, set(internal)

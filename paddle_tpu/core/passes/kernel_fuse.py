@@ -22,6 +22,15 @@ off this pass is a provable no-op):
    verified disjoint, and the only shared read (the learning rate) folds
    per-element — so the bundle is bitwise the per-op sequence.
 
+Both rewrites take their hazard answers from ONE dataflow analysis
+(``analysis/dataflow.py``) built over the ORIGINAL program before
+either rewrite mutates the graph — positions, write windows and
+pinning all refer to where ops sat in the PROGRAM, never to where a
+prior rewrite's replacement landed in the node list (node-list
+adjacency after a removal is not program adjacency; that distinction
+was a confirmed PR 8 miscompile). Each rewrite is declared in the
+pass's rewrite log for the translation validator (``analysis/tv.py``).
+
 Like every pass here, the rewires preserve BITWISE semantics on the
 default (composed) dispatch path; a tuned Pallas winner changes numerics
 only within each kernel's stated tolerance, and only when a tuned cache
@@ -33,9 +42,6 @@ from __future__ import annotations
 from typing import Dict, List
 
 from ..ir import Graph, Node, Pass, PatternMatcher, register_pass
-from ..program import op_effects
-from .common import (Unfingerprintable, attrs_fingerprint, is_pure,
-                     pinned_names, write_counts)
 
 # the shared slot tables (kernels/optimizer_update.py): this pass
 # assembles fused_optimizer_update's ins/outs from the SAME definition
@@ -65,9 +71,15 @@ class FuseKernelTierPass(Pass):
 
     fetch_names = frozenset()
     scope = None
+    # knock-out seams for tools/pass_fuzz.py — each resurrects a
+    # confirmed PR 8 miscompile so the corpus can prove the validator
+    # catches it. NEVER ship False.
+    adjacency_guard = True  # optimizer-group reorder (orig adjacency)
+    raw_guard = True        # fused-replay read-after-write
 
     def apply(self, graph: Graph) -> Graph:
         self.changed = False
+        self.rewrites = []
         self.stats: Dict[str, int] = {"ln_residual_fused": 0,
                                       "optimizer_groups": 0,
                                       "ops_fused_away": 0}
@@ -75,23 +87,13 @@ class FuseKernelTierPass(Pass):
 
         if not kernels.kernels_enabled():
             return graph
+        from .common import Dataflow
+
         program = graph.program
-        counts = write_counts(program)
-        pinned = pinned_names(program)
-        # ORIGINAL program positions + write positions, snapshotted
-        # before either rewrite mutates graph.op_nodes: both rewrites
-        # reason about where ops sat in the PROGRAM, never about where
-        # a prior rewrite's replacement node landed in the node list
-        # (op_nodes adjacency after a removal is not program adjacency)
-        orig_pos = {id(n): i for i, n in enumerate(graph.op_nodes)}
-        write_pos: Dict[str, List[int]] = {}
-        for i, onode in enumerate(graph.op_nodes):
-            for nm in op_effects(program, onode.op)[1]:
-                write_pos.setdefault(nm, []).append(i)
-        n_opt, opt_removed = self._fuse_optimizer_runs(
-            graph, program, counts, pinned, orig_pos)
-        n_ln = self._fuse_ln_residual(graph, program, counts, pinned,
-                                      orig_pos, write_pos)
+        df = Dataflow(program, fetch_names=self.fetch_names,
+                      scope=self.scope)
+        n_opt, opt_removed = self._fuse_optimizer_runs(graph, program, df)
+        n_ln = self._fuse_ln_residual(graph, program, df)
         self.stats = {"ln_residual_fused": n_ln,
                       "optimizer_groups": n_opt,
                       "ops_fused_away": n_ln + opt_removed}
@@ -99,8 +101,9 @@ class FuseKernelTierPass(Pass):
         return graph
 
     # ------------------------------------------------ residual+layernorm
-    def _fuse_ln_residual(self, graph, program, counts, pinned,
-                          orig_pos, write_pos) -> int:
+    def _fuse_ln_residual(self, graph, program, df) -> int:
+        from .common import Unfingerprintable, attrs_fingerprint
+
         def shapes_equal(*names):
             shapes = []
             for n in names:
@@ -112,13 +115,13 @@ class FuseKernelTierPass(Pass):
 
         def add_ok(node: Node) -> bool:
             op = node.op
-            if not is_pure(program, op):
+            if not df.is_pure(op):
                 return False
             x, y = _single(op, "X"), _single(op, "Y")
             out = _single_out(op, "Out")
             if not (x and y and out):
                 return False
-            if counts.get(out, 0) != 1 or out in pinned:
+            if df.write_count(out) != 1 or out in df.pinned:
                 return False
             # the fused kernel adds same-shape streams; a broadcasting
             # bias-add is NOT the residual seam
@@ -132,13 +135,13 @@ class FuseKernelTierPass(Pass):
 
         def ln_ok(node: Node) -> bool:
             op = node.op
-            if not is_pure(program, op):
+            if not df.is_pure(op):
                 return False
             if not (_single(op, "Scale") and _single(op, "Bias")):
                 return False  # kernel + fused lowering assume both
             for slot in ("Y", "Mean", "Variance"):
                 out = _single_out(op, slot)
-                if not out or counts.get(out, 0) != 1:
+                if not out or df.write_count(out) != 1:
                     return False
             try:
                 attrs_fingerprint(op.attrs)
@@ -154,41 +157,42 @@ class FuseKernelTierPass(Pass):
         pm.feeds(addn, link, slot="Out")
         pm.feeds(link, lnn, slot="X")
 
-        # snapshotted ORIGINAL positions: moving the add's reads to the
-        # ln's slot is only sound when nothing writes them in between
-        # (the fuse_elementwise chain_safe rule, specialized to one
-        # link). Conservative vs the optimizer rewrite that already
-        # ran: its replacement writes stay within its run's span, which
-        # the original write positions already cover
-        order = orig_pos
-
+        # ORIGINAL program positions (the dataflow was built before any
+        # rewrite): moving the add's reads to the ln's slot is only
+        # sound when nothing writes them in between — the can_move
+        # hazard with the residual link threaded internally.
+        # Conservative vs the optimizer rewrite that already ran: its
+        # replacement writes stay within its run's span, which the
+        # original write positions already cover
         claimed = set()
         fused = 0
         for m in sorted(pm.match(graph),
-                        key=lambda m: order[id(m["add"])]):
+                        key=lambda m: df.pos_of(m["add"].op)):
             add, ln, link_vn = m["add"], m["ln"], m["link"]
             if id(add) in claimed or id(ln) in claimed:
                 continue
             if add.op.attrs.get("__op_role__") \
                     != ln.op.attrs.get("__op_role__"):
                 continue
-            p_add, p_ln = order[id(add)], order[id(ln)]
+            p_add, p_ln = df.pos_of(add.op), df.pos_of(ln.op)
             if p_ln <= p_add:
                 continue
             # every OTHER consumer of the residual stream must sit at or
             # after the ln's slot — the fused op produces the name there
-            if any(order.get(id(c), -1) < p_ln for c in link_vn.outputs
-                   if c is not ln):
+            # (a consumer NOT in the pre-pass analysis is a node some
+            # earlier rewrite inserted: position unknowable, reject)
+            if any(not df.contains(c.op) or df.pos_of(c.op) < p_ln
+                   for c in link_vn.outputs if c is not ln):
                 continue
-            moved = [_single(add.op, "X"), _single(add.op, "Y")]
-            if any(p_add < w <= p_ln for n in moved
-                   for w in write_pos.get(n, ())):
-                continue
+            if not df.can_move(add.op, p_ln,
+                               ignore={link_vn.name}):
+                continue  # a read would move past a write
             attrs = {"add_attrs": dict(add.op.attrs),
                      "ln_attrs": dict(ln.op.attrs)}
             role = add.op.attrs.get("__op_role__")
             if role:
                 attrs["__op_role__"] = role
+            moved = [_single(add.op, "X"), _single(add.op, "Y")]
             ins = {"X": [moved[0]], "Residual": [moved[1]],
                    "Scale": [_single(ln.op, "Scale")],
                    "Bias": [_single(ln.op, "Bias")]}
@@ -200,14 +204,22 @@ class FuseKernelTierPass(Pass):
             claimed.update((id(add), id(ln)))
             graph.remove_op_node(add)
             graph.remove_op_node(ln)
-            graph.insert_op_node("fused_layernorm_residual", ins, outs,
-                                 attrs=attrs, provenance_from=srcs)
+            new_node = graph.insert_op_node(
+                "fused_layernorm_residual", ins, outs,
+                attrs=attrs, provenance_from=srcs)
+            # the residual link is threaded INSIDE the fused kernel
+            # (computed, normed, and also emitted under its original
+            # name via ResOut)
+            self.rewrites.append({"kind": "fuse", "ops": srcs,
+                                  "into": new_node.op,
+                                  "internal": {link_vn.name}})
             fused += 1
         return fused
 
     # --------------------------------------------------- optimizer runs
-    def _fuse_optimizer_runs(self, graph, program, counts, pinned,
-                             orig_pos):
+    def _fuse_optimizer_runs(self, graph, program, df):
+        from .common import Unfingerprintable, attrs_fingerprint
+
         def group_key(op):
             if op.type not in _OPTIMIZER_KINDS:
                 return None
@@ -217,9 +229,9 @@ class FuseKernelTierPass(Pass):
             out_names = [_single_out(op, s) for s in outs]
             if not all(names) or not all(out_names):
                 return None
-            if any(n in pinned for n in names + out_names):
+            if any(n in df.pinned for n in names + out_names):
                 return None
-            if any(counts.get(n, 0) != 1 for n in out_names):
+            if any(df.write_count(n) != 1 for n in out_names):
                 return None
             pvar = program.global_block()._find_var_recursive(names[0])
             if pvar is None or pvar.dtype is None:
@@ -236,21 +248,29 @@ class FuseKernelTierPass(Pass):
             return (op.type, op.attrs.get("__op_role__"),
                     op.attrs.get("__amp__"), pvar.dtype, fp)
 
-        # runs require ORIGINAL-program adjacency (orig_pos delta of
-        # exactly 1), not node-list adjacency: a prior rewrite removing
-        # ops between two optimizer ops must not make them "consecutive"
-        # — the fused op anchors at the run tail, and an op that
-        # genuinely sat between the constituents would then read a
-        # param update too early/late
+        # runs require ORIGINAL-program adjacency (position delta of
+        # exactly 1 in the pre-pass dataflow), not node-list adjacency:
+        # a prior rewrite removing ops between two optimizer ops must
+        # not make them "consecutive" — the fused op anchors at the run
+        # tail, and an op that genuinely sat between the constituents
+        # would then read a param update too early/late
         runs: List[List[Node]] = []
         cur: List[Node] = []
         cur_key = None
         for node in sorted((n for n in graph.op_nodes
-                            if id(n) in orig_pos),
-                           key=lambda n: orig_pos[id(n)]):
+                            if df.contains(n.op)),
+                           key=lambda n: df.pos_of(n.op)):
             key = group_key(node.op)
-            if key is not None and key == cur_key and cur \
-                    and orig_pos[id(node)] == orig_pos[id(cur[-1])] + 1:
+            if key is None and cur and not self.adjacency_guard:
+                # knock-out seam: the historical bug judged adjacency on
+                # the node LIST, where fused-away interveners had
+                # vanished — modeled here as interveners not breaking
+                # the run
+                continue
+            adjacent = bool(cur) and (
+                df.pos_of(node.op) == df.pos_of(cur[-1].op) + 1
+                or not self.adjacency_guard)  # knock-out seam
+            if key is not None and key == cur_key and adjacent:
                 cur.append(node)
                 continue
             if len(cur) >= 2:
@@ -277,7 +297,7 @@ class FuseKernelTierPass(Pass):
                 writes = {_single_out(node.op, s) for s in out_slots}
                 for later in run[i + 1:]:
                     reads = {_single(later.op, s) for s in slots}
-                    if writes & reads:
+                    if writes & reads and self.raw_guard:
                         ok = False
                         break
                 if not ok:
@@ -302,8 +322,14 @@ class FuseKernelTierPass(Pass):
             srcs = [n.op for n in run]
             for node in run:
                 graph.remove_op_node(node)
-            graph.insert_op_node("fused_optimizer_update", ins, outs,
-                                 attrs=attrs, provenance_from=srcs)
+            new_node = graph.insert_op_node(
+                "fused_optimizer_update", ins, outs,
+                attrs=attrs, provenance_from=srcs)
+            # NO internal names: the fused replay fetches every input
+            # at entry, which is exactly why the RAW guard above exists
+            self.rewrites.append({"kind": "fuse", "ops": srcs,
+                                  "into": new_node.op,
+                                  "internal": set()})
             fused += 1
             removed += len(run) - 1
         return fused, removed

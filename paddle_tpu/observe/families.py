@@ -498,7 +498,9 @@ _ANALYSIS_RULES = (
     "shape-infer", "shape-annotation", "dtype-annotation",
     "unregistered-op", "def-before-use", "undefined-input",
     "fetch-undefined", "dead-var", "dead-op", "double-write",
-    "int64-feed", "int64-narrowing", "grad-pairing", "sub-block")
+    "int64-feed", "int64-narrowing", "grad-pairing", "sub-block",
+    # dataflow-engine-powered rules (analysis/dataflow.py)
+    "dead-store", "write-after-write", "use-before-init")
 for _r in _ANALYSIS_RULES:
     ANALYSIS_FINDINGS.labels(rule=_r)
 ANALYSIS_VERIFY_SECONDS = REGISTRY.histogram(
@@ -561,9 +563,30 @@ _OPTIMIZER_PASSES = (
     "fuse_elementwise_pass",
     "amp_bf16_pass",
 )
+OPTIMIZER_TV_CHECKS = REGISTRY.counter(
+    "paddle_optimizer_tv_checks_total",
+    "Per-pass translation validations run (analysis/tv.py: the pass's "
+    "declared rewrite log machine-checked against before/after "
+    "reaching-definition facts); one per structural pass application "
+    "that changed the program, once per plan-cache miss. 0 under "
+    "PADDLE_TPU_OPTIMIZE_TV=0", labels=("pass",))
+OPTIMIZER_TV_VIOLATIONS = REGISTRY.counter(
+    "paddle_optimizer_tv_violations_total",
+    "Translation-validation violations found, by pass — every count "
+    "here also raised an OptimizerPassError (the run FAILED loudly; "
+    "this is the rate, the exception text has the def-chains). A "
+    "nonzero steady-state value means a pass is rewriting programs it "
+    "cannot prove equivalent: report it as a pass bug", labels=("pass",))
+OPTIMIZER_TV_SECONDS = REGISTRY.histogram(
+    "paddle_optimizer_tv_seconds",
+    "Wall time of one per-pass translation validation (snapshot "
+    "excluded — it rides the pass row; scales with op count x reads "
+    "per op, never with tensor sizes)")
 for _p in _OPTIMIZER_PASSES:
     OPTIMIZER_OPS_REMOVED.labels(**{"pass": _p})
     OPTIMIZER_PASS_SECONDS.labels(**{"pass": _p})
+    OPTIMIZER_TV_CHECKS.labels(**{"pass": _p})
+    OPTIMIZER_TV_VIOLATIONS.labels(**{"pass": _p})
 
 # --------------------------------------------------------------- kernels
 # (paddle_tpu/kernels/: the Pallas kernel tier + per-shape autotuner —
@@ -666,9 +689,10 @@ TRACE_SITES = (
     # — the story of who left/joined and what the job did about it
     "elastic.membership", "elastic.generation", "elastic.reshard",
     # optimizer (core/passes): one pipeline span per optimized program,
-    # one child span per applied pass — optimization cost shows up in
-    # the flight recorder next to the compile it feeds
-    "optimizer.pipeline", "optimizer.pass",
+    # one child span per applied pass, one per-pass translation-
+    # validation span — optimization cost shows up in the flight
+    # recorder next to the compile it feeds
+    "optimizer.pipeline", "optimizer.pass", "optimizer.tv",
     # kernel tier (kernels/tune.py): one span per autotune run, so a
     # slow first-compile is attributable to measurement, not a wedge
     "kernel.tune",

@@ -1,0 +1,406 @@
+"""Dataflow engine (analysis/dataflow.py) + translation validator
+(analysis/tv.py) tests.
+
+Covers:
+
+* the engine's facts on a hand-built non-SSA program: write timelines,
+  reaching definitions, versions, pinning, hazard queries
+  (can_remove / can_merge / can_move / value_key);
+* the shared dead-op slice: the DCE pass and the lint rule report the
+  SAME set (the op_effects unification applied to deadness);
+* the dataflow-powered lint rules (dead-store, write-after-write,
+  use-before-init) with positive and negative programs;
+* the translation validator: declared rewrites pass, undeclared
+  removals/creations/reorders and non-equivalent merges fail with op
+  provenance, and the PassManager wires it in (on by default,
+  PADDLE_TPU_OPTIMIZE_TV=0 opts out, paddle_optimizer_tv_* counters).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.analysis import lint_program
+from paddle_tpu.analysis.dataflow import Dataflow
+from paddle_tpu.analysis.tv import (ProgramSnapshot, describe_rewrites,
+                                    tv_enabled, validate_rewrite)
+from paddle_tpu.core.passes import (OptimizerPassError, PassManager,
+                                    optimize_program)
+from paddle_tpu.observe.families import REGISTRY
+
+
+def _nonssa_program():
+    """x(data) -> a=exp(x); s=scale(x); s=scale(s) IN PLACE; b=exp(x);
+    c=assign(a); out=add(c, s). Non-SSA on purpose (s written twice)."""
+    main = fluid.Program()
+    blk = main.global_block()
+    blk.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    for n in ("a", "s", "b", "c", "outv"):
+        blk.create_var(name=n, shape=(4,), dtype="float32")
+    blk.append_op("exp", {"X": ["x"]}, {"Out": ["a"]})           # 0
+    blk.append_op("scale", {"X": ["x"]}, {"Out": ["s"]},         # 1
+                  {"scale": 2.0})
+    blk.append_op("scale", {"X": ["s"]}, {"Out": ["s"]},         # 2
+                  {"scale": 3.0})
+    blk.append_op("exp", {"X": ["x"]}, {"Out": ["b"]})           # 3
+    blk.append_op("assign", {"X": ["a"]}, {"Out": ["c"]})        # 4
+    blk.append_op("elementwise_add", {"X": ["c"], "Y": ["s"]},   # 5
+                  {"Out": ["outv"]})
+    return main
+
+
+# ------------------------------------------------------------- engine
+def test_write_timelines_and_reaching_defs():
+    main = _nonssa_program()
+    df = Dataflow(main, fetch_names=["outv"])
+    assert df.write_count("s") == 2
+    assert df.write_positions("s") == (1, 2)
+    assert df.last_write_before("s", 2) == 1
+    assert df.last_write_before("s", 6) == 2
+    assert df.last_write_before("x", 5) is None  # external (feed)
+    assert df.first_write_at_or_after("s", 2) == 2
+    assert df.writes_between("s", 1, 5) == (2,)
+    assert df.reads_between("s", 1, 5) == (2, 5)
+    assert df.version_at("s", 2) == 1 and df.version_at("s", 3) == 2
+    assert df.reaching_def("s", 6) is main.global_block().ops[2]
+    assert df.reaching_def("x", 3) is None
+
+
+def test_hazard_queries_on_nonssa_program():
+    main = _nonssa_program()
+    ops = main.global_block().ops
+    df = Dataflow(main, fetch_names=["outv"])
+    # can_remove: pure + droppable outputs; 's' is written twice so its
+    # writers are not removable; the fetched add is not removable
+    assert df.can_remove(ops[0])
+    assert not df.can_remove(ops[1])
+    assert not df.can_remove(ops[5])
+    # value_key: the two exp(x) reads see the same version -> equal
+    assert df.value_key(ops[0]) == df.value_key(ops[3])
+    assert df.can_merge(ops[0], ops[3])
+    # the two scale ops differ in attrs AND read different versions
+    assert df.value_key(ops[1]) != df.value_key(ops[2])
+    # can_move: assign(a)->c may move back to just after a's def...
+    assert df.can_move(ops[4], 1)
+    # ...but not BEFORE it (its read would cross a's write)
+    assert not df.can_move(ops[4], 0)
+    # the in-place scale cannot jump the later read of s
+    assert not df.can_move(ops[2], 5)
+    # moving exp(x) forward across the in-place scale is fine (reads x)
+    assert df.can_move(ops[0], 3)
+
+
+def test_versioned_reads_never_merge():
+    """Reads of the same NAME around an in-place write get different
+    value keys — the CSE write-versioning guarantee, at engine level."""
+    main = fluid.Program()
+    blk = main.global_block()
+    blk.create_var(name="s", shape=(4,), dtype="float32",
+                   persistable=True)
+    for n in ("r1", "r2"):
+        blk.create_var(name=n, shape=(4,), dtype="float32")
+    blk.append_op("exp", {"X": ["s"]}, {"Out": ["r1"]})
+    blk.append_op("scale", {"X": ["s"]}, {"Out": ["s"]}, {"scale": 2.0})
+    blk.append_op("exp", {"X": ["s"]}, {"Out": ["r2"]})
+    df = Dataflow(main, fetch_names=["r1", "r2"])
+    ops = main.global_block().ops
+    assert df.value_key(ops[0]) != df.value_key(ops[2])
+    assert not df.can_merge(ops[0], ops[2])
+
+
+def test_pinned_names_resolve_sub_block_chain(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        L = fluid.layers
+        x = L.data(name="x", shape=[4], dtype="float32")
+        z = L.fill_constant([4], "float32", 0.0)
+        pred = L.less_than(L.reduce_mean(x),
+                           L.fill_constant([1], "float32", 0.5))
+        L.cond(pred, lambda: L.assign(
+            L.fill_constant([4], "float32", 1.0), output=z))
+        out = L.reduce_mean(L.elementwise_add(x, z))
+    df = Dataflow(main, fetch_names=[out.name])
+    assert z.name in df.pinned  # written from the sub-block
+    assert not df.removable_output(z.name)
+
+
+def test_dead_slice_shared_by_dce_and_lint(fresh_programs):
+    """THE unification: the lint's advisory dead-op rule and the acting
+    DCE pass report the SAME slice — including keeping RNG consumers,
+    which the old lint-local copy wrongly flagged."""
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        L = fluid.layers
+        x = L.data(name="x", shape=[4], dtype="float32")
+        live = L.reduce_mean(L.relu(x))
+        dead_rng = L.dropout(x, dropout_prob=0.5)  # dead but RNG: kept
+        L.tanh(dead_rng)                           # dead, pure
+        L.sigmoid(x)                               # dead, pure
+    df = Dataflow(main, fetch_names=[live.name])
+    dead_types = {df.ops[i].type for i in df.dead_ops()}
+    assert dead_types == {"tanh", "sigmoid"}
+    findings = lint_program(main, fetch_names=[live.name],
+                            rules=("dead-op",))
+    assert {f.op_type for f in findings} == {"tanh", "sigmoid"}
+    # and the pass removes exactly that set
+    opt, _ = optimize_program(main, fetch_list=[live.name], level=1)
+    types = [op.type for op in opt.global_block().ops]
+    assert "dropout" in types
+    assert "tanh" not in types and "sigmoid" not in types
+
+
+# ----------------------------------------------- dataflow lint rules
+def test_dead_store_and_write_after_write_rules():
+    main = fluid.Program()
+    blk = main.global_block()
+    blk.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    for n in ("t", "u", "outv"):
+        blk.create_var(name=n, shape=(4,), dtype="float32")
+    blk.append_op("scale", {"X": ["x"]}, {"Out": ["t"]}, {"scale": 2.0})
+    blk.append_op("scale", {"X": ["x"]}, {"Out": ["t"]}, {"scale": 3.0})
+    blk.append_op("tanh", {"X": ["t"]}, {"Out": ["u"]})  # reads write 2
+    blk.append_op("scale", {"X": ["u"]}, {"Out": ["outv"]},
+                  {"scale": 1.0})
+    findings = lint_program(main, fetch_names=["outv"],
+                            rules=("dead-store", "write-after-write"))
+    waw = [f for f in findings if f.rule == "write-after-write"]
+    assert len(waw) == 1 and waw[0].var == "t"
+    assert waw[0].severity == "info"
+    # 'u' IS read, 'outv' is fetched -> neither is a dead store; but an
+    # unread write that is never overwritten lands in dead-store
+    blk.create_var(name="litter", shape=(4,), dtype="float32")
+    blk.append_op("tanh", {"X": ["x"]}, {"Out": ["litter"]})
+    findings = lint_program(main, fetch_names=["outv"],
+                            rules=("dead-store",))
+    ds = [f for f in findings if f.rule == "dead-store"]
+    assert [f.var for f in ds] == ["litter"]
+
+
+def test_write_after_write_skips_persistable_and_read_between():
+    main = fluid.Program()
+    blk = main.global_block()
+    blk.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    blk.create_var(name="p", shape=(4,), dtype="float32",
+                   persistable=True)  # persistables: double-write's turf
+    blk.create_var(name="t", shape=(4,), dtype="float32")
+    blk.append_op("scale", {"X": ["x"]}, {"Out": ["p"]}, {"scale": 1.0})
+    blk.append_op("scale", {"X": ["x"]}, {"Out": ["p"]}, {"scale": 2.0})
+    blk.append_op("scale", {"X": ["x"]}, {"Out": ["t"]}, {"scale": 1.0})
+    blk.append_op("tanh", {"X": ["t"]}, {"Out": ["outv"]})  # read between
+    blk.create_var(name="outv", shape=(4,), dtype="float32")
+    blk.append_op("scale", {"X": ["x"]}, {"Out": ["t"]}, {"scale": 3.0})
+    findings = lint_program(main, fetch_names=["outv"],
+                            rules=("write-after-write",))
+    assert [f for f in findings if f.var == "p"] == []
+    assert [f for f in findings if f.var == "t"] == []
+
+
+def test_use_before_init_rule(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        L = fluid.layers
+        x = L.data(name="x", shape=[4], dtype="float32")
+        pred = L.less_than(L.reduce_mean(x),
+                           L.fill_constant([1], "float32", 0.5))
+        # GOOD: z pre-created unconditionally, then conditionally set
+        z = L.fill_constant([4], "float32", 0.0)
+        L.cond(pred, lambda: L.assign(
+            L.fill_constant([4], "float32", 1.0), output=z))
+        ok = L.reduce_mean(L.elementwise_add(x, z))
+    findings = lint_program(main, fetch_names=[ok.name],
+                            rules=("use-before-init",))
+    assert findings == []
+    # BAD: the only write of `hole` sits inside the conditional block
+    blk = main.global_block()
+    blk.create_var(name="hole", shape=(4,), dtype="float32")
+    with fluid.program_guard(main, startup):
+        L = fluid.layers
+        L.cond(pred, lambda: L.assign(
+            L.fill_constant([4], "float32", 1.0),
+            output=blk.vars["hole"]))
+        bad = L.reduce_mean(blk.vars["hole"])
+    findings = lint_program(main, fetch_names=[bad.name],
+                            rules=("use-before-init",))
+    hits = [f for f in findings if f.var == "hole"]
+    assert len(hits) == 1 and hits[0].severity == "info"
+
+
+# --------------------------------------------- translation validation
+def _snap_and_ops(main):
+    return ProgramSnapshot(main), main.global_block().ops
+
+
+def test_tv_accepts_declared_removal_rejects_undeclared():
+    main = _nonssa_program()
+    snap, ops = _snap_and_ops(main)
+    dead = ops[3]  # exp->b: nothing reads b
+    main.global_block().ops = [op for op in ops if op is not dead]
+    # undeclared: violation with provenance
+    v = validate_rewrite(snap, main, [], fetch_names=["outv"])
+    assert any(x.rule == "tv-undeclared-removal" for x in v)
+    # declared: clean
+    v = validate_rewrite(snap, main, [{"kind": "remove", "op": dead}],
+                         fetch_names=["outv"])
+    assert v == []
+
+
+def test_tv_rejects_undeclared_reordering():
+    main = _nonssa_program()
+    snap, ops = _snap_and_ops(main)
+    # swapping the two independent exp ops is bitwise-harmless here,
+    # but it is UNDECLARED — the validator holds the declared-log line
+    main.global_block().ops = [ops[3], ops[0]] + ops[1:3] + ops[4:]
+    v = validate_rewrite(snap, main, [], fetch_names=["outv"])
+    assert any(x.rule == "tv-reorder" for x in v)
+
+
+def test_tv_rejects_merge_of_different_write_versions():
+    main = fluid.Program()
+    blk = main.global_block()
+    blk.create_var(name="s", shape=(4,), dtype="float32",
+                   persistable=True)
+    for n in ("r1", "r2", "outv"):
+        blk.create_var(name=n, shape=(4,), dtype="float32")
+    blk.append_op("exp", {"X": ["s"]}, {"Out": ["r1"]})
+    blk.append_op("scale", {"X": ["s"]}, {"Out": ["s"]}, {"scale": 2.0})
+    blk.append_op("exp", {"X": ["s"]}, {"Out": ["r2"]})
+    blk.append_op("elementwise_add", {"X": ["r1"], "Y": ["r2"]},
+                  {"Out": ["outv"]})
+    snap, ops = _snap_and_ops(main)
+    dup, first, consumer = ops[2], ops[0], ops[3]
+    consumer.inputs["Y"] = ["r1"]  # rewire the consumer onto r1
+    main.global_block().ops = [op for op in ops if op is not dup]
+    v = validate_rewrite(
+        snap, main,
+        [{"kind": "merge", "op": dup, "into": first,
+          "alias": {"r2": "r1"}}], fetch_names=["outv"])
+    assert any(x.rule == "tv-bad-merge" for x in v)
+    assert any("versioned" in x.message for x in v)
+
+
+def test_tv_rejects_dropped_root_def():
+    main = _nonssa_program()
+    snap, ops = _snap_and_ops(main)
+    add = ops[5]  # produces the fetched 'outv'
+    main.global_block().ops = [op for op in ops if op is not add]
+    v = validate_rewrite(snap, main, [{"kind": "remove", "op": add}],
+                         fetch_names=["outv"])
+    assert any(x.rule == "tv-dropped-def" and x.var == "outv"
+               for x in v)
+
+
+def test_tv_violation_carries_op_provenance(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.reduce_mean(fluid.layers.relu(x))
+    snap = ProgramSnapshot(main)
+    ops = main.global_block().ops
+    relu = [op for op in ops if op.type == "relu"][0]
+    main.global_block().ops = [op for op in ops if op is not relu]
+    v = validate_rewrite(snap, main, [], fetch_names=[out.name])
+    assert v
+    text = v[0].format()
+    assert "relu" in text and "test_dataflow" in text  # def-site
+
+
+def test_tv_describe_rewrites_renders_log():
+    main = _nonssa_program()
+    ops = main.global_block().ops
+    lines = describe_rewrites([
+        {"kind": "remove", "op": ops[0]},
+        {"kind": "forward", "op": ops[4], "name": "c"},
+        {"kind": "merge", "op": ops[3], "into": ops[0],
+         "alias": {"b": "a"}},
+    ])
+    assert lines[0] == "remove exp"
+    assert "forward c" in lines[1]
+    assert "b=a" in lines[2]
+
+
+def test_tv_on_by_default_and_counts(fresh_programs, monkeypatch):
+    assert tv_enabled()
+
+    def counters():
+        snap = REGISTRY.snapshot()["metrics"]
+        out = {}
+        for name in ("paddle_optimizer_tv_checks_total",
+                     "paddle_optimizer_tv_violations_total"):
+            out[name] = sum(s.get("value", s.get("count", 0))
+                            for s in snap[name]["samples"])
+        return out
+
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        L = fluid.layers
+        x = L.data(name="x", shape=[4], dtype="float32")
+        L.sigmoid(x)  # dead: DCE fires, so at least one TV check runs
+        out = L.reduce_mean(L.tanh(L.relu(x)))
+    before = counters()
+    optimize_program(main, fetch_list=[out], level=2)
+    after = counters()
+    assert after["paddle_optimizer_tv_checks_total"] \
+        > before["paddle_optimizer_tv_checks_total"]
+    assert after["paddle_optimizer_tv_violations_total"] \
+        == before["paddle_optimizer_tv_violations_total"]
+    # PADDLE_TPU_OPTIMIZE_TV=0 opts out: zero movement
+    monkeypatch.setenv("PADDLE_TPU_OPTIMIZE_TV", "0")
+    assert not tv_enabled()
+    before = counters()
+    optimize_program(main, fetch_list=[out], level=2)
+    assert counters() == before
+
+
+def test_pass_with_declared_log_is_held_to_it(fresh_programs,
+                                              monkeypatch):
+    """A registered pass that declares a rewrite log but performs an
+    undeclared removal fails TV with the pass's name."""
+    import paddle_tpu.core.passes as passes_mod
+    from paddle_tpu.core.ir import Pass, register_pass
+
+    @register_pass("tv_test_lying_pass")
+    class _Liar(Pass):
+        """Test-only pass: removes a live op, declares nothing."""
+
+        fetch_names = frozenset()
+        scope = None
+
+        def apply(self, graph):
+            self.rewrites = []
+            self.changed = True
+            for node in graph.op_nodes:
+                if node.op.type == "relu":
+                    graph.remove_op_node(node)
+                    break
+            return graph
+
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.reduce_mean(fluid.layers.relu(x))
+    monkeypatch.setattr(passes_mod, "PIPELINE",
+                        (("tv_test_lying_pass", 1),))
+    with pytest.raises(OptimizerPassError) as ei:
+        optimize_program(main, fetch_list=[out], level=1)
+    assert "tv_test_lying_pass" in str(ei.value)
+    assert "tv-" in str(ei.value)
+
+
+def test_rewrite_log_reaches_pass_manager(fresh_programs):
+    main, startup, _ = fresh_programs
+    with fluid.program_guard(main, startup):
+        L = fluid.layers
+        x = L.data(name="x", shape=[4], dtype="float32")
+        h = L.assign(L.relu(x))       # copy-prop forward
+        L.sigmoid(x)                  # dead -> DCE remove
+        out = L.reduce_mean(L.tanh(L.tanh(h)))  # fusable chain
+    mgr = PassManager(level=2, fetch_names=[out.name])
+    clone = main.clone()
+    mgr.run(clone)
+    by_pass = {e["pass"]: e["rewrites"] for e in mgr.rewrite_log}
+    assert any(r["kind"] == "forward"
+               for r in by_pass["copy_propagation_pass"])
+    assert any(r["kind"] == "remove"
+               for r in by_pass["dead_op_elimination_pass"])
+    assert any(r["kind"] == "fuse"
+               for r in by_pass["fuse_elementwise_pass"])

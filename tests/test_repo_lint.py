@@ -219,6 +219,64 @@ def test_repo_kernel_registry_entries_are_complete():
     assert repo_lint.kernel_registry_violations(ROOT) == []
 
 
+def _fake_repo_with_fault_sites(tmp_path, other_src):
+    root = _fake_repo(tmp_path, "x = 1\n", other_src)
+    fam = os.path.join(root, "paddle_tpu", "observe", "families.py")
+    with open(fam, "a") as f:
+        f.write('FAULT_SITES = ("good.fault", "other.fault")\n')
+    return root
+
+
+def test_undeclared_fault_site_detected(tmp_path):
+    # rule 6: literal fault_point()/FaultPlan.arm() sites must be in
+    # FAULT_SITES; dynamic sites and declared ones stay silent (names
+    # assembled by concatenation so THIS file never trips the lint)
+    src = (
+        "def fault_point(s):\n    return s\n"
+        "class Plan:\n"
+        "    def arm(self, s, **kw):\n        return self\n"
+        "class Servo:\n"
+        "    def arm(self, s):\n        return self\n"
+        'a = fault_point("good.fault")\n'            # declared: ok
+        'b = fault_point("ty" + "po.fault")\n'       # dynamic: skipped
+        'c = Plan().arm("other.fault", steps=(1,))\n'  # declared: ok
+        'd = Servo().arm("left")\n'  # non-FaultPlan receiver: not a site
+    )
+    root = _fake_repo_with_fault_sites(tmp_path, src)
+    assert repo_lint.run(root) == []
+    bad = (
+        "def fault_point(s):\n    return s\n"
+        "class Plan:\n"
+        "    def arm(self, s, **kw):\n        return self\n"
+        'a = fault_point("typo.fault")\n'
+        'b = Plan().arm("typo.armed", every=True)\n'
+    )
+    root2 = _fake_repo_with_fault_sites(tmp_path / "second", bad)
+    out = repo_lint.run(root2)
+    assert len(out) == 2
+    assert any("typo.fault" in v and "fault_point" in v for v in out)
+    assert any("typo.armed" in v and "FAULT_SITES" in v for v in out)
+
+
+def test_repo_uses_only_declared_fault_sites():
+    # subset of test_repo_is_clean, kept separate so a fault-site
+    # regression names the rule (same pattern as the trace-site rule)
+    assert repo_lint.fault_site_violations(ROOT) == []
+
+
+def test_declared_fault_sites_parse():
+    sites = repo_lint.declared_fault_sites(ROOT)
+    assert "executor." + "dispatch" in sites
+    assert "checkpoint." + "write" in sites
+    assert "membership." + "join" in sites
+    # declarations and the runtime tuple agree (the lint parses the
+    # AST, the runtime imports the module — same contract as
+    # TRACE_SITES)
+    from paddle_tpu.observe.families import FAULT_SITES
+
+    assert sites == set(FAULT_SITES)
+
+
 def test_kernel_op_schema_matches_registry():
     # families.py pre-materializes the per-op kernel series from a plain
     # tuple (importing kernels would cycle); it must track the registry

@@ -61,9 +61,9 @@ def test_fault_plan_parse_rejects_junk():
     with pytest.raises(ValueError, match="mode must be one of"):
         FaultPlan.parse("executor.dispatch@1:explode")
     with pytest.raises(ValueError, match="exactly ONE trigger"):
-        FaultPlan().arm("x", steps=(1,), every=True)
+        FaultPlan().arm("rpc.send", steps=(1,), every=True)
     with pytest.raises(ValueError, match="probability"):
-        FaultPlan().arm("x", p=1.5)
+        FaultPlan().arm("rpc.send", p=1.5)
 
 
 def test_fault_point_fires_on_chosen_occurrence_with_telemetry():

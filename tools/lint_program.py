@@ -11,8 +11,17 @@ program, and reports findings as text or JSON.
     python tools/lint_program.py --model gpt resnet   # a subset
     python tools/lint_program.py --json               # machine-readable
     python tools/lint_program.py --min-severity warning
+    python tools/lint_program.py --validate           # + optimizer TV
 
-Exit code: 0 = no error findings, 1 = at least one error, 2 = bad usage.
+``--validate`` additionally runs the graph-optimizer pipeline over each
+program with per-pass translation validation FORCED on
+(``analysis/tv.py``) and prints the declared rewrite logs — the
+standalone way to ask "does the optimizer provably preserve this
+program?" without executing anything.
+
+Exit code: 0 = no error findings (and, with --validate, every program
+optimized TV-clean), 1 = at least one error or TV violation, 2 = bad
+usage.
 """
 
 from __future__ import annotations
@@ -167,6 +176,10 @@ def main(argv=None):
                    default="info", help="hide findings below this severity")
     p.add_argument("--no-optimizer", action="store_true",
                    help="verify the forward-only program (no Adam step)")
+    p.add_argument("--validate", action="store_true",
+                   help="also run the optimizer pipeline with per-pass "
+                        "translation validation forced ON; print the "
+                        "rewrite logs, exit 1 on any violation")
     args = p.parse_args(argv)
 
     order = {"info": 0, "warning": 1, "error": 2}
@@ -188,12 +201,47 @@ def main(argv=None):
                      sum(1 for f in findings if f.severity == "info")))
             for f in shown:
                 print("   " + f.format())
+        if args.validate:
+            n_errors += _validate_example(
+                name, optimizer=not args.no_optimizer,
+                quiet=args.json)
     if args.json:
         json.dump({name: [f.to_dict() for f in fs]
                    for name, fs in report.items()},
                   sys.stdout, indent=2)
         sys.stdout.write("\n")
     return 1 if n_errors else 0
+
+
+def _validate_example(name, optimizer=True, quiet=False) -> int:
+    """Run the optimizer's translation validator over one example
+    (level 2, TV forced on). Returns the number of failures (0/1) and
+    prints the declared rewrite log unless ``quiet``."""
+    from paddle_tpu.analysis.tv import describe_rewrites
+    from paddle_tpu.core.passes import (OptimizerPassError,
+                                        optimize_program)
+
+    main, startup, loss = build_example(name, optimizer=optimizer)
+    for tag, prog, fetch in (("main", main, [loss.name]),
+                             ("startup", startup, [])):
+        try:
+            _, _, mgr = optimize_program(prog, fetch_list=fetch,
+                                         level=2, tv=True,
+                                         return_manager=True)
+        except OptimizerPassError as e:
+            # stderr under --json: stdout must stay one valid JSON
+            # document (the exit code carries the verdict either way)
+            print("== %s %s: TRANSLATION VALIDATION FAILED\n%s"
+                  % (name, tag, e),
+                  file=sys.stderr if quiet else sys.stdout)
+            return 1
+        if not quiet:
+            for entry in mgr.rewrite_log:
+                print("   %s rewrite log [%s] (validated):"
+                      % (tag, entry["pass"]))
+                for line in describe_rewrites(entry["rewrites"]):
+                    print("      " + line)
+    return 0
 
 
 if __name__ == "__main__":

@@ -12,12 +12,18 @@ each pass did.
     python tools/optimize_program.py --level 1          # no fusion
     python tools/optimize_program.py --json             # machine-readable
     python tools/optimize_program.py --dot /tmp/dots    # pre/post graphs
+    python tools/optimize_program.py --validate         # + rewrite logs
 
 ``--dot DIR`` writes ``<model>_<program>_{pre,post}.dot`` GraphViz files
 (core/ir.py ``to_dot``) so a fusion or DCE decision can be eyeballed.
+``--validate`` forces per-pass translation validation ON (even under
+``PADDLE_TPU_OPTIMIZE_TV=0``) and prints each pass's declared rewrite
+log — the removals/merges/forwards/fusions the validator held the pass
+to (docs/OPTIMIZER.md "Translation validation contract").
 
-Exit code: 0 = every program optimized and re-verified clean, 1 = an
-optimizer pass broke invariants (OptimizerPassError), 2 = bad usage.
+Exit code: 0 = every program optimized, translation-validated and
+re-verified clean, 1 = an optimizer pass broke invariants
+(OptimizerPassError — TV violation or verify finding), 2 = bad usage.
 """
 
 from __future__ import annotations
@@ -32,10 +38,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from lint_program import EXAMPLE_BUILDERS, build_example  # noqa: E402
 
 
-def optimize_example(name, level=None, optimizer=True):
+def optimize_example(name, level=None, optimizer=True, tv=None):
     """Build example ``name`` and optimize train + startup programs.
-    Returns {"main": {...}, "startup": {...}} with per-pass stats and
-    the optimized programs under "_programs"."""
+    Returns {"main": {...}, "startup": {...}} with per-pass stats, each
+    pass's declared rewrite log (human-readable lines), and the
+    optimized programs under "_programs". ``tv=True`` forces per-pass
+    translation validation on regardless of PADDLE_TPU_OPTIMIZE_TV."""
+    from paddle_tpu.analysis.tv import describe_rewrites
     from paddle_tpu.core.passes import optimize_program
 
     main, startup, loss = build_example(name, optimizer=optimizer)
@@ -44,13 +53,18 @@ def optimize_example(name, level=None, optimizer=True):
     for tag, prog, fetch in (("main", main, [loss]),
                              ("startup", startup, [])):
         before = len(prog.global_block().ops)
-        optimized, stats = optimize_program(prog, fetch_list=fetch,
-                                            level=level)
+        optimized, stats, mgr = optimize_program(
+            prog, fetch_list=fetch, level=level, tv=tv,
+            return_manager=True)
         programs[tag] = (prog, optimized)
         report[tag] = {
             "ops_before": before,
             "ops_after": len(optimized.global_block().ops),
             "passes": stats,
+            "rewrite_log": [
+                {"pass": entry["pass"],
+                 "rewrites": describe_rewrites(entry["rewrites"])}
+                for entry in mgr.rewrite_log],
         }
     report["_programs"] = programs
     return report
@@ -85,6 +99,10 @@ def main(argv=None):
     p.add_argument("--no-optimizer", action="store_true",
                    help="optimize the forward-only program (no Adam "
                         "step; elementwise chains fuse more there)")
+    p.add_argument("--validate", action="store_true",
+                   help="force per-pass translation validation ON and "
+                        "print each pass's declared rewrite log; exit "
+                        "1 on any violation")
     args = p.parse_args(argv)
 
     from paddle_tpu.core.passes import OptimizerPassError
@@ -95,7 +113,8 @@ def main(argv=None):
     for name in names:
         try:
             report = optimize_example(name, level=args.level,
-                                      optimizer=not args.no_optimizer)
+                                      optimizer=not args.no_optimizer,
+                                      tv=True if args.validate else None)
         except OptimizerPassError as e:
             failed += 1
             out[name] = {"error": str(e)}
@@ -120,6 +139,12 @@ def main(argv=None):
                           % (row["pass"], row["ops_before"],
                              row["ops_after"], delta,
                              "  %s" % extra if extra else ""))
+                if args.validate:
+                    for entry in r["rewrite_log"]:
+                        print("   rewrite log [%s] (validated):"
+                              % entry["pass"])
+                        for line in entry["rewrites"]:
+                            print("      " + line)
     if args.json:
         json.dump(out, sys.stdout, indent=2)
         sys.stdout.write("\n")

@@ -1,0 +1,557 @@
+#!/usr/bin/env python
+"""Differential pass fuzzer: seeded random programs, level 2 vs level 0.
+
+The optimizer's correctness story has three legs — the dataflow engine
+every pass queries (``analysis/dataflow.py``), the per-pass translation
+validator (``analysis/tv.py``), and THIS harness, which closes the loop
+empirically: generate a seeded random program exercising every hazard
+the historical miscompiles involved (elementwise chains, in-place
+optimizer updates, assign copies, shared subexpressions, dead branches,
+RNG consumers, conditional sub-blocks), run it at ``PADDLE_TPU_OPTIMIZE``
+level 2 and level 0 on CPU, and require BITWISE-identical fetches and
+persistable state plus a TV-clean pipeline. One seed = one program =
+one fully deterministic replay (the seed is printed on every failure).
+
+    python tools/pass_fuzz.py --seeds 200            # sweep
+    python tools/pass_fuzz.py --seeds 1 --start 1234 # replay one seed
+    python tools/pass_fuzz.py --corpus               # the six miscompiles
+    python tools/pass_fuzz.py --json                 # machine-readable
+
+The **corpus** re-expresses the six confirmed historical miscompiles
+(CSE write-versioning, copy-prop aliasing, materialize ordering, fusion
+read-after-write, optimizer-group reorder, fused-replay RAW) as tiny
+programs, each paired with a **knock-out** that disables exactly the
+guard whose absence caused the original bug (the passes expose the
+guards as documented class-attr seams; the materialize knock-out
+reinstates the pre-review min-consumer splice). ``--corpus`` proves,
+per entry: (a) the guarded pipeline is differentially clean, (b) with
+the guard knocked out the translation validator trips
+(``OptimizerPassError`` carrying a ``tv-*`` violation — NOT just a
+wrong number), and (c) with the guard out AND validation off the
+miscompile is real (bitwise diff or broken program). A future pass
+regression therefore cannot land silently: either TV names it, or this
+harness bisects it to a seed.
+
+Exit code: 0 = all clean, 1 = any failure, 2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import random  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+D = 8  # feature width of every generated tensor
+B = 4  # feed batch rows
+
+_UNARY = ("relu", "tanh", "sigmoid")
+_BINARY = ("elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_max", "elementwise_min")
+
+
+# ------------------------------------------------------------ generator
+def gen_program(seed):
+    """Build one seeded random (main, startup, feed, fetch_names)
+    program. Pure function of the seed: layer choices, constants and
+    wiring all come from ``random.Random(seed)``; the feed comes from
+    ``np.random.RandomState(seed)``."""
+    import paddle_tpu as fluid
+
+    rng = random.Random(seed)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7  # dropout RNG chain: fixed, level-independent
+    startup.random_seed = 7
+    fetch = []
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            L = fluid.layers
+            x = L.data(name="x", shape=[D], dtype="float32")
+            vals = [x]
+            recipes = []  # (kind, payload) replayable for shared subexprs
+            n_params = 0
+
+            def emit(kind, payload):
+                recipes.append((kind, payload))
+                return _apply(L, vals, kind, payload)
+
+            for _step in range(rng.randint(10, 22)):
+                roll = rng.random()
+                if roll < 0.30:
+                    emit("unary", (rng.choice(_UNARY),
+                                   rng.randrange(len(vals))))
+                elif roll < 0.45:
+                    emit("binary", (rng.choice(_BINARY),
+                                    rng.randrange(len(vals)),
+                                    rng.randrange(len(vals))))
+                elif roll < 0.55:
+                    emit("scale", (round(rng.uniform(-1.2, 1.2), 3),
+                                   round(rng.uniform(-0.5, 0.5), 3),
+                                   rng.randrange(len(vals))))
+                elif roll < 0.65 and recipes:
+                    # shared subexpression: REPLAY an earlier recipe
+                    # verbatim — structurally identical ops, CSE fodder
+                    emit(*recipes[rng.randrange(len(recipes))])
+                elif roll < 0.72:
+                    emit("copy", (rng.randrange(len(vals)),))
+                elif roll < 0.80:
+                    emit("const_chain", (round(rng.uniform(0.5, 2.0), 3),
+                                         rng.randint(1, 4),
+                                         rng.randrange(len(vals))))
+                elif roll < 0.86:
+                    emit("dropout", (rng.choice((0.2, 0.5)),
+                                     rng.randrange(len(vals))))
+                elif roll < 0.92:
+                    # dead branch: never fetched, reduced to a scalar
+                    d = L.tanh(vals[rng.randrange(len(vals))])
+                    L.reduce_mean(L.sigmoid(d))
+                elif roll < 0.97:
+                    n_params += 1
+                    _param_update_block(fluid, L, rng, vals, n_params,
+                                        seed)
+                else:
+                    _cond_block(fluid, L, rng, vals)
+            loss = L.reduce_mean(vals[-1])
+            fetch.append(loss.name)
+            if len(vals) > 2 and rng.random() < 0.5:
+                fetch.append(L.reduce_mean(
+                    vals[rng.randrange(1, len(vals))]).name)
+    feed = {"x": np.random.RandomState(seed).uniform(
+        -1.0, 1.0, size=(B, D)).astype(np.float32)}
+    return main, startup, feed, fetch
+
+
+def _apply(L, vals, kind, payload):
+    if kind == "unary":
+        op, i = payload
+        vals.append(getattr(L, op)(vals[i % len(vals)]))
+    elif kind == "binary":
+        op, i, j = payload
+        fn = {"elementwise_add": L.elementwise_add,
+              "elementwise_sub": L.elementwise_sub,
+              "elementwise_mul": L.elementwise_mul,
+              "elementwise_max": L.elementwise_max,
+              "elementwise_min": L.elementwise_min}[op]
+        vals.append(fn(vals[i % len(vals)], vals[j % len(vals)]))
+    elif kind == "scale":
+        s, b, i = payload
+        vals.append(L.scale(vals[i % len(vals)], scale=s, bias=b))
+    elif kind == "copy":
+        (i,) = payload
+        vals.append(L.assign(vals[i % len(vals)]))
+    elif kind == "const_chain":
+        v0, n, i = payload
+        c = L.fill_constant([D], "float32", v0)
+        for _ in range(n):
+            c = L.scale(c, scale=1.1, bias=0.1)
+        vals.append(L.elementwise_add(vals[i % len(vals)], c))
+    elif kind == "dropout":
+        p, i = payload
+        vals.append(L.dropout(vals[i % len(vals)], dropout_prob=p))
+    else:  # pragma: no cover - recipe vocabulary is closed
+        raise ValueError(kind)
+
+
+def _sgd(block, param, grad, lr):
+    block.append_op("sgd",
+                    {"Param": [param.name], "Grad": [grad.name],
+                     "LearningRate": [lr.name]},
+                    {"ParamOut": [param.name]},
+                    {"__op_role__": "optimize"})
+
+
+def _param_update_block(fluid, L, rng, vals, idx, seed):
+    """In-place optimizer update + optional pre-update snapshot: the
+    copy-prop/CSE hazard shapes, wired into the live value stream."""
+    w = L.create_parameter([D], "float32", name="fz_w_%d_%d"
+                           % (seed % 1000, idx))
+    lr = L.fill_constant([1], "float32", 0.05)
+    snap = L.assign(w) if rng.random() < 0.6 else None
+    pre = L.tanh(w) if rng.random() < 0.5 else None
+    grad = L.scale(w, scale=0.3)  # reads w: RAW fodder around the sgd
+    block = w.block
+    _sgd(block, w, grad, lr)
+    if rng.random() < 0.5:  # a second, ADJACENT update: group fodder
+        w2 = L.create_parameter([D], "float32", name="fz_v_%d_%d"
+                                % (seed % 1000, idx))
+        _sgd(block, w2, grad, lr)
+        vals.append(L.elementwise_add(vals[-1], w2))
+    post = L.tanh(w)  # reads the UPDATED w: versioned-CSE fodder vs pre
+    vals.append(L.elementwise_add(vals[-1], post))
+    if pre is not None:
+        vals.append(L.elementwise_add(vals[-1], pre))
+    if snap is not None:
+        vals.append(L.elementwise_add(vals[-1], snap))
+
+
+def _cond_block(fluid, L, rng, vals):
+    """Conditional sub-block writing a pre-created var (layers.cond):
+    pins its names, exercises sub-block parent-chain resolution."""
+    z = L.fill_constant([D], "float32", 0.0)
+    pred = L.less_than(L.reduce_mean(vals[-1]),
+                       L.fill_constant([1], "float32", 0.25))
+
+    def then():
+        L.assign(L.fill_constant([D], "float32", 1.0), output=z)
+
+    L.cond(pred, then)
+    vals.append(L.elementwise_add(vals[-1], z))
+
+
+# ----------------------------------------------------------- harness
+def run_program(main, startup, feed, fetch, level, steps=2):
+    """Run ``steps`` executor steps at the given optimize level in a
+    fresh scope; returns (per-step fetch bytes, persistable bytes)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    old = os.environ.get("PADDLE_TPU_OPTIMIZE")
+    os.environ["PADDLE_TPU_OPTIMIZE"] = str(level)
+    try:
+        scope = Scope()
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            outs = []
+            for _ in range(steps):
+                vals = exe.run(main, feed=dict(feed) if feed else None,
+                               fetch_list=list(fetch), scope=scope)
+                outs.append([np.asarray(v).tobytes() for v in vals])
+            persist = {}
+            for var in main.global_block().vars.values():
+                if var.persistable and scope.has_var(var.name):
+                    persist[var.name] = np.asarray(
+                        scope.find_var(var.name)).tobytes()
+        return outs, persist
+    finally:
+        if old is None:
+            os.environ.pop("PADDLE_TPU_OPTIMIZE", None)
+        else:
+            os.environ["PADDLE_TPU_OPTIMIZE"] = old
+
+
+def diff_run(main, startup, feed, fetch, steps=2):
+    """Differential check: level 2 vs level 0, bitwise. Returns a list
+    of mismatch descriptions (empty = clean). An OptimizerPassError or
+    execution failure at level 2 is reported as a failure, never
+    swallowed."""
+    base, base_p = run_program(main, startup, feed, fetch, level=0,
+                               steps=steps)
+    try:
+        opt, opt_p = run_program(main, startup, feed, fetch, level=2,
+                                 steps=steps)
+    except Exception as e:  # OptimizerPassError, lowering KeyError, ...
+        return ["level-2 run failed: %s: %s" % (type(e).__name__, e)]
+    problems = []
+    for s, (a, b) in enumerate(zip(base, opt)):
+        for i, (va, vb) in enumerate(zip(a, b)):
+            if va != vb:
+                problems.append("step %d fetch %r differs bitwise"
+                                % (s, fetch[i]))
+    for name in sorted(set(base_p) | set(opt_p)):
+        if base_p.get(name) != opt_p.get(name):
+            problems.append("persistable %r differs bitwise" % name)
+    return problems
+
+
+def fuzz_one(seed, steps=2):
+    """Generate + differentially check ONE seed. Returns problem list."""
+    main, startup, feed, fetch = gen_program(seed)
+    return diff_run(main, startup, feed, fetch, steps=steps)
+
+
+# ------------------------------------------------------------- corpus
+# The six confirmed historical miscompiles, as programs + knock-outs.
+def _corpus_cse_write_versioning(fluid, L):
+    """PR 7: CSE merged identical reads AROUND an in-place write."""
+    s = L.create_parameter([D], "float32", name="cwv_s")
+    r1 = L.tanh(s)
+    lr = L.fill_constant([1], "float32", 0.5)
+    _sgd(s.block, s, L.scale(s, scale=1.0), lr)  # in-place update of s
+    r2 = L.tanh(s)  # same op+input NAME, different write version
+    out = L.reduce_mean(L.elementwise_add(r1, r2))
+    return [out.name]
+
+
+def _corpus_copy_prop_aliasing(fluid, L):
+    """PR 7: a pre-update snapshot copy dropped as if it were an alias."""
+    w = L.create_parameter([D], "float32", name="cpa_w")
+    snap = L.assign(w)  # SNAPSHOT of w before the update
+    lr = L.fill_constant([1], "float32", 0.5)
+    _sgd(w.block, w, L.scale(w, scale=1.0), lr)
+    out = L.reduce_mean(L.elementwise_add(snap, L.scale(w, scale=0.0)))
+    return [out.name]
+
+
+def _corpus_materialize_ordering(fluid, L):
+    """PR 7 round 3: min-consumer splicing put fused chain B before the
+    fused chain A it consumes."""
+    x = L.data(name="x", shape=[D], dtype="float32")
+    out_a = L.tanh(L.relu(x))          # chain A
+    out_b = L.sigmoid(L.tanh(out_a))   # chain B consumes A
+    s_b = L.reduce_mean(out_b)         # B's consumer FIRST
+    s_a = L.reduce_mean(out_a)         # A's consumer after
+    return [s_b.name, s_a.name]
+
+
+def _corpus_fusion_read_after_write(fluid, L):
+    """PR 7 round 4: a chain's external read moved past an in-place
+    write when the fused body ran at the chain tail's slot."""
+    w = L.create_parameter([D], "float32", name="raw_w")
+    t1 = L.relu(w)  # reads PRE-update w
+    lr = L.fill_constant([1], "float32", 0.5)
+    _sgd(w.block, w, L.scale(w, scale=1.0), lr)  # in-place update
+    t2 = L.tanh(t1)  # relu->tanh chain would fuse at THIS slot
+    out = L.reduce_mean(L.elementwise_add(t2, w))
+    return [out.name]
+
+
+def _corpus_optimizer_group_reorder(fluid, L):
+    """PR 8: two updates separated by a live read became 'consecutive'
+    under node-list adjacency and the first write moved past the read."""
+    w1 = L.create_parameter([D], "float32", name="ogr_w1")
+    w2 = L.create_parameter([D], "float32", name="ogr_w2")
+    lr = L.fill_constant([1], "float32", 0.5)
+    _sgd(w1.block, w1, L.scale(w1, scale=1.0), lr)
+    mid = L.scale(w1, scale=1.0)  # reads w1 BETWEEN the two updates
+    _sgd(w2.block, w2, L.scale(w2, scale=1.0), lr)
+    out = L.reduce_mean(mid)
+    return [out.name]
+
+
+def _corpus_fused_replay_raw(fluid, L):
+    """PR 8: the fused replay fetches every input at op entry, so a
+    later constituent reading an earlier one's write saw stale state."""
+    a = L.create_parameter([D], "float32", name="frr_a")
+    b = L.create_parameter([D], "float32", name="frr_b")
+    g = L.fill_constant([D], "float32", 0.25)
+    lr = L.fill_constant([1], "float32", 0.5)
+    _sgd(a.block, a, g, lr)        # writes a
+    _sgd(b.block, b, a, lr)        # ADJACENT, reads the updated a
+    out = L.reduce_mean(L.elementwise_add(a, b))
+    return [out.name]
+
+
+@contextlib.contextmanager
+def _patch_attr(obj, name, value):
+    old = getattr(obj, name)
+    setattr(obj, name, value)
+    try:
+        yield
+    finally:
+        setattr(obj, name, old)
+
+
+@contextlib.contextmanager
+def _knockout_cse():
+    from paddle_tpu.core.passes.cse import \
+        CommonSubexpressionEliminationPass as P
+
+    with _patch_attr(P, "versioned", False):
+        yield
+
+
+@contextlib.contextmanager
+def _knockout_copy_prop():
+    from paddle_tpu.core.passes.cse import CopyPropagationPass as P
+
+    with _patch_attr(P, "snapshot_guard", False):
+        yield
+
+
+@contextlib.contextmanager
+def _knockout_fusion_raw():
+    from paddle_tpu.core.passes.fuse import FuseElementwisePass as P
+
+    with _patch_attr(P, "move_guard", False):
+        yield
+
+
+@contextlib.contextmanager
+def _knockout_group_adjacency():
+    from paddle_tpu.core.passes.kernel_fuse import FuseKernelTierPass as P
+
+    with _patch_attr(P, "adjacency_guard", False):
+        yield
+
+
+@contextlib.contextmanager
+def _knockout_replay_raw():
+    from paddle_tpu.core.passes.kernel_fuse import FuseKernelTierPass as P
+
+    with _patch_attr(P, "raw_guard", False):
+        yield
+
+
+def _buggy_materialize(self):
+    """The pre-PR 7-round-3 Graph.materialize: EVERY new op splices at
+    min(consumer position) — no replacement anchoring. Resurrected only
+    as the materialize-ordering knock-out."""
+    block = self.program.global_block()
+    old_pos = {id(op): i for i, op in enumerate(block.ops)}
+    alive = {id(n.op) for n in self.op_nodes}
+    keyed = sorted((old_pos[id(op)], k, op)
+                   for k, op in enumerate(block.ops) if id(op) in alive)
+    order = [op for _i, _k, op in keyed]
+    for node in (n for n in self.op_nodes if id(n.op) not in old_pos):
+        pos = {id(op): i for i, op in enumerate(order)}
+        consumers = [pos[id(c.op)] for vn in node.outputs
+                     for c in vn.outputs
+                     if c is not node and id(c.op) in pos]
+        if consumers:
+            at = min(consumers)
+        else:
+            producers = [pos[id(p.op)] for vn in node.inputs
+                         for p in vn.inputs
+                         if p is not node and id(p.op) in pos]
+            at = max(producers) + 1 if producers else len(order)
+        order.insert(at, node.op)
+    block.ops = order
+    self.program._bump()
+    return self.program
+
+
+@contextlib.contextmanager
+def _knockout_materialize():
+    from paddle_tpu.core.ir import Graph
+
+    with _patch_attr(Graph, "materialize", _buggy_materialize):
+        yield
+
+
+CORPUS = {
+    "cse_write_versioning": (_corpus_cse_write_versioning, _knockout_cse),
+    "copy_prop_aliasing": (_corpus_copy_prop_aliasing,
+                           _knockout_copy_prop),
+    "materialize_ordering": (_corpus_materialize_ordering,
+                             _knockout_materialize),
+    "fusion_read_after_write": (_corpus_fusion_read_after_write,
+                                _knockout_fusion_raw),
+    "optimizer_group_reorder": (_corpus_optimizer_group_reorder,
+                                _knockout_group_adjacency),
+    "fused_replay_raw": (_corpus_fused_replay_raw, _knockout_replay_raw),
+}
+
+
+def build_corpus_program(name):
+    """(main, startup, feed, fetch) for one corpus entry."""
+    import paddle_tpu as fluid
+
+    builder, _ko = CORPUS[name]
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            fetch = builder(fluid, fluid.layers)
+    feed = {}
+    if "x" in main.global_block().vars:
+        feed = {"x": np.random.RandomState(0).uniform(
+            -1.0, 1.0, size=(B, D)).astype(np.float32)}
+    return main, startup, feed, fetch
+
+
+def corpus_check(name):
+    """Three-way proof for one corpus entry (see module docstring):
+    returns {"clean": [...], "tv_trips": bool, "tv_rules": [...],
+    "miscompiles": bool, "knocked_out_problems": [...]}."""
+    from paddle_tpu.core.passes import OptimizerPassError, optimize_program
+
+    _builder, knockout = CORPUS[name]
+    result = {}
+    # (a) guarded pipeline: differentially clean
+    main, startup, feed, fetch = build_corpus_program(name)
+    result["clean"] = diff_run(main, startup, feed, fetch)
+    # (b) guard knocked out: the translation validator trips
+    with knockout():
+        main, startup, feed, fetch = build_corpus_program(name)
+        try:
+            optimize_program(main, fetch_list=list(fetch), level=2,
+                             verify=False, tv=True)
+            result["tv_trips"] = False
+            result["tv_rules"] = []
+        except OptimizerPassError as e:
+            result["tv_trips"] = True
+            result["tv_rules"] = sorted(
+                {getattr(f, "rule", "?") for f in e.findings})
+        # (c) guard out AND validation off: the miscompile is REAL
+        old_tv = os.environ.get("PADDLE_TPU_OPTIMIZE_TV")
+        old_vf = os.environ.get("PADDLE_TPU_OPTIMIZE_VERIFY")
+        os.environ["PADDLE_TPU_OPTIMIZE_TV"] = "0"
+        os.environ["PADDLE_TPU_OPTIMIZE_VERIFY"] = "0"
+        try:
+            main, startup, feed, fetch = build_corpus_program(name)
+            problems = diff_run(main, startup, feed, fetch)
+        finally:
+            for key, val in (("PADDLE_TPU_OPTIMIZE_TV", old_tv),
+                             ("PADDLE_TPU_OPTIMIZE_VERIFY", old_vf)):
+                if val is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = val
+        result["miscompiles"] = bool(problems)
+        result["knocked_out_problems"] = problems
+    return result
+
+
+# ---------------------------------------------------------------- CLI
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="differential pass fuzzer (level 2 vs level 0, "
+                    "bitwise + TV-clean)")
+    p.add_argument("--seeds", type=int, default=25,
+                   help="number of seeds to sweep (default 25)")
+    p.add_argument("--start", type=int, default=0,
+                   help="first seed (replay a failure with "
+                        "--start SEED --seeds 1)")
+    p.add_argument("--steps", type=int, default=2,
+                   help="executor steps per program (default 2)")
+    p.add_argument("--corpus", action="store_true",
+                   help="run the six-miscompile knock-out corpus "
+                        "instead of the random sweep")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    failures = 0
+    report = {}
+    if args.corpus:
+        for name in sorted(CORPUS):
+            r = corpus_check(name)
+            ok = (not r["clean"]) and r["tv_trips"] and r["miscompiles"]
+            failures += 0 if ok else 1
+            report[name] = r
+            if not args.json:
+                print("== corpus %-26s %s" % (name, "ok" if ok else
+                                              "FAIL %r" % (r,)))
+    else:
+        for seed in range(args.start, args.start + args.seeds):
+            problems = fuzz_one(seed, steps=args.steps)
+            report[str(seed)] = problems
+            if problems:
+                failures += 1
+                print("== seed %d FAILED (replay: python "
+                      "tools/pass_fuzz.py --start %d --seeds 1)"
+                      % (seed, seed))
+                for pr in problems:
+                    print("   " + pr)
+            elif not args.json:
+                print("== seed %d ok" % seed)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    # standalone CLI runs force the cpu backend BEFORE paddle_tpu
+    # imports jax; only under __main__ (tests import this module — see
+    # tools/lint_program.py for the env-leak this avoids)
+    os.environ.setdefault("PADDLE_TPU_PLATFORM", "cpu")
+    sys.exit(main())

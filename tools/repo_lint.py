@@ -35,6 +35,15 @@ tests/test_repo_lint.py):
    kernel with no fallback has no parity baseline and no composed
    dispatch target; registry.py enforces both at runtime too, but the
    lint catches it before anything imports.
+6. **undeclared-fault-site** — the trace-site contract (rule 3) for the
+   fault-injection plane: every literal site passed to ``fault_point``
+   (the compiled-in hot-path stamps) or armed via ``FaultPlan.arm``
+   must be declared in ``families.py``'s ``FAULT_SITES`` tuple. A
+   typo'd site would arm a spec nothing ever fires (a chaos test that
+   silently tests nothing) — or stamp a site whose injections land in
+   an undeclared ``paddle_resilience_faults_injected_total`` series
+   outside the pre-materialized schema. Dynamic sites (variables,
+   concatenation, the env-plan parser) are skipped like rule 3's.
 
 Usage: ``python tools/repo_lint.py [--root DIR]``; exit 1 on violations.
 """
@@ -173,18 +182,7 @@ _TRACE_CALL_FNS = ("trace_span", "trace_event", "record_span")
 
 def declared_trace_sites(root: str) -> Set[str]:
     """Site names in families.py's ``TRACE_SITES = (...)`` tuple."""
-    tree = _parse(os.path.join(root, FAMILIES_FILE))
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        if not any(isinstance(t, ast.Name) and t.id == "TRACE_SITES"
-                   for t in node.targets):
-            continue
-        if isinstance(node.value, (ast.Tuple, ast.List)):
-            return {el.value for el in node.value.elts
-                    if isinstance(el, ast.Constant)
-                    and isinstance(el.value, str)}
-    return set()
+    return _declared_tuple(root, "TRACE_SITES")
 
 
 def trace_site_violations(root: str, files=None) -> List[str]:
@@ -211,6 +209,78 @@ def trace_site_violations(root: str, files=None) -> List[str]:
                 violations.append(
                     "%s:%d: trace site %r is used by %s() but not "
                     "declared in %s TRACE_SITES"
+                    % (rel, node.lineno, site, fn_name, FAMILIES_FILE))
+    return violations
+
+
+def _declared_tuple(root: str, var_name: str) -> Set[str]:
+    """String elements of a top-level ``VAR = (...)`` tuple/list in
+    observe/families.py (TRACE_SITES, FAULT_SITES)."""
+    tree = _parse(os.path.join(root, FAMILIES_FILE))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == var_name
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {el.value for el in node.value.elts
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)}
+    return set()
+
+
+def declared_fault_sites(root: str) -> Set[str]:
+    """Site names in families.py's ``FAULT_SITES = (...)`` tuple."""
+    return _declared_tuple(root, "FAULT_SITES")
+
+
+def _receiver_name(node) -> str:
+    """Terminal name of an attribute-call receiver: ``plan.arm`` ->
+    ``plan``, ``FaultPlan(seed=s).arm`` -> ``FaultPlan``."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def fault_site_violations(root: str, files=None) -> List[str]:
+    """Rule 6: literal first args of ``fault_point(...)`` and
+    ``<plan>.arm(...)`` must be declared in FAULT_SITES."""
+    declared = declared_fault_sites(root)
+    violations = []
+    fam_rel = FAMILIES_FILE.replace("/", os.sep)
+    for path in (files or iter_py_files(root)):
+        rel = os.path.relpath(path, root)
+        if rel == fam_rel:
+            continue
+        for node in ast.walk(_parse(path)):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fn_name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            # `arm` only as an attribute call on a FaultPlan-shaped
+            # receiver (FaultPlan().arm / plan.arm) — an unrelated
+            # API's `.arm(...)` is not a fault site; `fault_point` in
+            # either form
+            if fn_name == "arm":
+                if not isinstance(fn, ast.Attribute) or \
+                        "plan" not in _receiver_name(fn.value).lower():
+                    continue
+            if fn_name not in ("fault_point", "arm"):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue  # dynamic sites are a deliberate escape hatch
+            site = node.args[0].value
+            if site not in declared:
+                violations.append(
+                    "%s:%d: fault site %r is used by %s() but not "
+                    "declared in %s FAULT_SITES"
                     % (rel, node.lineno, site, fn_name, FAMILIES_FILE))
     return violations
 
@@ -284,7 +354,8 @@ def run(root: str = REPO_ROOT) -> List[str]:
     return (bare_except_violations(root) + family_ref_violations(root)
             + trace_site_violations(root)
             + pass_docstring_violations(root)
-            + kernel_registry_violations(root))
+            + kernel_registry_violations(root)
+            + fault_site_violations(root))
 
 
 def main(argv=None) -> int:
