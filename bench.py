@@ -133,6 +133,34 @@ def peak_flops():
     return None
 
 
+def _round_nonzero(x, digits):
+    """Round a MEASURED positive value for the row without ever
+    producing a false 0.0: a tiny value keeps enough digits to stay
+    nonzero (deepfm's 0.1% MFU must print as 0.001, and a 0.00004 must
+    not collapse to 0.0)."""
+    r = round(x, digits)
+    while r == 0.0 and x > 0 and digits <= 12:
+        digits += 2
+        r = round(x, digits)
+    return r if r != 0.0 else x
+
+
+def _mfu_fields(step_flops, steps, dt, peak):
+    """The ``tflops_per_sec``/``mfu`` row fields, with the null-never-
+    zero contract: ``None`` (JSON null) when ``cost_analysis`` produced
+    no flop count or the chip's peak is unknown — an UNMEASURED MFU
+    must never masquerade as a measured 0.0 (older sidecars like
+    BENCH_r04_builder.json show the 0.0 form this replaces). A measured
+    value is never rounded to 0.0 either (``_round_nonzero``)."""
+    if not step_flops or dt <= 0:
+        return {"tflops_per_sec": None, "mfu": None}
+    achieved = step_flops * steps / dt
+    return {
+        "tflops_per_sec": _round_nonzero(achieved / 1e12, 2),
+        "mfu": _round_nonzero(achieved / peak, 4) if peak else None,
+    }
+
+
 def _fused_attention_on():
     from paddle_tpu.ops.attention import fused_attention_enabled
 
@@ -302,9 +330,14 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             "PADDLE_TPU_BENCH_STEPS_PER_CALL",
             "1" if quick else str(DEFAULT_STEPS_PER_CALL)))
         if pipelined:
-            spc = 1  # per-step dispatch IS the pipelined mode's shape
+            # the pipelined mode drives the SAME windowed train_loop
+            # real training uses: K batches per scanned dispatch
+            # (whole-loop compilation), feeds starting host-side each
+            # step, the prefetcher's H2D under the window's compute.
+            # spc=1 (quick default) is the classic per-step loop.
             in_flight = int(os.environ.get("PADDLE_TPU_BENCH_IN_FLIGHT", "2"))
             depth = int(os.environ.get("PADDLE_TPU_BENCH_PREFETCH_DEPTH", "2"))
+            steps = max(steps, spc)  # at least one full window
             # fresh array copies per step: the const-feed dedup cache must
             # not short-circuit the H2D this mode exists to measure; lazy
             # so peak host RSS holds only the prefetch window, not steps x
@@ -317,12 +350,27 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             with _beacon(name, "compile/warmup"):
                 for _ in range(warmup):
                     exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
-            _log("%s: timing %d pipelined steps (in_flight=%d, depth=%d)"
-                 % (name, steps, in_flight, depth))
+                if spc > 1:
+                    # pay the K-step scan compile outside the timed
+                    # loop, through the SAME windowed loop shape (the
+                    # scan variant hangs off the per-step plan; a
+                    # run_repeated warmup would compile a different,
+                    # stacked-shape plan and leave this one cold)
+                    warm_batches = (
+                        {k: np.array(v, copy=True)
+                         for k, v in feed.items()} for _ in range(spc))
+                    exe.train_loop(
+                        main, iter(warm_batches), fetch_list=[loss],
+                        scope=scope, max_in_flight=in_flight,
+                        prefetch_depth=depth, steps_per_call=spc)
+            _log("%s: timing %d pipelined steps (steps_per_call=%d, "
+                 "in_flight=%d, depth=%d)"
+                 % (name, steps, spc, in_flight, depth))
             t0 = time.perf_counter()
             _n, vals = exe.train_loop(
                 main, iter(host_batches), fetch_list=[loss], scope=scope,
-                max_in_flight=in_flight, prefetch_depth=depth)
+                max_in_flight=in_flight, prefetch_depth=depth,
+                steps_per_call=spc)
             float(np.asarray(vals[0]).reshape(-1)[0])  # block on the result
             dt = time.perf_counter() - t0
         elif spc > 1:
@@ -357,7 +405,6 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
         _log("%s: cost_analysis" % name)
         step_flops = exe.cost_analysis(
             main, feed=feed, fetch_list=[loss], scope=scope).get("flops", 0.0)
-        achieved = step_flops * steps / dt
         peak = peak_flops()
         import jax as _jax
 
@@ -396,9 +443,11 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             **({"flash_min_seq": int(os.environ["PADDLE_TPU_FLASH_MIN_SEQ"])}
                if (attention and "PADDLE_TPU_FLASH_MIN_SEQ" in os.environ)
                else {}),
-            # K steps per host dispatch (run_repeated lax.scan); absent
-            # means the classic one-dispatch-per-step loop
-            **({"steps_per_call": spc} if spc > 1 else {}),
+            # K steps per host dispatch (run_repeated/train_loop
+            # lax.scan window) — recorded on EVERY train row (spc=1 =
+            # the classic one-dispatch-per-step loop), so rows from
+            # different dispatch modes can never be silently compared
+            "steps_per_call": spc,
             # pipelined-engine rows (DevicePrefetcher + async in-flight
             # dispatch, host-side feeds each step) are their own mode:
             # never regression-compared against pre-placed-feed
@@ -429,12 +478,9 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
                 and not (attention
                          and "PADDLE_TPU_FLASH_MIN_SEQ" in os.environ))
             else 1.0,
-            # None (not 0.0) when the backend produced no flop count —
-            # an unmeasured MFU must never masquerade as a measured zero
-            "tflops_per_sec": round(achieved / 1e12, 2)
-            if step_flops else None,
-            "mfu": round(achieved / peak, 4)
-            if (peak and step_flops) else None,
+            # null (never 0.0) when the backend produced no flop count
+            # or the chip peak is unknown — see _mfu_fields
+            **_mfu_fields(step_flops, steps, dt, peak),
         }
         print(json.dumps(rec), flush=True)
         return rec
@@ -847,6 +893,9 @@ def bench_deepfm_dist(amp, quick, uses_flash=False):
             "precision": "bf16_amp" if amp else "f32",
             "distributed": True,
             "pservers": n_ps,
+            # per-step RPC callbacks make spc=1 THIS row's default mode
+            # (recorded like every train row)
+            "steps_per_call": 1,
             "value": round(batch * steps / dt, 1),
             "unit": "examples/sec",
             "vs_baseline": round(
@@ -854,7 +903,9 @@ def bench_deepfm_dist(amp, quick, uses_flash=False):
                     "deepfm_dist_train_examples_per_sec_per_chip"], 3)
             if "deepfm_dist_train_examples_per_sec_per_chip" in BASELINES
             else 1.0,
-            "tflops_per_sec": None,  # RPC-bound; MFU is not the story
+            # null, never 0.0: the sparse path is RPC-bound and its
+            # dense-half flop count alone would be a lie — unmeasured
+            "tflops_per_sec": None,
             "mfu": None,
         }
         print(json.dumps(rec), flush=True)
@@ -1212,6 +1263,9 @@ def bench_elastic(amp, quick, uses_flash=False):
         "vs_baseline": 1.0,
         "tflops_per_sec": None,
         "mfu": None,
+        # elastic workers drive resilient_train_loop at its default
+        # per-step dispatch (recorded like every train row)
+        "steps_per_call": 1,
         "trainers": trainers,
         "steps": steps,
         "generations": res.generations,

@@ -56,7 +56,7 @@ from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .core.executor import Executor  # noqa: F401
 from .core.pipeline import (ConstFeedCache, DevicePrefetcher,  # noqa: F401
-                            FetchHandle)
+                            FetchHandle, WindowFeed)
 from .core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa: F401
                          TPUPlace, is_compiled_with_tpu)
 from .core.program import (  # noqa: F401

@@ -50,9 +50,28 @@ import numpy as np
 
 from ..observe import trace as _tr
 
-__all__ = ["DevicePrefetcher", "ConstFeedCache", "FetchHandle"]
+__all__ = ["DevicePrefetcher", "ConstFeedCache", "FetchHandle",
+           "WindowFeed"]
 
 _END = object()
+
+
+class WindowFeed:
+    """K per-step host batches stacked into ONE device-resident feed by
+    the prefetch thread (``reader.stack_feed_window``'s [K, ...] layout,
+    one ``jax.device_put`` per WINDOW instead of per batch — the H2D
+    half of whole-loop compilation's amortization; the scan dispatch is
+    the other half). ``feeds`` maps name -> stacked device array,
+    ``steps`` is K. Only a windowed prefetcher emits these; ragged
+    tails (reader dry / shape change mid-window) degrade to plain
+    per-step feed dicts, which the pipelined loop dispatches through
+    the per-step path."""
+
+    __slots__ = ("feeds", "steps")
+
+    def __init__(self, feeds: Dict[str, Any], steps: int):
+        self.feeds = feeds
+        self.steps = steps
 
 
 def _tree_nbytes(tree) -> int:
@@ -105,11 +124,18 @@ class ConstFeedCache:
         with self._lock:
             return name in self._const_names
 
-    def lookup(self, name: str, val, device=None) -> Optional[Any]:
+    def lookup(self, name: str, val, device=None,
+               shape=None) -> Optional[Any]:
         """Device array for (name, val) if cached, else None. ``device``
         (when given) guards a cache shared across prefetchers committed
         to different devices: an entry resident elsewhere is a MISS (and
-        the re-transfer overwrites it), never a mixed-device feed."""
+        the re-transfer overwrites it), never a mixed-device feed.
+        ``shape`` (when given) guards the by-name tier across dispatch
+        modes: a windowed loop stores the K-STACKED copy under the
+        name, and serving it to a per-step (ragged-fallback) dispatch —
+        or a per-step copy to a windowed one — would silently feed the
+        wrong rank; a shape mismatch is a MISS (no hit counted) and the
+        re-transfer overwrites the entry."""
         from ..observe.families import (PIPELINE_CONST_BYTES_SAVED,
                                         PIPELINE_CONST_HITS)
 
@@ -125,6 +151,9 @@ class ConstFeedCache:
                 dev = entry[1]
         if dev is not None and device is not None \
                 and getattr(dev, "device", device) != device:
+            return None
+        if dev is not None and shape is not None \
+                and getattr(dev, "shape", None) != tuple(shape):
             return None
         if dev is not None:
             PIPELINE_CONST_HITS.inc()
@@ -187,11 +216,23 @@ class DevicePrefetcher:
     def __init__(self, reader, place=None, program=None, depth: int = 2,
                  const_feed_names: Sequence[str] = (),
                  const_cache: Optional[ConstFeedCache] = None,
-                 const_dedup: bool = True):
+                 const_dedup: bool = True, window_resolver=None):
         if depth < 1:
             raise ValueError("DevicePrefetcher depth must be >= 1")
         self._reader = reader
         self._depth = depth
+        # whole-loop compilation hook (run_pipelined installs it when it
+        # constructs the prefetcher): called ONCE with the first HOST
+        # batch, returns (K, source). K > 1 switches the fill thread to
+        # window mode — K host batches stack into ONE WindowFeed with a
+        # single device_put per window, so per-batch H2D call overhead
+        # amortizes alongside the scan's dispatch overhead. The result
+        # lands in ``resolved_window`` BEFORE the first hand-off (the
+        # queue is the happens-before edge the consumer reads it after).
+        # In window mode ``depth`` counts hand-off UNITS: device memory
+        # is bounded by depth * K batches, not depth batches.
+        self._window_resolver = window_resolver
+        self.resolved_window = None  # (K, source) once resolved
         # const_dedup=False turns OFF the implicit identity tier — for
         # readers that refill ONE preallocated ndarray in place each step
         # (id stays constant while the data changes, so identity dedup
@@ -229,7 +270,11 @@ class DevicePrefetcher:
         cached, rest = {}, {}
         with _tr.trace_span("pipeline.const_lookup", feeds=len(feed)):
             for n, v in feed.items():
-                dev = self.const_cache.lookup(n, v, device=self._device) \
+                # shape-guarded: a windowed loop's by-name tier holds
+                # the K-stacked copy, which must never serve a ragged
+                # per-step dispatch (see ConstFeedCache.lookup)
+                dev = self.const_cache.lookup(n, v, device=self._device,
+                                              shape=np.shape(v)) \
                     if (self._dedup_unmarked or
                         self.const_cache.is_const(n)) \
                     else None
@@ -277,9 +322,66 @@ class DevicePrefetcher:
         PIPELINE_PREFETCH_DEPTH.set(self._q.qsize())
         return True
 
-    def _fill(self):
-        from ..observe.families import (DATA_BATCHES, PIPELINE_H2D_BYTES,
+    def _convert_window(self, buf) -> tuple:
+        """K host batches -> ONE stacked device-resident WindowFeed;
+        returns (WindowFeed, h2d_bytes). Host-side ``np.stack`` per feed
+        (``reader.stack_feed_window``'s layout) then a single
+        ``device_put`` of the whole window — K batches cross H2D at
+        per-CALL cost 1, not K. Const-MARKED names keep their by-name
+        tier (the stacked window transfers once, later values ignored
+        until invalidated — the same constancy promise); the implicit
+        identity tier is skipped in window mode (each stacked array is
+        a fresh object; single-batch dedup semantics don't map)."""
+        from ..reader import stack_feed_window
+        from .executor import feeds_to_device
+
+        stacked = stack_feed_window(buf)
+        cached, rest = {}, {}
+        with _tr.trace_span("pipeline.const_lookup", feeds=len(stacked)):
+            for n, v in stacked.items():
+                dev = self.const_cache.lookup(n, v, device=self._device,
+                                              shape=np.shape(v)) \
+                    if self.const_cache.is_const(n) else None
+                if dev is not None:
+                    cached[n] = dev
+                else:
+                    rest[n] = v
+        out, nbytes = feeds_to_device(rest, self._var_lookup, self._device)
+        for n in out:
+            if self.const_cache.is_const(n):
+                self.const_cache.store(n, stacked[n], out[n])
+        out.update(cached)
+        return WindowFeed(out, len(buf)), nbytes
+
+    def _emit(self, item, nbytes, t0, batches, k: int = 1) -> bool:
+        """Block until resident, record H2D telemetry (one observation
+        per hand-off unit), hand off; False if the consumer went away."""
+        from ..observe.families import (PIPELINE_H2D_BYTES,
                                         PIPELINE_H2D_SECONDS)
+
+        # block in THIS thread: the consumer must receive feeds that
+        # are truly resident, and the histogram must record real
+        # transfer latency, not an async hand-off
+        jax.block_until_ready(item.feeds if isinstance(item, WindowFeed)
+                              else item)
+        PIPELINE_H2D_SECONDS.observe(time.perf_counter() - t0)
+        PIPELINE_H2D_BYTES.inc(nbytes)
+        batches.inc(k)
+        return self._put(item)
+
+    def _flush_ragged(self, buf, batches) -> bool:
+        """Hand a partial window's batches off individually (per-step
+        conversion — the pipelined loop's ragged fallback path)."""
+        for feed in buf:
+            t0 = time.perf_counter()
+            with _tr.trace_span("pipeline.prefetch"):
+                dev, nbytes = self._convert(feed)
+                if not self._emit(dev, nbytes, t0, batches):
+                    return False
+        return True
+
+    def _fill(self):
+        from ..observe.families import DATA_BATCHES
 
         batches = DATA_BATCHES.labels(source="device_prefetcher")
         from ..resilience.faults import fault_point
@@ -290,6 +392,9 @@ class DevicePrefetcher:
             # explicit trace hand-off: adopt the consumer-pinned context
             # for this whole fill thread (attach(None) is a no-op scope)
             with _tr.attach(self.trace_ctx):
+                win = 1
+                buf: list = []    # host batches awaiting a full window
+                sig = None        # per-feed shape signature of the window
                 for feed in it:
                     if self._stop.is_set():
                         return
@@ -298,19 +403,43 @@ class DevicePrefetcher:
                     # re-raises in the consumer, exactly like a real
                     # reader failure
                     fault_point("reader.next")
-                    t0 = time.perf_counter()
-                    with _tr.trace_span("pipeline.prefetch"):
-                        dev, nbytes = self._convert(feed)
-                        # block in THIS thread: the consumer must receive
-                        # feeds that are truly resident, and the histogram
-                        # must record real transfer latency, not an async
-                        # hand-off
-                        jax.block_until_ready(dev)
-                    PIPELINE_H2D_SECONDS.observe(time.perf_counter() - t0)
-                    PIPELINE_H2D_BYTES.inc(nbytes)
-                    batches.inc()
-                    if not self._put(dev):
-                        return
+                    if self._window_resolver is not None:
+                        k, src = self._window_resolver(feed)
+                        win = max(1, int(k))
+                        # publish BEFORE the first hand-off: the queue
+                        # put is the happens-before edge the consumer
+                        # reads this after
+                        self.resolved_window = (win, src)
+                        self._window_resolver = None
+                    if win <= 1:
+                        t0 = time.perf_counter()
+                        with _tr.trace_span("pipeline.prefetch"):
+                            dev, nbytes = self._convert(feed)
+                            if not self._emit(dev, nbytes, t0, batches):
+                                return
+                        continue
+                    fsig = {n: np.shape(v) for n, v in feed.items()}
+                    if buf and fsig != sig:
+                        # a shape change breaks the window in progress:
+                        # the buffered batches degrade to per-step feeds
+                        # (stacking never mixes shapes)
+                        if not self._flush_ragged(buf, batches):
+                            return
+                        buf = []
+                    sig = fsig
+                    buf.append(feed)
+                    if len(buf) == win:
+                        t0 = time.perf_counter()
+                        with _tr.trace_span("pipeline.prefetch",
+                                            window=win):
+                            wf, nbytes = self._convert_window(buf)
+                            if not self._emit(wf, nbytes, t0, batches,
+                                              win):
+                                return
+                        buf = []
+                # ragged final window: the reader ran dry mid-window
+                if buf and not self._flush_ragged(buf, batches):
+                    return
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
             self._error = e
         finally:
@@ -396,12 +525,25 @@ class FetchHandle:
     polls.
     """
 
-    __slots__ = ("step", "fetch_names", "_fetches", "_return_numpy",
-                 "_values", "_materialized", "_completion", "_block_on")
+    __slots__ = ("step", "steps", "window", "fetch_names", "_fetches",
+                 "_return_numpy", "_values", "_materialized",
+                 "_completion", "_block_on", "_window_obs")
 
     def __init__(self, step: int, fetch_names: Sequence[str], fetches,
-                 return_numpy: bool = True, completion=None, block_on=()):
+                 return_numpy: bool = True, completion=None, block_on=(),
+                 steps: int = 1, window_obs=None, window=None):
         self.step = step
+        # train steps this handle resolves: 1 for a classic per-step
+        # dispatch, K for a whole-window scanned dispatch (step is then
+        # the window's LAST step index); train_loop sums these so
+        # windowed and per-step runs report the same step count
+        self.steps = steps
+        # the loop's RESOLVED window width K (>= 1) — `steps` for a
+        # full window, but a ragged fallback dispatch in a K>1 loop
+        # carries steps=1, window=K. resilient_train_loop records this
+        # (not max(steps) seen, which an all-ragged run would misreport
+        # as 1) in the checkpoint manifest's steps_per_call
+        self.window = steps if window is None else window
         self.fetch_names = tuple(fetch_names)
         self._fetches = list(fetches)
         self._return_numpy = return_numpy
@@ -415,6 +557,10 @@ class FetchHandle:
         # carries the step's state futures so wait() still means "this
         # step's device work finished" (released after the first wait)
         self._block_on = block_on
+        # windowed dispatches also land their dispatch-to-ready latency
+        # in paddle_pipeline_window_seconds{phase="complete"}: the
+        # executor passes that series' observe here (None otherwise)
+        self._window_obs = window_obs
 
     def done(self) -> bool:
         targets = self._fetches if self._fetches \
@@ -431,7 +577,11 @@ class FetchHandle:
         self._completion = None
         from .executor import _record_completion
 
-        _record_completion(steady, site, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _record_completion(steady, site, dt)
+        if self._window_obs is not None:
+            self._window_obs(dt)
+            self._window_obs = None
 
     def wait(self) -> "FetchHandle":
         jax.block_until_ready(self._fetches if self._fetches

@@ -45,7 +45,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["CACHE_VERSION", "cache_dir", "cache_path", "tune_enabled",
-           "deterministic_seed", "lookup", "tune", "set_entry",
+           "deterministic_seed", "lookup", "peek", "tune", "set_entry",
            "load_disk_entries", "persist_entry", "reset", "config_key",
            "sig_key"]
 
@@ -193,6 +193,22 @@ def lookup(op: str, sig: Tuple) -> Optional[Dict[str, Any]]:
             return dec
     KERNEL_TUNER_MISSES.inc()
     return None
+
+
+def peek(op: str, sig: Tuple) -> Optional[Dict[str, Any]]:
+    """``lookup`` without the hit/miss counters: the resolution probe
+    for callers that consult the table on EVERY loop entry (the
+    windowed train loop's steps_per_call auto-resolution) — a per-loop
+    probe must not inflate the lookup counters whose exact movement the
+    kernel-tier acceptance tests pin. Dispatch decisions that act on
+    the answer still count through ``lookup``/``decide_and_note``."""
+    key = sig_key(op, sig)
+    with _LOCK:
+        dec = _MEM.get(key)
+        if dec is not None:
+            return dec
+        _ensure_disk_loaded()
+        return _MEM.get(key)
 
 
 def set_entry(op: str, sig: Tuple, decision: Dict[str, Any],

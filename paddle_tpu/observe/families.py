@@ -73,14 +73,20 @@ PIPELINE_PREFETCH_DEPTH = REGISTRY.gauge(
     "zeroes it")
 PIPELINE_IN_FLIGHT = REGISTRY.gauge(
     "paddle_pipeline_in_flight_steps",
-    "Dispatched-but-unresolved steps in run_pipelined's window")
+    "Dispatched-but-unresolved DISPATCH UNITS in run_pipelined's "
+    "in-flight window: steps in the classic loop, K-step scanned "
+    "windows under whole-loop compilation (a reading of 2 at "
+    "steps_per_call=25 means 50 training steps in flight)")
 PIPELINE_H2D_BYTES = REGISTRY.counter(
     "paddle_pipeline_h2d_bytes_total",
     "Feed bytes transferred host->device by DevicePrefetcher")
 PIPELINE_H2D_SECONDS = REGISTRY.histogram(
     "paddle_pipeline_h2d_seconds",
-    "Per-batch DevicePrefetcher convert + device_put + ready wall time "
-    "(off the step loop's critical path)")
+    "Per-hand-off DevicePrefetcher convert + device_put + ready wall "
+    "time (off the step loop's critical path): one observation per "
+    "batch in the classic loop, one per K-batch stacked WINDOW under "
+    "whole-loop compilation (the single device_put that amortizes "
+    "per-batch H2D call overhead)")
 PIPELINE_WAIT_SECONDS = REGISTRY.histogram(
     "paddle_pipeline_wait_seconds",
     "Time run_pipelined blocked on the OLDEST in-flight step — at the "
@@ -100,6 +106,38 @@ PIPELINE_CONST_HITS = REGISTRY.counter(
 PIPELINE_CONST_BYTES_SAVED = REGISTRY.counter(
     "paddle_pipeline_const_feed_bytes_saved_total",
     "H2D bytes avoided by const-feed dedup hits")
+
+# ------------------------------------------- pipeline: windowed dispatch
+# (whole-loop compilation: run_pipelined/train_loop with steps_per_call
+# K > 1 scan K batches per device dispatch — see docs/PERFORMANCE.md
+# "Whole-loop compilation". `stats_dump --grep paddle_pipeline_window`
+# is the one-liner that shows whether the amortization engaged.)
+PIPELINE_WINDOW_SIZE = REGISTRY.gauge(
+    "paddle_pipeline_window_size",
+    "Resolved steps_per_call K of the last windowed run_pipelined loop "
+    "(explicit arg, PADDLE_TPU_STEPS_PER_CALL, or the tuned "
+    "train_window winner); 1 = the classic one-dispatch-per-step loop")
+PIPELINE_WINDOW_STEPS = REGISTRY.histogram(
+    "paddle_pipeline_window_steps_per_dispatch",
+    "Steps carried by each windowed scan dispatch — full windows "
+    "observe K; the ragged tail's per-step fallback dispatches land in "
+    "ragged_steps_total instead of here")
+PIPELINE_WINDOW_SECONDS = REGISTRY.histogram(
+    "paddle_pipeline_window_seconds",
+    "Windowed-dispatch latency by phase: 'dispatch' is the async "
+    "hand-off of one K-step scan (host time until the XLA launch "
+    "returns — the cost amortized over K steps), 'complete' is "
+    "dispatch-to-results-ready, observed when the window's FetchHandle "
+    "first blocks (like executor_run_seconds, ~max_in_flight windows "
+    "late by design)", labels=("phase",))
+for _phase in ("dispatch", "complete"):
+    PIPELINE_WINDOW_SECONDS.labels(phase=_phase)
+PIPELINE_WINDOW_RAGGED = REGISTRY.counter(
+    "paddle_pipeline_window_ragged_steps_total",
+    "Steps dispatched through the per-step fallback because the window "
+    "could not fill (reader ran dry mid-window, or a batch's shapes "
+    "differed from the window in progress) — a ragged tail never "
+    "compiles a second scan length")
 
 # ------------------------------------------------------------------ rpc
 RPC_CALLS = REGISTRY.counter(
@@ -626,9 +664,12 @@ KERNEL_DISPATCHES = REGISTRY.counter(
     "per-compile semantics as paddle_engine_collectives_total",
     labels=("op", "impl"))
 # pre-materialize the op schema — kept as a plain tuple HERE (importing
-# kernels would cycle); tests pin it equal to kernels.all_kernels()
+# kernels would cycle); tests pin it equal to kernels.all_kernels() plus
+# the window tuner's op (core/window_tune.py WINDOW_OP: the training-
+# loop window length K rides the same tuner/winner cache without being
+# a Pallas kernel registry entry)
 _KERNEL_OPS = ("adam_update", "attention", "layernorm_residual",
-               "sgd_update")
+               "sgd_update", "train_window")
 for _op in _KERNEL_OPS:
     for _c in ("pallas", "composed"):
         KERNEL_WINNERS.labels(op=_op, choice=_c)

@@ -233,8 +233,13 @@ def _run_trainer() -> int:
         else None,
         # window 1: the compiled step carries ordered RPC callbacks —
         # overlapping two in-flight steps would interleave two barrier
-        # cycles on the wire
+        # cycles on the wire. steps_per_call pinned for the same
+        # reason, and because heartbeats ride on_step: left to
+        # auto-resolve, a global PADDLE_TPU_STEPS_PER_CALL=50 would
+        # beat once per 50-step window and expire the live trainer's
+        # membership lease mid-window
         max_in_flight=1,
+        steps_per_call=1,
     )
     complete_and_reset()  # Complete -> the pserver loop can drain
     if hb is not None:

@@ -142,13 +142,20 @@ class _Checkpointer:
         # a dict merged verbatim, or a callable(step, epoch,
         # batch_in_epoch) -> dict evaluated at each checkpoint
         self._manifest_extra = manifest_extra
+        # the windowed loop's K: recorded in every manifest so a resume
+        # (or a post-mortem) knows the dispatch shape checkpoints were
+        # aligned to — every checkpointed step is a window boundary.
+        # The supervisor learns it from the handles it resolves and
+        # passes it per checkpoint() call
+        self._steps_per_call = 1
         man = read_manifest(checkpoint_dir)
         self._retained = list(man["retained"]) if man else []
         self._pending = None  # (AsyncCheckpoint, manifest-entry meta)
 
     _RESERVED_KEYS = frozenset((
         "latest", "step", "epoch", "batch_in_epoch", "completed",
-        "var_names", "version", "retained", "unix_time"))
+        "var_names", "version", "retained", "unix_time",
+        "steps_per_call"))
 
     def _extra(self, step, epoch, batch_in_epoch) -> dict:
         extra = self._manifest_extra
@@ -164,7 +171,11 @@ class _Checkpointer:
         return dict(extra or {})
 
     def checkpoint(self, exe, program, scope, step: int, epoch: int,
-                   batch_in_epoch: int, completed: bool = False) -> None:
+                   batch_in_epoch: int, completed: bool = False,
+                   steps_per_call: Optional[int] = None) -> None:
+        if steps_per_call is not None:
+            # the loop's RESOLVED window length, handle-reported
+            self._steps_per_call = max(1, int(steps_per_call))
         from ..core.executor import RNG_VAR
         from ..io import _persistable_names, save_persistables_async
         from ..observe.families import RESILIENCE_CHECKPOINT_SECONDS
@@ -181,7 +192,7 @@ class _Checkpointer:
         meta = {
             "latest": name, "step": step, "epoch": epoch,
             "batch_in_epoch": batch_in_epoch, "completed": completed,
-            "var_names": names,
+            "var_names": names, "steps_per_call": self._steps_per_call,
         }
         meta.update(self._extra(step, epoch, batch_in_epoch))
         self._pending = (handle, meta)
@@ -289,6 +300,8 @@ def resilient_train_loop(
     resume: bool = True,
     manifest_extra=None,
     resume_program=None,
+    steps_per_call: Optional[int] = None,
+    reduce_fetches: str = "last",
 ) -> SupervisorResult:
     """Drive ``epochs`` passes of ``reader`` through the pipelined
     executor under checkpoint-restart supervision (module doc above).
@@ -310,7 +323,21 @@ def resilient_train_loop(
     manifest (the elastic tier's ``world`` section rides this).
     ``resume_program`` runs right after ANY successful manifest restore
     (initial entry and in-call recovery) — e.g. re-publishing restored
-    params to parameter servers before training resumes."""
+    params to parameter servers before training resumes.
+
+    **Windowed training** (``steps_per_call=K > 1``, or None to let the
+    loop resolve env/tuned-winner/1 — see ``Executor.run_pipelined``):
+    the loop dispatches one K-step scanned executable per window, and
+    checkpoints land ONLY at window boundaries — at the first boundary
+    at-or-after each ``checkpoint_every`` multiple — so the snapshot is
+    always a fully-resolved post-step state and crash-resume stays
+    bitwise. The manifest records ``steps_per_call``; a resumed run
+    fast-forwards the reader to the recorded batch and starts a fresh
+    window there (every checkpointed step IS a window edge, so windows
+    re-align automatically; a resume may legally run a different K —
+    the state/RNG advance is identical either way). ``on_step`` fires
+    once per resolved WINDOW (global step of its last step, values per
+    ``reduce_fetches``), still at-least-once across recoveries."""
     from ..core.executor import RNG_VAR, Executor
     from ..core.scope import global_scope
     from ..observe.families import (RESILIENCE_BACKOFF_SECONDS,
@@ -403,7 +430,7 @@ def resilient_train_loop(
                     checkpoint_every, keep_last, checkpoint_dir, on_step,
                     max_in_flight, return_numpy,
                     lambda: own_manifest.__setitem__(0, True),
-                    manifest_extra)
+                    manifest_extra, steps_per_call, reduce_fetches)
                 result.last, result.steps = last, steps
                 break
             except retryable as e:
@@ -433,7 +460,8 @@ def resilient_train_loop(
 def _attempt(exe, program, reader, fetch_list, scope, pos, epochs,
              checkpoint_every, keep_last, checkpoint_dir, on_step,
              max_in_flight, return_numpy, on_written=None,
-             manifest_extra=None):
+             manifest_extra=None, steps_per_call=None,
+             reduce_fetches="last"):
     """One uninterrupted run from ``pos`` to the end of the last epoch.
     Raises on the first fault; the caller decides whether to recover.
     ``checkpoint_every=0``: read-only — no checkpointer is even built,
@@ -446,6 +474,7 @@ def _attempt(exe, program, reader, fetch_list, scope, pos, epochs,
         if checkpoint_every else None
     pending = deque()
     last = [None]
+    cur_k = [1]  # the loop's resolved window width (handle-reported)
 
     def resolve(entry):
         gstep, h = entry
@@ -473,13 +502,29 @@ def _attempt(exe, program, reader, fetch_list, scope, pos, epochs,
             for h in exe.run_pipelined(
                     program, ff_reader, fetch_list, scope,
                     max_in_flight=max_in_flight,
-                    return_numpy=return_numpy):
-                step += 1
-                batch_in_epoch += 1
+                    return_numpy=return_numpy,
+                    steps_per_call=steps_per_call,
+                    reduce_fetches=reduce_fetches):
+                prev = step
+                step += h.steps
+                batch_in_epoch += h.steps
+                # the handle reports the loop's RESOLVED K, not this
+                # dispatch's step count — an all-ragged run (reader ran
+                # dry before filling a window) still records the K the
+                # loop resolved, and a max over h.steps could never
+                # have seen it
+                cur_k[0] = h.window
                 pending.append((step, h))
                 if len(pending) > max_in_flight:
                     resolve(pending.popleft())
-                if ck is not None and step % checkpoint_every == 0:
+                if ck is not None and \
+                        step // checkpoint_every > prev // checkpoint_every:
+                    # checkpoints land only at WINDOW boundaries: the
+                    # first boundary at-or-after each checkpoint_every
+                    # multiple (for K=1 this is exactly the old
+                    # `step % checkpoint_every == 0`). A window is one
+                    # indivisible dispatch — there is no consistent
+                    # mid-window state to snapshot.
                     # drain BEFORE checkpointing: once this manifest is
                     # finalized, a later fault resumes past these steps
                     # and a handle still pending here would never get
@@ -490,10 +535,12 @@ def _attempt(exe, program, reader, fetch_list, scope, pos, epochs,
                     while pending:
                         resolve(pending.popleft())
                     # the generator is suspended right after dispatching
-                    # step `step` (state written back, next step not yet
-                    # dispatched): the snapshot is exactly post-step state
+                    # the window ending at `step` (state written back,
+                    # next window not yet dispatched): the snapshot is
+                    # exactly post-step state at a window edge
                     ck.checkpoint(exe, program, scope, step, epoch,
-                                  batch_in_epoch)
+                                  batch_in_epoch,
+                                  steps_per_call=cur_k[0])
         while pending:
             resolve(pending.popleft())
         # final checkpoint: epoch == epochs / batch 0 means "nothing left
@@ -501,7 +548,7 @@ def _attempt(exe, program, reader, fetch_list, scope, pos, epochs,
         # trains zero further steps
         if ck is not None:
             ck.checkpoint(exe, program, scope, step, epochs, 0,
-                          completed=True)
+                          completed=True, steps_per_call=cur_k[0])
             ck.finalize()
         return last[0], step
     except BaseException:

@@ -223,6 +223,19 @@ class ReplicaRouter:
         watchdog fires, instead of waiting out the poll interval."""
         self._nudge.set()
 
+    def set_stall_deadline(self, seconds: Optional[float]) -> None:
+        """(Re)arm wedge detection at a new deadline; ``None`` disarms.
+        The monitor reads the deadline on every poll, so this takes
+        effect immediately — the knob for arming detection only AFTER
+        warmup with a deadline CALIBRATED from measured healthy request
+        latency (a fixed deadline chosen before the box's real speed is
+        known either misses wedges or drains healthy-but-slow replicas;
+        the chaos tests use exactly this pattern)."""
+        if seconds is not None and seconds <= 0:
+            raise ValueError("stall deadline must be > 0 or None, got %r"
+                             % (seconds,))
+        self._stall_deadline_s = seconds
+
     # ---------------------------------------------------------- dispatch
     def _healthy(self, exclude=()):
         return [r for r in self._replicas

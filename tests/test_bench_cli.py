@@ -56,6 +56,12 @@ def test_bench_orchestrator_happy_path():
     assert row["value"] > 0
     assert row["unit"] == "examples/sec"
     assert "vs_baseline" in row and "tflops_per_sec" in row
+    # the MFU campaign's row contract: every train row carries mfu
+    # (number or null, NEVER a false 0.0) and its steps_per_call
+    # dispatch mode (quick mode = the classic per-step loop)
+    assert "mfu" in row and row["mfu"] != 0.0
+    assert row["tflops_per_sec"] != 0.0
+    assert row["steps_per_call"] == 1
 
 
 def test_bench_fused_row_records_pallas_mode():
@@ -94,6 +100,32 @@ def test_check_pallas_mode_failure_path(monkeypatch):
     assert bench._check_pallas_mode(True) == "interpret"
     # non-attention workloads are unaffected
     assert bench._check_pallas_mode(False) is None
+
+
+def test_mfu_fields_null_never_zero():
+    """The null-never-zero contract (ISSUE 13): rows whose
+    cost_analysis yields no flops (or whose chip peak is unknown)
+    record mfu/tflops_per_sec as JSON null, never 0.0 — and a MEASURED
+    tiny MFU (deepfm's 0.1%) never rounds down to a false 0.0."""
+    sys.path.insert(0, os.path.dirname(BENCH))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    # no flop count -> both null (the 0.0 form older sidecars show)
+    assert bench._mfu_fields(0.0, 10, 1.0, 1e15) \
+        == {"tflops_per_sec": None, "mfu": None}
+    assert bench._mfu_fields(None, 10, 1.0, 1e15)["mfu"] is None
+    # unknown peak -> mfu null, achieved tflops still measured
+    f = bench._mfu_fields(1e9, 10, 1.0, None)
+    assert f["mfu"] is None and f["tflops_per_sec"] == 0.01
+    # a tiny measured value keeps digits instead of collapsing to 0.0
+    f = bench._mfu_fields(1e9, 1, 1.0, 1e15)  # true mfu = 1e-6
+    assert f["mfu"] is not None and 0.0 < f["mfu"] < 1e-4
+    assert f["tflops_per_sec"] is not None and f["tflops_per_sec"] > 0.0
+    # degenerate timing -> unmeasured, not a divide-by-zero or a 0.0
+    assert bench._mfu_fields(1e9, 1, 0.0, 1e15)["mfu"] is None
 
 
 def test_bench_orchestrator_kills_hung_workload():

@@ -280,7 +280,13 @@ def test_declared_fault_sites_parse():
 def test_kernel_op_schema_matches_registry():
     # families.py pre-materializes the per-op kernel series from a plain
     # tuple (importing kernels would cycle); it must track the registry
+    # PLUS the window tuner's op — the training-loop window length K
+    # (core/window_tune.py WINDOW_OP) rides the same tuner/winner cache
+    # and counter schema without being a Pallas kernel registry entry
+    from paddle_tpu.core.window_tune import WINDOW_OP
     from paddle_tpu.kernels import all_kernels
     from paddle_tpu.observe.families import _KERNEL_OPS
 
-    assert tuple(all_kernels()) == _KERNEL_OPS
+    assert tuple(sorted(tuple(all_kernels()) + (WINDOW_OP,))) \
+        == _KERNEL_OPS
+    assert WINDOW_OP not in all_kernels()
