@@ -1279,6 +1279,121 @@ def bench_elastic(amp, quick, uses_flash=False):
     return rec
 
 
+def bench_quantized(amp, quick, uses_flash=False):
+    """Int8 PTQ rows (docs/OPTIMIZER.md "Post-training int8
+    quantization"): for each of three model-zoo INFERENCE programs
+    (forward-only, startup-initialized weights), measure steady-state
+    steps/sec with the quantize pass opted in
+    (PADDLE_TPU_OPTIMIZE_QUANT=1) and the accuracy delta vs the same
+    program's unquantized run on identical feeds. Rows carry
+    quantized:"int8" + accuracy_delta NEXT TO optimize_level —
+    pin_baselines treats quantized rows as incomparable with the
+    plain-config baselines (a different program compiled)."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import lint_program as _lint_cli
+
+    import jax as _jax
+    import paddle_tpu as fluid
+    from paddle_tpu import observe as _observe
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    steps, warmup = (2, 1) if quick else (10, 3)
+    batch = 2 if quick else 8
+    models = ("mnist", "gpt", "resnet")
+    rng = np.random.RandomState(0)
+
+    def _feed_for(main):
+        feed = {}
+        for var in main.global_block().vars.values():
+            if not var.is_data:
+                continue
+            shape = [batch if (s is None or s < 0) else int(s)
+                     for s in (var.shape or [batch])]
+            if var.dtype.startswith(("int", "uint")):
+                # ids/labels: {0,1} is in-vocab for every zoo model
+                # (bert's type_vocab=2 is the smallest table)
+                feed[var.name] = rng.randint(0, 2, shape).astype("int64")
+            else:
+                feed[var.name] = rng.uniform(
+                    -1, 1, shape).astype("float32")
+        return feed
+
+    def _quant_weight_count():
+        fam = _observe.snapshot()["metrics"].get(
+            "paddle_quant_weights_quantized_total", {})
+        return sum(s["value"] for s in fam.get("samples", []))
+
+    recs = []
+    for model in models:
+        with _beacon("quantized", model):
+            main, startup, loss = _lint_cli.build_example(
+                model, optimizer=False)
+            scope = Scope()
+            feed = _feed_for(main)
+            with scope_guard(scope):
+                exe = fluid.Executor(fluid.TPUPlace())
+                exe.run(startup, scope=scope)
+                _log("quantized/%s: unquantized reference run" % model)
+                base, = exe.run(main, feed=feed, fetch_list=[loss],
+                                scope=scope)
+                base = np.asarray(base)
+                before = _quant_weight_count()
+                old = os.environ.get("PADDLE_TPU_OPTIMIZE_QUANT")
+                os.environ["PADDLE_TPU_OPTIMIZE_QUANT"] = "1"
+                try:
+                    qexe = fluid.Executor(fluid.TPUPlace())
+                    _log("quantized/%s: compiling + %d warmup steps"
+                         % (model, warmup))
+                    for _ in range(warmup):
+                        qv, = qexe.run(main, feed=feed,
+                                       fetch_list=[loss], scope=scope)
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        qv, = qexe.run(main, feed=feed,
+                                       fetch_list=[loss], scope=scope)
+                    float(np.asarray(qv).reshape(-1)[0])  # block
+                    dt = time.perf_counter() - t0
+                finally:
+                    if old is None:
+                        os.environ.pop("PADDLE_TPU_OPTIMIZE_QUANT", None)
+                    else:
+                        os.environ["PADDLE_TPU_OPTIMIZE_QUANT"] = old
+            qv = np.asarray(qv)
+            delta = float(np.max(np.abs(qv.astype(np.float64)
+                                        - base.astype(np.float64)))) \
+                if qv.shape == base.shape else None
+            n_weights = int(_quant_weight_count() - before)
+            rec = {
+                "metric": "quantized_%s" % model,
+                "platform": _jax.devices()[0].platform.lower(),
+                # the mode marker pin_baselines keys the skip on: a
+                # quantized row compiled a DIFFERENT program than the
+                # plain-config baseline
+                "quantized": "int8",
+                # metric delta vs the unquantized run on the same feeds
+                # (max |diff| of the fetched metric; the stated pass
+                # tolerance is the contract it must stay within)
+                "accuracy_delta": delta,
+                # always explicit next to the quantized marker, even at
+                # the default level (the pass is level 2)
+                "optimize_level": _optimize_level(),
+                "weights_quantized": n_weights,
+                "value": round(steps / dt, 1),
+                "unit": "steps/sec",
+                "steps_per_call": 1,
+                "vs_baseline": 1.0,
+                "tflops_per_sec": None,
+                "mfu": None,
+                **({"quick": True} if quick else {}),
+            }
+            print(json.dumps(rec), flush=True)
+            recs.append(rec)
+    return recs
+
+
 WORKLOADS = {
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
@@ -1310,6 +1425,13 @@ ELASTIC_ORDER = ["elastic"]
 ELASTIC_WORKLOADS = {"elastic": bench_elastic}
 WORKLOADS.update(ELASTIC_WORKLOADS)
 
+# PADDLE_TPU_BENCH_QUANT=1 swaps the workload list for the int8 PTQ
+# rows (docs/OPTIMIZER.md). Rows are marked quantized:"int8" and never
+# pin as training baselines.
+QUANT_ORDER = ["quantized"]
+QUANT_WORKLOADS = {"quantized": bench_quantized}
+WORKLOADS.update(QUANT_WORKLOADS)
+
 
 def _serving_mode():
     return os.environ.get("PADDLE_TPU_BENCH_SERVING", "0") != "0"
@@ -1317,6 +1439,10 @@ def _serving_mode():
 
 def _elastic_mode():
     return os.environ.get("PADDLE_TPU_BENCH_ELASTIC", "0") != "0"
+
+
+def _quant_mode():
+    return os.environ.get("PADDLE_TPU_BENCH_QUANT", "0") != "0"
 
 # Safe (no custom-kernel) workloads first: if the tunnel wedges or a
 # Pallas compile hangs partway through, the rows already printed stand.
@@ -1335,8 +1461,9 @@ ATTENTION_SEQ = {"transformer": 128, "transformer_long": 1024,
 ATTENTION_WORKLOADS = frozenset(ATTENTION_SEQ)
 
 assert set(ORDER) | set(SERVING_ORDER) | set(ELASTIC_ORDER) \
-    == set(WORKLOADS), \
-    "ORDER/SERVING_ORDER/ELASTIC_ORDER out of sync with WORKLOADS"
+    | set(QUANT_ORDER) == set(WORKLOADS), \
+    "ORDER/SERVING_ORDER/ELASTIC_ORDER/QUANT_ORDER out of sync " \
+    "with WORKLOADS"
 
 
 def _probe_backend(timeout_s=None, attempts=None, probe_fn=None):
@@ -1593,9 +1720,11 @@ def main():
         _dump_telemetry("probe")
         return 0
 
-    # PADDLE_TPU_BENCH_SERVING=1 / PADDLE_TPU_BENCH_ELASTIC=1 swap the
-    # default workload list; --only still picks any single workload
-    default_order = (ELASTIC_ORDER if _elastic_mode()
+    # PADDLE_TPU_BENCH_SERVING=1 / PADDLE_TPU_BENCH_ELASTIC=1 /
+    # PADDLE_TPU_BENCH_QUANT=1 swap the default workload list; --only
+    # still picks any single workload
+    default_order = (QUANT_ORDER if _quant_mode()
+                     else ELASTIC_ORDER if _elastic_mode()
                      else SERVING_ORDER if _serving_mode() else ORDER)
     if args.worker:
         return _run_worker(args.worker, not args.fp32, args.quick)

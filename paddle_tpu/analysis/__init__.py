@@ -17,16 +17,21 @@ for the core op vocabulary to the registry's ``infer_shape`` hook, and
 See docs/ANALYSIS.md for the rule catalog and how to write a rule.
 """
 
+from . import range_rules  # noqa: F401  (attaches the transfer set)
 from . import shape_rules  # noqa: F401  (attaches the core rule set)
 from .dataflow import Dataflow  # noqa: F401
 from .infer import (Finding, InferContext, InferError,  # noqa: F401
                     ProgramVerifyError, infer_program_shapes,
                     validation_enabled, verify_program)
 from .lint import LINT_RULES, lint_program  # noqa: F401
+from .ranges import (AbstractValue, Calibration,  # noqa: F401
+                     RangeAnalysis, RangeContext, register_range_rule)
 from .tv import (ProgramSnapshot, RewriteViolation,  # noqa: F401
                  describe_rewrites, tv_enabled, validate_rewrite)
 
 __all__ = [
+    "AbstractValue",
+    "Calibration",
     "Dataflow",
     "Finding",
     "InferContext",
@@ -34,10 +39,13 @@ __all__ = [
     "LINT_RULES",
     "ProgramSnapshot",
     "ProgramVerifyError",
+    "RangeAnalysis",
+    "RangeContext",
     "RewriteViolation",
     "describe_rewrites",
     "infer_program_shapes",
     "lint_program",
+    "register_range_rule",
     "tv_enabled",
     "validate_rewrite",
     "validation_enabled",

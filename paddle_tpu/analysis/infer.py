@@ -59,6 +59,13 @@ RULES = (
     "dead-store",         # write never read before block end, not live-out
     "write-after-write",  # non-persistable overwritten with no read between
     "use-before-init",    # only conditional sub-block defs reach the read
+    # range-engine-powered numerics rules (analysis/ranges.py). The
+    # contract is PROVABLE-ONLY: a finding needs finite interval
+    # evidence — T inputs stay silent, so range-blind programs never
+    # get noise
+    "bf16-overflow",      # bf16-policied op provably exceeds bf16 max
+    "domain-violation",   # exp/log/sqrt/div input provably out of domain
+    "int-narrowing-loss",  # int narrowing provably loses values
 )
 
 
@@ -334,7 +341,8 @@ def validation_enabled() -> bool:
 
 def verify_program(program: Program, fetch_list=None, scope=None,
                    raise_on_error: bool = True, fill: bool = True,
-                   site: str = "validate") -> List[Finding]:
+                   site: str = "validate",
+                   calibration=None) -> List[Finding]:
     """Shape/dtype inference + the IR lint suite over one Program.
 
     Returns all findings (severity error/warning/info); with
@@ -342,7 +350,8 @@ def verify_program(program: Program, fetch_list=None, scope=None,
     ``fetch_list`` (names or Variables) enables the fetch-of-undefined
     and dead-op rules; ``scope`` lets reads of runtime state (persistable
     vars living only in the Scope) resolve instead of reporting
-    undefined-input."""
+    undefined-input; ``calibration`` (a ``ranges.Calibration``) refines
+    the numerics rules with observed per-var min/max."""
     import time
 
     from ..observe.families import (ANALYSIS_FINDINGS, ANALYSIS_PROGRAMS,
@@ -355,7 +364,7 @@ def verify_program(program: Program, fetch_list=None, scope=None,
     findings: List[Finding] = []
     infer_program_shapes(program, findings, fill=fill)
     lint_program(program, fetch_names=fetch_names, scope=scope,
-                 findings=findings)
+                 findings=findings, calibration=calibration)
     ANALYSIS_PROGRAMS.labels(site=site).inc()
     for f in findings:
         ANALYSIS_FINDINGS.labels(rule=f.rule).inc()

@@ -244,6 +244,250 @@ def rule_use_before_init(program, ctx, findings):
             block, df.ops[pos], var=name))
 
 
+# ------------------------------------------------- numerics (range engine)
+def _ranges_of(program, ctx):
+    """ONE shared RangeAnalysis per lint run (the dataflow-sharing
+    idiom); built lazily — each numerics rule early-returns before
+    calling this when the program has no op it could possibly flag, so
+    range-free programs pay nothing. ``infer=False``: every lint entry
+    (verify_program, the PassManager re-verify) runs shape inference
+    first, so the engine must not walk it again; a bare lint_program
+    call without prior inference only loses shape-dependent precision
+    (wider intervals), never soundness."""
+    ra = ctx.get("ranges")
+    if ra is None:
+        from .ranges import RangeAnalysis
+
+        ra = RangeAnalysis(program, fetch_names=ctx.get("fetch_names")
+                           or (), scope=ctx.get("scope"),
+                           calibration=ctx.get("calibration"),
+                           infer=False)
+        ctx["ranges"] = ra
+    return ra
+
+
+def _read_av(ctx, ra, name: str, pos: int):
+    """Version-accurate abstract value of a read at ``pos`` (the shared
+    Dataflow supplies the write version, so a read before an in-place
+    update is never judged by the post-update value)."""
+    df = ctx.get("dataflow")
+    if df is None:
+        return ra.value_of(name)
+    return ra.at_version(name, df.version_at(name, pos))
+
+
+def rule_bf16_overflow(program, ctx, findings):
+    """Under AMP, an op whose bf16-policy inputs or outputs provably
+    exceed the bf16 finite range (~3.39e38) rounds to inf at the cast
+    (warning — the range-aware amp_bf16_pass keeps such ops in f32 when
+    enabled). Provable-only: needs a finite bound above the limit, so
+    T-ranged programs never warn."""
+    if not getattr(program, "amp", False):
+        return
+    from ..core.amp import policy_for
+    from .ranges import BF16_MAX
+
+    block = program.global_block()
+    if not any((op.attrs.get("__amp__") or policy_for(op.type))
+               == "bf16" for op in block.ops):
+        return  # nothing to flag: don't build the range analysis
+    ra = _ranges_of(program, ctx)
+    for pos, op in enumerate(block.ops):
+        tag = op.attrs.get("__amp__") or policy_for(op.type)
+        if tag != "bf16":
+            continue
+        for name in op.input_names() + op.output_names():
+            if not name:
+                continue
+            av = ra.output_av(op, name) if name in op.output_names() \
+                else _read_av(ctx, ra, name, pos)
+            if av.bounded and av.magnitude > BF16_MAX:
+                findings.append(finding_for_op(
+                    "bf16-overflow", "warning",
+                    "%r is provably up to %.4g in magnitude — beyond "
+                    "the bf16 finite range, so the AMP bf16 cast "
+                    "rounds it to inf (keep this op in f32: set its "
+                    "__amp__ attr, or enable the range-aware amp "
+                    "upgrade)" % (name, av.magnitude), block, op,
+                    var=name))
+                break  # one finding per op: the fix is one stamp
+
+
+# (op type, input slot) -> domain spec checked by rule_domain_violation
+_DOMAIN_OPS = {
+    "exp": ("X", "exp"),
+    "log": ("X", "log"),
+    "sqrt": ("X", "sqrt"),
+    "rsqrt": ("X", "rsqrt"),
+    "reciprocal": ("X", "div"),
+    "elementwise_div": ("Y", "div"),
+    "elementwise_mod": ("Y", "div"),
+    "elementwise_floordiv": ("Y", "div"),
+}
+
+
+def rule_domain_violation(program, ctx, findings):
+    """exp/log/sqrt/div inputs provably outside the op's domain.
+    Error when EVERY value in the interval violates (the op returns
+    inf/nan for all inputs — log of a non-positive interval, division
+    by const zero, exp past the f32 overflow knee); warning when a
+    finite bound proves some values violate (nan possible). T inputs
+    never fire — no proof, no noise."""
+    from .ranges import EXP_OVERFLOW
+
+    block = program.global_block()
+    if not any(op.type in _DOMAIN_OPS for op in block.ops):
+        return  # nothing to flag: don't build the range analysis
+    ra = _ranges_of(program, ctx)
+    for pos, op in enumerate(block.ops):
+        spec = _DOMAIN_OPS.get(op.type)
+        if spec is None:
+            continue
+        slot, kind = spec
+        names = op.inputs.get(slot) or []
+        if not names or not names[0]:
+            continue
+        name = names[0]
+        av = _read_av(ctx, ra, name, pos)
+        msg, severity = None, None
+        if kind == "exp":
+            if av.lo > EXP_OVERFLOW:
+                msg, severity = ("every input is > %.4g: exp() is inf "
+                                 "for the whole interval [%g, %g]"
+                                 % (EXP_OVERFLOW, av.lo, av.hi), "error")
+            elif av.bounded and av.hi > EXP_OVERFLOW:
+                msg, severity = ("inputs provably reach %.4g (> the "
+                                 "f32 exp overflow knee %.4g): inf "
+                                 "possible" % (av.hi, EXP_OVERFLOW),
+                                 "warning")
+        elif kind == "log":
+            if av.hi < 0 or (av.hi == 0 and av.lo == av.hi):
+                msg, severity = ("every input is <= 0: log() is "
+                                 "nan/-inf for the whole interval "
+                                 "[%g, %g]" % (av.lo, av.hi), "error")
+            elif av.bounded and av.lo < 0:
+                msg, severity = ("inputs provably reach %g < 0: "
+                                 "log() nan possible" % av.lo, "warning")
+        elif kind == "sqrt":
+            if av.hi < 0:
+                msg, severity = ("every input is < 0: sqrt() is nan "
+                                 "for the whole interval [%g, %g]"
+                                 % (av.lo, av.hi), "error")
+            elif av.bounded and av.lo < 0:
+                msg, severity = ("inputs provably reach %g < 0: "
+                                 "sqrt() nan possible" % av.lo,
+                                 "warning")
+        elif kind == "rsqrt":
+            if av.hi < 0:
+                msg, severity = ("every input is < 0: rsqrt() is nan "
+                                 "for the whole interval [%g, %g]"
+                                 % (av.lo, av.hi), "error")
+            elif av.bounded and av.lo < 0:
+                msg, severity = ("inputs provably reach %g < 0: "
+                                 "rsqrt() nan possible" % av.lo,
+                                 "warning")
+        elif kind == "div":
+            if av.lo == 0 and av.hi == 0:
+                msg, severity = ("the divisor is provably zero "
+                                 "everywhere", "error")
+            elif av.is_const:
+                import numpy as _np
+
+                if bool((_np.asarray(av.const) == 0).any()):
+                    msg, severity = ("the divisor literal contains an "
+                                     "exact zero", "error")
+        if msg is not None:
+            findings.append(finding_for_op(
+                "domain-violation", severity,
+                "%s reading %r: %s" % (op.type, name, msg), block, op,
+                var=name))
+
+
+def rule_int_narrowing_loss(program, ctx, findings):
+    """Int narrowing with PROVABLE value loss. At the feed boundary:
+    an int64/uint64 data var whose (calibration-observed) range exceeds
+    int32 — values the device narrowing provably clips (error; the
+    info-level int64-feed advisory stays for the no-evidence case). At
+    cast ops targeting a narrower int: an input interval whose
+    TRUNCATED image lies entirely outside the target range (error), a
+    const literal with post-truncation out-of-range elements (error),
+    or a truncated finite bound past the edge (info). Truncation
+    toward zero models the conversion, so 127.5 -> int8 (really 127,
+    nothing lost) never false-positives."""
+    import math as _math
+
+    import numpy as _np
+
+    from .ranges import INT_RANGES
+
+    block = program.global_block()
+    if not (any(v.is_data and v.dtype in ("int64", "uint64")
+                for v in block.vars.values())
+            or any(op.type == "cast"
+                   and str(op.attrs.get("out_dtype")) in INT_RANGES
+                   for op in block.ops)):
+        return  # nothing to flag: don't build the range analysis
+    ra = _ranges_of(program, ctx)
+    i32lo, i32hi = INT_RANGES["int32"]
+    for var in program.global_block().vars.values():
+        if not (var.is_data and var.dtype in ("int64", "uint64")):
+            continue
+        av = ra.value_of(var.name)
+        if av.bounded and (av.hi > i32hi or av.lo < i32lo):
+            findings.append(Finding(
+                "int-narrowing-loss", "error",
+                "feed var %r is %s with observed/derived range "
+                "[%g, %g]: the device's int32 narrowing provably "
+                "loses values (use the distributed sparse-table path "
+                "for ids beyond int32)" % (var.name, var.dtype,
+                                           av.lo, av.hi),
+                var=var.name))
+    block = program.global_block()
+    for pos, op in enumerate(block.ops):
+        if op.type != "cast":
+            continue
+        dt = str(op.attrs.get("out_dtype"))
+        rng = INT_RANGES.get(dt)
+        if rng is None:
+            continue
+        names = op.inputs.get("X") or []
+        if not names or not names[0]:
+            continue
+        name = names[0]
+        av = _read_av(ctx, ra, name, pos)
+        tlo, thi = rng
+        lo = av.lo if not _math.isfinite(av.lo) else float(
+            _math.trunc(av.lo))
+        hi = av.hi if not _math.isfinite(av.hi) else float(
+            _math.trunc(av.hi))
+        if av.bounded and (lo > thi or hi < tlo):
+            findings.append(finding_for_op(
+                "int-narrowing-loss", "error",
+                "cast to %s of %r whose interval [%g, %g] lies "
+                "entirely outside [%g, %g]: every value is lost"
+                % (dt, name, av.lo, av.hi, tlo, thi), block, op,
+                var=name))
+        elif av.is_const and bool(
+                ((_np.trunc(_np.asarray(av.const,
+                                        dtype=_np.float64)) > thi)
+                 | (_np.trunc(_np.asarray(av.const,
+                                          dtype=_np.float64)) < tlo))
+                .any()):
+            findings.append(finding_for_op(
+                "int-narrowing-loss", "error",
+                "cast to %s of literal %r with elements outside "
+                "[%g, %g]: those values are lost" % (dt, name,
+                                                     tlo, thi),
+                block, op, var=name))
+        elif av.bounded and (hi > thi or lo < tlo):
+            findings.append(finding_for_op(
+                "int-narrowing-loss", "info",
+                "cast to %s of %r whose interval [%g, %g] extends "
+                "past [%g, %g]: values near the bound would be lost"
+                % (dt, name, av.lo, av.hi, tlo, thi), block, op,
+                var=name))
+
+
 def rule_double_write(program, ctx, findings):
     """Two writes to a persistable var with no read between them: the
     first write is lost state (warning)."""
@@ -357,21 +601,31 @@ LINT_RULES = {
     "int64-boundaries": rule_int64_boundaries,
     "grad-pairing": rule_grad_pairing,
     "sub-block": rule_sub_blocks,
+    "bf16-overflow": rule_bf16_overflow,
+    "domain-violation": rule_domain_violation,
+    "int-narrowing-loss": rule_int_narrowing_loss,
 }
 
 # rules that consult the dataflow engine: lint_program builds ONE
 # analysis and shares it through the ctx so a four-rule run costs one
-# O(ops) construction, not four
+# O(ops) construction, not four. The range-engine rules ride the same
+# sharing (one RangeAnalysis per run, built lazily in _ranges_of) and
+# want the dataflow too (version-accurate reads).
 _DATAFLOW_RULES = ("dead-op", "dead-store", "write-after-write",
-                   "use-before-init")
+                   "use-before-init", "bf16-overflow",
+                   "domain-violation", "int-narrowing-loss")
 
 
 def lint_program(program: Program, fetch_names: Sequence[str] = (),
                  scope=None, findings: Optional[List[Finding]] = None,
-                 rules: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Run the lint pass suite; returns (and appends to) ``findings``."""
+                 rules: Optional[Sequence[str]] = None,
+                 calibration=None) -> List[Finding]:
+    """Run the lint pass suite; returns (and appends to) ``findings``.
+    ``calibration`` (a ``ranges.Calibration``) refines the numerics
+    rules' intervals with observed per-var min/max."""
     findings = findings if findings is not None else []
-    ctx = {"fetch_names": list(fetch_names), "scope": scope}
+    ctx = {"fetch_names": list(fetch_names), "scope": scope,
+           "calibration": calibration}
     active = [name for name in LINT_RULES
               if rules is None or name in rules]
     if any(name in _DATAFLOW_RULES for name in active):

@@ -1,0 +1,790 @@
+"""Whole-program value-range analysis by abstract interpretation.
+
+The third analysis engine, completing the stack PR 5 (shape/dtype
+inference, ``infer.py``) and PR 11 (write-versioned dataflow,
+``dataflow.py``) started: per variable-version, an **abstract value** —
+
+* an interval ``[lo, hi]`` (``-inf``/``inf`` ends allowed),
+* **finiteness** (every element provably a finite float — no inf/nan),
+* **integrality** (provably integer-valued, whatever the storage dtype),
+* an **exact constant** when the value is a compile-time literal
+  (``fill_constant`` scalars, the ``assign_value`` arrays constant
+  folding materializes — the fold's literals feed straight back in).
+
+Transfer functions are registered per op type in ``range_rules.py``
+(``register_range_rule``, the ``shape_rules.py`` idiom); an op with no
+rule widens its outputs to ⊤ **explicitly** — either declared in
+``range_rules.WIDEN_TO_TOP`` (tools/repo_lint.py rule 7 holds the
+partition total over every shape-ruled op type) or counted as an
+unknown-op widening. Sub-blocks run a bounded fixpoint through the
+parent chain: a conditional body's writes join the fall-through state,
+a loop body iterates until stable or widens its writes to ⊤.
+
+Versioning rides ``analysis/dataflow.py``: the engine walks the global
+block with the same ``op_effects`` write attribution, so ``(name,
+version)`` here means exactly what ``Dataflow.version_at`` means — a
+read around an in-place ``sgd ParamOut=param`` update sees two
+different abstract values for one name.
+
+**Calibration** (optional): a :class:`Calibration` records observed
+per-var min/max — fed automatically from N feed batches via the
+executor's feed-observer hook (``Executor``/``add_feed_observer``,
+``cal.attach()``), or explicitly via ``cal.observe(name, array)`` for
+fetched intermediates — and the analysis refines the matching
+variables' intervals with the observed bounds. Calibration facts are
+data-derived, not proofs: findings built on them hold for the observed
+batches (the PTQ contract), not for all inputs.
+
+Consumers: the numerics lint rules (``lint.py``: bf16-overflow,
+exp/log/div domain violations, int narrowing with provable loss), the
+int8 PTQ pass (``core/passes/quantize_pass.py`` — eligibility and
+range-derived scales), the range-aware AMP upgrade (``amp_bf16_pass``
+keeps provably-overflow-prone ops in f32), and
+``tools/lint_program.py --ranges``.
+
+``paddle_analysis_ranges_*`` observe families count programs analyzed,
+per-var interval kinds, explicit widenings and calibration batches.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.program import Program, op_effects
+
+__all__ = [
+    "AbstractValue",
+    "BF16_MAX",
+    "Calibration",
+    "EXP_OVERFLOW",
+    "INT_RANGES",
+    "RANGE_RULES",
+    "RangeAnalysis",
+    "RangeContext",
+    "av_const",
+    "av_interval",
+    "av_join",
+    "av_top",
+    "register_range_rule",
+]
+
+_INF = math.inf
+
+# largest finite bfloat16 (values beyond round to inf under the AMP
+# bf16 cast) and the float32 exp() overflow threshold: exp(x) is inf
+# for x > ~88.72 in f32
+BF16_MAX = 3.3895313892515355e38
+F32_MAX = 3.4028234663852886e38
+EXP_OVERFLOW = 88.72
+
+INT_RANGES = {
+    "int8": (-128.0, 127.0),
+    "uint8": (0.0, 255.0),
+    "int16": (-32768.0, 32767.0),
+    "uint16": (0.0, 65535.0),
+    "int32": (-2147483648.0, 2147483647.0),
+    "uint32": (0.0, 4294967295.0),
+    "int64": (-9.223372036854776e18, 9.223372036854776e18),
+    "uint64": (0.0, 1.8446744073709552e19),
+}
+
+
+class AbstractValue:
+    """One variable-version's abstract value.
+
+    ``lo``/``hi`` bound every element (``-inf``/``inf`` ends = unknown
+    in that direction); ``finite`` means every element is provably a
+    finite float (bounded intervals within the f32 range imply it, but
+    it can hold without bounds — a gaussian sample is always finite);
+    ``integral`` means provably integer-valued; ``const`` carries the
+    exact ndarray for compile-time literals (small ones — the engine
+    caps what it keeps). Immutable by convention: transfer functions
+    build new values."""
+
+    __slots__ = ("lo", "hi", "finite", "integral", "const")
+
+    def __init__(self, lo: float = -_INF, hi: float = _INF,
+                 finite: bool = False, integral: bool = False,
+                 const=None):
+        if math.isnan(lo) or math.isnan(hi):
+            lo, hi = -_INF, _INF
+            finite = False
+        if lo > hi:  # empty interval: normalize instead of propagating
+            lo, hi = hi, lo
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.finite = bool(finite)
+        self.integral = bool(integral)
+        self.const = const
+
+    # ------------------------------------------------------- predicates
+    @property
+    def bounded(self) -> bool:
+        """Both interval ends finite — a "finite interval"."""
+        return math.isfinite(self.lo) and math.isfinite(self.hi)
+
+    @property
+    def is_top(self) -> bool:
+        return (not self.bounded and not self.finite
+                and not self.integral and self.const is None)
+
+    @property
+    def is_const(self) -> bool:
+        return self.const is not None
+
+    @property
+    def magnitude(self) -> float:
+        """max |value| the interval allows (inf when unbounded)."""
+        return max(abs(self.lo), abs(self.hi))
+
+    def contains(self, x: float) -> bool:
+        return self.lo <= x <= self.hi
+
+    # ------------------------------------------------------ combinators
+    def join(self, other: "AbstractValue") -> "AbstractValue":
+        """Least upper bound (control-flow merge)."""
+        return AbstractValue(
+            min(self.lo, other.lo), max(self.hi, other.hi),
+            finite=self.finite and other.finite,
+            integral=self.integral and other.integral)
+
+    def refine(self, lo: float, hi: float,
+               finite: bool = True) -> "AbstractValue":
+        """Intersect with an externally-known bound (calibration)."""
+        nlo, nhi = max(self.lo, lo), min(self.hi, hi)
+        if nlo > nhi:  # disjoint evidence: trust the refinement
+            nlo, nhi = lo, hi
+        return AbstractValue(nlo, nhi,
+                             finite=self.finite or (
+                                 finite and math.isfinite(nlo)
+                                 and math.isfinite(nhi)),
+                             integral=self.integral, const=self.const)
+
+    def drop_const(self) -> "AbstractValue":
+        if self.const is None:
+            return self
+        return AbstractValue(self.lo, self.hi, finite=self.finite,
+                             integral=self.integral)
+
+    def __eq__(self, other):
+        if not isinstance(other, AbstractValue):
+            return NotImplemented
+        ca = None if self.const is None else np.asarray(self.const)
+        cb = None if other.const is None else np.asarray(other.const)
+        cst = (ca is None) == (cb is None) and (
+            ca is None or (ca.shape == cb.shape and bool((ca == cb).all())))
+        return (self.lo == other.lo and self.hi == other.hi
+                and self.finite == other.finite
+                and self.integral == other.integral and cst)
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    def __repr__(self):
+        if self.is_const:
+            c = np.asarray(self.const)
+            body = "const=%s" % (
+                c.item() if c.size == 1 else "array%s" % (c.shape,))
+        else:
+            body = "[%s, %s]" % (_fmt(self.lo), _fmt(self.hi))
+        flags = "".join(f for f, on in (("F", self.finite),
+                                        ("Z", self.integral)) if on)
+        return "AV(%s%s)" % (body, " " + flags if flags else "")
+
+
+def _fmt(x: float) -> str:
+    if x == _INF:
+        return "inf"
+    if x == -_INF:
+        return "-inf"
+    return "%.6g" % x
+
+
+def av_top() -> AbstractValue:
+    return AbstractValue()
+
+
+def av_interval(lo: float, hi: float, finite: Optional[bool] = None,
+                integral: bool = False) -> AbstractValue:
+    """Interval value; ``finite`` defaults to bounded-within-f32 (a
+    bounded interval beyond the f32 range can still round to inf)."""
+    if finite is None:
+        finite = (math.isfinite(lo) and math.isfinite(hi)
+                  and max(abs(lo), abs(hi)) <= F32_MAX)
+    return AbstractValue(lo, hi, finite=finite, integral=integral)
+
+
+_CONST_CAP = 65536  # elements kept exactly; larger literals keep bounds only
+
+
+def av_const(value) -> AbstractValue:
+    """Exact-constant value (interval collapses to the array's min/max)."""
+    arr = np.asarray(value)
+    if arr.size == 0:
+        return av_top()
+    finite = bool(np.isfinite(arr).all())
+    if not finite:
+        lo, hi = -_INF, _INF
+    else:
+        lo, hi = float(arr.min()), float(arr.max())
+    integral = bool(np.issubdtype(arr.dtype, np.integer)) or (
+        finite and bool(np.equal(np.mod(arr, 1), 0).all()))
+    return AbstractValue(lo, hi, finite=finite, integral=integral,
+                         const=arr if arr.size <= _CONST_CAP else None)
+
+
+def av_join(*avs: AbstractValue) -> AbstractValue:
+    out = avs[0]
+    for a in avs[1:]:
+        out = out.join(a)
+    return out
+
+
+# --------------------------------------------------- interval arithmetic
+def _finite_result(a: AbstractValue, b: Optional[AbstractValue],
+                   lo: float, hi: float) -> bool:
+    """Result provably finite: operands finite AND the computed bounds
+    stay inside the f32 range (two finite f32s can still overflow)."""
+    ok = a.finite and (b is None or b.finite)
+    return ok and math.isfinite(lo) and math.isfinite(hi) \
+        and max(abs(lo), abs(hi)) <= F32_MAX
+
+
+def _ends(vals: Sequence[float]) -> Tuple[float, float]:
+    clean = [-_INF if math.isnan(v) else v for v in vals]
+    has_nan = any(math.isnan(v) for v in vals)
+    if has_nan:
+        return -_INF, _INF
+    return min(clean), max(clean)
+
+
+def av_add(a: AbstractValue, b: AbstractValue) -> AbstractValue:
+    lo, hi = _ends([a.lo + b.lo, a.hi + b.hi])
+    return AbstractValue(lo, hi, finite=_finite_result(a, b, lo, hi),
+                         integral=a.integral and b.integral)
+
+
+def av_sub(a: AbstractValue, b: AbstractValue) -> AbstractValue:
+    return av_add(a, av_neg(b))
+
+
+def av_neg(a: AbstractValue) -> AbstractValue:
+    return AbstractValue(-a.hi, -a.lo, finite=a.finite,
+                         integral=a.integral)
+
+
+def av_mul(a: AbstractValue, b: AbstractValue) -> AbstractValue:
+    lo, hi = _ends([a.lo * b.lo, a.lo * b.hi, a.hi * b.lo, a.hi * b.hi])
+    return AbstractValue(lo, hi, finite=_finite_result(a, b, lo, hi),
+                         integral=a.integral and b.integral)
+
+
+def av_div(a: AbstractValue, b: AbstractValue) -> AbstractValue:
+    if b.contains(0.0):
+        return av_top()  # possible division by zero: no bounds, inf/nan
+    lo, hi = _ends([a.lo / b.lo, a.lo / b.hi, a.hi / b.lo, a.hi / b.hi])
+    return AbstractValue(lo, hi, finite=_finite_result(a, b, lo, hi))
+
+
+def av_abs(a: AbstractValue) -> AbstractValue:
+    if a.lo >= 0:
+        lo, hi = a.lo, a.hi
+    elif a.hi <= 0:
+        lo, hi = -a.hi, -a.lo
+    else:
+        lo, hi = 0.0, max(-a.lo, a.hi)
+    return AbstractValue(lo, hi, finite=a.finite, integral=a.integral)
+
+
+def av_min_const(a: AbstractValue, c: float) -> AbstractValue:
+    return AbstractValue(min(a.lo, c), min(a.hi, c), finite=a.finite,
+                         integral=a.integral and float(c).is_integer())
+
+
+def av_max_const(a: AbstractValue, c: float) -> AbstractValue:
+    return AbstractValue(max(a.lo, c), max(a.hi, c), finite=a.finite,
+                         integral=a.integral and float(c).is_integer())
+
+
+def av_scale(a: AbstractValue, scale: float,
+             bias: float = 0.0) -> AbstractValue:
+    return av_add(av_mul(a, av_const(scale).drop_const()),
+                  av_const(bias).drop_const())
+
+
+def av_monotone(a: AbstractValue, fn: Callable[[float], float],
+                out_lo: float = -_INF,
+                out_hi: float = _INF) -> AbstractValue:
+    """Image of a monotone-nondecreasing scalar ``fn`` over the
+    interval, clipped to the function's stated output range (which also
+    bounds the ⊤ input case)."""
+    def _safe(x):
+        try:
+            v = fn(x)
+        except (OverflowError, ValueError):
+            return _INF
+        return v
+    lo = _safe(a.lo) if math.isfinite(a.lo) else out_lo
+    hi = _safe(a.hi) if math.isfinite(a.hi) else out_hi
+    lo, hi = max(lo, out_lo), min(hi, out_hi)
+    finite = (math.isfinite(lo) and math.isfinite(hi)
+              and max(abs(lo), abs(hi)) <= F32_MAX
+              and (a.finite or (math.isfinite(out_lo)
+                                and math.isfinite(out_hi))))
+    return AbstractValue(lo, hi, finite=finite)
+
+
+# ----------------------------------------------------------- rule registry
+# op type -> transfer function fn(RangeContext) -> None. Registered by
+# analysis/range_rules.py; an op type in neither RANGE_RULES nor
+# range_rules.WIDEN_TO_TOP widens with reason="unknown-op" (repo_lint
+# rule 7 keeps the partition total over every shape-ruled op).
+RANGE_RULES: Dict[str, Callable] = {}
+
+
+def register_range_rule(*op_types: str):
+    """Attach a value-range transfer function to op types (the
+    ``register_shape_rule`` idiom; see docs/ANALYSIS.md for the
+    authoring guide). Unlike shape rules this keeps its own registry —
+    range rules are an analysis concern, not an OpDef hook."""
+
+    def deco(fn: Callable) -> Callable:
+        for t in op_types:
+            if t in RANGE_RULES:
+                raise ValueError(
+                    "range rule for op %r registered twice" % t)
+            RANGE_RULES[t] = fn
+        return fn
+
+    return deco
+
+
+class RangeContext:
+    """What a range transfer function sees: input abstract values (plus
+    the inferred shapes/dtypes shape inference filled in), attrs, and
+    output setters. Outputs left unset default to ⊤."""
+
+    def __init__(self, op, lookup: Callable[[str], AbstractValue],
+                 var_lookup: Callable[[str], object]):
+        self.op = op
+        self._lookup = lookup
+        self._var_lookup = var_lookup
+        self.outputs: Dict[Tuple[str, int], AbstractValue] = {}
+
+    # ---- inputs ----
+    def input_name(self, slot: str, idx: int = 0) -> Optional[str]:
+        names = self.op.inputs.get(slot) or []
+        return names[idx] if idx < len(names) and names[idx] else None
+
+    def num_inputs(self, slot: str) -> int:
+        return len([n for n in (self.op.inputs.get(slot) or []) if n])
+
+    def input_av(self, slot: str, idx: int = 0) -> AbstractValue:
+        name = self.input_name(slot, idx)
+        return av_top() if name is None else self._lookup(name)
+
+    def input_shape(self, slot: str, idx: int = 0) -> Optional[tuple]:
+        name = self.input_name(slot, idx)
+        if name is None:
+            return None
+        var = self._var_lookup(name)
+        if var is None or var.shape is None:
+            return None
+        return tuple(-1 if (s is None or int(s) < 0) else int(s)
+                     for s in var.shape)
+
+    def input_dtype(self, slot: str, idx: int = 0) -> Optional[str]:
+        name = self.input_name(slot, idx)
+        var = self._var_lookup(name) if name else None
+        return var.dtype if var is not None else None
+
+    def input_numel(self, slot: str, idx: int = 0) -> Optional[int]:
+        shape = self.input_shape(slot, idx)
+        if shape is None or any(s < 0 for s in shape):
+            return None
+        n = 1
+        for s in shape:
+            n *= s
+        return n
+
+    # ---- attrs / outputs ----
+    def attr(self, name: str, default=None):
+        return self.op.attrs.get(name, default)
+
+    def set(self, slot: str, av: AbstractValue, idx: int = 0) -> None:
+        self.outputs[(slot, idx)] = av
+
+    def set_all(self, av: AbstractValue) -> None:
+        for slot, names in self.op.outputs.items():
+            for idx, n in enumerate(names):
+                if n:
+                    self.outputs[(slot, idx)] = av
+
+
+# ------------------------------------------------------------ calibration
+class Calibration:
+    """Observed per-var min/max from real data, refined into the
+    analysis. ``observe_feed`` records every array of one feed dict
+    (the executor's feed-observer hook calls it per run when attached
+    via ``attach()``); ``observe`` records one named array (fetched
+    activations). The refinement contract is calibration's, not a
+    proof's: bounds hold for the observed batches."""
+
+    def __init__(self):
+        self.observed: Dict[str, Tuple[float, float]] = {}
+        self.batches = 0
+
+    def observe(self, name: str, value) -> None:
+        try:
+            arr = np.asarray(value)
+            if arr.size == 0 or not np.issubdtype(arr.dtype, np.number):
+                return
+            lo = float(arr.min())
+            hi = float(arr.max())
+        except (TypeError, ValueError):
+            return
+        old = self.observed.get(name)
+        if old is not None:
+            lo, hi = min(lo, old[0]), max(hi, old[1])
+        self.observed[name] = (lo, hi)
+
+    def observe_feed(self, feed: Dict[str, object]) -> None:
+        from ..observe.families import ANALYSIS_RANGES_CALIBRATION_BATCHES
+
+        self.batches += 1
+        ANALYSIS_RANGES_CALIBRATION_BATCHES.inc()
+        for name, value in feed.items():
+            self.observe(name, value)
+
+    def attach(self):
+        """Context manager: register this calibration as an executor
+        feed observer — every ``Executor.run`` feed dict inside the
+        block is observed (N feed batches = N ``observe_feed`` calls)."""
+        import contextlib
+
+        from ..core import executor as _exe
+
+        @contextlib.contextmanager
+        def _guard():
+            _exe.add_feed_observer(self.observe_feed)
+            try:
+                yield self
+            finally:
+                _exe.remove_feed_observer(self.observe_feed)
+
+        return _guard()
+
+    def refinement(self, name: str) -> Optional[Tuple[float, float]]:
+        return self.observed.get(name)
+
+
+# ----------------------------------------------------------------- engine
+class RangeAnalysis:
+    """Abstract interpretation of one program's blocks.
+
+    Walks the global block in op order (the same ``op_effects`` write
+    attribution as :class:`~paddle_tpu.analysis.dataflow.Dataflow`, so
+    versions line up), applying per-op transfer functions; sub-blocks
+    run a bounded fixpoint (conditional bodies join the fall-through
+    state, loop bodies widen to ⊤ when not stable after one
+    re-iteration).
+
+    ``scope`` + ``use_scope_values=True`` turns persistable scope state
+    into exact min/max intervals (one device->host reduction per var —
+    deliberately opt-in; the lint path keeps them ⊤). ``calibration``
+    refines any observed name's interval at its definition (and feeds
+    at their initial read). ``infer=True`` (default) runs shape
+    inference first so shape-dependent transfer functions (matmul's
+    contraction width, reduction sizes) see filled shapes.
+    """
+
+    def __init__(self, program: Program, fetch_names: Sequence[str] = (),
+                 scope=None, calibration: Optional[Calibration] = None,
+                 use_scope_values: bool = False, infer: bool = True):
+        import time
+
+        from ..observe.families import (ANALYSIS_RANGES_PROGRAMS,
+                                        ANALYSIS_RANGES_SECONDS,
+                                        ANALYSIS_RANGES_VARS,
+                                        ANALYSIS_RANGES_WIDENED)
+
+        t0 = time.perf_counter()
+        self.program = program
+        self.scope = scope
+        self.calibration = calibration
+        self.use_scope_values = use_scope_values
+        if infer:
+            from .infer import infer_program_shapes
+
+            infer_program_shapes(program, findings=[], fill=True)
+        # current abstract value per name (latest version)
+        self._env: Dict[str, AbstractValue] = {}
+        # frozen per-(name, write-version) values; version counting is
+        # op_effects-based, identical to Dataflow.version_at semantics
+        self._defs: Dict[Tuple[str, int], AbstractValue] = {}
+        self._version: Dict[str, int] = {}
+        # per-op output values (id(op) from the analyzed program)
+        self._op_out: Dict[Tuple[int, str], AbstractValue] = {}
+        self._declared_top: Set[str] = set()
+        self.widened: Dict[str, str] = {}  # op type -> reason (last)
+        self._widen_counts: Dict[str, int] = {}
+        self._scope_cache: Dict[str, Optional[AbstractValue]] = {}
+        block = program.global_block()
+        for op in block.ops:
+            self._transfer(op, self._env, top_level=True)
+        # telemetry: one program, per-var interval kinds, wall time
+        ANALYSIS_RANGES_PROGRAMS.inc()
+        stats = self.stats()
+        for kind in ("const", "bounded", "finite", "top"):
+            if stats[kind]:
+                ANALYSIS_RANGES_VARS.labels(kind=kind).inc(stats[kind])
+        for reason, n in self._widen_counts.items():
+            ANALYSIS_RANGES_WIDENED.labels(reason=reason).inc(n)
+        ANALYSIS_RANGES_SECONDS.observe(time.perf_counter() - t0)
+
+    # ------------------------------------------------------------ queries
+    def value_of(self, name: str) -> AbstractValue:
+        """Final abstract value of ``name`` (after the last write), or
+        its external/initial value if never written."""
+        v = self._env.get(name)
+        return v if v is not None else self._initial(name)
+
+    def at_version(self, name: str, version: int) -> AbstractValue:
+        """Value of write-version ``version`` of ``name`` (0 = the
+        external value — Dataflow.version_at semantics)."""
+        if version <= 0:
+            return self._initial(name)
+        v = self._defs.get((name, version))
+        return v if v is not None else av_top()
+
+    def output_av(self, op, name: str) -> AbstractValue:
+        """Abstract value ``op``'s write of ``name`` produced (⊤ for
+        ops not in the analyzed program)."""
+        v = self._op_out.get((id(op), name))
+        return v if v is not None else av_top()
+
+    def declared_top(self, name: str) -> bool:
+        """True when ``name``'s producer is a declared
+        ``WIDEN_TO_TOP`` op (⊤ by declaration, not by analysis gap)."""
+        return name in self._declared_top
+
+    def stats(self) -> Dict[str, int]:
+        """Per-var interval-kind counts over every written name (final
+        version): ``const`` exact literals, ``bounded`` finite
+        intervals, ``finite`` finiteness-only proofs, ``top`` nothing,
+        plus ``declared_top`` (the subset of ``top`` whose producers
+        deliberately widen) and ``vars`` total."""
+        out = {"vars": 0, "const": 0, "bounded": 0, "finite": 0,
+               "top": 0, "declared_top": 0}
+        for name, av in self._env.items():
+            out["vars"] += 1
+            if av.is_const:
+                out["const"] += 1
+            elif av.bounded:
+                out["bounded"] += 1
+            elif av.finite:
+                out["finite"] += 1
+            else:
+                out["top"] += 1
+                if name in self._declared_top:
+                    out["declared_top"] += 1
+        return out
+
+    def table(self) -> List[Tuple[str, AbstractValue]]:
+        """(name, value) rows, name-sorted — the ``--ranges`` CLI
+        rendering."""
+        return sorted(self._env.items())
+
+    # ----------------------------------------------------------- internals
+    def _initial(self, name: str) -> AbstractValue:
+        """External value: scope state (exact when opted in), feed
+        (calibration-refined), or dtype-shaped ⊤."""
+        var = self._var(name)
+        av = None
+        if self.use_scope_values and self.scope is not None \
+                and self.scope.has_var(name):
+            av = self._scope_av(name)
+        if av is None:
+            av = av_top()
+            if var is not None and var.dtype == "bool":
+                av = av_interval(0.0, 1.0, integral=True)
+            elif var is not None and (var.dtype.startswith("int")
+                                      or var.dtype.startswith("uint")):
+                av = AbstractValue(integral=True)
+        if self.calibration is not None:
+            ref = self.calibration.refinement(name)
+            if ref is not None:
+                av = av.refine(ref[0], ref[1])
+        return av
+
+    def _scope_av(self, name: str) -> Optional[AbstractValue]:
+        if name in self._scope_cache:
+            return self._scope_cache[name]
+        try:
+            arr = np.asarray(self.scope.find_var(name))
+            av = None
+            if arr.size and np.issubdtype(arr.dtype, np.number):
+                if np.isfinite(arr).all():
+                    av = av_interval(
+                        float(arr.min()), float(arr.max()),
+                        integral=bool(np.issubdtype(arr.dtype,
+                                                    np.integer)))
+        except (TypeError, ValueError):
+            av = None
+        self._scope_cache[name] = av
+        return av
+
+    def _var(self, name: str):
+        v = self.program.global_block()._find_var_recursive(name)
+        if v is not None:
+            return v
+        for b in self.program.blocks:
+            if name in b.vars:
+                return b.vars[name]
+        return None
+
+    def _lookup_in(self, env: Dict[str, AbstractValue]):
+        def lookup(name: str) -> AbstractValue:
+            v = env.get(name)
+            return v if v is not None else self._initial(name)
+
+        return lookup
+
+    def _transfer(self, op, env: Dict[str, AbstractValue],
+                  top_level: bool = False) -> None:
+        if "sub_block" in op.attrs:
+            self._sub_block(op, env, top_level=top_level)
+            return
+        from .range_rules import WIDEN_TO_TOP  # populated on import
+
+        rule = RANGE_RULES.get(op.type)
+        ctx = RangeContext(op, self._lookup_in(env), self._var)
+        declared_widen = False
+        if rule is not None:
+            try:
+                rule(ctx)
+            except Exception:  # a buggy rule widens, never sinks analysis
+                ctx.outputs = {}
+                self._widen(op.type, "rule-error")
+        else:
+            base = op.type[:-5] if op.type.endswith("_grad") else None
+            if op.type in WIDEN_TO_TOP or (base is not None):
+                # gradients widen by declaration: their magnitudes are
+                # a training-dynamics question, not a static one
+                declared_widen = True
+                self._widen(op.type, "declared")
+            else:
+                self._widen(op.type, "unknown-op")
+        self._commit(op, ctx.outputs, env, declared=declared_widen,
+                     top_level=top_level)
+
+    def _commit(self, op, outputs, env, declared=False, top_level=False):
+        for slot, names in op.outputs.items():
+            for idx, name in enumerate(names):
+                if not name:
+                    continue
+                av = outputs.get((slot, idx))
+                if av is None:
+                    av = av_top()
+                    if declared:
+                        self._declared_top.add(name)
+                elif name in self._declared_top:
+                    self._declared_top.discard(name)
+                if self.calibration is not None:
+                    ref = self.calibration.refinement(name)
+                    if ref is not None:
+                        av = av.refine(ref[0], ref[1])
+                env[name] = av
+                self._op_out[(id(op), name)] = av
+                if top_level and env is self._env:
+                    v = self._version.get(name, 0) + 1
+                    self._version[name] = v
+                    self._defs[(name, v)] = av
+
+    # sub-block execution shapes, by op type: a `conditional_block`
+    # body runs 0-or-1 times (join with the fall-through state), a
+    # `recompute_block` body runs EXACTLY once (single pass, no join),
+    # everything else — `while` (which ALSO carries a `condition` attr,
+    # so attr presence cannot distinguish it from a conditional),
+    # `recurrent`, unknown control flow — is loop-shaped: bounded
+    # fixpoint with widening, joined with the pre-state because a loop
+    # may run zero times.
+    _CONDITIONAL_SUB_BLOCK_OPS = ("conditional_block",)
+    _ONCE_SUB_BLOCK_OPS = ("recompute_block",)
+
+    def _sub_block(self, op, env, top_level=False):
+        idx = op.attrs.get("sub_block")
+        if not isinstance(idx, int) or not 0 <= idx < len(
+                self.program.blocks) or idx == 0:
+            self._widen(op.type, "unknown-op")
+            self._commit(op, {}, env, top_level=top_level)
+            return
+        sub = self.program.block(idx)
+        writes: List[str] = []
+        seen = set()
+        for n in op_effects(self.program, op)[1]:
+            if n not in seen:
+                seen.add(n)
+                writes.append(n)
+
+        def run_body(state):
+            scratch = dict(state)
+            for sop in sub.ops:
+                self._transfer(sop, scratch)
+            return scratch
+
+        def fallthrough(n):
+            return env[n] if n in env else self._initial(n)
+
+        after1 = run_body(env)
+        if op.type in self._ONCE_SUB_BLOCK_OPS:
+            # runs exactly once: the body result stands
+            result = {n: after1.get(n, av_top()).drop_const()
+                      for n in writes}
+        elif op.type in self._CONDITIONAL_SUB_BLOCK_OPS:
+            # body may not run: each write joins its fall-through value
+            result = {n: after1.get(n, av_top()).join(fallthrough(n))
+                      for n in writes}
+        else:
+            # loop-shaped body: re-run on its own results; stable ->
+            # keep, else widen the unstable writes to T (the bounded
+            # fixpoint's widening step). Either way join the pre-state:
+            # a while loop may run zero times
+            after2 = run_body(after1)
+            result = {}
+            for n in writes:
+                a1 = after1.get(n, av_top())
+                a2 = after2.get(n, av_top())
+                if a1 == a2:
+                    result[n] = a1.drop_const().join(fallthrough(n))
+                else:
+                    result[n] = av_top()
+                    self._widen(op.type, "loop")
+        outs = {}
+        for slot, names in op.outputs.items():
+            for i, name in enumerate(names):
+                if name and name in result:
+                    outs[(slot, i)] = result[name]
+        # writes not on the op's own output slots (sub-block interior
+        # names op_effects attributes to this op) update the env too.
+        # Version counting walks the DUPLICATE-keeping write list so
+        # numbers line up with Dataflow.version_at (each sub-op write is
+        # a distinct version; all of them carry the post-fixpoint value)
+        for n in writes:
+            if n in result:
+                env[n] = result[n]
+        if top_level and env is self._env:
+            for n in op_effects(self.program, op)[1]:
+                if n in result:
+                    v = self._version.get(n, 0) + 1
+                    self._version[n] = v
+                    self._defs[(n, v)] = result[n]
+        self._commit(op, outs, env, top_level=False)
+
+    def _widen(self, op_type: str, reason: str) -> None:
+        self.widened[op_type] = reason
+        self._widen_counts[reason] = self._widen_counts.get(reason, 0) + 1

@@ -944,3 +944,64 @@ def _r_optimizer(ctx: InferContext):
 register_shape_rule("sgd", "momentum", "lars_momentum", "adam", "adamax",
                     "adagrad", "decayed_adagrad", "adadelta", "rmsprop",
                     "ftrl", "lamb")(_r_optimizer)
+
+
+# ------------------------------------------------------------ quantization
+# (ops/quant_ops.py: the fake_quantize simulation family + the real
+# int8 pair the quantize_pass inserts. The lowerings emit float scale
+# statistics as shape-[1] f32 tensors and — for the real pair — int8
+# payloads; declaring those here is what lets the dtype-annotation lint
+# catch a var built with the wrong dtype, the topk-int32 class of bug.)
+def _r_fake_quantize(ctx: InferContext):
+    xs = ctx.input_shape("X")
+    if xs is not None:
+        ctx.set("Out", xs)
+    for slot in ("OutScale", "OutAccum", "OutState"):
+        if slot in ctx.op.outputs:
+            ctx.set(slot, (1,), dtype="float32")
+
+
+register_shape_rule("fake_quantize_abs_max",
+                    "fake_quantize_range_abs_max",
+                    "fake_quantize_moving_average_abs_max")(
+                        _r_fake_quantize)
+
+
+@register_shape_rule("fake_dequantize_max_abs")
+def _r_fake_dequantize(ctx):
+    xs = ctx.input_shape("X")
+    if xs is not None:
+        ctx.set("Out", xs)
+
+
+def _quant_channel_count(ctx: InferContext) -> "Optional[int]":
+    xs = ctx.input_shape("X")
+    axis = int(ctx.attr("axis", 0))
+    if xs is None or not -len(xs) <= axis < len(xs):
+        return None
+    c = xs[axis]
+    return c if c >= 0 else None
+
+
+@register_shape_rule("quantize_channel_abs_max")
+def _r_quantize_channel(ctx):
+    xs = ctx.input_shape("X")
+    ss = ctx.input_shape("InScale")
+    c = _quant_channel_count(ctx)
+    if ss is not None and c is not None and is_concrete(ss) \
+            and numel(ss) != c:
+        ctx.fail("per-channel scale has %d entries but axis %d of X "
+                 "has %d channels" % (numel(ss), ctx.attr("axis", 0), c))
+    ctx.set("Out", xs, dtype="int8")
+
+
+@register_shape_rule("dequantize_channel_abs_max")
+def _r_dequantize_channel(ctx):
+    xs = ctx.input_shape("X")
+    ss = ctx.input_shape("Scales")
+    c = _quant_channel_count(ctx)
+    if ss is not None and c is not None and is_concrete(ss) \
+            and numel(ss) != c:
+        ctx.fail("per-channel scale has %d entries but axis %d of X "
+                 "has %d channels" % (numel(ss), ctx.attr("axis", 0), c))
+    ctx.set("Out", xs, dtype="float32")

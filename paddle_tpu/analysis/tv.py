@@ -50,7 +50,9 @@ differentially over seeded random programs.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
 
 from ..core.program import Program
 from .dataflow import Dataflow, Unfingerprintable, attrs_fingerprint
@@ -151,6 +153,19 @@ class ProgramSnapshot:
 #    "from": [removed producer ops]}
 #       constant folding's assign_value: the new op produces `out` in
 #       place of its removed producer(s)
+#   {"kind": "quantize", "weight": w, "axis": a, "bit_length": b,
+#    "scale_name"/"quantized"/"dequant": names,
+#    "scale_op"/"quant_op"/"dequant_op": new ops,
+#    "new_ops": [all three], "consumers": [(op, slot), ...]}
+#       int8 PTQ (quantize_pass): three new ops splice a
+#       scale-literal -> quantize -> dequantize chain off weight `w`
+#       and every declared consumer's `slot` is rewired onto the
+#       dequantized value. The validator checks the chain's wiring,
+#       that each consumer originally read the EXTERNAL weight, and —
+#       numerics, not just dataflow — that the baked scale literal
+#       equals the per-channel abs-max recomputed from the scope
+#       weight (a wrong-scale rewrite is a violation, not a silent
+#       accuracy hole).
 
 
 def _resolve_before(snap: ProgramSnapshot, forwards: Dict[int, dict],
@@ -189,6 +204,8 @@ def validate_rewrite(before: ProgramSnapshot, program: Program,
     fused: Dict[int, dict] = {}
     mat_from: Dict[int, dict] = {}
     new_ops: Dict[int, dict] = {}
+    quants: List[dict] = []
+    quant_rewires: Dict[Tuple[int, str], dict] = {}
     for rec in rewrites or ():
         kind = rec.get("kind")
         if kind == "remove":
@@ -206,6 +223,12 @@ def validate_rewrite(before: ProgramSnapshot, program: Program,
             for c in rec.get("from", ()):
                 mat_from[id(c)] = rec
             new_ops[id(rec["into"])] = rec
+        elif kind == "quantize":
+            for c in rec.get("new_ops", ()):
+                new_ops[id(c)] = rec
+            quants.append(rec)
+            for cop, slot in rec.get("consumers", ()):
+                quant_rewires[(id(cop), slot)] = rec
         else:
             v.append(RewriteViolation(
                 "bad-log", "unknown rewrite record kind %r" % (kind,)))
@@ -374,6 +397,22 @@ def validate_rewrite(before: ProgramSnapshot, program: Program,
                     continue
                 if not nb:
                     continue
+                qrec = quant_rewires.get((id(op), slot))
+                if qrec is not None and nb == qrec.get("weight") \
+                        and na == qrec.get("dequant"):
+                    # declared PTQ rewire: the quantize-record check
+                    # below proves the dequantized value derives from
+                    # the same external weight; here only pin that the
+                    # read actually observes the declared dequantize op
+                    actual = ra(na, q)
+                    if not (actual[0] == "op"
+                            and actual[1] is qrec.get("dequant_op")):
+                        v.append(RewriteViolation(
+                            "quantize-chain",
+                            "rewired weight read of %r does not "
+                            "observe the declared dequantize op" % na,
+                            op, var=na))
+                    continue
                 expected = map_value(rb(nb, i))
                 actual = ra(na, q)
                 if expected[0] == "dead":
@@ -398,6 +437,8 @@ def validate_rewrite(before: ProgramSnapshot, program: Program,
 
     # ----------------------------------------- 5. new ops' replay reads
     for rec in new_ops.values():
+        if rec.get("kind") == "quantize":
+            continue  # validated by the dedicated chain check below
         new_op = rec["into"]
         q = after.pos_of(new_op) if after.contains(new_op) else None
         if q is None:
@@ -450,6 +491,102 @@ def validate_rewrite(before: ProgramSnapshot, program: Program,
                 "bad-log",
                 "replacement op reads %s, which no constituent declared"
                 % sorted(actual_reads - declared_ext - internal), new_op))
+
+    # -------------------------------------------- 5b. quantize records
+    # (int8 PTQ: chain wiring, external-weight provenance, and the
+    # NUMERIC scale contract — baked per-channel scales must equal the
+    # abs-max recomputed here from the scope weight, independently of
+    # whatever the pass computed)
+    for rec in quants:
+        w_name = rec.get("weight")
+        s_op = rec.get("scale_op")
+        q_op = rec.get("quant_op")
+        dq_op = rec.get("dequant_op")
+        missing = [(lbl, nop) for lbl, nop in (
+            ("scale-literal", s_op), ("quantize", q_op),
+            ("dequantize", dq_op))
+            if nop is None or not after.contains(nop)]
+        if missing:
+            for lbl, nop in missing:
+                v.append(RewriteViolation(
+                    "bad-log", "quantize record's %s op is not in the "
+                    "after-program" % lbl, nop))
+            continue
+        qpos, dqpos = after.pos_of(q_op), after.pos_of(dq_op)
+
+        def _reaches(name, pos, producer, what, anchor):
+            d = after.reaching_def(name, pos)
+            if d is not producer:
+                v.append(RewriteViolation(
+                    "quantize-chain",
+                    "%s of %r resolves to %s, not the declared %s op"
+                    % (what, name,
+                       "op %s" % d.type if d is not None
+                       else "the external value", producer.type),
+                    anchor, var=name or ""))
+
+        _reaches(rec.get("quantized"), dqpos, q_op,
+                 "dequantize's payload read", dq_op)
+        _reaches(rec.get("scale_name"), dqpos, s_op,
+                 "dequantize's scale read", dq_op)
+        _reaches(rec.get("scale_name"), qpos, s_op,
+                 "quantize's scale read", q_op)
+        # every declared consumer must have read the EXTERNAL weight
+        # (scope value — the thing the scales were derived from), and
+        # the quantize op must observe that same definition at its slot
+        act_w = ra(w_name, qpos)
+        for cop, _slot in rec.get("consumers", ()):
+            cpos = before.pos.get(id(cop))
+            if cpos is None:
+                v.append(RewriteViolation(
+                    "bad-log",
+                    "quantize record references an unknown consumer",
+                    cop))
+                continue
+            exp_w = map_value(rb(w_name, cpos))
+            if exp_w[0] != "ext":
+                v.append(RewriteViolation(
+                    "quantize-chain",
+                    "consumer read a mid-program definition of %r — "
+                    "only external (scope) weights are quantizable"
+                    % w_name, cop, var=w_name))
+            elif ident(exp_w) != ident(act_w):
+                v.append(RewriteViolation(
+                    "read-moved-past-write",
+                    "quantize op observes %s of %r, but the consumer "
+                    "read %s" % (_dsc(act_w), w_name, _dsc(exp_w)),
+                    q_op, var=w_name))
+        # numeric scale contract
+        if scope is None or not scope.has_var(w_name):
+            v.append(RewriteViolation(
+                "quantize-scale",
+                "no scope value for %r: the baked per-channel scales "
+                "cannot be verified" % w_name, q_op, var=w_name))
+            continue
+        try:
+            w_arr = np.asarray(scope.find_var(w_name))
+        except (TypeError, ValueError):
+            w_arr = None
+        ax = int(rec.get("axis", 0))
+        if w_arr is None or not 0 <= ax < w_arr.ndim:
+            v.append(RewriteViolation(
+                "quantize-chain",
+                "weight %r is unreadable or axis %d is out of range"
+                % (w_name, ax), q_op, var=w_name))
+            continue
+        expect = np.max(np.abs(w_arr),
+                        axis=tuple(i for i in range(w_arr.ndim)
+                                   if i != ax)).reshape(-1)
+        baked = np.asarray(s_op.attrs.get("values", ()),
+                           dtype=np.float64).reshape(-1)
+        if baked.shape != expect.shape or not np.allclose(
+                baked, expect.astype(np.float64), rtol=1e-5, atol=1e-8):
+            v.append(RewriteViolation(
+                "quantize-scale",
+                "baked per-channel scales for %r do not equal the "
+                "abs-max of the scope weight (the rewrite's numerics "
+                "are wrong; dequantized values will not track f32)"
+                % w_name, s_op, var=rec.get("scale_name", "")))
 
     # ------------------------------------------------- 6. root terminals
     end_b = len(before.ops)
@@ -511,6 +648,12 @@ def describe_rewrites(rewrites: Sequence[dict]) -> List[str]:
             out.append("materialize %s <- folded [%s]"
                        % (rec.get("name"),
                           "+".join(c.type for c in rec.get("from", ()))))
+        elif kind == "quantize":
+            out.append("quantize %s -> int8 (axis %s, %d consumer(s) "
+                       "rewired onto %s)"
+                       % (rec.get("weight"), rec.get("axis"),
+                          len(rec.get("consumers", ())),
+                          rec.get("dequant")))
         else:
             out.append("?? %r" % (kind,))
     return out

@@ -827,6 +827,13 @@ class Executor:
         """Shared run()/cost_analysis() plumbing: feed conversion, plan
         cache lookup, and state/RNG argument gathering."""
         feed = feed or {}
+        if feed and _FEED_OBSERVERS:
+            # calibration hook (analysis/ranges.Calibration.attach):
+            # observers see the raw host feed dict before conversion.
+            # Observer exceptions propagate — a broken calibrator must
+            # fail loudly, not silently record nothing
+            for _obs in list(_FEED_OBSERVERS):
+                _obs(feed)
         fetch_names = [
             v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
         ]
@@ -1391,6 +1398,30 @@ def _feed_to_device(name: str, val, var):
         return val if (want is None or val.dtype == want) \
             else jnp.asarray(val, dtype=want)
     return jnp.asarray(_feed_host_array(name, val, var), dtype=want)
+
+
+# feed-observer hook: callables invoked with every raw feed dict an
+# Executor converts (run/run_repeated/cost_analysis — once per _gather).
+# The consumer is value-range calibration (analysis/ranges.Calibration
+# records observed per-var min/max over N feed batches); anything else
+# wanting a data-shaped tap can register too. Process-wide, like the
+# default scope.
+_FEED_OBSERVERS: List[Any] = []
+
+
+def add_feed_observer(fn) -> None:
+    """Register ``fn(feed_dict)`` to be called with every raw feed an
+    executor in this process converts. Pair with
+    ``remove_feed_observer`` (or use ``Calibration.attach()``)."""
+    _FEED_OBSERVERS.append(fn)
+
+
+def remove_feed_observer(fn) -> None:
+    """Unregister a feed observer (no-op if not registered)."""
+    try:
+        _FEED_OBSERVERS.remove(fn)
+    except ValueError:
+        pass
 
 
 def feeds_to_device(feed: Dict[str, Any], var_lookup, device=None):

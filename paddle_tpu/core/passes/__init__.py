@@ -18,11 +18,14 @@ constant_folding_pass                    1   evaluate const-only subgraphs
 copy_propagation_pass                    1   drop assign/share_data copies
 common_subexpression_elimination_pass    1   merge value-identical ops
 dead_op_elimination_pass                 1   fetch-relative backward slice
+post_training_quantize_pass              2   int8 PTQ weights (opt-in:
+                                             PADDLE_TPU_OPTIMIZE_QUANT)
+amp_bf16_pass                            1   stamp bf16 policy onto the IR
+                                             (range-aware f32 keep)
 fuse_kernel_tier_pass                    2   residual+layernorm pairs and
                                              optimizer runs -> kernel-tier
                                              fused ops (PADDLE_TPU_KERNELS)
 fuse_elementwise_pass                    2   chain -> one fused op
-amp_bf16_pass                            1   stamp bf16 policy onto the IR
 ====================================== ===== ==============================
 
 Safety: every pass preserves BITWISE semantics (RNG consumers are never
@@ -57,6 +60,7 @@ from typing import Dict, List, Optional, Sequence
 from ..ir import Graph, get_pass
 from ..program import Program
 from . import amp_pass, cse, fold, fuse, kernel_fuse  # noqa: F401
+from . import quantize_pass as _quantize_pass  # noqa: F401
 
 __all__ = [
     "PIPELINE",
@@ -80,13 +84,22 @@ PIPELINE = (
     ("copy_propagation_pass", 1),
     ("common_subexpression_elimination_pass", 1),
     ("dead_op_elimination_pass", 1),
+    # int8 PTQ AFTER the cleanup passes (quantizing a weight DCE would
+    # remove is waste) and BEFORE the fusion passes (the inserted
+    # dequantize must not sit inside a fused chain's slot window);
+    # PADDLE_TPU_OPTIMIZE_QUANT=0 (default) makes it a provable no-op
+    ("post_training_quantize_pass", 2),
+    # AMP stamping BEFORE the fusion passes: the stamps ride into the
+    # fused descriptors (the replay honors each constituent's __amp__,
+    # so stamped == table stays bitwise), and the range-aware f32 keep
+    # can see ops a fused chain would otherwise swallow
+    ("amp_bf16_pass", 1),
     # kernel-tier fusion BEFORE generic elementwise fusion: the residual
     # add would otherwise be swallowed into an elementwise chain and the
     # add->layer_norm seam lost (kernel_fuse.py; PADDLE_TPU_KERNELS=0
     # makes it a provable no-op)
     ("fuse_kernel_tier_pass", 2),
     ("fuse_elementwise_pass", 2),
-    ("amp_bf16_pass", 1),
 )
 
 
@@ -103,13 +116,18 @@ def optimize_level() -> int:
 def config_key() -> tuple:
     """Every knob that changes WHAT the pipeline produces, for the
     executor's plan-cache key: a run under one optimizer config must
-    never be served a plan compiled under another."""
+    never be served a plan compiled under another. The quantize opt-in
+    and the range-aware amp guard both change output — a quantized plan
+    must never serve an unquantized run and vice versa."""
+    from .amp_pass import amp_range_guard
     from .fold import fold_max_elems
+    from .quantize_pass import quant_min_elems, quantize_enabled
 
     level = optimize_level()
     if level <= 0:
         return (0,)
-    return (level, fold_max_elems())
+    return (level, fold_max_elems(), quantize_enabled(),
+            quant_min_elems(), amp_range_guard())
 
 
 def verify_each_pass() -> bool:

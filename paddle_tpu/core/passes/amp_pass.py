@@ -13,18 +13,42 @@ same points, so they are bitwise identical; tests pin it.
 
 An op carrying a pre-existing ``__amp__`` attr (user override) is left
 untouched — that is the point of materializing the policy in the IR.
+
+**Range-aware upgrade** (``PADDLE_TPU_AMP_RANGE_GUARD``, on by
+default): a bf16-policy op whose inputs or outputs PROVABLY exceed the
+bf16 finite range (the value-range engine, ``analysis/ranges.py``) is
+stamped "f32" instead — the bf16 cast would round it to inf. The guard
+fires only on finite interval evidence (⊤-ranged programs — every
+ordinary model — stamp identically to the table, preserving the
+bitwise level-2-vs-0 contract); when it DOES fire, level 2 deliberately
+differs from level 0 by returning the finite f32 number the table
+policy would have turned into inf. Each kept op counts into
+``paddle_quant_amp_kept_f32_total``, and the knob rides
+``passes.config_key()`` so cached plans never cross configurations.
 """
 
 from __future__ import annotations
 
+import os
+
 from ..ir import Graph, Pass, register_pass
+
+
+def amp_range_guard() -> bool:
+    """``PADDLE_TPU_AMP_RANGE_GUARD=0`` disables the range-aware f32
+    keep (on by default; it only changes output on ops with PROVEN
+    bf16 overflow, so ordinary programs are bitwise unaffected)."""
+    return os.environ.get(
+        "PADDLE_TPU_AMP_RANGE_GUARD", "1").lower() not in (
+            "0", "false", "off")
 
 
 @register_pass("amp_bf16_pass")
 class AmpBf16Pass(Pass):
     """Stamp the bf16/f32/keep AMP policy onto every op as an
     ``__amp__`` attr (no-op unless the program has AMP enabled;
-    pre-existing per-op overrides are preserved)."""
+    pre-existing per-op overrides are preserved). With the range guard
+    on, provably-overflow-prone bf16 ops are stamped f32 instead."""
 
     fetch_names = frozenset()
     scope = None
@@ -38,12 +62,58 @@ class AmpBf16Pass(Pass):
             return graph
         from ..amp import policy_for
 
+        guard = amp_range_guard()
+        ranges = df = None
+        kept_f32 = 0
         tagged = 0
         for block in program.blocks:
-            for op in block.ops:
+            for pos, op in enumerate(block.ops):
                 if "__amp__" in op.attrs:
                     continue  # explicit per-op override wins
-                op.attrs["__amp__"] = policy_for(op.type)
+                tag = policy_for(op.type)
+                if tag == "bf16" and guard and block.idx == 0:
+                    if ranges is None:
+                        from ...analysis.dataflow import Dataflow
+                        from ...analysis.ranges import RangeAnalysis
+
+                        ranges = RangeAnalysis(
+                            program,
+                            fetch_names=tuple(self.fetch_names or ()),
+                            scope=self.scope)
+                        df = Dataflow(program,
+                                      fetch_names=tuple(
+                                          self.fetch_names or ()),
+                                      scope=self.scope)
+                    if self._overflows_bf16(ranges, df, op, pos):
+                        tag = "f32"
+                        kept_f32 += 1
+                op.attrs["__amp__"] = tag
                 tagged += 1
-        self.stats = {"amp_tagged": tagged}
+        if kept_f32:
+            from ...observe.families import QUANT_AMP_KEPT_F32
+
+            QUANT_AMP_KEPT_F32.inc(kept_f32)
+        self.stats = {"amp_tagged": tagged, "amp_kept_f32": kept_f32}
         return graph
+
+    @staticmethod
+    def _overflows_bf16(ranges, df, op, pos) -> bool:
+        """PROVEN overflow only: a finite interval bound beyond the
+        bf16 finite range on any input or output. ⊤ values (no proof)
+        never fire — the stamp then matches the table policy exactly.
+        Inputs resolve at the WRITE VERSION this op actually reads (a
+        later overwrite of the same name must not retroactively stamp
+        an earlier reader)."""
+        from ...analysis.ranges import BF16_MAX
+
+        for name in op.output_names():
+            if name:
+                av = ranges.output_av(op, name)
+                if av.bounded and av.magnitude > BF16_MAX:
+                    return True
+        for name in op.input_names():
+            if name:
+                av = ranges.at_version(name, df.version_at(name, pos))
+                if av.bounded and av.magnitude > BF16_MAX:
+                    return True
+        return False

@@ -538,13 +538,51 @@ _ANALYSIS_RULES = (
     "fetch-undefined", "dead-var", "dead-op", "double-write",
     "int64-feed", "int64-narrowing", "grad-pairing", "sub-block",
     # dataflow-engine-powered rules (analysis/dataflow.py)
-    "dead-store", "write-after-write", "use-before-init")
+    "dead-store", "write-after-write", "use-before-init",
+    # range-engine-powered numerics rules (analysis/ranges.py)
+    "bf16-overflow", "domain-violation", "int-narrowing-loss")
 for _r in _ANALYSIS_RULES:
     ANALYSIS_FINDINGS.labels(rule=_r)
 ANALYSIS_VERIFY_SECONDS = REGISTRY.histogram(
     "paddle_analysis_verify_seconds",
     "Wall time of one verify_program pass (shape inference + lint "
     "suite) — scales with op count, not with tensor sizes")
+
+# value-range abstract interpretation (analysis/ranges.py — see
+# docs/ANALYSIS.md "The range engine")
+ANALYSIS_RANGES_PROGRAMS = REGISTRY.counter(
+    "paddle_analysis_ranges_programs_total",
+    "Programs run through the value-range abstract interpreter "
+    "(RangeAnalysis construction): once per lint run that activates a "
+    "range-powered rule, per quantize-pass application, per "
+    "lint_program.py --ranges invocation")
+ANALYSIS_RANGES_VARS = REGISTRY.counter(
+    "paddle_analysis_ranges_vars_total",
+    "Variables classified per analysis, by final interval kind: "
+    "'const' = exact compile-time literal, 'bounded' = finite "
+    "[lo, hi], 'finite' = provably no inf/nan but unbounded, 'top' = "
+    "nothing provable (incl. the declared WIDEN_TO_TOP widenings)",
+    labels=("kind",))
+for _k in ("const", "bounded", "finite", "top"):
+    ANALYSIS_RANGES_VARS.labels(kind=_k)
+ANALYSIS_RANGES_WIDENED = REGISTRY.counter(
+    "paddle_analysis_ranges_widened_total",
+    "Explicit widenings to T, by reason: 'declared' = the op is in "
+    "range_rules.WIDEN_TO_TOP (or a *_grad), 'unknown-op' = no rule "
+    "and no declaration (repo_lint rule 7 keeps this 0 for shape-ruled "
+    "ops), 'loop' = a loop body's write did not stabilize in the "
+    "bounded fixpoint, 'rule-error' = a transfer function crashed "
+    "(widen, never sink the analysis)", labels=("reason",))
+for _r in ("declared", "unknown-op", "loop", "rule-error"):
+    ANALYSIS_RANGES_WIDENED.labels(reason=_r)
+ANALYSIS_RANGES_SECONDS = REGISTRY.histogram(
+    "paddle_analysis_ranges_seconds",
+    "Wall time of one whole-program range analysis (scales with op "
+    "count; scope-value reads are opt-in and excluded by default)")
+ANALYSIS_RANGES_CALIBRATION_BATCHES = REGISTRY.counter(
+    "paddle_analysis_ranges_calibration_batches_total",
+    "Feed batches observed by an attached ranges.Calibration (the "
+    "executor feed-observer hook): N batches = N increments")
 
 # ------------------------------------------------------------- optimizer
 # (paddle_tpu/core/passes/: graph-optimizing pass pipeline — see
@@ -597,9 +635,10 @@ _OPTIMIZER_PASSES = (
     "copy_propagation_pass",
     "common_subexpression_elimination_pass",
     "dead_op_elimination_pass",
+    "post_training_quantize_pass",
+    "amp_bf16_pass",
     "fuse_kernel_tier_pass",
     "fuse_elementwise_pass",
-    "amp_bf16_pass",
 )
 OPTIMIZER_TV_CHECKS = REGISTRY.counter(
     "paddle_optimizer_tv_checks_total",
@@ -625,6 +664,40 @@ for _p in _OPTIMIZER_PASSES:
     OPTIMIZER_PASS_SECONDS.labels(**{"pass": _p})
     OPTIMIZER_TV_CHECKS.labels(**{"pass": _p})
     OPTIMIZER_TV_VIOLATIONS.labels(**{"pass": _p})
+
+# ------------------------------------------------------------ quantization
+# (core/passes/quantize_pass.py + the range-aware amp upgrade — see
+# docs/OPTIMIZER.md "Post-training int8 quantization".
+# PADDLE_TPU_OPTIMIZE_QUANT=0 (the default) bypasses the pass; tests pin
+# that NONE of these families move then.)
+QUANT_WEIGHTS = REGISTRY.counter(
+    "paddle_quant_weights_quantized_total",
+    "Weights rewritten to int8 storage + per-channel dequantize by the "
+    "quantize_pass, by consuming op type; once per pass application "
+    "(= once per plan-cache miss)", labels=("op",))
+for _op in ("mul", "matmul", "matmul_v2", "conv2d"):
+    QUANT_WEIGHTS.labels(op=_op)
+QUANT_OPS_INSERTED = REGISTRY.counter(
+    "paddle_quant_ops_inserted_total",
+    "quantize/dequantize/scale-literal ops the quantize_pass spliced "
+    "into optimized programs (3 per quantized weight)")
+QUANT_SKIPPED = REGISTRY.counter(
+    "paddle_quant_skipped_total",
+    "Weight-consuming ops the quantize_pass examined and refused, by "
+    "reason: 'written' = the program writes the weight (training), "
+    "'grad' = a gradient for it exists, 'dtype' = not float32, "
+    "'shape' = rank unsupported for per-channel scales, 'scope' = no "
+    "concrete value in the run scope, 'unproven' = the range engine "
+    "could not prove the weight finite, 'small' = below the size "
+    "floor", labels=("reason",))
+for _r in ("written", "grad", "dtype", "shape", "scope", "unproven",
+           "small"):
+    QUANT_SKIPPED.labels(reason=_r)
+QUANT_AMP_KEPT_F32 = REGISTRY.counter(
+    "paddle_quant_amp_kept_f32_total",
+    "Ops the range-aware amp_bf16_pass stamped f32 instead of the "
+    "table's bf16 because their output interval provably exceeds the "
+    "bf16 finite range — each count is a would-have-been inf")
 
 # --------------------------------------------------------------- kernels
 # (paddle_tpu/kernels/: the Pallas kernel tier + per-shape autotuner —

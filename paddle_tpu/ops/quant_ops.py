@@ -110,3 +110,45 @@ def _fake_dequantize_max_abs(ctx, ins, attrs):
     scale = ins["Scale"][0]
     max_range = float(attrs.get("max_range", 127.0))
     return {"Out": [x * scale.reshape(()) / max_range]}
+
+
+# --------------------------------------------------------- real int8 PTQ
+# Unlike the fake_quantize family above (quantize-round-dequantize in
+# float storage, simulating int8 during training), these two ops carry
+# REAL int8 storage through the program: the graduation from simulation
+# to IR pass the quantize_pass (core/passes/quantize_pass.py) performs.
+# Scales are per-channel and provided as an input (the pass bakes them
+# as an assign_value literal derived from the range analysis), so the
+# translation validator can machine-check the baked values against the
+# scope weights.
+
+
+def _channel_shape(x, axis: int):
+    bshape = [1] * x.ndim
+    bshape[axis] = -1
+    return bshape
+
+
+@register_op("quantize_channel_abs_max", no_grad=True)
+def _quantize_channel_abs_max(ctx, ins, attrs):
+    """Symmetric per-channel int8 quantization with provided scales:
+    Out[int8] = clip(round(X / scale * qmax), -qmax, qmax)."""
+    x = ins["X"][0]
+    scale = ins["InScale"][0]
+    axis = int(attrs.get("axis", 0))
+    qmax = _qrange(int(attrs.get("bit_length", 8)))
+    s = jnp.maximum(scale.reshape(_channel_shape(x, axis)), 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return {"Out": [q.astype(jnp.int8)]}
+
+
+@register_op("dequantize_channel_abs_max", no_grad=True)
+def _dequantize_channel_abs_max(ctx, ins, attrs):
+    """Per-channel dequantize: Out[f32] = X * scale / qmax (the exact
+    inverse of quantize_channel_abs_max's grid)."""
+    x = ins["X"][0]
+    scale = ins["Scales"][0]
+    axis = int(attrs.get("axis", 0))
+    qmax = _qrange(int(attrs.get("bit_length", 8)))
+    s = scale.reshape(_channel_shape(x, axis)).astype(jnp.float32)
+    return {"Out": [x.astype(jnp.float32) * s / qmax]}

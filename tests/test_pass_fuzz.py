@@ -159,3 +159,42 @@ def test_pass_fuzz_full_sweep_200_seeds():
     assert not failures, (
         "pass fuzzer sweep failed (replay each with `python "
         "tools/pass_fuzz.py --start <seed> --seeds 1`): %r" % failures)
+
+
+def test_generator_emits_quant_clip_and_activation_patterns():
+    """The generator's vocabulary covers the quantization-adjacent
+    shapes: clip, fake_quantize (simulation ops entering via
+    transpilers), and the widened activation set — so the differential
+    sweep exercises them against fold/CSE/fusion."""
+    seen = set()
+    for seed in range(60):
+        main, _startup, _feed, _fetch = pass_fuzz.gen_program(seed)
+        seen.update(op.type for op in main.global_block().ops)
+        if {"clip", "fake_quantize_abs_max", "gelu"} <= seen:
+            break
+    assert "clip" in seen
+    assert "fake_quantize_abs_max" in seen
+    assert "gelu" in seen
+
+
+def test_quantize_corpus_entry_uses_tolerance_harness():
+    """The quantize entry's parity leg is the STATED tolerance, not
+    bitwise (quantized programs only): the guarded pipeline really
+    quantizes (outputs differ bitwise from level 0) yet reports clean."""
+    import numpy as np
+
+    cfg = pass_fuzz._corpus_cfg("quantize_wrong_scale")
+    assert cfg["tolerance"] and cfg["env"] == {
+        "PADDLE_TPU_OPTIMIZE_QUANT": "1"}
+    main, startup, feed, fetch = pass_fuzz.build_corpus_program(
+        "quantize_wrong_scale")
+    base, _ = pass_fuzz.run_program(main, startup, feed, fetch, level=0,
+                                    env=cfg["env"])
+    opt, _ = pass_fuzz.run_program(main, startup, feed, fetch, level=2,
+                                   env=cfg["env"])
+    diffs = [not np.array_equal(a, b)
+             for a, b in zip(base[0], opt[0])]
+    assert any(diffs), "guarded quantize produced bitwise-equal output"
+    assert pass_fuzz.diff_run(main, startup, feed, fetch,
+                              tolerance=cfg["tolerance"],
+                              env=cfg["env"]) == []

@@ -175,3 +175,20 @@ def test_kernel_tuned_and_bypass_rows_never_pin(tmp_path):
         {"metric": ROW, "value": 9999.0, "steps_per_call": 10,
          "kernel_tier": {"attention": "flash"}}])
     assert base[ROW] == 9999.0
+
+
+def test_quantized_rows_never_pin(tmp_path):
+    # int8 PTQ rows (PADDLE_TPU_BENCH_QUANT=1) compiled a DIFFERENT
+    # program with its own accuracy/latency trade — incomparable with
+    # the plain-config baseline, even at a higher steps/sec
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": "quantized_mnist", "value": 9e9,
+         "quantized": "int8", "accuracy_delta": 0.006,
+         "optimize_level": 2, "steps_per_call": 10},
+        {"metric": ROW, "value": 9999.0, "quantized": "int8",
+         "accuracy_delta": 0.0, "optimize_level": 2,
+         "steps_per_call": 10}])
+    assert proc.stdout.count("SKIP") == 2
+    assert "quantized" in proc.stdout
+    assert base[ROW] == 509.8
+    assert "quantized_mnist" not in base

@@ -1,0 +1,336 @@
+"""Int8 PTQ pass (core/passes/quantize_pass.py): rewrite structure, the
+TV quantize-record check (incl. the wrong-scale knockout), the stated
+tolerance parity contract on model-zoo inference programs, the
+default-off zero-counter gate, and the range-aware AMP upgrade."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers as L
+from paddle_tpu import observe
+from paddle_tpu.core.passes import OptimizerPassError, optimize_program
+from paddle_tpu.core.passes.quantize_pass import (
+    QUANT_TOLERANCE, PostTrainingQuantizePass)
+from paddle_tpu.core.scope import Scope, scope_guard
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import lint_program as lint_cli  # noqa: E402
+
+
+@pytest.fixture
+def quant_on(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_OPTIMIZE_QUANT", "1")
+
+
+def _fc_net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[8], dtype="float32")
+        h = L.fc(x, size=16, act="relu")
+        p = L.fc(h, size=4, act="softmax")
+    return main, startup, p
+
+
+def _init(startup, scope):
+    with scope_guard(scope):
+        fluid.Executor().run(startup, scope=scope)
+
+
+def _quant_counters():
+    out = {}
+    for fam, data in observe.snapshot()["metrics"].items():
+        if fam.startswith("paddle_quant"):
+            for s in data["samples"]:
+                out[(fam,) + tuple(sorted(s["labels"].items()))] = \
+                    s["value"]
+    return out
+
+
+# ---------------------------------------------------- rewrite structure
+def test_quantize_rewrites_weights_tv_clean(quant_on):
+    main, startup, p = _fc_net()
+    scope = Scope()
+    _init(startup, scope)
+    opt, stats, mgr = optimize_program(
+        main, fetch_list=[p.name], scope=scope, level=2, tv=True,
+        return_manager=True)
+    row = [r for r in stats
+           if r["pass"] == "post_training_quantize_pass"][0]
+    assert row["weights_quantized"] == 2
+    types = [op.type for op in opt.global_block().ops]
+    assert types.count("quantize_channel_abs_max") == 2
+    assert types.count("dequantize_channel_abs_max") == 2
+    # consumers read the dequantized value; the original weight read
+    # survives only as the quantize op's input
+    muls = [op for op in opt.global_block().ops if op.type == "mul"]
+    assert all(op.input("Y")[0].endswith(".dequant") for op in muls)
+    # the TV log carries one quantize record per weight
+    qlog = [e for e in mgr.rewrite_log
+            if e["pass"] == "post_training_quantize_pass"][0]
+    assert len(qlog["rewrites"]) == 2
+    assert all(r["kind"] == "quantize" for r in qlog["rewrites"])
+    # scale literals equal the per-channel abs-max of the scope weights
+    for rec in qlog["rewrites"]:
+        w = np.asarray(scope.find_var(rec["weight"]))
+        expect = np.max(np.abs(w), axis=0)
+        baked = np.asarray(rec["scale_op"].attrs["values"])
+        np.testing.assert_allclose(baked, expect, rtol=1e-6)
+    # inserted ops keep provenance pointing at the model build site
+    qop = next(op for op in opt.global_block().ops
+               if op.type == "quantize_channel_abs_max")
+    assert qop.name_scope.startswith("fused:")
+
+
+def test_quantize_parity_within_stated_tolerance(quant_on):
+    main, startup, p = _fc_net()
+    scope = Scope()
+    _init(startup, scope)
+    X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    with scope_guard(scope):
+        os.environ.pop("PADDLE_TPU_OPTIMIZE_QUANT")
+        base, = fluid.Executor().run(main, feed={"x": X},
+                                     fetch_list=[p], scope=scope)
+        os.environ["PADDLE_TPU_OPTIMIZE_QUANT"] = "1"
+        q, = fluid.Executor().run(main, feed={"x": X},
+                                  fetch_list=[p], scope=scope)
+    base, q = np.asarray(base), np.asarray(q)
+    assert not np.array_equal(q, base)  # quantization really happened
+    assert np.allclose(q, base, **QUANT_TOLERANCE)
+
+
+def test_wrong_scales_trip_tv(quant_on, monkeypatch):
+    main, startup, p = _fc_net()
+    scope = Scope()
+    _init(startup, scope)
+    monkeypatch.setattr(PostTrainingQuantizePass, "scale_guard", False)
+    with pytest.raises(OptimizerPassError) as e:
+        optimize_program(main, fetch_list=[p.name], scope=scope,
+                         level=2, tv=True)
+    assert any(f.rule == "tv-quantize-scale" for f in e.value.findings)
+
+
+def test_training_weights_never_quantized(quant_on):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[8], dtype="float32")
+        y = L.data(name="y", shape=[1], dtype="float32")
+        pred = L.fc(x, size=1)
+        loss = L.mean(L.square_error_cost(pred, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    scope = Scope()
+    _init(startup, scope)
+    before = _quant_counters()
+    opt, stats = optimize_program(main, fetch_list=[loss.name],
+                                  scope=scope, level=2)
+    types = [op.type for op in opt.global_block().ops]
+    assert "quantize_channel_abs_max" not in types
+    after = _quant_counters()
+    moved = {k: after[k] - before.get(k, 0)
+             for k in after if after[k] != before.get(k, 0)}
+    # every examined weight refused for a counted reason, none rewritten
+    assert all("skipped" in k[0] for k in moved), moved
+    assert any("skipped" in k[0] for k in moved)
+
+
+def test_default_off_moves_zero_quant_counters():
+    assert os.environ.get("PADDLE_TPU_OPTIMIZE_QUANT", "0") == "0"
+    main, startup, p = _fc_net()
+    scope = Scope()
+    _init(startup, scope)
+    before = _quant_counters()
+    optimize_program(main, fetch_list=[p.name], scope=scope, level=2)
+    X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    with scope_guard(scope):
+        fluid.Executor().run(main, feed={"x": X}, fetch_list=[p],
+                             scope=scope)
+    assert _quant_counters() == before
+
+
+def test_quant_knob_rides_config_key(quant_on, monkeypatch):
+    from paddle_tpu.core import passes
+
+    on = passes.config_key()
+    monkeypatch.delenv("PADDLE_TPU_OPTIMIZE_QUANT")
+    off = passes.config_key()
+    assert on != off
+    monkeypatch.setenv("PADDLE_TPU_AMP_RANGE_GUARD", "0")
+    assert passes.config_key() != off
+
+
+# ----------------------------------------------- model-zoo acceptance
+@pytest.mark.parametrize("model", ["mnist", "gpt", "ctr"])
+def test_model_zoo_inference_ptq_verify_tv_and_tolerance(model,
+                                                         quant_on,
+                                                         monkeypatch):
+    """The acceptance gate: int8 PTQ on model-zoo INFERENCE programs
+    passes verify + TV (both forced on through the executor prepare
+    path) and the fetched metric stays within the stated tolerance of
+    the unquantized run."""
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "1")
+    monkeypatch.setenv("PADDLE_TPU_OPTIMIZE_TV", "1")
+    main, startup, loss = lint_cli.build_example(model, optimizer=False)
+    scope = Scope()
+    _init(startup, scope)
+    rng = np.random.RandomState(0)
+    feed = {}
+    for var in main.global_block().vars.values():
+        if not var.is_data:
+            continue
+        shape = [2 if (s is None or s < 0) else int(s)
+                 for s in (var.shape or [2])]
+        if var.dtype.startswith(("int", "uint")):
+            feed[var.name] = rng.randint(0, 2, shape).astype("int64")
+        else:
+            feed[var.name] = rng.uniform(-1, 1, shape).astype("float32")
+    before = _quant_counters()
+    with scope_guard(scope):
+        os.environ.pop("PADDLE_TPU_OPTIMIZE_QUANT")
+        base, = fluid.Executor().run(main, feed=feed, fetch_list=[loss],
+                                     scope=scope)
+        os.environ["PADDLE_TPU_OPTIMIZE_QUANT"] = "1"
+        q, = fluid.Executor().run(main, feed=feed, fetch_list=[loss],
+                                  scope=scope)
+    moved = {k: v for k, v in _quant_counters().items()
+             if v != before.get(k, 0)
+             and "weights_quantized" in k[0]}
+    assert moved, "no weight was quantized on %s" % model
+    base, q = np.asarray(base), np.asarray(q)
+    assert np.allclose(q, base, **QUANT_TOLERANCE), (
+        model, float(np.max(np.abs(q - base))))
+
+
+# ------------------------------------------------ range-aware AMP keep
+def _overflow_amp_net():
+    main, startup = fluid.Program(), fluid.Program()
+    main.set_amp(True)
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        # past the bf16 round-to-nearest midpoint (~3.396e38), so the
+        # bf16 cast rounds to inf; still finite in f32
+        big = L.fill_constant([4], "float32", 3.4019e38)
+        out = L.elementwise_mul(L.sigmoid(x), big)
+    return main, startup, out
+
+
+def test_amp_range_guard_keeps_overflow_prone_ops_f32():
+    def kept():
+        fam = observe.snapshot()["metrics"][
+            "paddle_quant_amp_kept_f32_total"]
+        return fam["samples"][0]["value"] if fam["samples"] else 0
+
+    main, _startup, out = _overflow_amp_net()
+    before = kept()
+    opt, _ = optimize_program(main, fetch_list=[out.name], level=2)
+    stamps = {}
+    for op in opt.global_block().ops:
+        if op.type == "fused_elementwise":
+            for spec in op.attrs["ops"]:
+                stamps[spec["type"]] = spec["attrs"].get("__amp__")
+        else:
+            stamps[op.type] = op.attrs.get("__amp__")
+    assert stamps["elementwise_mul"] == "f32"
+    assert stamps["sigmoid"] == "bf16"  # only the proven op is kept
+    assert kept() == before + 1
+
+
+def test_amp_range_guard_off_keeps_table_policy(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_AMP_RANGE_GUARD", "0")
+    main, _startup, out = _overflow_amp_net()
+    opt, _ = optimize_program(main, fetch_list=[out.name], level=2)
+    stamps = {}
+    for op in opt.global_block().ops:
+        if op.type == "fused_elementwise":
+            for spec in op.attrs["ops"]:
+                stamps[spec["type"]] = spec["attrs"].get("__amp__")
+    assert stamps["elementwise_mul"] == "bf16"
+
+
+def test_amp_range_guard_end_to_end_finite_vs_inf(monkeypatch):
+    """The payoff: with the guard, level 2 returns the finite f32
+    number; without it, the bf16 cast overflows to inf."""
+    X = np.full((2, 4), 9.0, dtype=np.float32)  # sigmoid ~ 1.0
+
+    def run():
+        main, startup, out = _overflow_amp_net()
+        scope = Scope()
+        _init(startup, scope)
+        with scope_guard(scope):
+            v, = fluid.Executor().run(main, feed={"x": X},
+                                      fetch_list=[out], scope=scope)
+        return np.asarray(v)
+
+    guarded = run()
+    assert np.isfinite(guarded).all()
+    monkeypatch.setenv("PADDLE_TPU_AMP_RANGE_GUARD", "0")
+    unguarded = run()
+    assert np.isinf(unguarded).all()
+
+
+# ----------------------------------------------------- quant op numerics
+def test_quant_dequant_roundtrip_matches_reference(quant_on):
+    main, startup = fluid.Program(), fluid.Program()
+    W = np.random.RandomState(3).randn(8, 4).astype(np.float32) * 3.0
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        w = blk.create_var(name="w_in", shape=[8, 4], dtype="float32",
+                           persistable=True)
+        s = blk.create_var(name="s_in", shape=[4], dtype="float32",
+                           persistable=True)
+        q = blk.create_var(name="q_out", shape=[8, 4], dtype="int8")
+        dq = blk.create_var(name="dq_out", shape=[8, 4],
+                            dtype="float32")
+        blk.append_op("quantize_channel_abs_max",
+                      {"X": [w.name], "InScale": [s.name]},
+                      {"Out": [q.name]}, {"axis": 1, "bit_length": 8})
+        blk.append_op("dequantize_channel_abs_max",
+                      {"X": [q.name], "Scales": [s.name]},
+                      {"Out": [dq.name]}, {"axis": 1, "bit_length": 8})
+    scope = Scope()
+    scope.set_var("w_in", W)
+    scales = np.max(np.abs(W), axis=0)
+    scope.set_var("s_in", scales)
+    with scope_guard(scope):
+        got, = fluid.Executor().run(main, fetch_list=[dq], scope=scope)
+    ref = np.clip(np.round(W / scales * 127), -127, 127) * scales / 127
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-6,
+                               atol=1e-7)
+    # the per-weight error bound the tolerance contract leans on
+    assert np.max(np.abs(ref - W)) <= np.max(scales) / 254 + 1e-6
+
+
+def test_amp_range_guard_reads_the_version_the_op_sees():
+    """Review regression: a LATER overwrite of an input name with a
+    huge literal must not retroactively stamp an earlier reader f32 —
+    the guard resolves inputs at the write version the op reads."""
+    from paddle_tpu.analysis.ranges import RangeAnalysis  # noqa: F401
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.set_amp(True)
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        s = L.sigmoid(x)                       # [0, 1]
+        out = L.elementwise_mul(s, s)          # bf16, provably tiny
+        blk = main.global_block()
+        big = L.fill_constant([4], "float32", 3.4019e38)
+        # overwrite s AFTER the mul: its final version is huge
+        blk.append_op("assign", {"X": [big.name]}, {"Out": [s.name]}, {})
+        sink = L.scale(s, scale=1.0)
+    opt, _ = optimize_program(main, fetch_list=[out.name, sink.name],
+                              level=2)
+    stamps = {}
+    for op in opt.global_block().ops:
+        if op.type == "fused_elementwise":
+            for spec in op.attrs["ops"]:
+                stamps.setdefault(spec["type"],
+                                  spec["attrs"].get("__amp__"))
+        else:
+            stamps.setdefault(op.type, op.attrs.get("__amp__"))
+    # the mul read version-1 s ([0,1]): no proven overflow, stays bf16
+    assert stamps["elementwise_mul"] == "bf16", stamps

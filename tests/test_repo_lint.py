@@ -290,3 +290,73 @@ def test_kernel_op_schema_matches_registry():
     assert tuple(sorted(tuple(all_kernels()) + (WINDOW_OP,))) \
         == _KERNEL_OPS
     assert WINDOW_OP not in all_kernels()
+
+
+# ------------------------------------------------ rule 7: range coverage
+def _range_rule_tree(tmp_path, shape_src, range_src):
+    root = tmp_path / "rr"
+    (root / "paddle_tpu" / "analysis").mkdir(parents=True)
+    (root / "paddle_tpu" / "observe").mkdir(parents=True)
+    for d in ("tools", "tests", "examples"):
+        (root / d).mkdir()
+    (root / "paddle_tpu" / "observe" / "families.py").write_text(
+        "REGISTRY = None\n")
+    (root / "paddle_tpu" / "analysis" / "shape_rules.py").write_text(
+        shape_src)
+    (root / "paddle_tpu" / "analysis" / "range_rules.py").write_text(
+        range_src)
+    return str(root)
+
+
+def test_range_rule_coverage_detected(tmp_path):
+    # an op with a shape rule but no range story trips rule 7; the
+    # three registration idioms (literal, *star, for-loop) all resolve
+    shape_src = (
+        "_ACTS = (\"actA\", \"actB\")\n"
+        "register_shape_rule(*_ACTS)(None)\n"
+        "for _t in (\"loopC\",):\n"
+        "    register_shape_rule(_t)(None)\n"
+        "@register_shape_rule(\"litD\", \"uncovE\")\n"
+        "def _r(ctx):\n    pass\n")
+    range_src = (
+        "@register_range_rule(\"actA\", \"litD\")\n"
+        "def _rr(ctx):\n    pass\n"
+        "WIDEN_TO_TOP = (\"actB\", \"loopC\")\n")
+    out = repo_lint.range_rule_coverage_violations(
+        _range_rule_tree(tmp_path, shape_src, range_src))
+    assert len(out) == 1 and "uncovE" in out[0] \
+        and "WIDEN_TO_TOP" in out[0]
+    # covered partition: clean
+    range_src2 = range_src.replace("(\"actB\", \"loopC\")",
+                                   "(\"actB\", \"loopC\", \"uncovE\")")
+    assert repo_lint.range_rule_coverage_violations(
+        _range_rule_tree(tmp_path / "b", shape_src, range_src2)) == []
+    # overlap (declared T with a rule) is a stale declaration
+    range_src3 = range_src2.replace("\"actA\", \"litD\"",
+                                    "\"actA\", \"litD\", \"actB\"")
+    out3 = repo_lint.range_rule_coverage_violations(
+        _range_rule_tree(tmp_path / "c", shape_src, range_src3))
+    assert len(out3) == 1 and "actB" in out3[0] and "stale" in out3[0]
+
+
+def test_range_rule_registrations_match_runtime():
+    """Schema pin: the AST resolver sees exactly what the runtime
+    registries hold — for shape rules AND range rules — so rule 7 can
+    never silently diverge from reality."""
+    import paddle_tpu  # noqa: F401  (fills the registries)
+    from paddle_tpu.analysis.range_rules import WIDEN_TO_TOP
+    from paddle_tpu.analysis.ranges import RANGE_RULES
+    from paddle_tpu.core.registry import OPS
+
+    ast_shaped = repo_lint._rule_registrations(
+        os.path.join(ROOT, repo_lint.SHAPE_RULES_FILE),
+        "register_shape_rule")
+    ast_ranged = repo_lint._rule_registrations(
+        os.path.join(ROOT, repo_lint.RANGE_RULES_FILE),
+        "register_range_rule")
+    assert ast_shaped == {t for t, d in OPS.items()
+                          if d.infer_shape is not None}
+    assert ast_ranged == set(RANGE_RULES)
+    assert repo_lint.declared_widen_to_top(ROOT) == set(WIDEN_TO_TOP)
+    # the partition is total AND disjoint on the real tree
+    assert repo_lint.range_rule_coverage_violations(ROOT) == []

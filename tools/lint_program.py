@@ -12,12 +12,21 @@ program, and reports findings as text or JSON.
     python tools/lint_program.py --json               # machine-readable
     python tools/lint_program.py --min-severity warning
     python tools/lint_program.py --validate           # + optimizer TV
+    python tools/lint_program.py --ranges             # + value ranges
 
 ``--validate`` additionally runs the graph-optimizer pipeline over each
 program with per-pass translation validation FORCED on
 (``analysis/tv.py``) and prints the declared rewrite logs — the
 standalone way to ask "does the optimizer provably preserve this
 program?" without executing anything.
+
+``--ranges`` additionally runs the value-range abstract interpreter
+(``analysis/ranges.py``) over each train program and prints the per-var
+interval table (text) or embeds it per model (JSON: each model maps to
+``{"findings", "ranges", "range_stats"}`` instead of a bare findings
+list). The numerics lint rules (bf16-overflow / domain-violation /
+int-narrowing-loss) always ride the ordinary verify, so an
+error-severity numerics finding exits 1 with or without the flag.
 
 Exit code: 0 = no error findings (and, with --validate, every program
 optimized TV-clean), 1 = at least one error or TV violation, 2 = bad
@@ -180,6 +189,9 @@ def main(argv=None):
                    help="also run the optimizer pipeline with per-pass "
                         "translation validation forced ON; print the "
                         "rewrite logs, exit 1 on any violation")
+    p.add_argument("--ranges", action="store_true",
+                   help="also run the value-range abstract interpreter "
+                        "and print per-var intervals")
     args = p.parse_args(argv)
 
     order = {"info": 0, "warning": 1, "error": 2}
@@ -187,7 +199,8 @@ def main(argv=None):
     report = {}
     n_errors = 0
     for name in names:
-        findings, _ = verify_example(name, optimize=not args.no_optimizer)
+        findings, (main, _startup) = verify_example(
+            name, optimize=not args.no_optimizer)
         shown = [f for f in findings
                  if order[f.severity] >= order[args.min_severity]]
         n_errors += sum(1 for f in findings if f.severity == "error")
@@ -201,16 +214,48 @@ def main(argv=None):
                      sum(1 for f in findings if f.severity == "info")))
             for f in shown:
                 print("   " + f.format())
+        if args.ranges:
+            report[name] = _ranges_report(name, main, shown,
+                                          quiet=args.json)
         if args.validate:
             n_errors += _validate_example(
                 name, optimizer=not args.no_optimizer,
                 quiet=args.json)
     if args.json:
-        json.dump({name: [f.to_dict() for f in fs]
-                   for name, fs in report.items()},
+        json.dump({name: (rep if isinstance(rep, dict)
+                          else [f.to_dict() for f in rep])
+                   for name, rep in report.items()},
                   sys.stdout, indent=2)
         sys.stdout.write("\n")
     return 1 if n_errors else 0
+
+
+def _ranges_report(name, main, shown, quiet=False):
+    """Run the range engine over one example's train program; print the
+    interval table (text mode) and return the JSON-shaped report entry
+    ``{"findings", "ranges", "range_stats"}``."""
+    import math
+
+    from paddle_tpu.analysis.ranges import RangeAnalysis
+
+    ra = RangeAnalysis(main)
+    stats = ra.stats()
+
+    def _num(x):
+        return None if not math.isfinite(x) else x
+
+    ranges = {vname: {"lo": _num(av.lo), "hi": _num(av.hi),
+                      "finite": av.finite, "integral": av.integral,
+                      "const": av.is_const}
+              for vname, av in ra.table()}
+    if not quiet:
+        print("   -- ranges: %(vars)d vars (%(const)d const, "
+              "%(bounded)d bounded, %(finite)d finite, %(top)d top, "
+              "%(declared_top)d declared-top)" % stats)
+        for vname, av in ra.table():
+            print("   %-48s %r" % (vname, av))
+    return {"findings": [f.to_dict() for f in shown], "ranges": ranges,
+            "range_stats": stats}
 
 
 def _validate_example(name, optimizer=True, quiet=False) -> int:

@@ -52,7 +52,7 @@ import numpy as np  # noqa: E402
 D = 8  # feature width of every generated tensor
 B = 4  # feed batch rows
 
-_UNARY = ("relu", "tanh", "sigmoid")
+_UNARY = ("relu", "tanh", "sigmoid", "gelu", "softplus", "square")
 _BINARY = ("elementwise_add", "elementwise_sub", "elementwise_mul",
            "elementwise_max", "elementwise_min")
 
@@ -99,13 +99,22 @@ def gen_program(seed):
                     # shared subexpression: REPLAY an earlier recipe
                     # verbatim — structurally identical ops, CSE fodder
                     emit(*recipes[rng.randrange(len(recipes))])
-                elif roll < 0.72:
+                elif roll < 0.70:
                     emit("copy", (rng.randrange(len(vals)),))
-                elif roll < 0.80:
+                elif roll < 0.76:
                     emit("const_chain", (round(rng.uniform(0.5, 2.0), 3),
                                          rng.randint(1, 4),
                                          rng.randrange(len(vals))))
-                elif roll < 0.86:
+                elif roll < 0.80:
+                    emit("clip", (round(rng.uniform(-1.0, -0.1), 3),
+                                  round(rng.uniform(0.1, 1.0), 3),
+                                  rng.randrange(len(vals))))
+                elif roll < 0.84:
+                    # fake-quantize simulation: pure, deterministic,
+                    # CSE/fold-adjacent (quant-dequant of a live value)
+                    emit("fake_quantize", (len(recipes),
+                                           rng.randrange(len(vals))))
+                elif roll < 0.88:
                     emit("dropout", (rng.choice((0.2, 0.5)),
                                      rng.randrange(len(vals))))
                 elif roll < 0.92:
@@ -155,8 +164,33 @@ def _apply(L, vals, kind, payload):
     elif kind == "dropout":
         p, i = payload
         vals.append(L.dropout(vals[i % len(vals)], dropout_prob=p))
+    elif kind == "clip":
+        lo, hi, i = payload
+        vals.append(L.clip(vals[i % len(vals)], min=lo, max=hi))
+    elif kind == "fake_quantize":
+        tag, i = payload
+        vals.append(_fake_quantize(vals[i % len(vals)], tag))
     else:  # pragma: no cover - recipe vocabulary is closed
         raise ValueError(kind)
+
+
+def _fake_quantize(x, tag):
+    """Append a fake_quantize_abs_max op by hand (no layers wrapper —
+    the quant family enters programs through transpilers). A REPLAYED
+    recipe (shared-subexpression fodder) re-emits the same op over the
+    same input but needs fresh output names, so the name carries both
+    the recipe tag and the input it quantizes."""
+    block = x.block
+    base = "fz_fq_%s_%s" % (tag, x.name.replace("@", "_"))
+    n = 0
+    while block.has_var("%s_%d.out" % (base, n)):
+        n += 1
+    out = block.create_var(name="%s_%d.out" % (base, n), dtype="float32")
+    sc = block.create_var(name="%s_%d.scale" % (base, n), dtype="float32")
+    block.append_op("fake_quantize_abs_max", {"X": [x.name]},
+                    {"Out": [out.name], "OutScale": [sc.name]},
+                    {"bit_length": 8})
+    return out
 
 
 def _sgd(block, param, grad, lr):
@@ -206,15 +240,33 @@ def _cond_block(fluid, L, rng, vals):
 
 
 # ----------------------------------------------------------- harness
-def run_program(main, startup, feed, fetch, level, steps=2):
+@contextlib.contextmanager
+def _env_overrides(env):
+    old = {}
+    for k, v in (env or {}).items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_program(main, startup, feed, fetch, level, steps=2, env=None):
     """Run ``steps`` executor steps at the given optimize level in a
-    fresh scope; returns (per-step fetch bytes, persistable bytes)."""
+    fresh scope; returns (per-step fetch arrays, persistable arrays).
+    ``env`` holds extra environment overrides for the run (the quantize
+    corpus entry opts the PTQ pass in with it)."""
     import paddle_tpu as fluid
     from paddle_tpu.core.scope import Scope, scope_guard
 
-    old = os.environ.get("PADDLE_TPU_OPTIMIZE")
-    os.environ["PADDLE_TPU_OPTIMIZE"] = str(level)
-    try:
+    overrides = dict(env or {})
+    overrides["PADDLE_TPU_OPTIMIZE"] = str(level)
+    with _env_overrides(overrides):
         scope = Scope()
         with scope_guard(scope):
             exe = fluid.Executor()
@@ -223,41 +275,50 @@ def run_program(main, startup, feed, fetch, level, steps=2):
             for _ in range(steps):
                 vals = exe.run(main, feed=dict(feed) if feed else None,
                                fetch_list=list(fetch), scope=scope)
-                outs.append([np.asarray(v).tobytes() for v in vals])
+                outs.append([np.asarray(v) for v in vals])
             persist = {}
             for var in main.global_block().vars.values():
                 if var.persistable and scope.has_var(var.name):
                     persist[var.name] = np.asarray(
-                        scope.find_var(var.name)).tobytes()
+                        scope.find_var(var.name))
         return outs, persist
-    finally:
-        if old is None:
-            os.environ.pop("PADDLE_TPU_OPTIMIZE", None)
-        else:
-            os.environ["PADDLE_TPU_OPTIMIZE"] = old
 
 
-def diff_run(main, startup, feed, fetch, steps=2):
-    """Differential check: level 2 vs level 0, bitwise. Returns a list
-    of mismatch descriptions (empty = clean). An OptimizerPassError or
-    execution failure at level 2 is reported as a failure, never
-    swallowed."""
+def _arrays_match(a, b, tolerance):
+    if tolerance is None:
+        return a.tobytes() == b.tobytes()
+    return a.shape == b.shape and bool(np.allclose(a, b, **tolerance))
+
+
+def diff_run(main, startup, feed, fetch, steps=2, tolerance=None,
+             env=None):
+    """Differential check: level 2 vs level 0. BITWISE by default;
+    ``tolerance`` (an ``np.allclose`` kwargs dict) switches to the
+    stated-tolerance parity harness — the contract for QUANTIZED
+    programs only, where bitwise is impossible by design. Returns a
+    list of mismatch descriptions (empty = clean). An
+    OptimizerPassError or execution failure at level 2 is reported as a
+    failure, never swallowed."""
     base, base_p = run_program(main, startup, feed, fetch, level=0,
-                               steps=steps)
+                               steps=steps, env=env)
     try:
         opt, opt_p = run_program(main, startup, feed, fetch, level=2,
-                                 steps=steps)
+                                 steps=steps, env=env)
     except Exception as e:  # OptimizerPassError, lowering KeyError, ...
         return ["level-2 run failed: %s: %s" % (type(e).__name__, e)]
+    word = "bitwise" if tolerance is None else (
+        "beyond tolerance %r" % (tolerance,))
     problems = []
     for s, (a, b) in enumerate(zip(base, opt)):
         for i, (va, vb) in enumerate(zip(a, b)):
-            if va != vb:
-                problems.append("step %d fetch %r differs bitwise"
-                                % (s, fetch[i]))
+            if not _arrays_match(va, vb, tolerance):
+                problems.append("step %d fetch %r differs %s"
+                                % (s, fetch[i], word))
     for name in sorted(set(base_p) | set(opt_p)):
-        if base_p.get(name) != opt_p.get(name):
-            problems.append("persistable %r differs bitwise" % name)
+        pa, pb = base_p.get(name), opt_p.get(name)
+        if pa is None or pb is None or not _arrays_match(pa, pb,
+                                                         tolerance):
+            problems.append("persistable %r differs %s" % (name, word))
     return problems
 
 
@@ -324,6 +385,20 @@ def _corpus_optimizer_group_reorder(fluid, L):
     _sgd(w2.block, w2, L.scale(w2, scale=1.0), lr)
     out = L.reduce_mean(mid)
     return [out.name]
+
+
+def _corpus_quantize_wrong_scale(fluid, L):
+    """PR 14: the int8 PTQ pass with deliberately wrong (quartered)
+    per-channel scales — values past 25% of the channel max clip, so
+    the dequantized weight is badly wrong. The guarded pipeline must
+    stay within the stated QUANT_TOLERANCE; the knocked-out one must
+    trip the TV quantize-record scale check, and with validation off
+    the parity harness must catch the real accuracy hole."""
+    x = L.data(name="x", shape=[D], dtype="float32")
+    w = L.create_parameter([D, D], "float32", name="qws_w")
+    h = L.mul(x, w)
+    out = L.reduce_mean(L.tanh(h))
+    return [out.name, h.name]
 
 
 def _corpus_fused_replay_raw(fluid, L):
@@ -426,6 +501,15 @@ def _knockout_materialize():
         yield
 
 
+@contextlib.contextmanager
+def _knockout_quant_scale():
+    from paddle_tpu.core.passes.quantize_pass import \
+        PostTrainingQuantizePass as P
+
+    with _patch_attr(P, "scale_guard", False):
+        yield
+
+
 CORPUS = {
     "cse_write_versioning": (_corpus_cse_write_versioning, _knockout_cse),
     "copy_prop_aliasing": (_corpus_copy_prop_aliasing,
@@ -437,7 +521,31 @@ CORPUS = {
     "optimizer_group_reorder": (_corpus_optimizer_group_reorder,
                                 _knockout_group_adjacency),
     "fused_replay_raw": (_corpus_fused_replay_raw, _knockout_replay_raw),
+    "quantize_wrong_scale": (_corpus_quantize_wrong_scale,
+                             _knockout_quant_scale),
 }
+
+# per-entry deviations from the bitwise default: the quantize entry
+# opts the PTQ pass in, compares under the pass's STATED tolerance (the
+# quantized-programs-only parity contract), and needs the run scope
+# (the pass derives scales from concrete scope weights, and the TV
+# check re-derives them from the same scope).
+CORPUS_CFG = {
+    "quantize_wrong_scale": {
+        "env": {"PADDLE_TPU_OPTIMIZE_QUANT": "1"},
+        "tolerance": "QUANT_TOLERANCE",  # resolved from quantize_pass
+        "needs_scope": True,
+    },
+}
+
+
+def _corpus_cfg(name):
+    cfg = dict(CORPUS_CFG.get(name, ()))
+    if cfg.get("tolerance") == "QUANT_TOLERANCE":
+        from paddle_tpu.core.passes.quantize_pass import QUANT_TOLERANCE
+
+        cfg["tolerance"] = dict(QUANT_TOLERANCE)
+    return cfg
 
 
 def build_corpus_program(name):
@@ -458,23 +566,44 @@ def build_corpus_program(name):
     return main, startup, feed, fetch
 
 
+def _corpus_scope(main, startup, env):
+    """Fresh scope with the startup program run (the quantize entry's
+    pass + TV check both need concrete weights)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    scope = Scope()
+    with _env_overrides(env), scope_guard(scope):
+        fluid.Executor().run(startup, scope=scope)
+    return scope
+
+
 def corpus_check(name):
     """Three-way proof for one corpus entry (see module docstring):
     returns {"clean": [...], "tv_trips": bool, "tv_rules": [...],
-    "miscompiles": bool, "knocked_out_problems": [...]}."""
+    "miscompiles": bool, "knocked_out_problems": [...]}. Entries with a
+    CORPUS_CFG row run under its env/tolerance/scope config (the
+    quantize entry's parity leg is the stated-tolerance harness, not
+    bitwise)."""
     from paddle_tpu.core.passes import OptimizerPassError, optimize_program
 
     _builder, knockout = CORPUS[name]
+    cfg = _corpus_cfg(name)
+    env = cfg.get("env")
+    tolerance = cfg.get("tolerance")
     result = {}
     # (a) guarded pipeline: differentially clean
     main, startup, feed, fetch = build_corpus_program(name)
-    result["clean"] = diff_run(main, startup, feed, fetch)
+    result["clean"] = diff_run(main, startup, feed, fetch,
+                               tolerance=tolerance, env=env)
     # (b) guard knocked out: the translation validator trips
-    with knockout():
+    with knockout(), _env_overrides(env):
         main, startup, feed, fetch = build_corpus_program(name)
+        scope = _corpus_scope(main, startup, env) \
+            if cfg.get("needs_scope") else None
         try:
             optimize_program(main, fetch_list=list(fetch), level=2,
-                             verify=False, tv=True)
+                             scope=scope, verify=False, tv=True)
             result["tv_trips"] = False
             result["tv_rules"] = []
         except OptimizerPassError as e:
@@ -482,20 +611,11 @@ def corpus_check(name):
             result["tv_rules"] = sorted(
                 {getattr(f, "rule", "?") for f in e.findings})
         # (c) guard out AND validation off: the miscompile is REAL
-        old_tv = os.environ.get("PADDLE_TPU_OPTIMIZE_TV")
-        old_vf = os.environ.get("PADDLE_TPU_OPTIMIZE_VERIFY")
-        os.environ["PADDLE_TPU_OPTIMIZE_TV"] = "0"
-        os.environ["PADDLE_TPU_OPTIMIZE_VERIFY"] = "0"
-        try:
-            main, startup, feed, fetch = build_corpus_program(name)
-            problems = diff_run(main, startup, feed, fetch)
-        finally:
-            for key, val in (("PADDLE_TPU_OPTIMIZE_TV", old_tv),
-                             ("PADDLE_TPU_OPTIMIZE_VERIFY", old_vf)):
-                if val is None:
-                    os.environ.pop(key, None)
-                else:
-                    os.environ[key] = val
+        main, startup, feed, fetch = build_corpus_program(name)
+        problems = diff_run(
+            main, startup, feed, fetch, tolerance=tolerance,
+            env=dict(env or {}, PADDLE_TPU_OPTIMIZE_TV="0",
+                     PADDLE_TPU_OPTIMIZE_VERIFY="0"))
         result["miscompiles"] = bool(problems)
         result["knocked_out_problems"] = problems
     return result

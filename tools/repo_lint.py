@@ -45,6 +45,23 @@ tests/test_repo_lint.py):
    outside the pre-materialized schema. Dynamic sites (variables,
    concatenation, the env-plan parser) are skipped like rule 3's.
 
+7. **range-rule-coverage** — the value-range abstract interpreter
+   (``analysis/ranges.py``) must never widen a *shape-ruled* op
+   silently: every op type registered with ``register_shape_rule`` in
+   ``analysis/shape_rules.py`` must either carry a
+   ``register_range_rule`` transfer function in
+   ``analysis/range_rules.py`` or be listed in that module's explicit
+   ``WIDEN_TO_TOP`` declaration — and the two sets must be disjoint
+   (a declared-⊤ op with a rule is a stale declaration). This keeps
+   the partition TOTAL over the checkable op vocabulary (a superset of
+   what appears in model-zoo programs — the runtime schema-pin test in
+   tests/test_ranges.py holds the model-zoo subset against reality),
+   so growing an op a shape rule without deciding its range story
+   fails CI. Registrations are resolved through the three idioms the
+   rule files use: literal decorator/call args, ``*NAME`` star-args
+   against module-level tuple assignments, and ``for V in (...)``
+   loops over literal tuples.
+
 Usage: ``python tools/repo_lint.py [--root DIR]``; exit 1 on violations.
 """
 
@@ -348,6 +365,99 @@ def kernel_registry_violations(root: str, files=None) -> List[str]:
     return violations
 
 
+SHAPE_RULES_FILE = os.path.join("paddle_tpu", "analysis",
+                                "shape_rules.py")
+RANGE_RULES_FILE = os.path.join("paddle_tpu", "analysis",
+                                "range_rules.py")
+
+
+def _rule_registrations(path: str, fn_name: str) -> Set[str]:
+    """Op types registered via ``fn_name(...)`` in one rule file,
+    resolving the three registration idioms: literal string args,
+    ``*NAME`` star-args against module-level tuple/list assignments,
+    and ``for V in (...):`` loops over literal tuples."""
+    tree = _parse(path)
+    tuples: Dict[str, Set[str]] = {}
+    loop_vars: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Tuple, ast.List)):
+            elts = {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tuples[t.id] = elts
+        elif isinstance(node, ast.For) and isinstance(
+                node.target, ast.Name) and isinstance(
+                node.iter, (ast.Tuple, ast.List)):
+            loop_vars[node.target.id] = {
+                e.value for e in node.iter.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)}
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name != fn_name:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str):
+                out.add(arg.value)
+            elif isinstance(arg, ast.Starred) and isinstance(
+                    arg.value, ast.Name):
+                out.update(tuples.get(arg.value.id, ()))
+            elif isinstance(arg, ast.Name):
+                out.update(loop_vars.get(arg.id, ()))
+                out.update(tuples.get(arg.id, ()))
+    return out
+
+
+def declared_widen_to_top(root: str) -> Set[str]:
+    """String elements of range_rules.py's ``WIDEN_TO_TOP`` tuple."""
+    tree = _parse(os.path.join(root, RANGE_RULES_FILE))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "WIDEN_TO_TOP"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return set()
+
+
+def range_rule_coverage_violations(root: str) -> List[str]:
+    """Rule 7: shape-ruled op types must be range-ruled or declared in
+    WIDEN_TO_TOP, and those two sets must be disjoint."""
+    shape_path = os.path.join(root, SHAPE_RULES_FILE)
+    range_path = os.path.join(root, RANGE_RULES_FILE)
+    if not os.path.exists(shape_path) or not os.path.exists(range_path):
+        return []  # synthetic trees without the analysis package
+    shaped = _rule_registrations(shape_path, "register_shape_rule")
+    ranged = _rule_registrations(range_path, "register_range_rule")
+    widen = declared_widen_to_top(root)
+    violations = []
+    for t in sorted(shaped - ranged - widen):
+        violations.append(
+            "%s: op type %r has a shape rule but neither a range "
+            "transfer rule in %s nor a WIDEN_TO_TOP declaration (the "
+            "range engine would widen it SILENTLY — decide its range "
+            "story)" % (SHAPE_RULES_FILE, t, RANGE_RULES_FILE))
+    for t in sorted(ranged & widen):
+        violations.append(
+            "%s: op type %r is declared WIDEN_TO_TOP but also has a "
+            "range transfer rule (stale declaration — remove one)"
+            % (RANGE_RULES_FILE, t))
+    return violations
+
+
 def run(root: str = REPO_ROOT) -> List[str]:
     """All violations (empty list = clean). tests/test_repo_lint.py
     asserts on this."""
@@ -355,7 +465,8 @@ def run(root: str = REPO_ROOT) -> List[str]:
             + trace_site_violations(root)
             + pass_docstring_violations(root)
             + kernel_registry_violations(root)
-            + fault_site_violations(root))
+            + fault_site_violations(root)
+            + range_rule_coverage_violations(root))
 
 
 def main(argv=None) -> int:
