@@ -161,6 +161,45 @@ def _mfu_fields(step_flops, steps, dt, peak):
     }
 
 
+def _peak_bytes_fields(main, feed, fetch_list, scope=None, spc=1,
+                       exe=None):
+    """``peak_bytes_predicted`` (the static liveness-based estimate,
+    analysis/memory.py) next to ``peak_bytes_xla`` (XLA's own
+    memory_analysis of the compiled step) — both number-or-null, NEVER
+    0.0, per the PR 12 MFU convention: an unmeasurable value must not
+    masquerade as a measured zero. Estimation failures null the field
+    instead of failing the row."""
+    out = {"peak_bytes_predicted": None, "peak_bytes_xla": None}
+    try:
+        from paddle_tpu.analysis.memory import MemoryAnalysis
+
+        batch = 1
+        for v in (feed or {}).values():
+            shape = np.shape(v)
+            if shape:
+                batch = max(1, int(shape[0]))
+                break
+        names = [getattr(v, "name", str(v)) for v in (fetch_list or [])]
+        pk = MemoryAnalysis(main, fetch_names=names, scope=scope,
+                            site="bench").peak_bytes(
+                                batch, steps_per_call=spc)
+        out["peak_bytes_predicted"] = int(pk) or None
+    except Exception:
+        pass
+    if exe is not None:
+        try:
+            from paddle_tpu.contrib.memory_usage_calc import \
+                compiled_memory_usage
+
+            xla = compiled_memory_usage(exe, main, feed,
+                                        fetch_list=fetch_list,
+                                        scope=scope)
+            out["peak_bytes_xla"] = int(xla) if xla else None
+        except Exception:
+            pass
+    return out
+
+
 def _fused_attention_on():
     from paddle_tpu.ops.attention import fused_attention_enabled
 
@@ -481,6 +520,10 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # null (never 0.0) when the backend produced no flop count
             # or the chip peak is unknown — see _mfu_fields
             **_mfu_fields(step_flops, steps, dt, peak),
+            # static peak-HBM estimate next to XLA's compiled number
+            # (analysis/memory.py; number-or-null, never 0.0)
+            **_peak_bytes_fields(main, feed, [loss], scope=scope,
+                                 spc=spc, exe=exe),
         }
         print(json.dumps(rec), flush=True)
         return rec
@@ -907,6 +950,11 @@ def bench_deepfm_dist(amp, quick, uses_flash=False):
             # dense-half flop count alone would be a lie — unmeasured
             "tflops_per_sec": None,
             "mfu": None,
+            # trainer-side static estimate only (the PS-resident tables
+            # live in other processes; no XLA number for the RPC step)
+            **{k: v for k, v in _peak_bytes_fields(
+                prog, feed, [loss], scope=scope).items()
+               if k == "peak_bytes_predicted"},
         }
         print(json.dumps(rec), flush=True)
         return rec
@@ -946,6 +994,9 @@ def _serving_row(name, value, unit, lat_s, extra):
         "vs_baseline": 1.0,
         "tflops_per_sec": None,  # scheduler-bound; MFU is not the story
         "mfu": None,
+        # engines that expose a byte model override this via extra
+        # (number-or-null, never 0.0 — the MFU convention)
+        "peak_bytes_predicted": None,
     }
     rec.update(extra)
     print(json.dumps(rec), flush=True)
@@ -1033,6 +1084,7 @@ def bench_serving_decode(amp, quick, uses_flash=False):
             "serving_gpt_decode_tokens_per_sec", tps, "tokens/sec", lat,
             {"b_max": b_max, "requests": n_req, "n_new": n_new,
              **({"quick": True} if quick else {}),
+             "peak_bytes_predicted": engine.predicted_resident_bytes(),
              "mean_occupancy": round((occ1[1] - occ0[1]) / steps, 3)
              if steps else None})
     finally:
@@ -1201,6 +1253,10 @@ def bench_serving_fleet(amp, quick, uses_flash=False):
             {"fleet": True, "replicas": 2, "b_max": b_max,
              "requests": n_req, "n_new": n_new,
              **({"quick": True} if quick else {}),
+             # per-replica resident bytes (replicas share the model
+             # shape, so one replica's number describes each)
+             "peak_bytes_predicted":
+                 router.replicas[0].engine.predicted_resident_bytes(),
              "prefix_share": 0.8,
              "p50_ms": (None if stats["p50_ms"] is None
                         else round(stats["p50_ms"], 2)),
@@ -1263,6 +1319,9 @@ def bench_elastic(amp, quick, uses_flash=False):
         "vs_baseline": 1.0,
         "tflops_per_sec": None,
         "mfu": None,
+        # null, never 0.0: the demo programs live in worker
+        # subprocesses — this process has nothing to analyze
+        "peak_bytes_predicted": None,
         # elastic workers drive resilient_train_loop at its default
         # per-step dispatch (recorded like every train row)
         "steps_per_call": 1,
@@ -1356,6 +1415,11 @@ def bench_quantized(amp, quick, uses_flash=False):
                                        fetch_list=[loss], scope=scope)
                     float(np.asarray(qv).reshape(-1)[0])  # block
                     dt = time.perf_counter() - t0
+                    # inside the env window: the XLA number must come
+                    # from the QUANTIZED plan (the config-keyed cache
+                    # would re-prepare unquantized once the env resets)
+                    peak_fields = _peak_bytes_fields(
+                        main, feed, [loss], scope=scope, exe=qexe)
                 finally:
                     if old is None:
                         os.environ.pop("PADDLE_TPU_OPTIMIZE_QUANT", None)
@@ -1387,6 +1451,10 @@ def bench_quantized(amp, quick, uses_flash=False):
                 "vs_baseline": 1.0,
                 "tflops_per_sec": None,
                 "mfu": None,
+                # source-program static estimate next to the compiled
+                # QUANTIZED plan's XLA number (captured inside the env
+                # window above): the memory payoff of PTQ
+                **peak_fields,
                 **({"quick": True} if quick else {}),
             }
             print(json.dumps(rec), flush=True)

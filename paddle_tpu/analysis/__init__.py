@@ -24,6 +24,9 @@ from .infer import (Finding, InferContext, InferError,  # noqa: F401
                     ProgramVerifyError, infer_program_shapes,
                     validation_enabled, verify_program)
 from .lint import LINT_RULES, lint_program  # noqa: F401
+from .memory import (BytesPoly, MemoryAnalysis,  # noqa: F401
+                     decode_cache_bytes, device_budget,
+                     estimate_peak_bytes, register_footprint_rule)
 from .ranges import (AbstractValue, Calibration,  # noqa: F401
                      RangeAnalysis, RangeContext, register_range_rule)
 from .tv import (ProgramSnapshot, RewriteViolation,  # noqa: F401
@@ -31,20 +34,26 @@ from .tv import (ProgramSnapshot, RewriteViolation,  # noqa: F401
 
 __all__ = [
     "AbstractValue",
+    "BytesPoly",
     "Calibration",
     "Dataflow",
     "Finding",
     "InferContext",
     "InferError",
     "LINT_RULES",
+    "MemoryAnalysis",
     "ProgramSnapshot",
     "ProgramVerifyError",
     "RangeAnalysis",
     "RangeContext",
     "RewriteViolation",
+    "decode_cache_bytes",
     "describe_rewrites",
+    "device_budget",
+    "estimate_peak_bytes",
     "infer_program_shapes",
     "lint_program",
+    "register_footprint_rule",
     "register_range_rule",
     "tv_enabled",
     "validate_rewrite",

@@ -66,6 +66,12 @@ RULES = (
     "bf16-overflow",      # bf16-policied op provably exceeds bf16 max
     "domain-violation",   # exp/log/sqrt/div input provably out of domain
     "int-narrowing-loss",  # int narrowing provably loses values
+    # memory-engine-powered rules (analysis/memory.py peak-HBM model).
+    # The budget rules are PROVABLE-ONLY too: without a configured
+    # device budget (PADDLE_TPU_DEVICE_HBM_BYTES) they stay silent
+    "memory-over-budget",  # predicted peak exceeds device HBM at B=1
+    "max-safe-batch",     # largest batch that fits the device budget
+    "dead-persistable",   # persistable resident but never read/written
 )
 
 

@@ -488,6 +488,125 @@ def rule_int_narrowing_loss(program, ctx, findings):
                 var=name))
 
 
+# ------------------------------------------------- memory (memory engine)
+def _memory_of(program, ctx):
+    """ONE shared MemoryAnalysis per lint run (the dataflow-sharing
+    idiom); built lazily — the budget rules early-return without a
+    configured device budget, so ordinary verify runs never pay it.
+    ``infer=False``: every lint entry runs shape inference first."""
+    ma = ctx.get("memory")
+    if ma is None:
+        from .memory import MemoryAnalysis
+
+        ma = MemoryAnalysis(program,
+                            fetch_names=ctx.get("fetch_names") or (),
+                            scope=ctx.get("scope"), infer=False,
+                            dataflow=ctx.get("dataflow"), site="lint")
+        ctx["memory"] = ma
+    return ma
+
+
+def rule_memory_budget(program, ctx, findings):
+    """OOM before compile. With a configured device budget
+    (``PADDLE_TPU_DEVICE_HBM_BYTES``): a program whose predicted peak
+    exceeds the budget ALREADY AT BATCH SIZE 1 cannot fit at any batch
+    size (every byte polynomial is monotone in B) — error naming the
+    peak op and its largest live tensors with PR 5 provenance. When
+    B=1 fits but the peak grows with B, the max safe batch solved from
+    the closed batch form is reported as an info. Provable-only: no
+    budget, no findings — and the estimate's known slack (it cannot
+    see XLA buffer reuse) only ever DELAYS the error, never fires it
+    on a program that fits."""
+    from .memory import device_budget, format_bytes
+
+    if ctx.get("_memory_budget_ran"):
+        return  # listed under BOTH rule names; one run emits both kinds
+    ctx["_memory_budget_ran"] = True
+    # honor the caller's rules= filter per finding KIND: one shared run
+    # must not emit a rule the caller excluded
+    active = ctx.get("active_rules")
+    emit_over = active is None or "memory-over-budget" in active
+    emit_safe = active is None or "max-safe-batch" in active
+    budget = device_budget()
+    if budget is None:
+        return
+    block = program.global_block()
+    ma = _memory_of(program, ctx)
+    peak, pos = ma.peak(1)
+    if peak > budget:
+        if not emit_over:
+            return
+        top = ma.top_tensors(1, k=3)
+        live = "; ".join(
+            "%s %s (%s%s)" % (
+                t["name"], format_bytes(t["bytes"]), t["kind"],
+                ", defined at %s" % t["def_site"] if t["def_site"]
+                else "")
+            for t in top)
+        if pos >= 0:
+            findings.append(finding_for_op(
+                "memory-over-budget", "error",
+                "predicted peak %s at batch size 1 exceeds the device "
+                "budget %s — largest live tensors: %s"
+                % (format_bytes(peak), format_bytes(budget), live),
+                block, ma.df.ops[pos]))
+        else:
+            findings.append(Finding(
+                "memory-over-budget", "error",
+                "predicted resident bytes %s exceed the device budget "
+                "%s — largest tensors: %s"
+                % (format_bytes(peak), format_bytes(budget), live)))
+        return
+    if not emit_safe or not ma.batch_dependent():
+        return
+    safe = ma.max_safe_batch(budget)
+    if safe is None:
+        return  # never reaches the budget at any sane batch size
+    peak_at = ma.peak_bytes(safe)
+    findings.append(Finding(
+        "max-safe-batch", "info",
+        "predicted peak is %s per the batch form (%s bytes); the "
+        "largest batch size fitting the %s device budget is %d "
+        "(peak %s there)"
+        % (format_bytes(peak), ma.peak_poly(safe).describe(),
+           format_bytes(budget), safe, format_bytes(peak_at))))
+
+
+def rule_dead_persistable(program, ctx, findings):
+    """A declared persistable var that NO op reads or writes anywhere
+    (and nothing fetches) is resident HBM bought for nothing — unlike
+    a dead temp (the dead-var warning, which skips persistables), it
+    occupies device memory for the process lifetime (warning, with the
+    wasted bytes when the shape is known)."""
+    from .memory import BytesPoly, format_bytes
+
+    fetch_names = set(ctx.get("fetch_names") or ())
+    referenced: Set[str] = set()
+    for block in program.blocks:
+        for op in block.ops:
+            referenced.update(op.input_names())
+            referenced.update(op.output_names())
+            cond = op.attrs.get("condition")
+            if cond:
+                referenced.add(cond)
+            referenced.update(op.attrs.get("__sub_bound__", ()))
+    for block in program.blocks:
+        for name, var in block.vars.items():
+            if not var.persistable:
+                continue
+            if name in referenced or name in fetch_names:
+                continue
+            poly = BytesPoly.from_shape(var.shape, var.dtype or "float32")
+            size = "" if poly is None else \
+                " (%s resident)" % format_bytes(poly.at(1))
+            findings.append(Finding(
+                "dead-persistable", "warning",
+                "persistable %r is declared in block %d but no op "
+                "reads or writes it%s — resident device memory bought "
+                "for nothing" % (name, block.idx, size), var=name,
+                block_idx=block.idx))
+
+
 def rule_double_write(program, ctx, findings):
     """Two writes to a persistable var with no read between them: the
     first write is lost state (warning)."""
@@ -604,6 +723,9 @@ LINT_RULES = {
     "bf16-overflow": rule_bf16_overflow,
     "domain-violation": rule_domain_violation,
     "int-narrowing-loss": rule_int_narrowing_loss,
+    "memory-over-budget": rule_memory_budget,
+    "max-safe-batch": rule_memory_budget,
+    "dead-persistable": rule_dead_persistable,
 }
 
 # rules that consult the dataflow engine: lint_program builds ONE
@@ -613,7 +735,8 @@ LINT_RULES = {
 # want the dataflow too (version-accurate reads).
 _DATAFLOW_RULES = ("dead-op", "dead-store", "write-after-write",
                    "use-before-init", "bf16-overflow",
-                   "domain-violation", "int-narrowing-loss")
+                   "domain-violation", "int-narrowing-loss",
+                   "memory-over-budget", "max-safe-batch")
 
 
 def lint_program(program: Program, fetch_names: Sequence[str] = (),
@@ -625,7 +748,10 @@ def lint_program(program: Program, fetch_names: Sequence[str] = (),
     rules' intervals with observed per-var min/max."""
     findings = findings if findings is not None else []
     ctx = {"fetch_names": list(fetch_names), "scope": scope,
-           "calibration": calibration}
+           "calibration": calibration,
+           # the memory-budget rule runs once for its two rule names
+           # and needs the filter to emit only the selected kinds
+           "active_rules": None if rules is None else set(rules)}
     active = [name for name in LINT_RULES
               if rules is None or name in rules]
     if any(name in _DATAFLOW_RULES for name in active):

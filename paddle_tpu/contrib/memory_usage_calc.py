@@ -2,11 +2,19 @@
 
 Reference: python/paddle/fluid/contrib/memory_usage_calc.py:46
 (`memory_usage(program, batch_size)` — sums var sizes with -1 dims
-taken as the batch). The TPU build keeps that quick shape-based
-estimate and adds the authoritative number: XLA's own buffer-assignment
-stats for the compiled step (`Executor.cost_analysis`), which accounts
-for fusion, liveness-based reuse and donation — things a per-var sum
-structurally overestimates.
+taken as the batch). The TPU build keeps the reference `(value, unit)`
+API but delegates to the liveness-based peak-HBM engine
+(`paddle_tpu.analysis.memory.MemoryAnalysis`): two temps whose
+lifetimes never overlap no longer sum, so the estimate tracks the real
+peak instead of the whole-block total the reference computed (and this
+file's earlier version admitted "structurally overestimates"). The old
+whole-block sum stays available as ``naive=True`` for comparison.
+
+The authoritative post-compile number is still XLA's own buffer
+assignment (`compiled_memory_usage`), which additionally accounts for
+fusion, buffer reuse and donation — things no pre-compile estimate can
+see. tests/test_memory.py holds the static estimate within a stated
+factor of it across the model zoo.
 """
 
 from __future__ import annotations
@@ -15,30 +23,44 @@ from typing import Optional, Tuple
 
 __all__ = ["memory_usage", "compiled_memory_usage"]
 
-_DTYPE_SIZE = {
-    "float64": 8, "float32": 4, "float16": 2, "bfloat16": 2,
-    "int64": 8, "int32": 4, "int16": 2, "int8": 1, "uint8": 1,
-    "bool": 1,
-}
+# kept as an alias for ported user code; the engine's table is THE
+# definition (unknown dtypes WARN there instead of silently assuming 4)
+from ..analysis.memory import DTYPE_BYTES as _DTYPE_SIZE  # noqa: F401
+from ..analysis.memory import dtype_bytes
 
 
-def memory_usage(program, batch_size: int) -> Tuple[float, str]:
-    """Shape-based estimate: sum of all block-0 var sizes, with -1 dims
-    substituted by ``batch_size``. Returns (value, unit-string) like the
-    reference (unit auto-scales B/KB/MB/GB)."""
-    if batch_size <= 0:
-        raise ValueError("batch_size must be positive, got %s" % batch_size)
-    total = 0
-    for var in program.global_block().vars.values():
-        shape = list(var.shape or [])
-        count = 1
-        for d in shape:
-            count *= batch_size if d in (-1, None) else int(d)
-        total += count * _DTYPE_SIZE.get(str(var.dtype), 4)
+def _scaled(total: float) -> Tuple[float, str]:
     for unit, scale in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
         if total >= scale:
             return total / scale, unit
     return float(total), "B"
+
+
+def memory_usage(program, batch_size: int,
+                 naive: bool = False) -> Tuple[float, str]:
+    """Static estimate of the program's peak device bytes at
+    ``batch_size``, as ``(value, unit)`` like the reference (unit
+    auto-scales B/KB/MB/GB).
+
+    Default: the liveness-based peak from the analysis engine
+    (persistables + feeds + peak concurrent activations + per-op
+    workspace). ``naive=True`` is the reference's whole-block var sum
+    — every block-0 var counted regardless of lifetime — kept for
+    comparison; the gap between the two is the liveness win."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive, got %s" % batch_size)
+    if naive:
+        total = 0
+        for var in program.global_block().vars.values():
+            shape = list(var.shape or [])
+            count = 1
+            for d in shape:
+                count *= batch_size if d in (-1, None) else int(d)
+            total += count * dtype_bytes(var.dtype)  # warns on unknown
+        return _scaled(total)
+    from ..analysis.memory import MemoryAnalysis
+
+    return _scaled(MemoryAnalysis(program).peak_bytes(batch_size))
 
 
 def compiled_memory_usage(executor, program, feed, fetch_list=None,

@@ -48,6 +48,13 @@ consults when no explicit ``steps_per_call`` was passed: explicit arg >
 default 1. The tuned probe uses ``tune.peek`` (counter-free) so a
 per-loop resolution never inflates the hit/miss counters the kernel
 acceptance tests pin. See docs/PERFORMANCE.md "Whole-loop compilation".
+
+Memory-aware pruning: a window of K stacks K batches device-resident,
+so with a device budget configured (``PADDLE_TPU_DEVICE_HBM_BYTES``)
+the tuner asks the static memory engine (``analysis/memory.py``) for
+each candidate's predicted peak and skips over-budget candidates
+WITHOUT measuring them — no compile paid, no OOM risked, counted in
+``paddle_analysis_memory_pruned_total``; K=1 is never pruned.
 """
 
 from __future__ import annotations
@@ -244,6 +251,51 @@ def _stack_feed(feed: Dict[str, Any], k: int) -> Dict[str, Any]:
     return {n: np.stack([np.asarray(v)] * k) for n, v in feed.items()}
 
 
+def _feed_batch_size(feed: Dict[str, Any]) -> int:
+    """The feed's leading batch dim (1 when feedless) — what the
+    memory pruner evaluates the batch polynomial at."""
+    for v in (feed or {}).values():
+        shape = np.shape(v)
+        if shape:
+            return max(1, int(shape[0]))
+    return 1
+
+
+def _memory_pruned(program, feed, fetch_list, scope, cands
+                   ) -> Dict[int, int]:
+    """Candidates whose PREDICTED peak exceeds the device budget
+    (analysis/memory.py; silent without PADDLE_TPU_DEVICE_HBM_BYTES):
+    {K: predicted bytes} for every over-budget K > 1 — pruned BEFORE
+    measurement, so the tuner never pays a compile (or an OOM) for a
+    window that provably cannot fit. K=1, the mandatory composed
+    fallback, is never pruned. Counted per candidate in
+    paddle_analysis_memory_pruned_total. An analysis failure prunes
+    nothing — the measurement path is the ground truth either way."""
+    from ..analysis.memory import MemoryAnalysis, device_budget
+    from ..observe.families import ANALYSIS_MEMORY_PRUNED
+
+    budget = device_budget()
+    if budget is None or not any(k > 1 for k in cands):
+        return {}
+    try:
+        fetch_names = [getattr(v, "name", str(v))
+                       for v in (fetch_list or [])]
+        ma = MemoryAnalysis(program, fetch_names=fetch_names,
+                            scope=scope, site="window_tune")
+        batch = _feed_batch_size(feed)
+        pruned = {}
+        for k in cands:
+            if k <= 1:
+                continue
+            predicted = ma.peak_bytes(batch, steps_per_call=k)
+            if predicted > budget:
+                pruned[k] = predicted
+                ANALYSIS_MEMORY_PRUNED.inc()
+        return pruned
+    except Exception:
+        return {}
+
+
 def tune_train_window(executor, program, feed: Dict[str, Any],
                       fetch_list: Optional[Sequence] = None,
                       scope=None, *, candidates: Optional[Sequence[int]]
@@ -252,7 +304,10 @@ def tune_train_window(executor, program, feed: Dict[str, Any],
     ``executor`` and install/persist the winner (module doc above).
     Returns the decision dict (``choice``/``cfg``/``seconds``/
     ``timings``). Scope state is bitwise restored — a tune right before
-    training never perturbs it."""
+    training never perturbs it. Candidates whose statically predicted
+    peak exceeds the device budget are skipped without measurement
+    (``_memory_pruned``; their timings entries carry ``pruned: True``
+    and ``seconds: None``)."""
     from ..kernels import tune
     from ..observe import trace as _tr
     from ..observe.families import KERNEL_TUNE_SECONDS, KERNEL_WINNERS
@@ -268,13 +323,22 @@ def tune_train_window(executor, program, feed: Dict[str, Any],
     repeats = tune._repeats()
     t0 = time.perf_counter()
     with _tr.trace_span("kernel.tune", op=WINDOW_OP, sig=str(sig)):
+        pruned = _memory_pruned(program, feed, fetch_list, scope, cands)
         plan = executor._gather(program, feed, fetch_list, scope)[0]
         snap = _snapshot_state(plan, scope)
         timings: List[Dict[str, Any]] = []
-        costs: List[float] = []
+        measured: List[Tuple[float, int]] = []  # (seconds, timings idx)
         try:
             for k in cands:
                 label = "composed" if k == 1 else "window:%d" % k
+                entry: Dict[str, Any] = {
+                    "label": label, "cfg": None if k == 1 else [k],
+                    "choice": "composed" if k == 1 else "pallas"}
+                if k in pruned:
+                    entry.update(seconds=None, pruned=True,
+                                 predicted_peak_bytes=int(pruned[k]))
+                    timings.append(entry)
+                    continue
                 if seed is not None:
                     secs = tune._fake_seconds(seed, WINDOW_OP, sig, label)
                 else:
@@ -282,14 +346,12 @@ def tune_train_window(executor, program, feed: Dict[str, Any],
                                               fetch_list, scope, k,
                                               repeats)
                     _restore_state(snap, scope)
-                timings.append({
-                    "label": label, "cfg": None if k == 1 else [k],
-                    "choice": "composed" if k == 1 else "pallas",
-                    "seconds": secs})
-                costs.append(secs)
+                entry["seconds"] = secs
+                timings.append(entry)
+                measured.append((secs, len(timings) - 1))
         finally:
             _restore_state(snap, scope)
-        best = timings[costs.index(min(costs))]
+        best = timings[min(measured)[1]]
         decision: Dict[str, Any] = {
             "choice": best["choice"], "cfg": best["cfg"],
             "seconds": best["seconds"], "source": "tuned",

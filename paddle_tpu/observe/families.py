@@ -364,9 +364,18 @@ SERVING_ROUTER_REJECTED = REGISTRY.counter(
     "cap, 'slo' = projected queue wait exceeded the request deadline "
     "(reject-early: the caller hears no at submit, not after the "
     "deadline burned in a queue), 'backpressure' = every healthy "
-    "replica's queue was full", labels=("reason",))
-for _r in ("quota", "slo", "backpressure"):
+    "replica's queue was full, 'memory' = every candidate replica's "
+    "predicted-bytes admission guard refused the prefill "
+    "(analysis/memory.py)", labels=("reason",))
+for _r in ("quota", "slo", "backpressure", "memory"):
     SERVING_ROUTER_REJECTED.labels(reason=_r)
+SERVING_MEMORY_DENIED = REGISTRY.counter(
+    "paddle_serving_memory_admissions_denied_total",
+    "Engine submits refused by the predicted-bytes admission guard: "
+    "resident bytes (weights + 2L decode-cache slabs) plus the prompt's "
+    "predicted prefill peak exceeded the engine's device budget — the "
+    "caller hears MemoryBudgetExceeded at submit instead of the "
+    "replica OOMing mid-prefill; 0 while no budget is configured")
 SERVING_ROUTER_READMITTED = REGISTRY.counter(
     "paddle_serving_router_readmitted_total",
     "In-flight requests re-admitted to a surviving replica after "
@@ -540,7 +549,9 @@ _ANALYSIS_RULES = (
     # dataflow-engine-powered rules (analysis/dataflow.py)
     "dead-store", "write-after-write", "use-before-init",
     # range-engine-powered numerics rules (analysis/ranges.py)
-    "bf16-overflow", "domain-violation", "int-narrowing-loss")
+    "bf16-overflow", "domain-violation", "int-narrowing-loss",
+    # memory-engine-powered rules (analysis/memory.py)
+    "memory-over-budget", "max-safe-batch", "dead-persistable")
 for _r in _ANALYSIS_RULES:
     ANALYSIS_FINDINGS.labels(rule=_r)
 ANALYSIS_VERIFY_SECONDS = REGISTRY.histogram(
@@ -583,6 +594,30 @@ ANALYSIS_RANGES_CALIBRATION_BATCHES = REGISTRY.counter(
     "paddle_analysis_ranges_calibration_batches_total",
     "Feed batches observed by an attached ranges.Calibration (the "
     "executor feed-observer hook): N batches = N increments")
+
+# static peak-HBM estimation (analysis/memory.py — see docs/ANALYSIS.md
+# "The memory engine")
+ANALYSIS_MEMORY_PROGRAMS = REGISTRY.counter(
+    "paddle_analysis_memory_programs_total",
+    "Programs run through the liveness-based peak-HBM estimator "
+    "(MemoryAnalysis construction), by trigger: 'lint' = the memory "
+    "lint rules, 'cli' = tools/memory_report.py, 'window_tune' = the "
+    "window-candidate budget pruner, 'serving' = the engine admission "
+    "guard, 'bench' = the peak_bytes_predicted row field, 'api' = "
+    "direct callers (contrib.memory_usage_calc and user code)",
+    labels=("site",))
+for _s in ("api", "lint", "cli", "window_tune", "serving", "bench"):
+    ANALYSIS_MEMORY_PROGRAMS.labels(site=_s)
+ANALYSIS_MEMORY_SECONDS = REGISTRY.histogram(
+    "paddle_analysis_memory_seconds",
+    "Wall time of one whole-program memory analysis (scales with op "
+    "count, never with tensor sizes — bytes ride shape algebra)")
+ANALYSIS_MEMORY_PRUNED = REGISTRY.counter(
+    "paddle_analysis_memory_pruned_total",
+    "Window-tune candidates skipped WITHOUT measurement because their "
+    "predicted peak exceeded the device budget "
+    "(PADDLE_TPU_DEVICE_HBM_BYTES) — each count is one avoided "
+    "compile-and-OOM; the K=1 composed fallback is never pruned")
 
 # ------------------------------------------------------------- optimizer
 # (paddle_tpu/core/passes/: graph-optimizing pass pipeline — see

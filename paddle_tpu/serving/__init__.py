@@ -37,11 +37,13 @@ from __future__ import annotations
 
 from .batcher import MicroBatcher
 from .engine import DecodeEngine
+from .engine import MemoryBudgetExceeded
 from .prefix import PrefixStore
 from .queue import (Cancelled, DeadlineExpired, QueueFull, RequestQueue,
                     ServingRequest)
 from .router import ReplicaRouter, TenantQuotaExceeded
 
-__all__ = ["Cancelled", "DeadlineExpired", "DecodeEngine", "MicroBatcher",
-           "PrefixStore", "QueueFull", "ReplicaRouter", "RequestQueue",
+__all__ = ["Cancelled", "DeadlineExpired", "DecodeEngine",
+           "MemoryBudgetExceeded", "MicroBatcher", "PrefixStore",
+           "QueueFull", "ReplicaRouter", "RequestQueue",
            "ServingRequest", "TenantQuotaExceeded"]

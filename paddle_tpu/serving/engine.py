@@ -61,9 +61,20 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..observe import trace as _tr
-from .queue import RequestQueue
+from .queue import QueueFull, RequestQueue
 
-__all__ = ["DecodeEngine"]
+__all__ = ["DecodeEngine", "MemoryBudgetExceeded"]
+
+
+class MemoryBudgetExceeded(QueueFull):
+    """Raised at submit when the predicted-bytes admission guard
+    refuses a prompt: engine-resident bytes (weights + the 2L
+    decode-cache slabs) plus the prompt's predicted prefill peak exceed
+    the engine's device budget (``device_budget=`` or
+    ``PADDLE_TPU_DEVICE_HBM_BYTES``). A ``QueueFull`` subclass so the
+    router's per-replica retry treats it like backpressure — but with
+    its own counter (``paddle_serving_memory_admissions_denied_total``)
+    and router rejection reason (``memory``)."""
 
 
 @contextlib.contextmanager
@@ -352,6 +363,49 @@ class _Lane:
             if v is not None:
                 self._prefill_scope.set_var(n, v)
 
+    # ------------------------------------------------- memory estimation
+    def memory_footprint(self) -> dict:
+        """Static byte model of this lane (analysis/memory.py), built
+        ONCE at engine construction — never from the submit path, so
+        the process-global ``program_guard`` is only ever entered from
+        the thread that is already building this engine's programs.
+
+        ``resident``: predicted peak of the decode-step program
+        (weights + the 2L ``[b_max, n_kv, max_len, Dh]`` cache slabs +
+        one step's activations). ``prefill_extra_lo``/``_hi``: the
+        NON-shared bytes a batch=1 prefill adds on top (its own caches
+        + activations + the P x P attention scores; weights shared with
+        the decode scope are excluded) at the two endpoint prompt
+        lengths ``p_lo``/``p_hi`` — prefill cost is convex in P, so the
+        chord through the endpoints brackets every P from above (the
+        admission guard's per-P form)."""
+        from ..analysis.memory import MemoryAnalysis
+
+        decode = MemoryAnalysis(self._decode_prog, site="serving")
+        resident = decode.peak_bytes(1)
+        persist = {n for n, t in decode.tensors.items()
+                   if t.kind == "persistable"}
+        p_lo, p_hi = 1, max(2, self.max_len - 1)
+
+        def extra(P: int) -> int:
+            fluid = self._fluid
+            prog, start = fluid.Program(), fluid.Program()
+            with fluid.program_guard(prog, start):
+                # IR only: no startup run, no compile — the analysis
+                # walks the graph, the throwaway programs are dropped
+                self._gpt.build_prefill_step(
+                    self.cfg, batch=1, prompt_len=P,
+                    max_len=self.max_len)
+            ma = MemoryAnalysis(prog, site="serving")
+            shared = sum(t.poly.at(1) for n, t in ma.tensors.items()
+                         if n in persist and t.kind == "persistable"
+                         and t.poly is not None)
+            return max(0, ma.peak_bytes(1) - shared)
+
+        return {"resident": resident, "p_lo": p_lo, "p_hi": p_hi,
+                "prefill_extra_lo": extra(p_lo),
+                "prefill_extra_hi": extra(p_hi)}
+
 
 class DecodeEngine:
     """Continuous-batching scheduler over one ``b_max`` decode
@@ -387,7 +441,7 @@ class DecodeEngine:
                  place=None, prefix_store=None, prefix_cache_bytes: int = 0,
                  draft_cfg=None,
                  draft_params: Optional[Dict[str, np.ndarray]] = None,
-                 spec_k: int = 0):
+                 spec_k: int = 0, device_budget: Optional[int] = None):
         import paddle_tpu as fluid
         from ..models import gpt
         from ..core.scope import scope_guard
@@ -425,6 +479,21 @@ class DecodeEngine:
         if prefix_store is None and prefix_cache_bytes > 0:
             prefix_store = PrefixStore(prefix_cache_bytes)
         self.prefix_store = prefix_store
+        # predicted-bytes admission guard (analysis/memory.py): the
+        # byte model is built HERE, in the one thread already building
+        # this engine's programs, never from submit — and a failed
+        # estimate disables the guard instead of sinking construction
+        from ..analysis.memory import device_budget as _env_budget
+
+        self.device_budget = (_env_budget() if device_budget is None
+                              else int(device_budget))
+        try:
+            self._mem = self._lane.memory_footprint()
+            if self._draft is not None:
+                self._mem["resident"] += \
+                    self._draft.memory_footprint()["resident"]
+        except Exception:
+            self._mem = None
         self.queue = RequestQueue(queue_capacity)
         self._slots: list = [None] * b_max
         self._n_active = 0
@@ -473,6 +542,19 @@ class DecodeEngine:
             raise ValueError(
                 "prefix_len=%r must be in [1, prompt length %d]"
                 % (prefix_len, P))
+        budget = self.device_budget
+        if budget is not None:
+            predicted = self.predicted_bytes(P)
+            if predicted is not None and predicted > budget:
+                from ..observe.families import SERVING_MEMORY_DENIED
+
+                SERVING_MEMORY_DENIED.inc()
+                raise MemoryBudgetExceeded(
+                    "predicted bytes %d (resident %d + prefill(P=%d) "
+                    "%d) exceed the engine's device budget %d — "
+                    "admission refused before any prefill compile"
+                    % (predicted, self._mem["resident"], P,
+                       predicted - self._mem["resident"], budget))
         payload = dict(prompt=prompt, n_new=int(n_new),
                        eos_id=self.eos_id if eos_id is None else eos_id,
                        temperature=float(temperature), top_k=int(top_k),
@@ -481,6 +563,29 @@ class DecodeEngine:
         return self.queue.submit(payload, deadline_s=deadline_s,
                                  tenant=tenant, trace_ctx=trace_ctx,
                                  report=report)
+
+    def predicted_resident_bytes(self) -> Optional[int]:
+        """Static estimate of this engine's resident device bytes
+        (target + draft weights, 2L cache slabs, one decode step's
+        activations) — None when the byte model could not be built.
+        The bench's serving ``peak_bytes_predicted`` field."""
+        return None if self._mem is None else int(self._mem["resident"])
+
+    def predicted_bytes(self, prompt_len: int) -> Optional[int]:
+        """Predicted peak while admitting a prompt of ``prompt_len``:
+        resident bytes plus the prefill's non-shared extra,
+        interpolated on the chord between the two analyzed endpoint
+        lengths (prefill cost is convex in P, so the chord brackets
+        every P from above). The admission guard's quantity."""
+        if self._mem is None:
+            return None
+        m = self._mem
+        p = min(max(int(prompt_len), m["p_lo"]), m["p_hi"])
+        span = max(1, m["p_hi"] - m["p_lo"])
+        extra = (m["prefill_extra_lo"]
+                 + (m["prefill_extra_hi"] - m["prefill_extra_lo"])
+                 * (p - m["p_lo"]) / span)
+        return int(m["resident"] + max(extra, 0))
 
     def alive(self) -> bool:
         """Health probe for replica supervision: started, scheduler

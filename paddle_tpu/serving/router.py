@@ -294,8 +294,15 @@ class ReplicaRouter:
                 lambda _inner, req=req: self._on_attempt_done(req))
             return
         from ..observe.families import SERVING_ROUTER_REJECTED
+        from .engine import MemoryBudgetExceeded
 
-        SERVING_ROUTER_REJECTED.labels(reason="backpressure").inc()
+        # a memory-guard refusal is its own admission story (the fleet
+        # provably cannot hold the prompt's prefill, more replicas of
+        # the same shape won't help) — count it apart from transient
+        # queue backpressure
+        reason = ("memory" if isinstance(last_exc, MemoryBudgetExceeded)
+                  else "backpressure")
+        SERVING_ROUTER_REJECTED.labels(reason=reason).inc()
         raise last_exc if last_exc is not None else QueueFull(
             "no healthy replica accepted the request")
 

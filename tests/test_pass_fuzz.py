@@ -198,3 +198,16 @@ def test_quantize_corpus_entry_uses_tolerance_harness():
     assert pass_fuzz.diff_run(main, startup, feed, fetch,
                               tolerance=cfg["tolerance"],
                               env=cfg["env"]) == []
+
+
+def test_peak_invariant_holds_on_fixed_seeds():
+    """The post-pipeline memory invariant in isolation: the default
+    level-2 pipeline never increases the statically predicted peak on
+    seeded programs (fuzz_one also runs it per seed; this pins the
+    helper's contract directly, incl. that it runs the optimizer on a
+    CLONE — the input program's op count must not change)."""
+    for seed in (0, 3, 11):
+        main, _startup, _feed, fetch = pass_fuzz.gen_program(seed)
+        n_ops = len(main.global_block().ops)
+        assert pass_fuzz.peak_invariant(main, fetch) == []
+        assert len(main.global_block().ops) == n_ops

@@ -192,3 +192,18 @@ def test_quantized_rows_never_pin(tmp_path):
     assert "quantized" in proc.stdout
     assert base[ROW] == 509.8
     assert "quantized_mnist" not in base
+
+
+def test_peak_bytes_columns_are_informational(tmp_path):
+    # peak_bytes_predicted / peak_bytes_xla ride every row as
+    # informational columns: they neither block a pin nor get pinned
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 999.9, "steps_per_call": 10,
+         "unit": "images/sec", "peak_bytes_predicted": 123456,
+         "peak_bytes_xla": 120000}])
+    assert proc.returncode == 0, proc.stderr
+    assert base[ROW] == 999.9       # pinned exactly as without them
+    assert spc[ROW] == 10
+    assert "peak_bytes" not in open(
+        str(tmp_path / "bench_copy.py")).read().split(
+        "BASELINE_SPC")[0].split("BASELINES")[1]

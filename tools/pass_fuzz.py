@@ -32,6 +32,12 @@ miscompile is real (bitwise diff or broken program). A future pass
 regression therefore cannot land silently: either TV names it, or this
 harness bisects it to a seed.
 
+Every fuzzed seed additionally holds a **post-pipeline memory
+invariant**: the default level-2 pipeline must never INCREASE the
+statically predicted peak (``analysis/memory.py`` — fold/copy-prop/
+CSE/DCE/fusion only remove or merge tensors); violations print the
+seed like every other mismatch.
+
 Exit code: 0 = all clean, 1 = any failure, 2 = bad usage.
 """
 
@@ -322,10 +328,37 @@ def diff_run(main, startup, feed, fetch, steps=2, tolerance=None,
     return problems
 
 
+def peak_invariant(main, fetch, batch_size=B):
+    """Post-pipeline memory invariant: the default level-2 pipeline
+    (fold/copy-prop/CSE/DCE/fusion — quantize is opt-in and NOT part
+    of this check) must never INCREASE the statically predicted peak
+    (analysis/memory.py): every default pass removes or merges
+    tensors, so a higher optimized peak means either a pass
+    materialized something it should not have, or the byte model
+    mis-attributes a lifetime. Returns a problem list (empty = holds);
+    failures print alongside the seed like every fuzz mismatch."""
+    from paddle_tpu.analysis.memory import MemoryAnalysis
+    from paddle_tpu.core.passes import optimize_program
+
+    base = MemoryAnalysis(main,
+                          fetch_names=fetch).peak_bytes(batch_size)
+    opt_prog = optimize_program(main, fetch_list=list(fetch), level=2)[0]
+    opt = MemoryAnalysis(opt_prog,
+                         fetch_names=fetch).peak_bytes(batch_size)
+    if opt > base:
+        return ["level-2 pipeline INCREASED the predicted peak: "
+                "%d -> %d bytes at batch %d" % (base, opt, batch_size)]
+    return []
+
+
 def fuzz_one(seed, steps=2):
-    """Generate + differentially check ONE seed. Returns problem list."""
+    """Generate + differentially check ONE seed (bitwise level 2 vs 0
+    plus the predicted-peak invariant). Returns problem list."""
     main, startup, feed, fetch = gen_program(seed)
-    return diff_run(main, startup, feed, fetch, steps=steps)
+    problems = diff_run(main, startup, feed, fetch, steps=steps)
+    main2, _, _, fetch2 = gen_program(seed)  # diff_run's runs filled
+    problems += peak_invariant(main2, fetch2)  # shapes; check pristine
+    return problems
 
 
 # ------------------------------------------------------------- corpus

@@ -45,6 +45,17 @@ tests/test_repo_lint.py):
    outside the pre-materialized schema. Dynamic sites (variables,
    concatenation, the env-plan parser) are skipped like rule 3's.
 
+8. **undocumented-env-knob** — every ``PADDLE_TPU_*`` environment knob
+   READ in ``paddle_tpu/`` or ``tools/`` (AST scan of literal
+   ``os.environ[...]`` / ``os.environ.get/setdefault/pop`` /
+   ``os.getenv`` arguments) must appear in a docs/*.md knob table —
+   the knob inventory has grown past grep-ability, and an undocumented
+   knob is a behavior switch nobody can discover. Dynamic names
+   (prefix concatenation, helper wrappers) are skipped like rule 3's
+   dynamic sites; the documented set is every ``PADDLE_TPU_*`` token
+   mentioned in ``docs/*.md`` (tables are prose — the mention IS the
+   documentation contract).
+
 7. **range-rule-coverage** — the value-range abstract interpreter
    (``analysis/ranges.py``) must never widen a *shape-ruled* op
    silently: every op type registered with ``register_shape_rule`` in
@@ -458,6 +469,97 @@ def range_rule_coverage_violations(root: str) -> List[str]:
     return violations
 
 
+# ------------------------------------------------- rule 8: env knobs
+# the trees whose env reads are user-facing knobs (tests/bench drive
+# internals and document their knobs next to the workloads they shape)
+ENV_KNOB_ROOTS = ("paddle_tpu", "tools")
+_ENV_KNOB_PREFIX = "PADDLE_TPU_"
+_ENV_GET_FNS = ("get", "getenv", "setdefault", "pop")
+_ENV_KNOB_RE = re.compile(r"PADDLE_TPU_[A-Z0-9_]+")
+
+
+def _env_receiver_ok(fn) -> bool:
+    """Only ``os.environ.<get/...>`` / ``environ.<get/...>`` /
+    ``os.getenv`` receivers count — an unrelated object's
+    ``.get("PADDLE_TPU_X")`` or ``.getenv(...)`` (a test's override
+    map, a config helper) is not an environment read."""
+    if isinstance(fn, ast.Name):  # bare getenv (from os import getenv)
+        return fn.id == "getenv"
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value
+        if fn.attr == "getenv":
+            return isinstance(recv, ast.Name) and recv.id == "os"
+        return (isinstance(recv, ast.Attribute) and recv.attr == "environ") \
+            or (isinstance(recv, ast.Name) and recv.id == "environ")
+    return False
+
+
+def env_knob_reads(root: str, files=None) -> Dict[str, List[str]]:
+    """{knob name: ["rel/path:line", ...]} for every literal
+    ``PADDLE_TPU_*`` env access in ENV_KNOB_ROOTS. Dynamic names
+    (concatenation, f-strings, helper indirection) are skipped — the
+    deliberate escape hatch every literal-contract rule here shares."""
+    targets = []
+    for path in (files or iter_py_files(root)):
+        rel = os.path.relpath(path, root)
+        if rel.split(os.sep)[0] in ENV_KNOB_ROOTS:
+            targets.append(path)
+    out: Dict[str, List[str]] = {}
+
+    def note(name, rel, lineno):
+        if name.startswith(_ENV_KNOB_PREFIX):
+            out.setdefault(name, []).append("%s:%d" % (rel, lineno))
+
+    for path in targets:
+        rel = os.path.relpath(path, root)
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                fn_name = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if fn_name in _ENV_GET_FNS and _env_receiver_ok(fn) \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    note(node.args[0].value, rel, node.lineno)
+            elif isinstance(node, ast.Subscript):
+                recv = node.value
+                is_env = (isinstance(recv, ast.Attribute)
+                          and recv.attr == "environ") or (
+                    isinstance(recv, ast.Name) and recv.id == "environ")
+                if is_env and isinstance(node.slice, ast.Constant) \
+                        and isinstance(node.slice.value, str):
+                    note(node.slice.value, rel, node.lineno)
+    return out
+
+
+def documented_knobs(root: str) -> Set[str]:
+    """Every PADDLE_TPU_* token mentioned anywhere in docs/*.md."""
+    out: Set[str] = set()
+    docs = os.path.join(root, "docs")
+    if not os.path.isdir(docs):
+        return out
+    for fname in os.listdir(docs):
+        if not fname.endswith(".md"):
+            continue
+        with open(os.path.join(docs, fname), "r", encoding="utf-8") as f:
+            out.update(_ENV_KNOB_RE.findall(f.read()))
+    return out
+
+
+def env_knob_violations(root: str, files=None) -> List[str]:
+    """Rule 8: scanned knob set ⊆ documented knob set."""
+    documented = documented_knobs(root)
+    violations = []
+    for name, sites in sorted(env_knob_reads(root, files=files).items()):
+        if name not in documented:
+            violations.append(
+                "%s: env knob %r is read in code but appears in no "
+                "docs/*.md knob table (document it where its subsystem's "
+                "knobs live)" % (sites[0], name))
+    return violations
+
+
 def run(root: str = REPO_ROOT) -> List[str]:
     """All violations (empty list = clean). tests/test_repo_lint.py
     asserts on this."""
@@ -466,7 +568,8 @@ def run(root: str = REPO_ROOT) -> List[str]:
             + pass_docstring_violations(root)
             + kernel_registry_violations(root)
             + fault_site_violations(root)
-            + range_rule_coverage_violations(root))
+            + range_rule_coverage_violations(root)
+            + env_knob_violations(root))
 
 
 def main(argv=None) -> int:
