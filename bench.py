@@ -155,9 +155,16 @@ def _mfu_fields(step_flops, steps, dt, peak):
     if not step_flops or dt <= 0:
         return {"tflops_per_sec": None, "mfu": None}
     achieved = step_flops * steps / dt
+    mfu = _round_nonzero(achieved / peak, 4) if peak else None
+    if mfu is not None:
+        # mirror the measured MFU into the live telemetry plane so a
+        # fleet_top watching this process's exporter sees it
+        from paddle_tpu.observe.families import BENCH_MFU
+
+        BENCH_MFU.set(mfu)
     return {
         "tflops_per_sec": _round_nonzero(achieved / 1e12, 2),
-        "mfu": _round_nonzero(achieved / peak, 4) if peak else None,
+        "mfu": mfu,
     }
 
 
@@ -968,29 +975,31 @@ def bench_deepfm_dist(amp, quick, uses_flash=False):
         shutil.rmtree(rdv, ignore_errors=True)
 
 
-def _serving_pctl(sorted_vals, q):
-    """Nearest-rank percentile of an already-sorted list."""
-    if not sorted_vals:
-        return None
-    i = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
-    return sorted_vals[i]
-
-
 def _serving_row(name, value, unit, lat_s, extra):
     """One serving bench row: open-loop p50/p99 latency + throughput.
     Marked "serving": pin_baselines never pins these over training
-    baselines (a scheduler-mode number is not a train-step number)."""
+    baselines (a scheduler-mode number is not a train-step number).
+    p50/p99 come from the shared ``Histogram.quantile`` over the
+    declared request-latency bucket schema (tools/serving_load.py
+    folds its latencies the same way), so the bench's percentiles and
+    every sidecar reader's agree by construction."""
     import jax as _jax
 
-    lat = sorted(lat_s)
+    _tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools")
+    if _tools not in sys.path:
+        sys.path.insert(0, _tools)
+    from serving_load import _latency_hist
+
+    hist = _latency_hist(lat_s)
     rec = {
         "metric": name,
         "platform": _jax.devices()[0].platform.lower(),
         "serving": True,
         "value": round(value, 1),
         "unit": unit,
-        "p50_ms": round(1e3 * _serving_pctl(lat, 0.50), 2) if lat else None,
-        "p99_ms": round(1e3 * _serving_pctl(lat, 0.99), 2) if lat else None,
+        "p50_ms": round(1e3 * hist.quantile(0.50), 2) if lat_s else None,
+        "p99_ms": round(1e3 * hist.quantile(0.99), 2) if lat_s else None,
         "vs_baseline": 1.0,
         "tflops_per_sec": None,  # scheduler-bound; MFU is not the story
         "mfu": None,

@@ -28,11 +28,19 @@ from __future__ import annotations
 
 from . import families  # noqa: F401  (declares the well-known families)
 from . import trace  # noqa: F401  (trace contexts + flight recorder)
+from .export import (MetricsExporter, active_exporter,  # noqa: F401
+                     default_instance, start_from_env, stop_exporter)
 from .families import REGISTRY
+from .fleet import FleetCollector, TelemetryPusher  # noqa: F401
 from .metrics import (Counter, DEFAULT_BUCKETS, Family, Gauge,  # noqa: F401
-                      Histogram, Registry)
+                      Histogram, Registry, quantile_from_buckets)
+from .promparse import ParseError, parse_prometheus  # noqa: F401
+from .shutdown import (install_shutdown_handlers,  # noqa: F401
+                       uninstall_shutdown_handlers)
+from .slo import Breach, Objective, SloMonitor  # noqa: F401
 from .spans import (Span, mark_batch_produced,  # noqa: F401
                     observe_feed_gap, span)
+from .timeseries import Ewma, TimeSeriesStore  # noqa: F401
 from .trace import (FlightRecorder, TraceContext, attach,  # noqa: F401
                     current, dump_flight_recorder, export_chrome_trace,
                     new_trace, record_span, trace_enabled, trace_event,
@@ -42,10 +50,20 @@ __all__ = ["REGISTRY", "counter", "gauge", "histogram", "get_metric",
            "snapshot", "render_prometheus", "dump", "reset",
            "span", "Span", "mark_batch_produced", "observe_feed_gap",
            "Counter", "Gauge", "Histogram", "Family", "Registry",
-           "DEFAULT_BUCKETS",
+           "DEFAULT_BUCKETS", "quantile_from_buckets",
            "TraceContext", "FlightRecorder", "trace_enabled", "new_trace",
            "current", "attach", "trace_span", "trace_event", "record_span",
-           "dump_flight_recorder", "export_chrome_trace"]
+           "dump_flight_recorder", "export_chrome_trace",
+           # the live telemetry plane (export/timeseries/fleet/slo/
+           # promparse/shutdown — docs/OBSERVABILITY.md "Fleet
+           # telemetry plane")
+           "MetricsExporter", "active_exporter", "start_from_env",
+           "stop_exporter", "default_instance",
+           "Ewma", "TimeSeriesStore",
+           "FleetCollector", "TelemetryPusher",
+           "SloMonitor", "Objective", "Breach",
+           "parse_prometheus", "ParseError",
+           "install_shutdown_handlers", "uninstall_shutdown_handlers"]
 
 # module-level facade over the process-wide registry
 counter = REGISTRY.counter

@@ -369,6 +369,14 @@ SERVING_ROUTER_REJECTED = REGISTRY.counter(
     "(analysis/memory.py)", labels=("reason",))
 for _r in ("quota", "slo", "backpressure", "memory"):
     SERVING_ROUTER_REJECTED.labels(reason=_r)
+SERVING_MEMORY_HEADROOM = REGISTRY.gauge(
+    "paddle_serving_memory_headroom_bytes",
+    "Device-budget headroom at the engine's last predicted-bytes "
+    "admission check: budget minus the prompt's predicted peak "
+    "(negative = that admission was denied). Process-global, "
+    "last-writer-wins like prefetch_queue_depth; 0 until an engine "
+    "with a configured budget admits — the autoscaler-facing "
+    "headroom signal tools/fleet_top.py columns")
 SERVING_MEMORY_DENIED = REGISTRY.counter(
     "paddle_serving_memory_admissions_denied_total",
     "Engine submits refused by the predicted-bytes admission guard: "
@@ -799,8 +807,10 @@ TRACE_EVENTS = REGISTRY.counter(
     "pins exactly that")
 TRACE_DUMPS = REGISTRY.counter(
     "paddle_trace_flight_dumps_total",
-    "Flight-recorder dumps written, by trigger", labels=("reason",))
-for _r in ("wedge", "crash", "atexit", "manual"):
+    "Flight-recorder dumps written, by trigger ('signal' = the "
+    "graceful-shutdown SIGTERM/SIGINT handlers, observe/shutdown.py)",
+    labels=("reason",))
+for _r in ("wedge", "crash", "atexit", "manual", "signal"):
     TRACE_DUMPS.labels(reason=_r)
 
 # Every span/trace-event SITE name used in code must appear here — the
@@ -869,3 +879,65 @@ BACKEND_PROBE_ATTEMPT_SECONDS = REGISTRY.histogram(
 BENCH_ROWS = REGISTRY.counter(
     "paddle_bench_rows_total",
     "Bench rows emitted by outcome", labels=("status",))
+BENCH_MFU = REGISTRY.gauge(
+    "paddle_bench_mfu",
+    "Model-flops utilization of the LAST bench row that measured one "
+    "(bench.py _mfu_fields; XLA cost_analysis flops / chip peak). "
+    "Stays 0 when no row measured MFU — the row fields keep the "
+    "null-never-zero contract; this gauge is the live-dashboard "
+    "mirror (tools/fleet_top.py MFU column)")
+
+# ------------------------------------------------------ fleet telemetry
+# (observe/export.py, fleet.py, slo.py, shutdown.py — the live metrics
+# plane; docs/OBSERVABILITY.md "Fleet telemetry plane". Every family
+# below moves ONLY when the plane is explicitly enabled: with
+# PADDLE_TPU_METRICS_PORT unset and no collector/monitor constructed,
+# tests pin zero movement across all of them, like PADDLE_TPU_TRACE=0.)
+EXPORT_HTTP_REQUESTS = REGISTRY.counter(
+    "paddle_export_http_requests_total",
+    "Requests the /metrics exporter answered, by endpoint ('metrics', "
+    "'snapshot' = /snapshot.json, 'healthz'; 'other' = 404s)",
+    labels=("endpoint",))
+for _e in ("metrics", "snapshot", "healthz", "other"):
+    EXPORT_HTTP_REQUESTS.labels(endpoint=_e)
+EXPORT_LISTENING = REGISTRY.gauge(
+    "paddle_export_listening",
+    "1 while the MetricsExporter HTTP thread is serving, 0 otherwise "
+    "— scrape-side liveness for the process itself")
+FLEET_INGESTS = REGISTRY.counter(
+    "paddle_fleet_ingests_total",
+    "Per-instance snapshots a FleetCollector absorbed, by transport: "
+    "'scrape' = HTTP pull of an exporter, 'push' = @TELEMETRY@ frames "
+    "over the RPC stack, 'ingest' = direct in-process hand-off",
+    labels=("source",))
+for _s in ("scrape", "push", "ingest"):
+    FLEET_INGESTS.labels(source=_s)
+FLEET_INSTANCES = REGISTRY.gauge(
+    "paddle_fleet_instances",
+    "Instances the FleetCollector currently tracks, by lease state "
+    "('live' = reported within the expiry window, 'stale' = lease "
+    "lapsed but series retained for post-mortem)", labels=("state",))
+for _s in ("live", "stale"):
+    FLEET_INSTANCES.labels(state=_s)
+FLEET_EXPIRED = REGISTRY.counter(
+    "paddle_fleet_instances_expired_total",
+    "Lease expiries: instances that stopped reporting and were marked "
+    "stale — a FaultPlan-killed trainer shows up here, not as a "
+    "forever-frozen 'live' row")
+SLO_EVALUATIONS = REGISTRY.counter(
+    "paddle_slo_evaluations_total",
+    "SloMonitor evaluation passes (each pass checks every declared "
+    "objective once over its window)")
+SLO_BREACHES = REGISTRY.counter(
+    "paddle_slo_breaches_total",
+    "Objective breaches, labelled by the declared objective name; at "
+    "most one increment per objective per evaluation window — a "
+    "sustained burn reads as breaches-per-window, not per-sample",
+    labels=("objective",))
+SHUTDOWN_SIGNALS = REGISTRY.counter(
+    "paddle_shutdown_signals_total",
+    "Graceful-shutdown signals handled (flight ring dumped, telemetry "
+    "sidecar flushed, exporter stopped) before re-raising the default "
+    "disposition", labels=("signal",))
+for _s in ("SIGTERM", "SIGINT"):
+    SHUTDOWN_SIGNALS.labels(signal=_s)

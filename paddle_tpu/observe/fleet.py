@@ -1,0 +1,316 @@
+"""FleetCollector: one view over N processes' telemetry.
+
+A fleet (trainers + pservers + serving replicas) is N per-process
+registries. This module aggregates their snapshots under an
+``instance`` label, fed by either transport:
+
+* **scrape** — HTTP pull of a process's MetricsExporter ``/metrics``
+  text, parsed by observe/promparse.py (:meth:`FleetCollector.scrape`).
+* **push** — processes that already speak the RPC stack send their
+  snapshot as an ``@TELEMETRY@`` frame (:class:`TelemetryPusher`), the
+  exact pattern of the elastic tier's ``@ELASTIC_HB@`` heartbeats
+  (distributed/membership.py): JSON bytes ride one ``send_var``, the
+  collector drains them with the same first-pop-blocks ``poll`` loop.
+
+Aggregation semantics (docs/OBSERVABILITY.md "Fleet telemetry plane"):
+counters SUM across instances (fleet totals), gauges stay PER-INSTANCE
+(an ``instance`` label is added — summing queue depths across replicas
+is a lie), histograms BUCKET-MERGE (every registry shares the fixed
+1-2-5/decade bounds, so per-``le`` counts add exactly).
+
+Liveness is lease-style, like MembershipView: an instance that stops
+reporting for ``lease_s`` goes STALE — flagged in :meth:`instances`,
+counted in ``paddle_fleet_instances{state=stale}`` and
+``paddle_fleet_instances_expired_total`` — instead of leaking as a
+forever-frozen "live" row. Stale series are retained (post-mortem
+reads still work) until ``drop_after_s`` passes, then dropped.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, Optional
+from urllib.request import urlopen
+
+__all__ = ["FleetCollector", "TelemetryPusher", "TELEMETRY_VAR"]
+
+# wire name for pushed snapshots — the @...@ namespace the elastic
+# heartbeats established for control-plane frames
+TELEMETRY_VAR = "@TELEMETRY@"
+
+
+def _merge_counter(acc: dict, s: dict) -> None:
+    acc["value"] = acc.get("value", 0.0) + s.get("value", 0.0)
+
+
+def _merge_histogram(acc: dict, s: dict) -> None:
+    acc["sum"] = acc.get("sum", 0.0) + s.get("sum", 0.0)
+    acc["count"] = acc.get("count", 0) + s.get("count", 0)
+    buckets = acc.setdefault("buckets", {})
+    for le, c in s.get("buckets", {}).items():
+        buckets[le] = buckets.get(le, 0) + c
+
+
+class FleetCollector:
+    """Aggregate N instances' snapshots into one fleet view.
+
+    Construct with ``port=0`` to open the push ingestion server
+    (kernel-assigned port; ``self.endpoint`` is what TelemetryPushers
+    dial); ``port=None`` (default) is pull/ingest-only — no socket."""
+
+    def __init__(self, lease_s: float = 10.0, *,
+                 drop_after_s: Optional[float] = None,
+                 port: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.lease_s = float(lease_s)
+        self.drop_after_s = (float(drop_after_s) if drop_after_s
+                             is not None else 10.0 * self.lease_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # instance -> {"snap": dict, "t": last-report, "stale": bool}
+        self._instances: Dict[str, dict] = {}
+        self._server = None
+        self.endpoint: Optional[str] = None
+        if port is not None:
+            from ..distributed.rpc import RPCServer
+
+            # async mode: telemetry frames go straight to the pop
+            # queue, never a data-plane barrier (membership.py idiom)
+            self._server = RPCServer(port=port, num_trainers=1,
+                                     sync=False)
+            self._server.start()
+            self.endpoint = "127.0.0.1:%d" % self._server.port
+
+    # ----------------------------------------------------------- feeding
+    def ingest(self, snap: dict, instance: Optional[str] = None,
+               source: str = "ingest",
+               now: Optional[float] = None) -> str:
+        """Absorb one snapshot for ``instance`` (default: the
+        snapshot's own ``instance``/``pid`` identity). Re-ingesting the
+        same instance replaces its snapshot and renews its lease."""
+        from .families import FLEET_INGESTS
+
+        if "metrics" not in snap:
+            raise ValueError("not a telemetry snapshot (no 'metrics')")
+        if instance is None:
+            instance = snap.get("instance") or "pid:%s" % snap.get("pid")
+        t = self._clock() if now is None else now
+        with self._lock:
+            self._instances[instance] = {"snap": snap, "t": t,
+                                         "stale": False}
+        FLEET_INGESTS.labels(source=source).inc()
+        self._update_gauges()
+        return instance
+
+    def scrape(self, endpoint: str,
+               instance: Optional[str] = None,
+               timeout_s: float = 5.0) -> str:
+        """Pull ``http://endpoint/metrics`` and ingest it (promparse
+        round-trip). ``endpoint`` is ``host:port`` — the exporter
+        port-file payload."""
+        from .promparse import parse_prometheus
+
+        with urlopen("http://%s/metrics" % endpoint,
+                     timeout=timeout_s) as resp:
+            text = resp.read().decode()
+        snap = parse_prometheus(text)
+        return self.ingest(snap, instance=instance or endpoint,
+                           source="scrape")
+
+    def poll(self, budget_s: float = 0.05) -> int:
+        """Drain pushed ``@TELEMETRY@`` frames, then sweep leases.
+        First pop blocks for the budget (paces a supervisor loop),
+        follow-ups only drain the backlog — the MembershipServer.poll
+        pattern. Returns frames absorbed."""
+        import numpy as np
+
+        n = 0
+        if self._server is not None:
+            deadline = self._clock() + max(budget_s, 0.0)
+            first_ms = max(int(budget_s * 1000), 1)
+            while True:
+                item = self._server.pop_async(
+                    timeout_ms=first_ms if n == 0 else 1)
+                if item is None:
+                    break
+                name, arr, _tid = item
+                if name == TELEMETRY_VAR:
+                    try:
+                        payload = json.loads(
+                            np.asarray(arr, dtype=np.uint8)
+                            .tobytes().decode())
+                        self.ingest(payload["snapshot"],
+                                    instance=payload.get("instance"),
+                                    source="push")
+                    except (ValueError, KeyError):
+                        pass  # torn/alien frame: drop, never crash
+                n += 1
+                if self._clock() >= deadline:
+                    break
+        self.sweep()
+        return n
+
+    # ---------------------------------------------------------- liveness
+    def sweep(self, now: Optional[float] = None) -> None:
+        """Apply lease expiry: live → stale past ``lease_s``, stale →
+        dropped past ``drop_after_s``."""
+        from .families import FLEET_EXPIRED
+
+        t = self._clock() if now is None else now
+        expired = 0
+        with self._lock:
+            for name in list(self._instances):
+                ent = self._instances[name]
+                age = t - ent["t"]
+                if age > self.drop_after_s:
+                    del self._instances[name]
+                elif age > self.lease_s and not ent["stale"]:
+                    ent["stale"] = True
+                    expired += 1
+        if expired:
+            FLEET_EXPIRED.inc(expired)
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        from .families import FLEET_INSTANCES
+
+        with self._lock:
+            stale = sum(1 for e in self._instances.values() if e["stale"])
+            live = len(self._instances) - stale
+        FLEET_INSTANCES.labels(state="live").set(live)
+        FLEET_INSTANCES.labels(state="stale").set(stale)
+
+    def instance_snapshot(self, instance: str) -> Optional[dict]:
+        """The last snapshot ingested for ``instance`` (None when
+        unknown) — per-instance reads for dashboards; the aggregate
+        view is :meth:`fleet_snapshot`."""
+        with self._lock:
+            ent = self._instances.get(instance)
+            return ent["snap"] if ent is not None else None
+
+    def instances(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """instance -> {stale, age_s, pid} (age since last report)."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            return {
+                name: {"stale": ent["stale"], "age_s": t - ent["t"],
+                       "pid": ent["snap"].get("pid")}
+                for name, ent in sorted(self._instances.items())
+            }
+
+    # ------------------------------------------------------- aggregation
+    def fleet_snapshot(self, include_stale: bool = True) -> dict:
+        """One snapshot-shaped dict over every tracked instance:
+        counters summed, gauges per-instance (``instance`` label
+        appended), histograms bucket-merged. Renders through the
+        ordinary ``Registry.render_prometheus``/stats_dump paths."""
+        with self._lock:
+            tracked = {name: ent["snap"]
+                       for name, ent in sorted(self._instances.items())
+                       if include_stale or not ent["stale"]}
+        metrics: Dict[str, dict] = {}
+        for instance, snap in tracked.items():
+            for name, m in snap["metrics"].items():
+                kind = m.get("type", "untyped")
+                fam = metrics.get(name)
+                if fam is None:
+                    lnames = list(m.get("labelnames") or [])
+                    if kind not in ("counter", "histogram"):
+                        lnames = lnames + ["instance"]
+                    fam = metrics[name] = {
+                        "type": kind, "help": m.get("help", ""),
+                        "labelnames": lnames, "samples": [],
+                        "_index": {}}
+                index = fam["_index"]
+                for s in m["samples"]:
+                    if kind == "counter":
+                        key = tuple(sorted(s["labels"].items()))
+                        acc = index.get(key)
+                        if acc is None:
+                            acc = index[key] = {
+                                "labels": dict(s["labels"]), "value": 0.0}
+                            fam["samples"].append(acc)
+                        _merge_counter(acc, s)
+                    elif kind == "histogram":
+                        key = tuple(sorted(s["labels"].items()))
+                        acc = index.get(key)
+                        if acc is None:
+                            acc = index[key] = {
+                                "labels": dict(s["labels"]),
+                                "sum": 0.0, "count": 0, "buckets": {}}
+                            fam["samples"].append(acc)
+                        _merge_histogram(acc, s)
+                    else:  # gauge/untyped: per-instance identity
+                        lbl = dict(s["labels"])
+                        lbl["instance"] = instance
+                        fam["samples"].append(
+                            {"labels": lbl, "value": s.get("value", 0.0)})
+        for fam in metrics.values():
+            fam.pop("_index", None)
+        return {"version": 1, "pid": None, "unix_time": None,
+                "instances": self.instances(), "metrics": metrics}
+
+    def close(self) -> None:
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+
+    def __enter__(self) -> "FleetCollector":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class TelemetryPusher:
+    """Process-side push producer: sends this process's registry
+    snapshot to a FleetCollector's endpoint as one ``@TELEMETRY@``
+    frame per :meth:`push`. Transport errors are swallowed after one
+    logged warning — telemetry must never take down the work it
+    measures (HeartbeatSender semantics)."""
+
+    def __init__(self, endpoint: str, instance: Optional[str] = None):
+        from .export import default_instance
+
+        self.endpoint = endpoint
+        self.instance = instance or default_instance()
+        self._client = None
+        self._warned = False
+
+    def push(self, snap: Optional[dict] = None) -> bool:
+        """Send one snapshot (default: the live registry's). Returns
+        False when the frame was dropped on a transport error."""
+        import numpy as np
+
+        from ..distributed.rpc import RPCClient, RPCError
+        from .families import REGISTRY
+
+        payload = json.dumps({
+            "instance": self.instance,
+            "snapshot": snap if snap is not None else REGISTRY.snapshot(),
+        }).encode()
+        try:
+            if self._client is None:
+                self._client = RPCClient(self.endpoint)
+                self._client.connect()
+            self._client.send_var(
+                TELEMETRY_VAR, np.frombuffer(payload, dtype=np.uint8))
+            return True
+        except (RPCError, OSError) as exc:
+            if not self._warned:
+                self._warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "telemetry endpoint %s unreachable (%s); further "
+                    "pushes from %s will be dropped silently",
+                    self.endpoint, exc, self.instance)
+            return False
+
+    def close(self) -> None:
+        c, self._client = self._client, None
+        if c is not None:
+            c.close()

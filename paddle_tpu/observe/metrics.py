@@ -33,7 +33,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Family", "Registry",
-           "DEFAULT_BUCKETS"]
+           "DEFAULT_BUCKETS", "quantile_from_buckets"]
 
 # 1-2-5 per decade, 1e-6 .. 1e3 (seconds-flavored but unit-agnostic:
 # byte-sized values simply land in +Inf's lower neighbors)
@@ -45,6 +45,32 @@ DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def quantile_from_buckets(buckets, count, q):
+    """Estimate quantile ``q`` from cumulative ``{le: count}`` buckets
+    (prometheus-style linear interpolation within the winning bucket;
+    the open-ended +Inf bucket reports its lower edge).
+
+    THE shared percentile implementation: ``Histogram.quantile``, the
+    bench serving sidecars, ``tools/serving_load.py`` and
+    ``tools/stats_dump.py`` all route through this one function so a
+    p99 means the same thing everywhere it is printed."""
+    if not count:
+        return None
+    target = q * count
+    prev_le, prev_c = 0.0, 0
+    items = sorted(((float("inf") if le == "+Inf" else float(le)), c)
+                   for le, c in buckets.items())
+    for le, c in items:
+        if c >= target:
+            if le == float("inf"):
+                return prev_le  # open-ended bucket: report its lower edge
+            span = c - prev_c
+            frac = (target - prev_c) / span if span else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_c = le, c
+    return prev_le
 
 
 def _escape_label_value(v: str) -> str:
@@ -157,6 +183,16 @@ class Histogram(_Child):
         out.append(("+Inf", acc + counts[-1]))
         return out
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated quantile from the fixed bucket boundaries (None
+        while empty). Resolution is bucket-width-bounded: with the
+        1-2-5/decade defaults the estimate lands within the true
+        value's bucket."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile must be in [0, 1]; got %r" % (q,))
+        return quantile_from_buckets(dict(self.cumulative_buckets()),
+                                     self.count, q)
+
     def _reset(self):
         self._counts = [0] * (len(self._bounds) + 1)
         self._sum = 0.0
@@ -230,6 +266,9 @@ class Family:
 
     def observe(self, value: float):
         self.labels().observe(value)
+
+    def quantile(self, q: float):
+        return self.labels().quantile(q)
 
     @property
     def value(self):

@@ -1,0 +1,170 @@
+"""Parse Prometheus text exposition back into a snapshot-shaped dict.
+
+The inverse of ``Registry.render_prometheus`` (metrics.py), and the
+parser the fleet scrape path uses: ``FleetCollector.scrape`` fetches a
+remote exporter's ``/metrics`` text and feeds it here to get the same
+``{"metrics": {name: {type, help, labelnames, samples}}}`` shape that
+``Registry.snapshot()`` produces, so aggregation (fleet.py) and the
+renderers (tools/stats_dump.py) never need to know whether a snapshot
+came from JSON or from the wire format.
+
+The contract tests/test_fleet_telemetry.py pins: render → parse →
+render is byte-identical for every declared family, including
+multi-label ordering, HELP escaping and histogram bucket ordering.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+__all__ = ["ParseError", "parse_prometheus"]
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(?:\{(.*)\})?"                        # optional {labels}
+    r"\s+(\S+)\s*$")                        # value
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+class ParseError(ValueError):
+    """A line the exposition grammar does not admit."""
+
+
+def _unescape(s: str) -> str:
+    out, i, n = [], 0, len(s)
+    while i < n:
+        c = s[i]
+        if c == "\\" and i + 1 < n:
+            nxt = s[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ("\\", '"'):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_labels(body: Optional[str]) -> Dict[str, str]:
+    if not body:
+        return {}
+    labels: Dict[str, str] = {}
+    pos = 0
+    while pos < len(body):
+        m = _LABEL_RE.match(body, pos)
+        if not m:
+            raise ParseError("bad label pair at %r" % (body[pos:pos + 40],))
+        labels[m.group(1)] = _unescape(m.group(2))
+        pos = m.end()
+        if pos < len(body):
+            if body[pos] != ",":
+                raise ParseError("expected ',' between labels in %r"
+                                 % (body,))
+            pos += 1
+    return labels
+
+
+def _parse_value(tok: str) -> float:
+    tok = tok.strip()
+    if tok == "+Inf":
+        return float("inf")
+    if tok == "-Inf":
+        return float("-inf")
+    try:
+        return float(tok)
+    except ValueError:
+        raise ParseError("bad sample value %r" % (tok,)) from None
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse exposition text into a snapshot-shaped dict (metrics.py
+    ``Registry.snapshot()`` layout; ``pid``/``unix_time`` are None —
+    the wire format does not carry them). Raises :class:`ParseError`
+    on malformed lines."""
+    metrics: Dict[str, dict] = {}
+    # per-histogram accumulation: label-signature -> sample dict, kept
+    # in first-seen order so re-rendering preserves sample order
+    hist_series: Dict[str, Dict[tuple, dict]] = {}
+
+    def family(name: str) -> dict:
+        fam = metrics.get(name)
+        if fam is None:
+            fam = metrics[name] = {"type": "untyped", "help": "",
+                                   "labelnames": [], "samples": []}
+        return fam
+
+    def hist_owner(name: str) -> Optional[str]:
+        # a family explicitly TYPEd under this exact name wins over a
+        # histogram-suffix interpretation (a counter named *_count is
+        # legal, if ill-advised)
+        if metrics.get(name, {}).get("type", "untyped") != "untyped":
+            return None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[:-len(suffix)]
+                if metrics.get(base, {}).get("type") == "histogram":
+                    return base
+        return None
+
+    def hist_sample(base: str, labels: Dict[str, str]) -> dict:
+        fam = metrics[base]
+        sig = tuple(sorted((k, v) for k, v in labels.items()
+                           if k != "le"))
+        table = hist_series.setdefault(base, {})
+        s = table.get(sig)
+        if s is None:
+            lbl = {k: v for k, v in labels.items() if k != "le"}
+            s = {"labels": lbl, "sum": 0.0, "count": 0, "buckets": {}}
+            table[sig] = s
+            fam["samples"].append(s)
+            if not fam["labelnames"] and lbl:
+                fam["labelnames"] = [k for k in labels if k != "le"]
+        return s
+
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "HELP":
+                family(parts[2])["help"] = _unescape(
+                    parts[3] if len(parts) > 3 else "")
+            elif len(parts) >= 4 and parts[1] == "TYPE":
+                family(parts[2])["type"] = parts[3]
+            # other comments are legal exposition; skip
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ParseError("line %d: unparseable sample %r"
+                             % (lineno, raw))
+        name, label_body, value_tok = m.groups()
+        labels = _parse_labels(label_body)
+        value = _parse_value(value_tok)
+        base = hist_owner(name)
+        if base is not None:
+            s = hist_sample(base, labels)
+            if name.endswith("_bucket"):
+                if "le" not in labels:
+                    raise ParseError("line %d: histogram bucket without "
+                                     "le label" % lineno)
+                s["buckets"][labels["le"]] = int(value) \
+                    if float(value).is_integer() else value
+            elif name.endswith("_sum"):
+                s["sum"] = value
+            else:
+                s["count"] = int(value) if float(value).is_integer() \
+                    else value
+            continue
+        fam = family(name)
+        fam["samples"].append({"labels": labels, "value": value})
+        if not fam["labelnames"] and labels:
+            fam["labelnames"] = list(labels)
+    return {"version": 1, "pid": None, "unix_time": None,
+            "metrics": metrics}

@@ -1,0 +1,276 @@
+"""Declared service-level objectives evaluated over telemetry windows.
+
+An objective is a named GOOD-condition over the registry, declared in
+a one-line grammar (docs/OBSERVABILITY.md "SLO grammar"):
+
+    p99(paddle_serving_request_seconds)              < 0.25
+    p99(paddle_executor_run_seconds{site=run,phase=dispatch}) < 0.1
+    rate(paddle_serving_requests_total{outcome=error})        < 0.5
+    ratio(paddle_serving_router_rejected_total,
+          paddle_serving_requests_total)             < 0.01
+    value(paddle_resilience_heartbeat_age_seconds)   < 30
+
+* ``pNN(hist)``  — quantile of the observations that landed IN THE
+  WINDOW (bucket deltas between successive evaluations, fed to the
+  shared ``quantile_from_buckets``) — a long-gone latency spike cannot
+  breach forever, and a sustained burn breaches every window.
+* ``rate(ctr)``  — counter increase / window seconds.
+* ``ratio(a,b)`` — windowed delta(a) / delta(b) (error-rate shape);
+  vacuously good while delta(b) is 0.
+* ``value(g)``   — the gauge's current reading (staleness shape).
+
+Selectors match samples whose labels ⊇ the given ``{l=v,...}`` pairs;
+multiple matches sum (counters/rates), bucket-merge (quantiles).
+
+:class:`SloMonitor` owns the windows: each :meth:`evaluate` call
+closes one window (opened by the previous call) and checks every
+objective once — so a breached objective increments
+``paddle_slo_breaches_total{objective}`` and fires the ``subscribe``d
+callbacks EXACTLY once per evaluation window, the contract the chaos
+test pins. The router's :meth:`~ReplicaRouter.on_breach` is a ready-
+made subscriber.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .metrics import quantile_from_buckets
+
+__all__ = ["Objective", "Breach", "SloMonitor"]
+
+_EXPR_RE = re.compile(
+    r"^\s*(p\d{1,3}|rate|ratio|value)\s*\(\s*(.*?)\s*\)\s*"
+    r"(<=|<|>=|>)\s*([-+0-9.eEinf]+)\s*$")
+_SELECTOR_RE = re.compile(
+    r"^\s*([a-zA-Z_:][a-zA-Z0-9_:]*)\s*(?:\{(.*)\})?\s*$")
+
+_OPS = {
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+}
+
+
+def _split_args(body: str) -> List[str]:
+    """Split on top-level commas (label blocks keep their commas)."""
+    out, depth, cur = [], 0, []
+    for ch in body:
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur or not out:
+        out.append("".join(cur).strip())
+    return out
+
+
+def _parse_selector(text: str):
+    m = _SELECTOR_RE.match(text)
+    if not m:
+        raise ValueError("bad metric selector %r" % (text,))
+    name, body = m.group(1), m.group(2)
+    labels: Dict[str, str] = {}
+    if body:
+        for part in body.split(","):
+            k, eq, v = part.partition("=")
+            if not eq:
+                raise ValueError("bad label matcher %r in %r"
+                                 % (part, text))
+            labels[k.strip()] = v.strip().strip('"')
+    return name, labels
+
+
+def _matching(snap: dict, name: str, labels: Dict[str, str]):
+    m = snap["metrics"].get(name)
+    if m is None:
+        return []
+    return [s for s in m["samples"]
+            if all(s["labels"].get(k) == v for k, v in labels.items())]
+
+
+def _scalar_total(snap: dict, name: str, labels: Dict[str, str]):
+    samples = _matching(snap, name, labels)
+    if not samples:
+        return None
+    return sum(s.get("value", s.get("count", 0.0)) for s in samples)
+
+
+def _merged_hist(snap: dict, name: str, labels: Dict[str, str]):
+    samples = [s for s in _matching(snap, name, labels) if "buckets" in s]
+    if not samples:
+        return None
+    buckets: Dict[str, float] = {}
+    count = 0
+    for s in samples:
+        count += s["count"]
+        for le, c in s["buckets"].items():
+            buckets[le] = buckets.get(le, 0) + c
+    return buckets, count
+
+
+class Objective:
+    """One parsed objective: ``name`` labels the breach counter series,
+    ``expr`` is the good-condition in the grammar above."""
+
+    def __init__(self, name: str, expr: str):
+        m = _EXPR_RE.match(expr)
+        if not m:
+            raise ValueError("unparseable SLO expression %r" % (expr,))
+        fn, body, op, threshold = m.groups()
+        self.name = name
+        self.expr = expr
+        self.fn = fn
+        self.op = op
+        self.threshold = float(threshold)
+        args = _split_args(body)
+        if fn == "ratio":
+            if len(args) != 2:
+                raise ValueError("ratio() takes two selectors: %r"
+                                 % (expr,))
+            self.selectors = [_parse_selector(a) for a in args]
+        else:
+            if len(args) != 1:
+                raise ValueError("%s() takes one selector: %r"
+                                 % (fn, expr))
+            self.selectors = [_parse_selector(args[0])]
+        if fn.startswith("p") and fn not in ("rate", "ratio", "value"):
+            q = int(fn[1:])
+            if not 0 <= q <= 100:
+                raise ValueError("quantile out of range in %r" % (expr,))
+            self.q = q / 100.0
+
+    # ------------------------------------------------------------- value
+    def measure(self, prev: Optional[dict], cur: dict,
+                dt: Optional[float]):
+        """The objective's windowed value, or None when the window has
+        no data for it (no data = no verdict, never a breach)."""
+        name, labels = self.selectors[0]
+        if self.fn == "value":
+            return _scalar_total(cur, name, labels)
+        if prev is None or not dt or dt <= 0:
+            return None  # no closed window yet
+        if self.fn == "rate":
+            a = _scalar_total(prev, name, labels)
+            b = _scalar_total(cur, name, labels)
+            if a is None or b is None:
+                return None
+            return (b - a) / dt
+        if self.fn == "ratio":
+            (na, la), (nb, lb) = self.selectors
+            a0, a1 = _scalar_total(prev, na, la), _scalar_total(cur, na, la)
+            b0, b1 = _scalar_total(prev, nb, lb), _scalar_total(cur, nb, lb)
+            if None in (a0, a1, b0, b1) or (b1 - b0) <= 0:
+                return None
+            return (a1 - a0) / (b1 - b0)
+        # quantile over the window's observations: bucket deltas
+        hp = _merged_hist(prev, name, labels)
+        hc = _merged_hist(cur, name, labels)
+        if hc is None:
+            return None
+        buckets_c, count_c = hc
+        buckets_p, count_p = hp if hp is not None else ({}, 0)
+        dcount = count_c - count_p
+        if dcount <= 0:
+            return None
+        dbuckets = {le: c - buckets_p.get(le, 0)
+                    for le, c in buckets_c.items()}
+        return quantile_from_buckets(dbuckets, dcount, self.q)
+
+    def ok(self, value) -> bool:
+        return _OPS[self.op](value, self.threshold)
+
+
+class Breach:
+    """One objective violation in one evaluation window."""
+
+    __slots__ = ("objective", "expr", "value", "threshold", "window_s")
+
+    def __init__(self, objective, expr, value, threshold, window_s):
+        self.objective = objective
+        self.expr = expr
+        self.value = value
+        self.threshold = threshold
+        self.window_s = window_s
+
+    def __repr__(self):
+        return ("Breach(%s: %s — measured %.6g over %.3gs window)"
+                % (self.objective, self.expr, self.value,
+                   self.window_s or 0.0))
+
+
+class SloMonitor:
+    """Window-closing evaluator over a snapshot source (default: this
+    process's live registry; pass ``source`` to monitor a
+    FleetCollector's ``fleet_snapshot`` instead)."""
+
+    def __init__(self, *, source: Optional[Callable[[], dict]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._source = source
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._objectives: Dict[str, Objective] = {}
+        self._callbacks: List[Callable] = []
+        self._prev: Optional[dict] = None
+        self._prev_t: Optional[float] = None
+
+    def objective(self, name: str, expr: str) -> Objective:
+        """Declare (or replace) an objective; pre-materializes its
+        breach-counter series so the schema shows it at 0."""
+        from .families import SLO_BREACHES
+
+        obj = Objective(name, expr)
+        with self._lock:
+            self._objectives[name] = obj
+        SLO_BREACHES.labels(objective=name)
+        return obj
+
+    def subscribe(self, callback: Callable) -> None:
+        """``callback(breach)`` per breach per window (e.g. a router's
+        ``on_breach``)."""
+        with self._lock:
+            self._callbacks.append(callback)
+
+    def evaluate(self, now: Optional[float] = None) -> List[Breach]:
+        """Close the current window: measure every objective against
+        (previous snapshot, current snapshot), fire breaches, open the
+        next window. The first call only establishes the baseline."""
+        from .families import SLO_BREACHES, SLO_EVALUATIONS
+
+        if self._source is not None:
+            snap = self._source()
+        else:
+            from .families import REGISTRY
+
+            snap = REGISTRY.snapshot()
+        t = self._clock() if now is None else now
+        with self._lock:
+            prev, prev_t = self._prev, self._prev_t
+            self._prev, self._prev_t = snap, t
+            objectives = list(self._objectives.values())
+            callbacks = list(self._callbacks)
+        SLO_EVALUATIONS.inc()
+        dt = (t - prev_t) if prev_t is not None else None
+        breaches: List[Breach] = []
+        for obj in objectives:
+            value = obj.measure(prev, snap, dt)
+            if value is None or obj.ok(value):
+                continue
+            breach = Breach(obj.name, obj.expr, value, obj.threshold, dt)
+            breaches.append(breach)
+            SLO_BREACHES.labels(objective=obj.name).inc()
+            for cb in callbacks:
+                try:
+                    cb(breach)
+                except Exception:  # noqa: BLE001 — a bad subscriber
+                    pass           # must not mask other breaches
+        return breaches

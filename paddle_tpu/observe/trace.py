@@ -197,7 +197,8 @@ class FlightRecorder:
             json.dump(payload, f, indent=1, sort_keys=True, default=repr)
         os.replace(tmp, path)
         TRACE_DUMPS.labels(reason=reason if reason in
-                           ("wedge", "crash", "atexit") else "manual").inc()
+                           ("wedge", "crash", "atexit", "signal")
+                           else "manual").inc()
         return payload
 
 

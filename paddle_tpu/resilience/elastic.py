@@ -81,6 +81,7 @@ ENV_STEPS = "PADDLE_TPU_ELASTIC_STEPS"
 ENV_CKPT_EVERY = "PADDLE_TPU_ELASTIC_CHECKPOINT_EVERY"
 ENV_BUILDER = "PADDLE_TPU_ELASTIC_BUILDER"
 ENV_TELEMETRY = "PADDLE_TPU_ELASTIC_TELEMETRY_OUT"
+ENV_METRICS_LINGER = "PADDLE_TPU_METRICS_LINGER_S"
 
 # worker exit codes the supervisor reads
 RC_OK = 0
@@ -280,12 +281,43 @@ def _dump_worker_telemetry() -> None:
         print("telemetry sidecar failed: %s" % exc, file=sys.stderr)
 
 
+def _linger_and_stop_exporter() -> None:
+    """Normal-exit exporter teardown: hold ``/metrics`` open for
+    ``PADDLE_TPU_METRICS_LINGER_S`` extra seconds so a fleet scraper
+    can catch the FINAL (post-sidecar-dump) state before the socket
+    disappears, then stop the thread."""
+    from ..observe.export import active_exporter, stop_exporter
+
+    if active_exporter() is None:
+        return
+    try:
+        linger = float(os.environ.get(ENV_METRICS_LINGER) or 0.0)
+    except ValueError:
+        linger = 0.0
+    if linger > 0:
+        time.sleep(linger)
+    stop_exporter()
+
+
 def worker_main(argv: Optional[List[str]] = None) -> int:
     """Entry for spawned elastic workers
     (``python -m paddle_tpu.resilience.elastic``); the role and the
     whole job spec ride the PADDLE_TPU_ELASTIC_* env contract."""
     del argv
+    from ..observe import export as _export
+    from ..observe import shutdown as _shutdown
+
     role = os.environ.get(ENV_ROLE, "trainer")
+    # fleet telemetry: every worker exports live metrics when the
+    # supervisor's environment asks for it (PADDLE_TPU_METRICS_PORT;
+    # _spawn hands each worker its own port-file rendezvous), and a
+    # supervisor SIGTERM flushes the same sidecar the normal exit
+    # path writes — a torn-down worker leaves forensics, not nothing
+    _export.start_from_env()
+    out = os.environ.get(ENV_TELEMETRY)
+    if out:
+        os.environ.setdefault(_shutdown.ENV_SIDECAR, out)
+    _shutdown.install_shutdown_handlers()
     try:
         if role == "pserver":
             return _run_pserver()
@@ -304,6 +336,7 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
         return RC_FAULT
     finally:
         _dump_worker_telemetry()
+        _linger_and_stop_exporter()
 
 
 # --------------------------------------------------------- supervisor
@@ -453,6 +486,9 @@ class ElasticJobSupervisor:
             env["PADDLE_TRAINING_ROLE"] = "PSERVER"
             if self.ps_recover_dir and generation > 0:
                 env["PADDLE_TPU_PS_RECOVER_DIR"] = self.ps_recover_dir
+            env[ENV_TELEMETRY] = os.path.join(
+                self.workdir, "telemetry",
+                "gen%d_pserver%d.json" % (generation, tid))
             log_name = "gen%d_pserver%d.log" % (generation, tid)
         else:
             rank = world["trainers"].index(tid)
@@ -469,6 +505,14 @@ class ElasticJobSupervisor:
                 env.update(self.worker_env.get(tid, {}))
             self._spawned_once.add(tid)
             log_name = "gen%d_trainer%d.log" % (generation, tid)
+        from ..observe.export import ENV_PORT, ENV_PORT_FILE
+
+        if env.get(ENV_PORT):
+            # exporting fleet: each worker gets its own port-file
+            # rendezvous, named per instance (not per generation) so a
+            # scraper follows the same file across respawns
+            env[ENV_PORT_FILE] = os.path.join(
+                self.workdir, "telemetry", "%s%d.port" % (role, tid))
         log_path = os.path.join(self.workdir, "logs", log_name)
         log_f = open(log_path, "ab")
         # -c (not -m): runpy would import the module a second time as

@@ -545,6 +545,12 @@ class DecodeEngine:
         budget = self.device_budget
         if budget is not None:
             predicted = self.predicted_bytes(P)
+            if predicted is not None:
+                from ..observe.families import SERVING_MEMORY_HEADROOM
+
+                # the live headroom signal the fleet dashboard and the
+                # roadmap's autoscaler watch (negative = this denial)
+                SERVING_MEMORY_HEADROOM.set(budget - predicted)
             if predicted is not None and predicted > budget:
                 from ..observe.families import SERVING_MEMORY_DENIED
 

@@ -47,6 +47,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from ..observe import trace as _tr
+from ..observe.timeseries import Ewma
 from .queue import Cancelled, DeadlineExpired, QueueFull, ServingRequest
 
 __all__ = ["ReplicaRouter", "TenantQuotaExceeded"]
@@ -106,8 +107,11 @@ class ReplicaRouter:
         self._factory = engine_factory
         self._tenant_quotas = dict(tenant_quotas or {})
         self._default_quota = default_quota
-        self._rate_tps = (float(service_rate_tps)
-                          if service_rate_tps else None)
+        # the shared smoothing implementation (observe/timeseries.py):
+        # the fleet plane reads rates with the identical arithmetic
+        self._rate = Ewma(alpha=0.2,
+                          initial=(float(service_rate_tps)
+                                   if service_rate_tps else None))
         self._max_readmissions = int(max_readmissions)
         self._stall_deadline_s = stall_deadline_s
         self._poll_s = float(poll_s)
@@ -223,6 +227,14 @@ class ReplicaRouter:
         watchdog fires, instead of waiting out the poll interval."""
         self._nudge.set()
 
+    def on_breach(self, breach=None) -> None:
+        """SLO-monitor hook: pass as
+        ``SloMonitor(...).subscribe(router.on_breach)`` (observe/slo.py)
+        to trigger an immediate health sweep when an objective breaches
+        — a latency SLO burning is often a replica wedging, and the
+        sweep is the router's cheapest diagnostic."""
+        self._nudge.set()
+
     def set_stall_deadline(self, seconds: Optional[float]) -> None:
         """(Re)arm wedge detection at a new deadline; ``None`` disarms.
         The monitor reads the deadline on every poll, so this takes
@@ -243,7 +255,7 @@ class ReplicaRouter:
                 and r.idx not in exclude]
 
     def _projected_wait(self) -> Optional[float]:
-        rate = self._rate_tps
+        rate = self._rate.value
         if rate is None or rate <= 0:
             return None
         cands = self._healthy()
@@ -359,8 +371,7 @@ class ReplicaRouter:
         # EWMA refinement of the per-stream token rate the SLO
         # projection divides by (inst includes queue wait — a loaded
         # fleet projects pessimistically, which is the safe direction)
-        self._rate_tps = (inst if self._rate_tps is None
-                          else 0.8 * self._rate_tps + 0.2 * inst)
+        self._rate.update(inst)
 
     # --------------------------------------------------------- monitoring
     def _monitor_loop(self) -> None:

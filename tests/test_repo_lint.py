@@ -63,6 +63,11 @@ def _fake_repo(tmp_path, resilience_src, other_src):
     (tmp_path / "paddle_tpu" / "resilience" / "mod.py").write_text(
         resilience_src)
     (tmp_path / "paddle_tpu" / "other.py").write_text(other_src)
+    # every declared family is referenced from tools/ so the dead-family
+    # rule (9) stays quiet in these synthetic repos unless a test
+    # removes this file to exercise it deliberately
+    (tmp_path / "tools" / "use_families.py").write_text(
+        'USED = (%r, %r)\n' % (good_counter, good_hist))
     return str(tmp_path)
 
 
@@ -431,3 +436,49 @@ def test_env_knob_scan_matches_real_tree():
     documented = repo_lint.documented_knobs(ROOT)
     assert set(reads) <= documented
     assert repo_lint.env_knob_violations(ROOT) == []
+
+
+# -------------------------------------------------- rule 9: dead families
+def test_dead_family_detected(tmp_path):
+    """A family declared in families.py but never referenced anywhere
+    in paddle_tpu/, tools/ or bench.py is a forever-zero series —
+    rule 9 names it."""
+    root = _fake_repo(tmp_path, "x = 1\n", "x = 1\n")
+    os.remove(os.path.join(root, "tools", "use_families.py"))
+    out = repo_lint.dead_family_violations(root)
+    assert len(out) == 2
+    assert any("paddle_good" + "_things_total" in v for v in out)
+    assert any("paddle_good" + "_seconds" in v for v in out)
+    # and run() carries them too (the rule is wired into the gate)
+    assert any("never referenced" in v for v in repo_lint.run(root))
+
+
+def test_dead_family_reference_forms(tmp_path):
+    """All three reference forms keep a family alive: the declaration
+    VAR imported by name, the VAR used as a bare name, and the family
+    name as a string literal (render-suffix variants included).
+    tests/ and examples/ do NOT count as references."""
+    counter = "paddle_good" + "_things_total"
+    hist_ref = "paddle_good" + "_seconds_bucket"  # suffix resolves to base
+    # import of the declaration var A keeps the counter alive; a string
+    # literal (with render suffix) keeps the histogram alive
+    root = _fake_repo(
+        tmp_path, "from ..observe.families import A\n",
+        'S = "%s"\n' % hist_ref)
+    os.remove(os.path.join(root, "tools", "use_families.py"))
+    assert repo_lint.dead_family_violations(root) == []
+    # a reference that only lives in tests/ does not count
+    root2 = _fake_repo(tmp_path / "b", "x = 1\n", "x = 1\n")
+    os.remove(os.path.join(root2, "tools", "use_families.py"))
+    with open(os.path.join(root2, "tests", "t.py"), "w") as f:
+        f.write('S = "%s"\nfrom x import A, B\n' % counter)
+    assert len(repo_lint.dead_family_violations(root2)) == 2
+
+
+def test_declared_family_vars_parse_real_tree():
+    """The VAR-name map over the real families.py resolves the
+    telemetry-plane declarations this PR added."""
+    fams = repo_lint.declared_family_vars(ROOT)
+    assert fams.get("SLO_BREACHES") == "paddle_slo" + "_breaches_total"
+    assert fams.get("FLEET_INSTANCES") == "paddle_fleet" + "_instances"
+    assert repo_lint.dead_family_violations(ROOT) == []

@@ -73,6 +73,15 @@ tests/test_repo_lint.py):
    against module-level tuple assignments, and ``for V in (...)``
    loops over literal tuples.
 
+9. **dead-family** — the reverse of rule 2: every family declared in
+   ``families.py`` must be REFERENCED somewhere in ``paddle_tpu/``,
+   ``tools/`` or ``bench.py`` (by the module-level variable it is
+   assigned to, or by its name in a string literal). A declared-but-
+   never-written family is schema noise: it renders as a forever-zero
+   series that reads like "this subsystem did nothing" when the truth
+   is "nothing ever reports here". Tests/examples do not count as
+   references — a family only a test touches measures nothing.
+
 Usage: ``python tools/repo_lint.py [--root DIR]``; exit 1 on violations.
 """
 
@@ -148,6 +157,71 @@ def declared_families(root: str) -> Set[str]:
                 and isinstance(node.args[0].value, str):
             names.add(node.args[0].value)
     return names
+
+
+def declared_family_vars(root: str) -> Dict[str, str]:
+    """{module-level variable: family name} for every
+    ``VAR = REGISTRY.counter/gauge/histogram("name", ...)`` assignment
+    in observe/families.py — the identifiers call sites import, which
+    is how rule 9 resolves a code reference back to its family."""
+    tree = _parse(os.path.join(root, FAMILIES_FILE))
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("counter", "gauge", "histogram")):
+            continue
+        if not (call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = call.args[0].value
+    return out
+
+
+def dead_family_violations(root: str, files=None) -> List[str]:
+    """Rule 9: declared ⊆ referenced. A reference is the family's
+    assignment variable used (or imported) in ``paddle_tpu/``,
+    ``tools/`` or ``bench.py``, or the family name appearing inside a
+    string literal there (the ``REGISTRY.get("...")``/snapshot-reader
+    idiom). families.py itself and the tests/examples trees never
+    count."""
+    var_to_name = declared_family_vars(root)
+    declared = declared_families(root)
+    referenced: Set[str] = set()
+    fam_rel = FAMILIES_FILE.replace("/", os.sep)
+    for path in (files or iter_py_files(root)):
+        rel = os.path.relpath(path, root)
+        if rel == fam_rel or rel.split(os.sep)[0] in ("tests", "examples"):
+            continue
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.Name) and node.id in var_to_name:
+                referenced.add(var_to_name[node.id])
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name in var_to_name:
+                        referenced.add(var_to_name[alias.name])
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                for m in _FAMILY_RE.finditer(node.value):
+                    name = m.group(0)
+                    for suf in ("",) + _RENDER_SUFFIXES:
+                        base = name[: -len(suf)] if suf else name
+                        if base in declared:
+                            referenced.add(base)
+                            break
+    violations = []
+    for name in sorted(declared - referenced):
+        violations.append(
+            "%s: family %r is declared but never referenced in "
+            "paddle_tpu/, tools/ or bench.py (a forever-zero series is "
+            "schema noise — wire it up or remove the declaration)"
+            % (FAMILIES_FILE, name))
+    return violations
 
 
 def bare_except_violations(root: str, paths=None) -> List[str]:
@@ -569,7 +643,8 @@ def run(root: str = REPO_ROOT) -> List[str]:
             + kernel_registry_violations(root)
             + fault_site_violations(root)
             + range_rule_coverage_violations(root)
-            + env_knob_violations(root))
+            + env_knob_violations(root)
+            + dead_family_violations(root))
 
 
 def main(argv=None) -> int:

@@ -38,12 +38,20 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 
-def _pctl(sorted_vals, q):
-    if not sorted_vals:
-        return None
-    i = min(len(sorted_vals) - 1,
-            max(0, int(round(q * (len(sorted_vals) - 1)))))
-    return sorted_vals[i]
+def _latency_hist(lat_s):
+    """Fold raw latencies into a PRIVATE histogram (the declared
+    request-latency family's bucket schema) so p50/p99 come from the
+    shared ``Histogram.quantile`` — the same estimator every sidecar
+    reader uses — instead of a hand-rolled percentile. Private
+    registry on purpose: the engine already observes these requests
+    into the process-wide ``paddle_serving_request_seconds``; folding
+    them again there would double-count."""
+    from paddle_tpu.observe.metrics import Registry
+
+    hist = Registry().histogram("paddle_serving_request_seconds")
+    for v in lat_s:
+        hist.observe(v)
+    return hist
 
 
 def drive(router, n_requests: int, mean_gap_s: float, *,
@@ -162,14 +170,14 @@ def drive(router, n_requests: int, mean_gap_s: float, *,
                       before["paddle_serving_spec_proposed_tokens_total"])
     accepted = _delta("paddle_serving_spec_accepted_tokens_total",
                       before["paddle_serving_spec_accepted_tokens_total"])
-    lat.sort()
+    hist = _latency_hist(lat)
     return {
         "requests": n_requests,
         "wall_s": wall,
         "tokens": tokens_done,
         "tokens_per_sec": tokens_done / wall if wall > 0 else 0.0,
-        "p50_ms": (1e3 * _pctl(lat, 0.50)) if lat else None,
-        "p99_ms": (1e3 * _pctl(lat, 0.99)) if lat else None,
+        "p50_ms": (1e3 * hist.quantile(0.50)) if lat else None,
+        "p99_ms": (1e3 * hist.quantile(0.99)) if lat else None,
         "outcomes": outcomes,
         "prefix_hit_rate": (hits / (hits + misses)
                             if hits + misses else None),

@@ -11,6 +11,12 @@ Usage:
                                                  # per-family deltas B vs A
     python tools/stats_dump.py BENCH_serving_decode.telemetry.json \
         --grep paddle_serving                    # just one family group
+    python tools/stats_dump.py --watch 127.0.0.1:9464 --interval 2
+                                                 # live: poll an exporter's
+                                                 # /snapshot.json; first
+                                                 # scrape renders the table,
+                                                 # later ones the diff vs
+                                                 # the previous scrape
 
 Reads the JSON written by `paddle_tpu.observe.dump()` (bench.py drops one
 per workload row, including failed rows) and renders counters/gauges as a
@@ -218,6 +224,49 @@ def render_diff(snap_a, snap_b, name_a="A", name_b="B", show_all=False,
               "the schema)", file=out)
 
 
+def _fetch_snapshot(endpoint, timeout_s=5.0):
+    """Pull /snapshot.json from a MetricsExporter (observe/export.py).
+    stdlib-only on purpose: the watch loop must work from any shell
+    without importing (or paying for) paddle_tpu."""
+    from urllib.request import urlopen
+
+    with urlopen("http://%s/snapshot.json" % endpoint,
+                 timeout=timeout_s) as resp:
+        snap = json.loads(resp.read().decode())
+    if "metrics" not in snap:
+        raise ValueError("%s/snapshot.json is not a telemetry snapshot"
+                         % endpoint)
+    return snap
+
+
+def watch(endpoint, interval=2.0, count=None, grep=None,
+          show_all=False, out=sys.stdout):
+    """Live mode: poll an exporter endpoint. The first scrape renders
+    the full table; every later one renders the per-series diff
+    against the PREVIOUS scrape (the same renderers as the file
+    modes, so --grep/--all compose unchanged)."""
+    import time
+
+    prev, n = None, 0
+    try:
+        while True:
+            snap = _fetch_snapshot(endpoint)
+            if prev is None:
+                render_table(snap, show_all=show_all, grep=grep, out=out)
+            else:
+                render_diff(prev, snap,
+                            name_a="scrape %d" % n,
+                            name_b="scrape %d" % (n + 1),
+                            show_all=show_all, grep=grep, out=out)
+            print(file=out, flush=True)
+            prev, n = snap, n + 1
+            if count is not None and n >= count:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def _load_snapshot(path, ap):
     with open(path) as f:
         snap = json.load(f)
@@ -243,7 +292,25 @@ def main(argv=None):
     ap.add_argument("--grep", default=None, metavar="SUBSTR",
                     help="only families whose name contains SUBSTR (e.g. "
                          "paddle_serving for the serving scheduler view)")
+    ap.add_argument("--watch", default=None, metavar="HOST:PORT",
+                    help="live mode: poll a MetricsExporter's "
+                         "/snapshot.json; table first, then diffs vs "
+                         "the previous scrape")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch poll interval (seconds)")
+    ap.add_argument("--count", type=int, default=None,
+                    help="--watch: stop after N scrapes (default: "
+                         "until Ctrl-C)")
     args = ap.parse_args(argv)
+
+    if args.watch is not None:
+        if args.live or args.snapshot is not None or args.prometheus \
+                or args.diff is not None:
+            ap.error("--watch composes only with --grep/--all/"
+                     "--interval/--count")
+        return watch(args.watch, interval=args.interval,
+                     count=args.count, grep=args.grep,
+                     show_all=args.all)
 
     if args.diff is not None:
         if args.live or args.snapshot is not None or args.prometheus:
