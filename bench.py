@@ -207,6 +207,46 @@ def _peak_bytes_fields(main, feed, fetch_list, scope=None, spc=1,
     return out
 
 
+def _cost_fields(main, feed, fetch_list, scope=None, spc=1,
+                 step_seconds=None):
+    """The roofline columns (analysis/cost.py): ``predicted_seconds``
+    (the model's per-step estimate at this row's batch and
+    steps-per-call) and ``cost_model_ratio`` (predicted / measured —
+    the quantity the zoo gate bounds at 4x). Returns ``(fields,
+    analytic_flops)``; the analytic per-step FLOPs feed ``_mfu_fields``
+    so MFU no longer depends on the backend's own ``cost_analysis``
+    (which prices the whole compiled module, fusion artifacts
+    included). Both columns are number-or-null, NEVER 0.0, per the
+    PR 12 convention; ``PADDLE_TPU_COST_MODEL=0`` nulls them and moves
+    no ``paddle_cost_*`` family."""
+    fields = {"predicted_seconds": None, "cost_model_ratio": None}
+    try:
+        from paddle_tpu.analysis.cost import (CostAnalysis,
+                                              cost_model_enabled)
+
+        if not cost_model_enabled():
+            return fields, None
+        batch = 1
+        for v in (feed or {}).values():
+            shape = np.shape(v)
+            if shape:
+                batch = max(1, int(shape[0]))
+                break
+        names = [getattr(v, "name", str(v)) for v in (fetch_list or [])]
+        ca = CostAnalysis(main, fetch_names=names, scope=scope,
+                          site="bench")
+        flops = ca.flops(batch)
+        pred = ca.predicted_seconds(batch, steps_per_call=spc)
+        if pred > 0:
+            fields["predicted_seconds"] = _round_nonzero(pred, 6)
+            if step_seconds and step_seconds > 0:
+                fields["cost_model_ratio"] = _round_nonzero(
+                    pred / step_seconds, 3)
+        return fields, (flops if flops > 0 else None)
+    except Exception:
+        return fields, None
+
+
 def _fused_attention_on():
     from paddle_tpu.ops.attention import fused_attention_enabled
 
@@ -449,7 +489,14 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
 
         throughput = items_per_batch * steps / dt
         _log("%s: cost_analysis" % name)
-        step_flops = exe.cost_analysis(
+        # analytic FLOPs (analysis/cost.py) price the PROGRAM the row
+        # ran, so MFU is comparable across backends and fusion
+        # decisions; the backend's own cost_analysis remains the
+        # fallback when the cost model is off or has no rule coverage
+        cost_fields, analytic_flops = _cost_fields(
+            main, feed, [loss], scope=scope, spc=spc,
+            step_seconds=dt / steps)
+        step_flops = analytic_flops or exe.cost_analysis(
             main, feed=feed, fetch_list=[loss], scope=scope).get("flops", 0.0)
         peak = peak_flops()
         import jax as _jax
@@ -531,6 +578,10 @@ def _run_workload(name, unit, items_per_batch, build_fn, feed_fn, amp,
             # (analysis/memory.py; number-or-null, never 0.0)
             **_peak_bytes_fields(main, feed, [loss], scope=scope,
                                  spc=spc, exe=exe),
+            # roofline prediction next to the measurement it models
+            # (analysis/cost.py; number-or-null, never 0.0; purely
+            # informational — pin_baselines provably ignores both)
+            **cost_fields,
         }
         print(json.dumps(rec), flush=True)
         return rec

@@ -19,6 +19,9 @@ See docs/ANALYSIS.md for the rule catalog and how to write a rule.
 
 from . import range_rules  # noqa: F401  (attaches the transfer set)
 from . import shape_rules  # noqa: F401  (attaches the core rule set)
+from .cost import (CostAnalysis, DeviceModel,  # noqa: F401
+                   cost_model_enabled, predict_step_seconds)
+from .cost_rules import register_cost_rule  # noqa: F401 (attaches rules)
 from .dataflow import Dataflow  # noqa: F401
 from .infer import (Finding, InferContext, InferError,  # noqa: F401
                     ProgramVerifyError, infer_program_shapes,
@@ -36,7 +39,9 @@ __all__ = [
     "AbstractValue",
     "BytesPoly",
     "Calibration",
+    "CostAnalysis",
     "Dataflow",
+    "DeviceModel",
     "Finding",
     "InferContext",
     "InferError",
@@ -47,12 +52,15 @@ __all__ = [
     "RangeAnalysis",
     "RangeContext",
     "RewriteViolation",
+    "cost_model_enabled",
     "decode_cache_bytes",
     "describe_rewrites",
     "device_budget",
     "estimate_peak_bytes",
     "infer_program_shapes",
     "lint_program",
+    "predict_step_seconds",
+    "register_cost_rule",
     "register_footprint_rule",
     "register_range_rule",
     "tv_enabled",

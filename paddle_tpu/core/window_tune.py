@@ -299,7 +299,9 @@ def _memory_pruned(program, feed, fetch_list, scope, cands
 def tune_train_window(executor, program, feed: Dict[str, Any],
                       fetch_list: Optional[Sequence] = None,
                       scope=None, *, candidates: Optional[Sequence[int]]
-                      = None, persist: bool = True) -> Dict[str, Any]:
+                      = None, persist: bool = True,
+                      cost_pruned: Optional[Dict[int, float]] = None
+                      ) -> Dict[str, Any]:
     """Measure every candidate window length for (program, feed) on
     ``executor`` and install/persist the winner (module doc above).
     Returns the decision dict (``choice``/``cfg``/``seconds``/
@@ -307,7 +309,11 @@ def tune_train_window(executor, program, feed: Dict[str, Any],
     training never perturbs it. Candidates whose statically predicted
     peak exceeds the device budget are skipped without measurement
     (``_memory_pruned``; their timings entries carry ``pruned: True``
-    and ``seconds: None``)."""
+    and ``seconds: None``). ``cost_pruned`` ({K: predicted seconds},
+    from ``kernels.autotune``) records Ks the roofline already
+    eliminated: they get the same pruned-entry treatment, with
+    ``predicted_seconds`` instead of ``predicted_peak_bytes``, and are
+    dropped from the measured set. K=1 is never prunable by either."""
     from ..kernels import tune
     from ..observe import trace as _tr
     from ..observe.families import KERNEL_TUNE_SECONDS, KERNEL_WINNERS
@@ -322,6 +328,8 @@ def tune_train_window(executor, program, feed: Dict[str, Any],
     seed = tune.deterministic_seed()
     repeats = tune._repeats()
     t0 = time.perf_counter()
+    cost_pruned = {int(k): float(s)
+                   for k, s in (cost_pruned or {}).items() if int(k) > 1}
     with _tr.trace_span("kernel.tune", op=WINDOW_OP, sig=str(sig)):
         pruned = _memory_pruned(program, feed, fetch_list, scope, cands)
         plan = executor._gather(program, feed, fetch_list, scope)[0]
@@ -337,6 +345,11 @@ def tune_train_window(executor, program, feed: Dict[str, Any],
                 if k in pruned:
                     entry.update(seconds=None, pruned=True,
                                  predicted_peak_bytes=int(pruned[k]))
+                    timings.append(entry)
+                    continue
+                if k in cost_pruned:
+                    entry.update(seconds=None, pruned=True,
+                                 predicted_seconds=cost_pruned[k])
                     timings.append(entry)
                     continue
                 if seed is not None:

@@ -627,6 +627,58 @@ ANALYSIS_MEMORY_PRUNED = REGISTRY.counter(
     "(PADDLE_TPU_DEVICE_HBM_BYTES) — each count is one avoided "
     "compile-and-OOM; the K=1 composed fallback is never pruned")
 
+# ------------------------------------------------------------ cost engine
+# (paddle_tpu/analysis/cost.py: the roofline cost model — per-op
+# FLOPs/bytes rules composed into predicted step seconds; ZERO family
+# movement with PADDLE_TPU_COST_MODEL=0, pinned by tests/test_autotune)
+ANALYSIS_COST_PROGRAMS = REGISTRY.counter(
+    "paddle_cost_programs_total",
+    "Programs run through the roofline cost engine (CostAnalysis "
+    "construction), by trigger: 'autotune' = the unified autotuner's "
+    "predict-then-prune ranking, 'bench' = analytic step FLOPs + "
+    "predicted_seconds row fields, 'cli' = tools/cost_report.py, "
+    "'api' = direct callers",
+    labels=("site",))
+for _s in ("api", "cli", "bench", "autotune"):
+    ANALYSIS_COST_PROGRAMS.labels(site=_s)
+ANALYSIS_COST_SECONDS = REGISTRY.histogram(
+    "paddle_cost_seconds",
+    "Wall time of one whole-program cost analysis (scales with op "
+    "count — FLOPs/bytes ride shape algebra, never tensor payloads)")
+ANALYSIS_COST_UNRULED = REGISTRY.counter(
+    "paddle_cost_unruled_ops_total",
+    "Ops priced WITHOUT a registered cost rule (bytes-only, zero "
+    "FLOPs): the engine's coverage debt. The shape-ruled vocabulary "
+    "can never land here — tools/repo_lint.py rule 10 proves every "
+    "shape-ruled op carries a cost rule or a ZERO_COST declaration")
+
+# ------------------------------------------------------ global autotuner
+# (paddle_tpu/kernels/autotune.py: predict with the cost engine, prune,
+# measure only survivors through kernels/tune.py + core/window_tune.py)
+AUTOTUNE_RUNS = REGISTRY.counter(
+    "paddle_autotune_runs_total",
+    "Unified-autotuner searches by axis ('kernel' = Pallas block "
+    "configs incl. the attention/flash grid, 'window' = train-window "
+    "K); one count per (axis, signature) searched",
+    labels=("axis",))
+AUTOTUNE_PRUNED = REGISTRY.counter(
+    "paddle_autotune_pruned_total",
+    "Joint-space candidates skipped WITHOUT measurement because the "
+    "roofline ranked them outside the survivor set — each count is "
+    "one avoided compile-and-measure; the composed/K=1 fallback is "
+    "never pruned. Frozen at zero when PADDLE_TPU_COST_MODEL=0",
+    labels=("axis",))
+AUTOTUNE_MEASURED = REGISTRY.counter(
+    "paddle_autotune_measured_total",
+    "Survivor candidates the autotuner actually measured through the "
+    "existing tuner machinery; measured+pruned = the full grid, and "
+    "the acceptance gate holds measured <= half of it",
+    labels=("axis",))
+for _a in ("kernel", "window"):
+    AUTOTUNE_RUNS.labels(axis=_a)
+    AUTOTUNE_PRUNED.labels(axis=_a)
+    AUTOTUNE_MEASURED.labels(axis=_a)
+
 # ------------------------------------------------------------- optimizer
 # (paddle_tpu/core/passes/: graph-optimizing pass pipeline — see
 # docs/OPTIMIZER.md. PADDLE_TPU_OPTIMIZE=0 bypasses the pipeline; tests

@@ -207,3 +207,20 @@ def test_peak_bytes_columns_are_informational(tmp_path):
     assert "peak_bytes" not in open(
         str(tmp_path / "bench_copy.py")).read().split(
         "BASELINE_SPC")[0].split("BASELINES")[1]
+
+
+def test_cost_model_columns_are_informational(tmp_path):
+    # predicted_seconds / cost_model_ratio (the roofline columns,
+    # analysis/cost.py) ride every row like the peak-bytes pair:
+    # informational only — they neither block a pin nor get pinned
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": ROW, "value": 999.9, "steps_per_call": 10,
+         "unit": "images/sec", "predicted_seconds": 0.0123,
+         "cost_model_ratio": 1.7}])
+    assert proc.returncode == 0, proc.stderr
+    assert base[ROW] == 999.9       # pinned exactly as without them
+    assert spc[ROW] == 10
+    pinned_span = open(str(tmp_path / "bench_copy.py")).read().split(
+        "BASELINE_SPC")[0].split("BASELINES")[1]
+    assert "predicted_seconds" not in pinned_span
+    assert "cost_model_ratio" not in pinned_span

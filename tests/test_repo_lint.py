@@ -482,3 +482,64 @@ def test_declared_family_vars_parse_real_tree():
     assert fams.get("SLO_BREACHES") == "paddle_slo" + "_breaches_total"
     assert fams.get("FLEET_INSTANCES") == "paddle_fleet" + "_instances"
     assert repo_lint.dead_family_violations(ROOT) == []
+
+
+# ------------------------------------------- rule 10: cost coverage
+def _cost_rule_tree(tmp_path, shape_src, cost_src):
+    root = tmp_path / "cr"
+    (root / "paddle_tpu" / "analysis").mkdir(parents=True)
+    (root / "paddle_tpu" / "observe").mkdir(parents=True)
+    for d in ("tools", "tests", "examples"):
+        (root / d).mkdir()
+    (root / "paddle_tpu" / "observe" / "families.py").write_text(
+        "REGISTRY = None\n")
+    (root / "paddle_tpu" / "analysis" / "shape_rules.py").write_text(
+        shape_src)
+    (root / "paddle_tpu" / "analysis" / "cost_rules.py").write_text(
+        cost_src)
+    return str(root)
+
+
+def test_cost_rule_coverage_detected(tmp_path):
+    # an op with a shape rule but no FLOP story trips rule 10; the
+    # registration idioms resolve like rule 7's
+    shape_src = (
+        "_ACTS = (\"actA\", \"actB\")\n"
+        "register_shape_rule(*_ACTS)(None)\n"
+        "@register_shape_rule(\"litC\", \"uncovD\")\n"
+        "def _r(ctx):\n    pass\n")
+    cost_src = (
+        "@register_cost_rule(\"actA\", \"litC\")\n"
+        "def _cr(ctx):\n    pass\n"
+        "ZERO_COST = (\"actB\",)\n")
+    out = repo_lint.cost_rule_coverage_violations(
+        _cost_rule_tree(tmp_path, shape_src, cost_src))
+    assert len(out) == 1 and "uncovD" in out[0] and "ZERO_COST" in out[0]
+    # covered partition: clean
+    cost_src2 = cost_src.replace("(\"actB\",)", "(\"actB\", \"uncovD\")")
+    assert repo_lint.cost_rule_coverage_violations(
+        _cost_rule_tree(tmp_path / "b", shape_src, cost_src2)) == []
+    # overlap (declared zero-cost with a rule) is a stale declaration
+    cost_src3 = cost_src2.replace("\"actA\", \"litC\"",
+                                  "\"actA\", \"litC\", \"actB\"")
+    out3 = repo_lint.cost_rule_coverage_violations(
+        _cost_rule_tree(tmp_path / "c", shape_src, cost_src3))
+    assert len(out3) == 1 and "actB" in out3[0] and "stale" in out3[0]
+    # a tree without the cost engine is out of rule 10's scope
+    assert repo_lint.cost_rule_coverage_violations(str(tmp_path)) == []
+
+
+def test_cost_rule_registrations_match_runtime():
+    """Schema pin (rule 7's mirror): the AST resolver sees exactly what
+    the runtime COST_RULES registry and ZERO_COST declaration hold, so
+    rule 10 can never silently diverge from reality."""
+    import paddle_tpu  # noqa: F401  (fills the registries)
+    from paddle_tpu.analysis.cost_rules import COST_RULES, ZERO_COST
+
+    ast_costed = repo_lint._rule_registrations(
+        os.path.join(ROOT, repo_lint.COST_RULES_FILE),
+        "register_cost_rule")
+    assert ast_costed == set(COST_RULES)
+    assert repo_lint.declared_zero_cost(ROOT) == set(ZERO_COST)
+    # the partition is total AND disjoint on the real tree
+    assert repo_lint.cost_rule_coverage_violations(ROOT) == []

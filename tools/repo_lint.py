@@ -82,6 +82,18 @@ tests/test_repo_lint.py):
    is "nothing ever reports here". Tests/examples do not count as
    references — a family only a test touches measures nothing.
 
+10. **cost-rule-coverage** — rule 7's mirror for the roofline cost
+    engine (``analysis/cost.py``): every op type registered with
+    ``register_shape_rule`` must either carry a ``register_cost_rule``
+    transfer function in ``analysis/cost_rules.py`` or be listed in
+    that module's explicit ``ZERO_COST`` declaration (pure
+    metadata/layout ops that move no payload bytes and execute no
+    FLOPs) — and the two sets must be disjoint. Without this, growing
+    an op a shape rule silently prices it bytes-only: its FLOPs vanish
+    from predicted MFU and the autotuner's ranking, exactly the silent
+    widening rule 7 exists to prevent in the range engine. Same
+    registration-idiom resolution as rule 7.
+
 Usage: ``python tools/repo_lint.py [--root DIR]``; exit 1 on violations.
 """
 
@@ -543,6 +555,52 @@ def range_rule_coverage_violations(root: str) -> List[str]:
     return violations
 
 
+COST_RULES_FILE = os.path.join("paddle_tpu", "analysis",
+                               "cost_rules.py")
+
+
+def declared_zero_cost(root: str) -> Set[str]:
+    """String elements of cost_rules.py's ``ZERO_COST`` tuple."""
+    tree = _parse(os.path.join(root, COST_RULES_FILE))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "ZERO_COST"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return set()
+
+
+def cost_rule_coverage_violations(root: str) -> List[str]:
+    """Rule 10 (the rule-7 mirror for the cost engine): shape-ruled op
+    types must carry a cost transfer rule or an explicit ``ZERO_COST``
+    declaration, and those two sets must be disjoint."""
+    shape_path = os.path.join(root, SHAPE_RULES_FILE)
+    cost_path = os.path.join(root, COST_RULES_FILE)
+    if not os.path.exists(shape_path) or not os.path.exists(cost_path):
+        return []  # synthetic trees without the analysis package
+    shaped = _rule_registrations(shape_path, "register_shape_rule")
+    costed = _rule_registrations(cost_path, "register_cost_rule")
+    zero = declared_zero_cost(root)
+    violations = []
+    for t in sorted(shaped - costed - zero):
+        violations.append(
+            "%s: op type %r has a shape rule but neither a cost "
+            "transfer rule in %s nor a ZERO_COST declaration (the cost "
+            "engine would price it bytes-only SILENTLY — decide its "
+            "FLOP story)" % (SHAPE_RULES_FILE, t, COST_RULES_FILE))
+    for t in sorted(costed & zero):
+        violations.append(
+            "%s: op type %r is declared ZERO_COST but also has a cost "
+            "transfer rule (stale declaration — remove one)"
+            % (COST_RULES_FILE, t))
+    return violations
+
+
 # ------------------------------------------------- rule 8: env knobs
 # the trees whose env reads are user-facing knobs (tests/bench drive
 # internals and document their knobs next to the workloads they shape)
@@ -644,7 +702,8 @@ def run(root: str = REPO_ROOT) -> List[str]:
             + fault_site_violations(root)
             + range_rule_coverage_violations(root)
             + env_knob_violations(root)
-            + dead_family_violations(root))
+            + dead_family_violations(root)
+            + cost_rule_coverage_violations(root))
 
 
 def main(argv=None) -> int:

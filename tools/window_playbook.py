@@ -21,7 +21,8 @@ Steps (artifacts):
      the kernel-vs-composed gap at short S
   7. tpu_validate --serving -> Python-free PJRT serving e2e proof
   8. dump_step_hlo resnet50 -> docs/perf/resnet50_* (op mix, aliasing)
-  9. flash_tune transformer_long (longest; only if still healthy)
+  9. kernel_tune --op attention --bench-sweep transformer_long
+     (longest; only if still healthy)
 
 Never run this concurrently with any other TPU-touching process: the
 tunnel is single-client and a SIGKILLed claim wedges the machine.
@@ -205,7 +206,8 @@ def main():
     run([PY, "tools/dump_step_hlo.py", "resnet50"], 900)
 
     # 9. block-size sweep (longest; last)
-    run([PY, "tools/flash_tune.py", "transformer_long"], 1800)
+    run([PY, "tools/kernel_tune.py", "--op", "attention",
+         "--bench-sweep", "transformer_long"], 1800)
 
     log("queue complete in %.0fs" % (time.time() - t0))
     return 0
