@@ -1522,6 +1522,113 @@ def bench_quantized(amp, quick, uses_flash=False):
     return recs
 
 
+def bench_dygraph(amp, quick, uses_flash=False):
+    """Dygraph capture rows (docs/IMPERATIVE.md): ONE eager MLP train
+    step (FC+dropout+FC, square loss, Adam) measured twice — op-by-op
+    eager dispatch, then replayed through the Program that
+    ``imperative.jit`` captured from it (``exact_numerics=False``: the
+    whole-graph-compiled fast path; the bitwise default trades that
+    fusion away and is pinned by tests, not benchmarked). Two rows,
+    both marked "dygraph"; the replay row additionally ``captured:true``
+    with the eager-relative speedup — pin_baselines never compares
+    either with graph training baselines."""
+    import jax as _jax
+
+    from paddle_tpu import imperative
+    from paddle_tpu.imperative import nn as inn
+    from paddle_tpu.imperative import optimizer as iopt
+    from paddle_tpu.imperative import trace_op
+
+    steps = 10 if quick else 60
+    warmup = 3 if quick else 10
+    batch, width = (8, 32) if quick else (32, 64)
+    rs = np.random.RandomState(0)
+    X = rs.rand(batch, width).astype("float32")
+    Y = rs.rand(batch, 1).astype("float32")
+
+    def run_mode(captured):
+        # parameter init draws GLOBAL numpy RNG — reseed so both modes
+        # start from identical weights and the rate gap is pure dispatch
+        np.random.seed(0)
+        with imperative.guard(seed=0):
+            fc1 = inn.FC("fc1", width, act="relu")
+            fc2 = inn.FC("fc2", 1)
+            adam = iopt.Adam(learning_rate=1e-3)
+
+            def step(x, y):
+                h = trace_op("dropout", {"X": [fc1(x)]},
+                             {"dropout_prob": 0.2, "is_test": False})["Out"][0]
+                d = trace_op("elementwise_sub",
+                             {"X": [fc2(h)], "Y": [y]}, {})["Out"][0]
+                sq = trace_op("square", {"X": [d]}, {})["Out"][0]
+                loss = trace_op("reduce_mean", {"X": [sq]}, {})["Out"][0]
+                loss.backward()
+                adam.step(fc1.parameters() + fc2.parameters())
+                return loss
+
+            fn = imperative.jit(step, exact_numerics=False,
+                                name="bench_dygraph") if captured else step
+            vx = imperative.to_variable(X)
+            vy = imperative.to_variable(Y)
+            vx.stop_gradient = True
+            vy.stop_gradient = True
+            for _ in range(warmup):
+                fn(vx, vy)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = fn(vx, vy)
+            float(np.asarray(loss.numpy()).reshape(-1)[0])  # block
+            dt = time.perf_counter() - t0
+            entry = fn._last_entry if captured else None
+        return steps / dt, entry
+
+    recs = []
+    with _beacon("dygraph", "eager steps"):
+        _log("dygraph: %d eager steps (batch %d, width %d)"
+             % (steps, batch, width))
+        eager_rate, _ = run_mode(False)
+    with _beacon("dygraph", "capture + replay"):
+        _log("dygraph: capture + %d replayed steps" % steps)
+        cap_rate, entry = run_mode(True)
+    platform = _jax.devices()[0].platform.lower()
+    common = {
+        "platform": platform,
+        # the mode marker pin_baselines keys the skip on: dygraph rows
+        # measure dispatch overhead, not a training baseline
+        "dygraph": True,
+        "unit": "steps/sec",
+        "steps_per_call": 1,
+        "vs_baseline": 1.0,
+        "tflops_per_sec": None,
+        "mfu": None,
+        **({"quick": True} if quick else {}),
+    }
+    rec = {
+        "metric": "dygraph_eager",
+        "value": round(eager_rate, 1),
+        # eager dispatch never builds a Program — nothing to analyze
+        "peak_bytes_predicted": None,
+        **common,
+    }
+    print(json.dumps(rec), flush=True)
+    recs.append(rec)
+    rec = {
+        "metric": "dygraph_captured",
+        "captured": True,
+        "value": round(cap_rate, 1),
+        # the replay-vs-eager ratio is the row's headline: what trace
+        # capture buys over op-by-op dispatch on this workload
+        "speedup_vs_eager": round(cap_rate / eager_rate, 2),
+        "peak_bytes_predicted": (int(entry.predicted_bytes)
+                                 if entry is not None
+                                 and entry.predicted_bytes else None),
+        **common,
+    }
+    print(json.dumps(rec), flush=True)
+    recs.append(rec)
+    return recs
+
+
 WORKLOADS = {
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
@@ -1560,6 +1667,14 @@ QUANT_ORDER = ["quantized"]
 QUANT_WORKLOADS = {"quantized": bench_quantized}
 WORKLOADS.update(QUANT_WORKLOADS)
 
+# PADDLE_TPU_BENCH_DYGRAPH=1 swaps the workload list for the dygraph
+# capture rows (docs/IMPERATIVE.md): eager vs captured-replay steps/sec.
+# Rows are marked "dygraph" (replay also captured:true) and never pin
+# as training baselines.
+DYGRAPH_ORDER = ["dygraph"]
+DYGRAPH_WORKLOADS = {"dygraph": bench_dygraph}
+WORKLOADS.update(DYGRAPH_WORKLOADS)
+
 
 def _serving_mode():
     return os.environ.get("PADDLE_TPU_BENCH_SERVING", "0") != "0"
@@ -1571,6 +1686,10 @@ def _elastic_mode():
 
 def _quant_mode():
     return os.environ.get("PADDLE_TPU_BENCH_QUANT", "0") != "0"
+
+
+def _dygraph_mode():
+    return os.environ.get("PADDLE_TPU_BENCH_DYGRAPH", "0") != "0"
 
 # Safe (no custom-kernel) workloads first: if the tunnel wedges or a
 # Pallas compile hangs partway through, the rows already printed stand.
@@ -1589,9 +1708,9 @@ ATTENTION_SEQ = {"transformer": 128, "transformer_long": 1024,
 ATTENTION_WORKLOADS = frozenset(ATTENTION_SEQ)
 
 assert set(ORDER) | set(SERVING_ORDER) | set(ELASTIC_ORDER) \
-    | set(QUANT_ORDER) == set(WORKLOADS), \
-    "ORDER/SERVING_ORDER/ELASTIC_ORDER/QUANT_ORDER out of sync " \
-    "with WORKLOADS"
+    | set(QUANT_ORDER) | set(DYGRAPH_ORDER) == set(WORKLOADS), \
+    "ORDER/SERVING_ORDER/ELASTIC_ORDER/QUANT_ORDER/DYGRAPH_ORDER out " \
+    "of sync with WORKLOADS"
 
 
 def _probe_backend(timeout_s=None, attempts=None, probe_fn=None):
@@ -1849,9 +1968,10 @@ def main():
         return 0
 
     # PADDLE_TPU_BENCH_SERVING=1 / PADDLE_TPU_BENCH_ELASTIC=1 /
-    # PADDLE_TPU_BENCH_QUANT=1 swap the default workload list; --only
-    # still picks any single workload
-    default_order = (QUANT_ORDER if _quant_mode()
+    # PADDLE_TPU_BENCH_QUANT=1 / PADDLE_TPU_BENCH_DYGRAPH=1 swap the
+    # default workload list; --only still picks any single workload
+    default_order = (DYGRAPH_ORDER if _dygraph_mode()
+                     else QUANT_ORDER if _quant_mode()
                      else ELASTIC_ORDER if _elastic_mode()
                      else SERVING_ORDER if _serving_mode() else ORDER)
     if args.worker:

@@ -64,6 +64,10 @@ class _Plan:
         self.multi = {}    # (steps, feed_stacked) -> jitted K-step
         #                    executable
         self.cost = None  # cost_analysis() result, filled on first request
+        self.exact = False  # exact_numerics program: fn is the UNJITTED
+        #                    step (per-primitive dispatch, bitwise the
+        #                    eager sequence) and K-step variants use a
+        #                    Python loop instead of a compiled lax.scan
         self.hlo_text = {}  # stage -> lowered_hlo() text (AOT compiles
         #                     can't reuse the jit cache; amortize them)
         self.compiled_sigs = set()  # dispatch signatures already compiled:
@@ -246,9 +250,7 @@ class Executor:
         key = (steps, feed_stacked, reduce_fetches)
         fn = plan.multi.get(key)
         if fn is None:
-            fn = jax.jit(make_scan_fn(plan.step, steps, feed_stacked,
-                                      reduce_fetches),
-                         donate_argnums=(2,))
+            fn = _make_multi_fn(plan, steps, feed_stacked, reduce_fetches)
             plan.multi[key] = fn
 
         from ..observe import observe_feed_gap
@@ -536,9 +538,7 @@ class Executor:
                 key = (k, True, reduce_fetches)
                 fn = plan.multi.get(key)
                 if fn is None:
-                    fn = jax.jit(make_scan_fn(plan.step, k, True,
-                                              reduce_fetches),
-                                 donate_argnums=(2,))
+                    fn = _make_multi_fn(plan, k, True, reduce_fetches)
                     plan.multi[key] = fn
                 sig = ("run_repeated",) + key
                 t0 = time.perf_counter()
@@ -912,18 +912,33 @@ class Executor:
             # carry the original build-site provenance.
             verify_program(program, fetch_list=fetch_names, scope=scope,
                            raise_on_error=True, site="prepare")
-        # graph-optimizing pass pipeline (core/passes): fold/copy-prop/
-        # CSE/DCE/fusion on a CLONE, so the optimized plan is what gets
-        # cached and the user's program is untouched. Level 0 bypasses
-        # entirely (the level is part of the plan-cache key). Once per
-        # plan-cache miss, like verification.
-        program = optimize_for_execution(program, fetch_names, scope=scope)
+        exact = getattr(program, "exact_numerics", False)
+        if not exact:
+            # graph-optimizing pass pipeline (core/passes): fold/copy-
+            # prop/CSE/DCE/fusion on a CLONE, so the optimized plan is
+            # what gets cached and the user's program is untouched.
+            # Level 0 bypasses entirely (the level is part of the plan-
+            # cache key). Once per plan-cache miss, like verification.
+            # exact_numerics programs (dygraph capture's bitwise-parity
+            # mode) skip it: fusion passes rewrite the op sequence and
+            # would break replay-equals-eager at the ULP level.
+            program = optimize_for_execution(program, fetch_names, scope=scope)
         feed_names = sorted(feed_vals)
         (feed_names, fetch_names, const_state, mut_state, pure_written,
          needs_rng, step) = analyze_block(program, feed_names, fetch_names, scope)
-        fn = jax.jit(step, donate_argnums=(2,))
-        return _Plan(feed_names, fetch_names, const_state, mut_state,
+        # exact_numerics: run the lowered step UNJITTED. Whole-graph XLA
+        # compilation contracts mul+add across op boundaries into FMAs
+        # (and no compiler_options combination restores parity without
+        # breaking dot emission — backend opt level 0 swaps Eigen dots
+        # for naive loops), so the only faithful executable is the same
+        # per-primitive dispatch sequence eager mode runs. Still one
+        # host call per step through the SAME plan cache, with all the
+        # framework Python (tape, VarBase wrapping) stripped.
+        fn = step if exact else jax.jit(step, donate_argnums=(2,))
+        plan = _Plan(feed_names, fetch_names, const_state, mut_state,
                      pure_written, needs_rng, fn, step=step)
+        plan.exact = exact
+        return plan
 
 
 @contextlib.contextmanager
@@ -1089,6 +1104,47 @@ def _check_reduce(reduce_fetches):
     if reduce_fetches not in ("last", "mean", "sum"):
         raise ValueError("reduce_fetches must be last|mean|sum; got %r"
                          % (reduce_fetches,))
+
+
+def _make_multi_fn(plan, steps, feed_stacked, reduce_fetches):
+    """The K-step executable for one plan: a jitted lax.scan normally, a
+    Python loop over the unjitted step for exact_numerics plans (a scan
+    would compile — and re-fuse — the body, breaking bitwise parity)."""
+    if plan.exact:
+        return make_loop_fn(plan.step, steps, feed_stacked, reduce_fetches)
+    return jax.jit(make_scan_fn(plan.step, steps, feed_stacked,
+                                reduce_fetches),
+                   donate_argnums=(2,))
+
+
+def make_loop_fn(raw_step, steps, feed_stacked, reduce_fetches="last"):
+    """Python-loop twin of ``make_scan_fn`` with the same contract
+    (carried state/RNG, last-or-reduced fetches). Used for
+    exact_numerics plans, where each step must stay the per-primitive
+    dispatch sequence eager mode runs."""
+    _check_reduce(reduce_fetches)
+
+    def _acc(old, new):
+        if reduce_fetches == "last" or not jnp.issubdtype(
+                jnp.asarray(new).dtype, jnp.floating):
+            return new
+        return old + new
+
+    def multi(feeds, const_vals, mut_vals, rng_key):
+        mut, key = mut_vals, rng_key
+        facc = pures = None
+        for i in range(steps):
+            step_feeds = [f[i] for f in feeds] if feed_stacked else feeds
+            fetches, mut, pures, key = raw_step(step_feeds, const_vals,
+                                                mut, key)
+            facc = (fetches if facc is None
+                    else [_acc(o, n) for o, n in zip(facc, fetches)])
+        if reduce_fetches == "mean":
+            facc = [f / steps if jnp.issubdtype(f.dtype, jnp.floating)
+                    else f for f in facc]
+        return facc, mut, pures, key
+
+    return multi
 
 
 def make_scan_fn(raw_step, steps, feed_stacked, reduce_fetches="last"):

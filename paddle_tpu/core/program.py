@@ -240,6 +240,9 @@ _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _MACHINERY_PREFIXES = (
     os.path.join(_PKG_ROOT, "core") + os.sep,
     os.path.join(_PKG_ROOT, "layers") + os.sep,
+    # dygraph capture: ops recorded by imperative/capture.py must carry
+    # the USER's eager line, not the trace_op/record_op plumbing
+    os.path.join(_PKG_ROOT, "imperative") + os.sep,
 )
 _MACHINERY_FILES = frozenset(
     os.path.join(_PKG_ROOT, f)
@@ -448,6 +451,13 @@ class Program:
         self._is_distributed = False
         self.amp = False  # bf16 compute policy (core/amp.py); set via set_amp
         self.grad_accum_steps = 1  # microbatch scan count (set_gradient_accumulation)
+        # bitwise-parity execution mode (imperative capture sets this):
+        # the executor skips the fusing pass pipeline and runs the
+        # lowered step UNJITTED — the same per-primitive dispatch eager
+        # mode uses — so replaying the program reproduces the eager
+        # sequence bit for bit (whole-graph XLA compilation contracts
+        # mul+add into fma across op boundaries and cannot be held back)
+        self.exact_numerics = False
 
     # ---- mutation tracking ----
     def _bump(self):
@@ -528,6 +538,7 @@ class Program:
         p.random_seed = self.random_seed
         p.amp = self.amp
         p.grad_accum_steps = self.grad_accum_steps
+        p.exact_numerics = self.exact_numerics
         p.blocks = []
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)

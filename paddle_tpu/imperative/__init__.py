@@ -27,9 +27,11 @@ from ..core.autodiff import ATTR_DIFF, ATTR_FWD_IN, ATTR_FWD_OUT
 from ..core.lowering import LowerContext, as_jax_dtype
 from ..core import registry as _registry
 from ..core.registry import get_op
+from . import capture as _capture
+from .capture import CaptureError
 
 __all__ = ["guard", "enabled", "to_variable", "VarBase", "Tracer", "Layer",
-           "PyLayer"]
+           "PyLayer", "trace_op", "jit", "CapturedFunction", "CaptureError"]
 
 _tracer: Optional["Tracer"] = None
 
@@ -126,6 +128,32 @@ class VarBase:
     def __truediv__(self, o):
         return self._binary(o, "elementwise_div")
 
+    # ---- scalar coercions: under capture these are BRANCH DECISIONS —
+    # the concrete value Python control flow acted on — so each one is
+    # recorded as a guard the replay path re-evaluates (capture.py)
+    def _coerce(self, kind: str, py):
+        val = py(np.asarray(self.value))
+        cap = _capture.active()
+        if cap is not None:
+            cap.record_guard(self, kind, val)
+        return val
+
+    def __bool__(self):
+        return self._coerce("bool", bool)
+
+    def __int__(self):
+        return self._coerce("int", int)
+
+    def __float__(self):
+        return self._coerce("float", float)
+
+    def item(self):
+        v = np.asarray(self.value).item()
+        return self._coerce("int" if isinstance(v, int)
+                            and not isinstance(v, bool) else
+                            "bool" if isinstance(v, bool) else "float",
+                            type(v))
+
 
 def to_variable(value, name=None, block=None) -> VarBase:
     """numpy -> VarBase (python/paddle/fluid/imperative/base.py:to_variable
@@ -162,6 +190,13 @@ class Tracer:
 
     # ----------------------------------------------------------- backward
     def backward(self, loss: VarBase):
+        cap = _capture.active()
+        if cap is not None:
+            # graph autodiff FIRST (tape -> append_backward, the shared-
+            # gradient contract): the captured block grows the same grad
+            # ops the static tier would build, then the eager walk below
+            # computes the concrete values those ops describe
+            cap.record_backward(loss)
         grads: Dict[int, jax.Array] = {id(loss): jnp.ones_like(loss.value)}
         ctx = LowerContext()
 
@@ -220,6 +255,11 @@ class Tracer:
         # leaf var grads are now in ._grad; clear tape (one backward per tape,
         # like the reference's ClearBlock)
         self.tape.clear()
+        if cap is not None:
+            # bind each leaf's concrete gradient array to its graph @GRAD
+            # name so a following eager optimizer step resolves its Grad
+            # inputs to the captured gradients
+            cap.map_leaf_grads()
 
 
 class _EagerCtx(LowerContext):
@@ -258,6 +298,11 @@ def trace_op(op_type: str, ins: Dict[str, Sequence[Optional[VarBase]]],
         outs[slot] = [None if v is None else VarBase(v, stop_gradient=stop)
                       for v in vs]
     tracer.trace(_TapeEntry(op_type, norm_ins, outs, dict(attrs)))
+    cap = _capture.active()
+    if cap is not None:
+        # capture mode: the op ALSO lands in the in-flight Program block
+        # (record-and-dispatch, not record-instead-of-dispatch)
+        cap.record_op(op_type, norm_ins, outs, attrs)
     return outs
 
 
@@ -380,3 +425,5 @@ def _py_layer_grad(ctx, ins, attrs):
                        for d in dins]}
 
 from . import nn  # noqa: E402,F401  (FC/Conv2D/BatchNorm/Embedding/Pool2D)
+from . import optimizer  # noqa: E402,F401  (eager Adam/SGD via trace_op)
+from .jit import CapturedFunction, jit  # noqa: E402,F401

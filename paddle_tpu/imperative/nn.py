@@ -102,9 +102,9 @@ class BatchNorm(Layer):
         self._bias = self.create_parameter("bias", (num_channels,), dtype,
                                            initializer=0.0)
         self._mean = VarBase(np.zeros(num_channels, np.float32),
-                             stop_gradient=True)
+                             name="mean", stop_gradient=True)
         self._variance = VarBase(np.ones(num_channels, np.float32),
-                                 stop_gradient=True)
+                                 name="variance", stop_gradient=True)
 
     def forward(self, x: VarBase) -> VarBase:
         outs = trace_op(
@@ -114,10 +114,14 @@ class BatchNorm(Layer):
             {"epsilon": self._eps, "momentum": self._momentum,
              "is_test": False})
         out = outs["Y"][0]
+        # update running stats IN PLACE: the VarBase objects stay
+        # identity-stable, so a trace capture that registered them as
+        # persistable state keeps pointing at the layer's live stats
+        # across re-traces (capture.py binds state by object identity)
         if outs.get("MeanOut"):
-            self._mean = outs["MeanOut"][0].detach()
+            self._mean.value = outs["MeanOut"][0].value
         if outs.get("VarianceOut"):
-            self._variance = outs["VarianceOut"][0].detach()
+            self._variance.value = outs["VarianceOut"][0].value
         if self._act:
             out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
         return out

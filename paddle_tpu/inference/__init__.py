@@ -91,6 +91,43 @@ class Predictor:
         for bs in self._buckets:
             self._warmup(bs)
 
+    @classmethod
+    def from_program(cls, program: Program, feed_names: Sequence[str],
+                     fetch_names: Sequence[str], params: Dict[str, object],
+                     warmup_batch_sizes: Sequence[int] = (),
+                     batch_major_fetches: Sequence[str] = ()):
+        """Build a Predictor from an IN-MEMORY Program — the dygraph
+        capture serving path (``CapturedFunction.as_predictor``): no
+        save/load round-trip; ``params`` hands captured state straight
+        into the predictor's scope. ``batch_major_fetches`` names fetch
+        vars whose lead dim is the batch axis (a capture records them
+        with the trace's concrete batch; the bucket router needs the
+        dynamic -1 marker to slice pad rows back off)."""
+        from ..core.executor import Executor
+
+        self = cls.__new__(cls)
+        config = AnalysisConfig()
+        config.warmup_batch_sizes = list(warmup_batch_sizes)
+        self.config = config
+        self.scope = Scope()
+        self._exe = Executor()
+        for n, v in params.items():
+            self.scope.set_var(n, v)
+        self.program = _rewrite_for_inference(program)
+        block = self.program.global_block()
+        for n in batch_major_fetches:
+            var = block.vars.get(n)
+            if var is not None and var.shape:
+                var.shape = (-1,) + tuple(var.shape[1:])
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.fetch_vars = [block.var(n) for n in fetch_names]
+        self._buckets = sorted(set(
+            int(b) for b in config.warmup_batch_sizes))
+        for bs in self._buckets:
+            self._warmup(bs)
+        return self
+
     # ------------------------------------------------------------- serving
     def get_input_names(self) -> List[str]:
         return list(self.feed_names)

@@ -539,7 +539,7 @@ ANALYSIS_PROGRAMS = REGISTRY.counter(
     "'validate' = explicit Program.validate(), 'prepare' = executor "
     "prepare-time checking (PADDLE_TPU_VALIDATE=1), 'cli' = "
     "tools/lint_program.py", labels=("site",))
-for _s in ("validate", "prepare", "cli"):
+for _s in ("validate", "prepare", "cli", "capture"):
     ANALYSIS_PROGRAMS.labels(site=_s)
 ANALYSIS_FINDINGS = REGISTRY.counter(
     "paddle_analysis_findings_total",
@@ -614,7 +614,8 @@ ANALYSIS_MEMORY_PROGRAMS = REGISTRY.counter(
     "guard, 'bench' = the peak_bytes_predicted row field, 'api' = "
     "direct callers (contrib.memory_usage_calc and user code)",
     labels=("site",))
-for _s in ("api", "lint", "cli", "window_tune", "serving", "bench"):
+for _s in ("api", "lint", "cli", "window_tune", "serving", "bench",
+           "capture"):
     ANALYSIS_MEMORY_PROGRAMS.labels(site=_s)
 ANALYSIS_MEMORY_SECONDS = REGISTRY.histogram(
     "paddle_analysis_memory_seconds",
@@ -651,6 +652,46 @@ ANALYSIS_COST_UNRULED = REGISTRY.counter(
     "FLOPs): the engine's coverage debt. The shape-ruled vocabulary "
     "can never land here — tools/repo_lint.py rule 10 proves every "
     "shape-ruled op carries a cost rule or a ZERO_COST declaration")
+
+# ----------------------------------------------------- dygraph capture
+# (paddle_tpu/imperative/jit.py + capture.py: eager functions traced
+# into Programs and replayed through the Executor — see
+# docs/IMPERATIVE.md)
+IMPERATIVE_CAPTURES = REGISTRY.counter(
+    "paddle_imperative_captures_total",
+    "Eager functions traced into a Program (first call per input "
+    "signature/branch/bucket); each capture pays eager execution + "
+    "verification once, replays ride the plan cache")
+IMPERATIVE_CAPTURE_SECONDS = REGISTRY.histogram(
+    "paddle_imperative_capture_seconds",
+    "Wall time of ONE capture: the eager trace, Program construction "
+    "and capture-time verification (excludes the replay-side XLA "
+    "compile, which lands in paddle_executor_compile_seconds)")
+IMPERATIVE_CAPTURED_OPS = REGISTRY.histogram(
+    "paddle_imperative_captured_ops",
+    "Ops per captured Program block (forward + captured backward + "
+    "optimizer update) — the size of what each replay fuses into one "
+    "XLA dispatch")
+IMPERATIVE_CACHE_HITS = REGISTRY.counter(
+    "paddle_imperative_cache_hits_total",
+    "Captured-function calls served by an existing entry (signature + "
+    "branch guards matched) — the steady state; a low hit ratio means "
+    "shape/branch churn is defeating the capture cache")
+IMPERATIVE_RETRACES = REGISTRY.counter(
+    "paddle_imperative_retraces_total",
+    "Re-captures AFTER a function's first trace, by trigger: 'shape' = "
+    "new input signature (bucketing off), 'bucket' = new lead-dim "
+    "bucket (PADDLE_TPU_CAPTURE_BUCKETS), 'branch' = Python control "
+    "flow took a path no cached entry's guards match, 'config' = "
+    "pass/kernel config_key changed under an already-seen signature",
+    labels=("reason",))
+for _r in ("shape", "bucket", "branch", "config"):
+    IMPERATIVE_RETRACES.labels(reason=_r)
+IMPERATIVE_CACHE_EVICTIONS = REGISTRY.counter(
+    "paddle_imperative_cache_evictions_total",
+    "Entries evicted from the size-capped capture LRU "
+    "(PADDLE_TPU_CAPTURE_CACHE_SIZE); sustained growth = signature "
+    "churn re-tracing in a loop")
 
 # ------------------------------------------------------ global autotuner
 # (paddle_tpu/kernels/autotune.py: predict with the cost engine, prune,
@@ -907,6 +948,9 @@ TRACE_SITES = (
     # kernel tier (kernels/tune.py): one span per autotune run, so a
     # slow first-compile is attributable to measurement, not a wedge
     "kernel.tune",
+    # dygraph capture (imperative/jit.py): one span per trace capture
+    # (tagged with the retrace reason) and one per cached replay
+    "imperative.capture", "imperative.replay",
 )
 
 # -------------------------------------------------------- backend/bench

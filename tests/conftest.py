@@ -94,6 +94,7 @@ SLOW_TESTS = {
     "test_bench_cli.py::test_bench_fused_row_records_pallas_mode",
     "test_bench_cli.py::test_bench_orchestrator_happy_path",
     "test_bench_cli.py::test_bench_orchestrator_kills_hung_workload",
+    "test_imperative_capture.py::test_captured_replay_2x_faster_than_eager",
     "test_book.py::test_image_classification_cifar_conv_bn",
     "test_book.py::test_label_semantic_roles_crf",
     "test_book.py::test_machine_translation_seq2seq_with_beam_decode",

@@ -166,6 +166,39 @@ def test_bench_pipelined_row(tmp_path):
     assert m["paddle_pipeline_overlap_ratio"]["samples"][0]["value"] > 0
 
 
+def test_bench_dygraph_rows(tmp_path):
+    """PADDLE_TPU_BENCH_DYGRAPH=1 swaps the workload list for the
+    dygraph capture rows: one eager and one captured-replay steps/sec
+    row, both marked dygraph:true (so pin_baselines skips them), the
+    replay row additionally captured:true with its eager-relative
+    speedup and the capture's predicted peak bytes."""
+    rc, rows = _run(["--worker", "dygraph", "--quick"],
+                    {"PADDLE_TPU_BENCH_DYGRAPH": "1",
+                     "PADDLE_TPU_TELEMETRY_DIR": str(tmp_path),
+                     "PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT": "560"}, 590)
+    assert rc == 0, rows
+    by_metric = {r["metric"]: r for r in rows if "value" in r}
+    assert set(by_metric) == {"dygraph_eager", "dygraph_captured"}
+    eager, cap = by_metric["dygraph_eager"], by_metric["dygraph_captured"]
+    for row in (eager, cap):
+        assert row["dygraph"] is True
+        assert row["value"] > 0
+        assert row["unit"] == "steps/sec"
+        assert row["vs_baseline"] == 1.0  # never compares to baselines
+    assert "captured" not in eager
+    assert cap["captured"] is True
+    assert cap["speedup_vs_eager"] == pytest.approx(
+        cap["value"] / eager["value"], rel=0.01)
+    assert cap["peak_bytes_predicted"] > 0
+    assert eager["peak_bytes_predicted"] is None
+    side = json.load(open(tmp_path / "BENCH_dygraph.telemetry.json"))
+    m = side["metrics"]
+    assert m["paddle_imperative_captures_total"][
+        "samples"][0]["value"] >= 1
+    assert m["paddle_imperative_cache_hits_total"][
+        "samples"][0]["value"] > 0
+
+
 def _mini_snap(steps, gap_bucket_counts):
     """Minimal valid telemetry snapshot for stats_dump --diff tests."""
     total = sum(gap_bucket_counts.values())

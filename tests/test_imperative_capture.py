@@ -1,0 +1,349 @@
+"""Dygraph trace capture (``imperative.jit``): the bitwise train-step
+contract, cache discipline (buckets / branches / config keys / LRU),
+Predictor serving, telemetry schema and the CLI face — everything
+docs/IMPERATIVE.md promises."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import imperative, observe
+from paddle_tpu.imperative import nn as inn
+from paddle_tpu.imperative import optimizer as iopt
+from paddle_tpu.imperative import trace_op
+from paddle_tpu.imperative.capture import CaptureError
+
+ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _value(name, **labels):
+    for s in observe.snapshot()["metrics"][name]["samples"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s.get("value", s.get("count"))
+    return 0.0
+
+
+def _mlp_step(fc1, fc2, adam):
+    """One dropout+Adam train step on the eager tape — the RNG chain
+    (dropout mask) and the optimizer state both advance per call."""
+    def step(x, y):
+        h = trace_op("dropout", {"X": [fc1(x)]},
+                     {"dropout_prob": 0.3, "is_test": False})["Out"][0]
+        d = trace_op("elementwise_sub", {"X": [fc2(h)], "Y": [y]},
+                     {})["Out"][0]
+        sq = trace_op("square", {"X": [d]}, {})["Out"][0]
+        loss = trace_op("reduce_mean", {"X": [sq]}, {})["Out"][0]
+        loss.backward()
+        adam.step(fc1.parameters() + fc2.parameters())
+        return loss
+    return step
+
+
+def _run_train(n_steps, captured):
+    """N train steps, eager or through imperative.jit; returns losses,
+    final params, final RNG chain key, and the CapturedFunction."""
+    rs = np.random.RandomState(0)
+    X = rs.rand(8, 16).astype(np.float32)
+    Y = rs.rand(8, 1).astype(np.float32)
+    np.random.seed(42)  # parameter init draws GLOBAL numpy RNG
+    with imperative.guard(seed=7):
+        fc1 = inn.FC("fc1", 16, act="relu")
+        fc2 = inn.FC("fc2", 1)
+        adam = iopt.Adam(learning_rate=1e-2)
+        step = _mlp_step(fc1, fc2, adam)
+        fn = imperative.jit(step) if captured else step
+        losses = []
+        for _ in range(n_steps):
+            vx = imperative.to_variable(X)
+            vy = imperative.to_variable(Y)
+            vx.stop_gradient = True
+            vy.stop_gradient = True
+            losses.append(np.asarray(fn(vx, vy).numpy()))
+        params = [np.asarray(p.numpy())
+                  for p in fc1.parameters() + fc2.parameters()]
+        rng = np.asarray(imperative.get_tracer()._rng)
+    return losses, params, rng, (fn if captured else None)
+
+
+def test_captured_train_step_bitwise_eager():
+    """THE acceptance criterion: one capture + N-1 replays advance
+    params AND the RNG chain bitwise identically to N eager steps —
+    dropout masks, Adam moments, everything."""
+    N = 5
+    e_losses, e_params, e_rng, _ = _run_train(N, captured=False)
+    c_losses, c_params, c_rng, cap = _run_train(N, captured=True)
+    assert cap.stats["captures"] == 1
+    assert cap.stats["hits"] == N - 1
+    for a, b in zip(e_losses, c_losses):
+        assert a.tobytes() == b.tobytes()
+    for a, b in zip(e_params, c_params):
+        assert a.tobytes() == b.tobytes()
+    assert e_rng.tobytes() == c_rng.tobytes()
+
+
+def test_capture_telemetry_and_pass_stats():
+    cap0 = _value("paddle_imperative_captures_total")
+    hit0 = _value("paddle_imperative_cache_hits_total")
+    _, _, _, cap = _run_train(3, captured=True)
+    assert _value("paddle_imperative_captures_total") == cap0 + 1
+    assert _value("paddle_imperative_cache_hits_total") == hit0 + 2
+    # the level-2 TV-checked shakedown ran at capture: per-pass op rows
+    rows = cap._last_entry.pass_stats
+    assert rows and all(
+        {"pass", "ops_before", "ops_after"} <= set(r) for r in rows)
+    assert cap._last_entry.predicted_bytes > 0  # memory engine priced it
+
+
+def test_bucketed_retrace_counted_in_telemetry():
+    """A new lead dim re-traces ONCE per bucket (padded feeds reuse the
+    bucket's program) and each re-trace lands in
+    paddle_imperative_retraces_total{reason=bucket}."""
+    b0 = _value("paddle_imperative_retraces_total", reason="bucket")
+    with imperative.guard():
+        fc = inn.FC("fc", 4)
+
+        @imperative.jit(buckets=[8, 16])
+        def fwd(x):
+            return fc(x)
+
+        def run(n):
+            v = imperative.to_variable(
+                np.ones((n, 6), np.float32))
+            v.stop_gradient = True
+            return fwd(v)
+
+        out = run(5)                     # initial capture @ bucket 8
+        assert out.shape[0] == 5         # padded rows sliced back off
+        run(7)                           # same bucket: replay, no trace
+        assert fwd.stats["captures"] == 1
+        assert fwd.stats["hits"] == 1
+        out = run(12)                    # NEW bucket 16: one re-trace
+        assert out.shape[0] == 12
+        assert fwd.stats["captures"] == 2
+        assert fwd.stats["retraces"]["bucket"] == 1
+        assert _value("paddle_imperative_retraces_total",
+                      reason="bucket") == b0 + 1
+        run(13)                          # bucket 16 again: replay
+        assert fwd.stats["captures"] == 2
+
+
+def test_branch_guard_mismatch_retraces():
+    """float() on a captured value bakes the branch decision in as a
+    guard; a replay whose guard evaluates differently re-traces the
+    other branch instead of silently replaying the wrong one."""
+    with imperative.guard():
+        @imperative.jit
+        def fn(x):
+            s = trace_op("reduce_sum", {"X": [x]},
+                         {"reduce_all": True})["Out"][0]
+            if float(s) > 0:
+                return trace_op("relu", {"X": [x]}, {})["Out"][0]
+            return trace_op("square", {"X": [x]}, {})["Out"][0]
+
+        def run(arr):
+            v = imperative.to_variable(arr.astype(np.float32))
+            v.stop_gradient = True
+            return np.asarray(fn(v).numpy())
+
+        pos = np.array([[1.0, 2.0]])
+        neg = np.array([[-1.0, -2.0]])
+        np.testing.assert_allclose(run(pos), [[1.0, 2.0]])   # relu branch
+        assert fn.stats["captures"] == 1
+        np.testing.assert_allclose(run(neg), [[1.0, 4.0]])   # square branch
+        assert fn.stats["captures"] == 2
+        assert fn.stats["retraces"]["branch"] == 1
+        np.testing.assert_allclose(run(pos), [[1.0, 2.0]])   # guard match
+        assert fn.stats["captures"] == 2
+        assert fn.stats["hits"] == 1
+
+
+def test_cache_lru_eviction_counted():
+    ev0 = _value("paddle_imperative_cache_evictions_total")
+    with imperative.guard():
+        @imperative.jit(cache_size=2)
+        def fwd(x):
+            return trace_op("square", {"X": [x]}, {})["Out"][0]
+
+        def run(shape):
+            v = imperative.to_variable(np.ones(shape, np.float32))
+            v.stop_gradient = True
+            return fwd(v)
+
+        for shape in [(2, 3), (3, 3), (4, 3)]:
+            run(shape)
+        assert fwd.stats["captures"] == 3
+        assert fwd.cache_len == 2        # LRU capped
+        assert _value("paddle_imperative_cache_evictions_total") == ev0 + 1
+        run((2, 3))                      # evicted: re-traces
+        assert fwd.stats["captures"] == 4
+
+
+def test_cache_size_env_knob(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CAPTURE_CACHE_SIZE", "1")
+    cap = imperative.jit(lambda x: x)
+    assert cap._cap == 1
+    monkeypatch.setenv("PADDLE_TPU_CAPTURE_CACHE_SIZE", "0")
+    with pytest.raises(ValueError):
+        imperative.jit(lambda x: x)
+
+
+def test_config_key_flip_retraces(monkeypatch):
+    """The capture key carries passes.config_key() + kernels.config_key():
+    flipping an optimization knob re-captures (never serves a plan built
+    under the old config — the PR 7/8 staleness hole, closed)."""
+    with imperative.guard():
+        @imperative.jit
+        def fwd(x):
+            return trace_op("square", {"X": [x]}, {})["Out"][0]
+
+        def run():
+            v = imperative.to_variable(np.ones((2, 2), np.float32))
+            v.stop_gradient = True
+            return fwd(v)
+
+        run()
+        run()
+        assert fwd.stats == {"captures": 1, "hits": 1,
+                             "retraces": {"shape": 0, "bucket": 0,
+                                          "branch": 0, "config": 0}}
+        monkeypatch.setenv("PADDLE_TPU_OPTIMIZE_QUANT", "1")
+        run()                            # same signature, new config key
+        assert fwd.stats["captures"] == 2
+        assert fwd.stats["retraces"]["config"] == 1
+
+
+def test_captured_inference_serves_through_predictor_bitwise():
+    """as_predictor: the captured program serves through serving's
+    Predictor with outputs BITWISE the eager function's, including a
+    dynamic batch routed through warmup buckets."""
+    np.random.seed(3)
+    X = np.random.RandomState(1).rand(4, 6).astype(np.float32)
+    with imperative.guard():
+        fc1 = inn.FC("fc1", 8, act="relu")
+        fc2 = inn.FC("fc2", 3)
+
+        @imperative.jit
+        def fwd(x):
+            return fc2(fc1(x))
+
+        v = imperative.to_variable(X)
+        v.stop_gradient = True
+        eager_out = np.asarray(fwd(v).numpy())
+        pred = fwd.as_predictor(warmup_batch_sizes=[4, 8])
+    out, = pred.run([X])
+    assert np.asarray(out).tobytes() == eager_out.tobytes()
+    # dynamic batch: 6 rows pad up to the 8-bucket, slice back
+    X7 = np.random.RandomState(2).rand(6, 6).astype(np.float32)
+    out7, = pred.run([X7])
+    assert out7.shape == (6, 3)
+    # a train capture must refuse to serve
+    with imperative.guard():
+        fc = inn.FC("fc", 1)
+        adam = iopt.Adam()
+
+        @imperative.jit
+        def train(x):
+            loss = trace_op("reduce_mean", {"X": [fc(x)]}, {})["Out"][0]
+            loss.backward()
+            adam.step(fc.parameters())
+            return loss
+
+        vv = imperative.to_variable(X)
+        vv.stop_gradient = True
+        train(vv)
+        with pytest.raises(CaptureError):
+            train.as_predictor()
+
+
+def test_capture_outside_guard_raises():
+    cap = imperative.jit(lambda x: x)
+    with pytest.raises(CaptureError):
+        cap(np.ones((2, 2), np.float32))
+
+
+def test_telemetry_schema_pinned():
+    """repo_lint satellite: every paddle_imperative_* family is declared
+    in observe/families.py and the capture spans + analysis site are in
+    the schema tuples."""
+    from paddle_tpu.observe.families import REGISTRY, TRACE_SITES
+
+    declared = set(REGISTRY._families)
+    assert {"paddle_imperative_captures_total",
+            "paddle_imperative_capture_seconds",
+            "paddle_imperative_captured_ops",
+            "paddle_imperative_cache_hits_total",
+            "paddle_imperative_retraces_total",
+            "paddle_imperative_cache_evictions_total"} <= declared
+    assert {"imperative.capture", "imperative.replay"} <= set(TRACE_SITES)
+    # the capture-time verify site is part of the analysis schema
+    assert _value("paddle_analysis_programs_verified_total",
+                  site="capture") >= 0
+    samples = observe.snapshot()[
+        "metrics"]["paddle_analysis_programs_verified_total"]["samples"]
+    assert any(s["labels"].get("site") == "capture" for s in samples)
+
+
+def test_capture_cli_smoke(capsys):
+    """tools/capture_program.py: lint findings + per-pass op counts +
+    predicted peak bytes, for eager example callables."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import capture_program
+    finally:
+        sys.path.pop(0)
+    rc = capture_program.main(["--model", "mlp", "mlp_train",
+                               "--batch", "32", "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert set(report) == {"mlp", "mlp_train"}
+    for rep in report.values():
+        assert rep["ops"] > 0
+        assert rep["passes"] and all("ops_before" in r for r in rep["passes"])
+        assert all(v > 0 for v in rep["peak_bytes"].values())
+        assert 32 in {int(b) for b in rep["peak_bytes"]}
+        assert not [f for f in rep["findings"]
+                    if f["severity"] == "error"]
+    assert report["mlp_train"]["trainable"] is True
+    assert report["mlp"]["trainable"] is False
+
+
+@pytest.mark.slow
+def test_captured_replay_2x_faster_than_eager():
+    """Perf acceptance: with exact_numerics=False (whole-graph XLA
+    fusion) a captured replay beats op-by-op eager dispatch by >=2x
+    steps/sec. Best-of-5 ratio, no absolute-ms thresholds."""
+    import time
+
+    def measure(captured):
+        np.random.seed(0)
+        with imperative.guard(seed=0):
+            fc1 = inn.FC("fc1", 32, act="relu")
+            fc2 = inn.FC("fc2", 1)
+            adam = iopt.Adam(learning_rate=1e-3)
+            step = _mlp_step(fc1, fc2, adam)
+            fn = imperative.jit(step, exact_numerics=False) \
+                if captured else step
+            rs = np.random.RandomState(0)
+            vx = imperative.to_variable(rs.rand(32, 64).astype(np.float32))
+            vy = imperative.to_variable(rs.rand(32, 1).astype(np.float32))
+            vx.stop_gradient = True
+            vy.stop_gradient = True
+            for _ in range(3):
+                fn(vx, vy)               # warmup (includes the capture)
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(10):
+                    loss = fn(vx, vy)
+                float(np.asarray(loss.numpy()).reshape(-1)[0])
+                best = min(best, time.perf_counter() - t0)
+        return 10.0 / best
+
+    eager_rate = measure(False)
+    captured_rate = measure(True)
+    assert captured_rate >= 2.0 * eager_rate, \
+        "captured %.1f steps/s vs eager %.1f steps/s" \
+        % (captured_rate, eager_rate)

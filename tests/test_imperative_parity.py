@@ -1,0 +1,187 @@
+"""Eager-vs-graph parity for every ``imperative.nn`` layer, THROUGH
+backward(): the tape walks the SAME grad-op lowerings ``append_backward``
+emits, so forward values, parameter gradients and input gradients must
+agree between the two dispatch modes (the one-gradient-implementation
+contract docs/IMPERATIVE.md pins)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import imperative
+from paddle_tpu.core.backward import append_backward
+from paddle_tpu.imperative import nn as inn
+
+
+def _eager_loss(out):
+    sq = imperative.trace_op("square", {"X": [out]}, {})["Out"][0]
+    return imperative.trace_op("mean", {"X": [sq]}, {})["Out"][0]
+
+
+def _graph_run(fresh_programs, build, feed, param_overrides,
+               extra_fetch=()):
+    """Build a graph program, overwrite its parameters with the EAGER
+    layer's arrays (creation order), run forward+backward once. Returns
+    (out, {param_name: grad}, [extra fetch values])."""
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        out, loss = build()
+        param_grads = append_backward(loss)
+    if callable(extra_fetch):  # resolved AFTER build (helper-made names)
+        extra_fetch = extra_fetch(main)
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    params = main.global_block().all_parameters()
+    assert len(params) == len(param_overrides), \
+        [p.name for p in params]
+    for p, v in zip(params, param_overrides):
+        assert tuple(p.shape) == tuple(np.shape(v)), (p.name, p.shape)
+        scope.set_var(p.name, np.asarray(v))
+    grad_names = [g.name for _, g in param_grads]
+    res = exe.run(main, feed=feed,
+                  fetch_list=[out.name] + grad_names + list(extra_fetch),
+                  scope=scope)
+    by_param = {p.name: np.asarray(g)
+                for (p, _), g in zip(param_grads, res[1:1 + len(grad_names)])}
+    # grads in PARAMETER CREATION order, matching param_overrides
+    grads = [by_param[p.name] for p in params]
+    return np.asarray(res[0]), grads, res[1 + len(grad_names):]
+
+
+def _close(a, b):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fc_parity(fresh_programs):
+    X = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+    with imperative.guard():
+        fc = inn.FC("fc", 3, act="relu")
+        xd = imperative.to_variable(X)
+        xd.stop_gradient = True
+        out = fc(xd)
+        _eager_loss(out).backward()
+        e_out, e_gw, e_gb = (out.numpy(), fc._w.gradient(),
+                             fc._b.gradient())
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        h = fluid.layers.fc(xv, 3, act="relu")
+        return h, fluid.layers.mean(fluid.layers.square(h))
+
+    g_out, grads, _ = _graph_run(fresh_programs, build, {"x": X},
+                                 [fc._w.numpy(), fc._b.numpy()])
+    _close(e_out, g_out)
+    gw, gb = grads
+    _close(e_gw, gw)
+    _close(e_gb, gb)
+
+
+def test_conv2d_parity(fresh_programs):
+    X = np.random.RandomState(1).rand(2, 3, 8, 8).astype(np.float32)
+    with imperative.guard():
+        conv = inn.Conv2D("conv", 3, 4, 3, stride=1, padding=1, act="relu")
+        xd = imperative.to_variable(X)
+        xd.stop_gradient = True
+        out = conv(xd)
+        _eager_loss(out).backward()
+        e_out = out.numpy()
+        e_gf, e_gb = conv._filter.gradient(), conv._b.gradient()
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+        h = fluid.layers.conv2d(xv, 4, 3, stride=1, padding=1, act="relu")
+        return h, fluid.layers.mean(fluid.layers.square(h))
+
+    g_out, grads, _ = _graph_run(fresh_programs, build, {"x": X},
+                                 [conv._filter.numpy(), conv._b.numpy()])
+    _close(e_out, g_out)
+    gf, gb = grads
+    _close(e_gf, gf)
+    _close(e_gb, gb)
+
+
+def test_pool2d_parity(fresh_programs):
+    # no parameters: parity target is the INPUT gradient, so the graph
+    # side models the input as a parameter to give it a @GRAD
+    X = np.random.RandomState(2).rand(2, 3, 8, 8).astype(np.float32)
+    with imperative.guard():
+        pool = inn.Pool2D("pool", pool_size=2, pool_type="avg",
+                          pool_stride=2)
+        xd = imperative.to_variable(X)  # stop_gradient=False: leaf
+        out = pool(xd)
+        _eager_loss(out).backward()
+        e_out, e_gx = out.numpy(), xd.gradient()
+
+    def build():
+        xv = fluid.layers.create_parameter(
+            [2, 3, 8, 8], "float32", name="xp",
+            default_initializer=fluid.initializer.NumpyArrayInitializer(X))
+        h = fluid.layers.pool2d(xv, pool_size=2, pool_type="avg",
+                                pool_stride=2)
+        return h, fluid.layers.mean(fluid.layers.square(h))
+
+    g_out, grads, _ = _graph_run(fresh_programs, build, {}, [X])
+    _close(e_out, g_out)
+    gx, = grads
+    _close(e_gx, gx)
+
+
+def test_batch_norm_parity(fresh_programs):
+    X = np.random.RandomState(3).rand(4, 3, 5, 5).astype(np.float32)
+    with imperative.guard():
+        bn = inn.BatchNorm("bn", 3, act="relu")
+        xd = imperative.to_variable(X)
+        xd.stop_gradient = True
+        out = bn(xd)
+        _eager_loss(out).backward()
+        e_out = out.numpy()
+        e_gs, e_gb = bn._scale.gradient(), bn._bias.gradient()
+        e_mean, e_var = bn._mean.numpy(), bn._variance.numpy()
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[3, 5, 5], dtype="float32")
+        h = fluid.layers.batch_norm(xv, act="relu")
+        return h, fluid.layers.mean(fluid.layers.square(h))
+
+    g_out, grads, extra = _graph_run(
+        fresh_programs, build, {"x": X},
+        [bn._scale.numpy(), bn._bias.numpy()],
+        extra_fetch=_bn_stat_names)
+    _close(e_out, g_out)
+    gs, gb = grads
+    _close(e_gs, gs)
+    _close(e_gb, gb)
+    # the running-stat updates are part of the layer contract too
+    _close(e_mean, extra[0])
+    _close(e_var, extra[1])
+
+
+def _bn_stat_names(main):
+    """Mean/Variance var names of the program's batch_norm op — the
+    helper generates them, so read them off the op."""
+    (op,) = [op for op in main.global_block().ops
+             if op.type == "batch_norm"]
+    return [op.inputs["Mean"][0], op.inputs["Variance"][0]]
+
+
+def test_embedding_parity(fresh_programs):
+    ids = np.array([[1], [4], [2], [1]], dtype=np.int64)
+    with imperative.guard():
+        emb = inn.Embedding("emb", (8, 5))
+        idv = imperative.to_variable(ids)
+        idv.stop_gradient = True
+        out = emb(idv)
+        _eager_loss(out).backward()
+        e_out, e_gw = out.numpy(), emb._w.gradient()
+
+    def build():
+        iv = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        h = fluid.layers.embedding(iv, size=[8, 5])
+        return h, fluid.layers.mean(fluid.layers.square(h))
+
+    g_out, grads, _ = _graph_run(fresh_programs, build, {"ids": ids},
+                                 [emb._w.numpy()])
+    _close(np.squeeze(e_out), np.squeeze(g_out))
+    gw, = grads
+    _close(e_gw, gw)

@@ -140,6 +140,25 @@ def test_fleet_rows_never_pin(tmp_path):
     assert "serving_fleet_tokens_per_sec" not in base
 
 
+def test_dygraph_rows_never_pin(tmp_path):
+    # PADDLE_TPU_BENCH_DYGRAPH=1 rows measure eager-vs-captured dispatch
+    # overhead on a toy MLP — neither the eager row nor the
+    # captured:true replay row may ever touch training baselines
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": "dygraph_eager", "value": 9e9, "dygraph": True,
+         "steps_per_call": 1},
+        {"metric": "dygraph_captured", "value": 9e9, "dygraph": True,
+         "captured": True, "speedup_vs_eager": 20.0,
+         "steps_per_call": 1},
+        {"metric": ROW, "value": 9999.0, "dygraph": True,
+         "steps_per_call": 1}])
+    assert proc.stdout.count("SKIP") == 3
+    assert "dygraph" in proc.stdout
+    assert base[ROW] == 509.8
+    assert "dygraph_eager" not in base
+    assert "dygraph_captured" not in base
+
+
 def test_dispatch_override_rows_never_pin(tmp_path):
     proc, base, spc = _pin(tmp_path, [
         {"metric": ROW, "value": 9999.0, "steps_per_call": 10,

@@ -91,17 +91,20 @@ def main():
         if row.get("recompute") or row.get("batch_scale", 1) != 1 \
                 or "flash_min_seq" in row or row.get("pipelined") \
                 or row.get("serving") or row.get("fleet") \
-                or row.get("elastic") or row.get("quantized"):
+                or row.get("elastic") or row.get("quantized") \
+                or row.get("dygraph"):
             # fleet rows (prefix cache + speculative draft + router)
             # measure a DIFFERENT serving configuration again: they are
             # incomparable with non-fleet serving rows too, not just
             # with training baselines; elastic rows measure a chaos
             # RECOVERY path on CPU subprocesses, not a training config;
             # quantized rows compiled a DIFFERENT (int8-PTQ) program
-            # with its own accuracy/latency trade
+            # with its own accuracy/latency trade; dygraph rows (eager
+            # AND captured-replay) measure dispatch overhead on a toy
+            # MLP, not any training baseline's workload
             print("SKIP %s: recompute/scaled-batch/dispatch-override/"
-                  "pipelined/serving/fleet/elastic/quantized rows "
-                  "never pin over the plain-config baseline" % name)
+                  "pipelined/serving/fleet/elastic/quantized/dygraph "
+                  "rows never pin over the plain-config baseline" % name)
             continue
         if row.get("kernel_tuned") or row.get("kernels") == "off":
             # a tuned kernel-tier cache or the PADDLE_TPU_KERNELS=0

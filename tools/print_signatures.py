@@ -40,6 +40,8 @@ MODULES = [
     "paddle_tpu.profiler",
     "paddle_tpu.imperative",
     "paddle_tpu.imperative.nn",
+    "paddle_tpu.imperative.optimizer",
+    "paddle_tpu.imperative.jit",
     "paddle_tpu.inference",
     "paddle_tpu.kernels",
     "paddle_tpu.serving",
