@@ -1629,6 +1629,112 @@ def bench_dygraph(amp, quick, uses_flash=False):
     return recs
 
 
+def bench_artifact(amp, quick, uses_flash=False):
+    """Deployable-artifact cold-start rows (docs/DEPLOYMENT.md): for
+    each of three model-zoo INFERENCE programs, measure
+    cold-start-to-first-token twice — from scratch (fresh Executor:
+    verify + optimize + analyze + XLA compile + first batch) and from a
+    frozen artifact (load_artifact + seeded predictor + first batch;
+    with a live AOT section the first token never touches XLA
+    lowering). Rows carry artifact:true + from_scratch_s +
+    speedup_vs_scratch — pin_baselines treats them as incomparable
+    with the training baselines (a load path, not a training
+    config)."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import tempfile
+
+    import lint_program as _lint_cli
+
+    import jax as _jax
+    import paddle_tpu as fluid
+    from paddle_tpu import export as _export
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    batch = 2 if quick else 8
+    models = ("mnist",) if quick else ("mnist", "ctr", "stacked_lstm")
+    rng = np.random.RandomState(0)
+
+    def _feed_for(main):
+        feed = {}
+        for var in main.global_block().vars.values():
+            if not var.is_data:
+                continue
+            shape = [batch if (s is None or s < 0) else int(s)
+                     for s in (var.shape or [batch])]
+            if var.dtype.startswith(("int", "uint")):
+                feed[var.name] = rng.randint(0, 2, shape).astype("int64")
+            else:
+                feed[var.name] = rng.uniform(
+                    -1, 1, shape).astype("float32")
+        return feed
+
+    recs = []
+    for model in models:
+        with _beacon("artifact", model):
+            main, startup, loss = _lint_cli.build_example(
+                model, optimizer=False)
+            scope = Scope()
+            feed = _feed_for(main)
+            feed_names = sorted(feed)
+            with scope_guard(scope):
+                exe0 = fluid.Executor(fluid.TPUPlace())
+                exe0.run(startup, scope=scope)
+                # from-scratch cold start: a fresh Executor pays the
+                # whole prepare pipeline + XLA compile for this first
+                # batch (plan caches are per-Executor)
+                _log("artifact/%s: from-scratch cold start" % model)
+                t0 = time.perf_counter()
+                exe = fluid.Executor(fluid.TPUPlace())
+                ref, = exe.run(main, feed=feed, fetch_list=[loss],
+                               scope=scope)
+                ref = np.asarray(ref)
+                dt_scratch = time.perf_counter() - t0
+                # freeze ONCE (the expensive half; deliberately outside
+                # both timed windows — deployment pays it at build time)
+                path = os.path.join(tempfile.mkdtemp(prefix="pt_art_"),
+                                    "%s.pdz" % model)
+                _log("artifact/%s: save_artifact" % model)
+                _export.save_artifact(
+                    main, path, feed_names=feed_names,
+                    fetch_names=[loss.name], scope=scope,
+                    batch_sizes=(batch,), name=model)
+            # artifact cold start: validate + rehydrate + seeded first
+            # batch — the serving process's actual startup path
+            _log("artifact/%s: artifact cold start" % model)
+            t0 = time.perf_counter()
+            art = _export.load_artifact(path)
+            pred = art.predictor()
+            out = np.asarray(pred.run(feed)[0])
+            dt_art = time.perf_counter() - t0
+            rec = {
+                "metric": "artifact_%s" % model,
+                "platform": _jax.devices()[0].platform.lower(),
+                # the mode marker pin_baselines keys the skip on:
+                # cold-start seconds, not a training throughput
+                "artifact": True,
+                "value": round(dt_art, 3),
+                "unit": "cold_start_seconds",
+                "from_scratch_s": round(dt_scratch, 3),
+                "speedup_vs_scratch": round(dt_scratch / dt_art, 2)
+                if dt_art > 0 else None,
+                "aot": sorted(art.aot) or None,
+                "tuned_imported": art.tuned_imported,
+                "bitwise_vs_scratch": bool(np.array_equal(ref, out)),
+                "peak_bytes_predicted": art.predicted_bytes(batch),
+                "steps_per_call": 1,
+                "vs_baseline": 1.0,
+                "tflops_per_sec": None,
+                "mfu": None,
+                **({"quick": True} if quick else {}),
+            }
+            print(json.dumps(rec), flush=True)
+            recs.append(rec)
+    return recs
+
+
 WORKLOADS = {
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
@@ -1675,6 +1781,14 @@ DYGRAPH_ORDER = ["dygraph"]
 DYGRAPH_WORKLOADS = {"dygraph": bench_dygraph}
 WORKLOADS.update(DYGRAPH_WORKLOADS)
 
+# PADDLE_TPU_BENCH_ARTIFACT=1 swaps the workload list for the deployable
+# artifact cold-start rows (docs/DEPLOYMENT.md): time-to-first-token
+# from an artifact load vs building the same serving path from scratch.
+# Rows are marked "artifact" and never pin as training baselines.
+ARTIFACT_ORDER = ["artifact"]
+ARTIFACT_WORKLOADS = {"artifact": bench_artifact}
+WORKLOADS.update(ARTIFACT_WORKLOADS)
+
 
 def _serving_mode():
     return os.environ.get("PADDLE_TPU_BENCH_SERVING", "0") != "0"
@@ -1690,6 +1804,10 @@ def _quant_mode():
 
 def _dygraph_mode():
     return os.environ.get("PADDLE_TPU_BENCH_DYGRAPH", "0") != "0"
+
+
+def _artifact_mode():
+    return os.environ.get("PADDLE_TPU_BENCH_ARTIFACT", "0") != "0"
 
 # Safe (no custom-kernel) workloads first: if the tunnel wedges or a
 # Pallas compile hangs partway through, the rows already printed stand.
@@ -1708,9 +1826,10 @@ ATTENTION_SEQ = {"transformer": 128, "transformer_long": 1024,
 ATTENTION_WORKLOADS = frozenset(ATTENTION_SEQ)
 
 assert set(ORDER) | set(SERVING_ORDER) | set(ELASTIC_ORDER) \
-    | set(QUANT_ORDER) | set(DYGRAPH_ORDER) == set(WORKLOADS), \
-    "ORDER/SERVING_ORDER/ELASTIC_ORDER/QUANT_ORDER/DYGRAPH_ORDER out " \
-    "of sync with WORKLOADS"
+    | set(QUANT_ORDER) | set(DYGRAPH_ORDER) | set(ARTIFACT_ORDER) \
+    == set(WORKLOADS), \
+    "ORDER/SERVING_ORDER/ELASTIC_ORDER/QUANT_ORDER/DYGRAPH_ORDER/" \
+    "ARTIFACT_ORDER out of sync with WORKLOADS"
 
 
 def _probe_backend(timeout_s=None, attempts=None, probe_fn=None):
@@ -1970,7 +2089,8 @@ def main():
     # PADDLE_TPU_BENCH_SERVING=1 / PADDLE_TPU_BENCH_ELASTIC=1 /
     # PADDLE_TPU_BENCH_QUANT=1 / PADDLE_TPU_BENCH_DYGRAPH=1 swap the
     # default workload list; --only still picks any single workload
-    default_order = (DYGRAPH_ORDER if _dygraph_mode()
+    default_order = (ARTIFACT_ORDER if _artifact_mode()
+                     else DYGRAPH_ORDER if _dygraph_mode()
                      else QUANT_ORDER if _quant_mode()
                      else ELASTIC_ORDER if _elastic_mode()
                      else SERVING_ORDER if _serving_mode() else ORDER)

@@ -913,7 +913,7 @@ class Executor:
             verify_program(program, fetch_list=fetch_names, scope=scope,
                            raise_on_error=True, site="prepare")
         exact = getattr(program, "exact_numerics", False)
-        if not exact:
+        if not exact and not getattr(program, "_pre_optimized", False):
             # graph-optimizing pass pipeline (core/passes): fold/copy-
             # prop/CSE/DCE/fusion on a CLONE, so the optimized plan is
             # what gets cached and the user's program is untouched.
@@ -922,6 +922,11 @@ class Executor:
             # exact_numerics programs (dygraph capture's bitwise-parity
             # mode) skip it: fusion passes rewrite the op sequence and
             # would break replay-equals-eager at the ULP level.
+            # _pre_optimized programs (export/ artifacts) already ran
+            # the pipeline, TV-checked, at save time — re-running it
+            # here would break the artifact's zero-optimize cold-start
+            # contract (and the config_key load check guarantees the
+            # frozen pipeline config matches this process's).
             program = optimize_for_execution(program, fetch_names, scope=scope)
         feed_names = sorted(feed_vals)
         (feed_names, fetch_names, const_state, mut_state, pure_written,
@@ -939,6 +944,40 @@ class Executor:
                      pure_written, needs_rng, fn, step=step)
         plan.exact = exact
         return plan
+
+    def seed_plan(self, program: Program, feed, fetch_list,
+                  scope: Optional[Scope] = None) -> bool:
+        """Install a prepared plan for (program, feed-signature,
+        fetches) WITHOUT counting a plan-cache miss — the artifact
+        cold-start path (paddle_tpu/export): a loaded artifact seeds
+        every covered signature so its first real run is a cache HIT,
+        and the cold-start acceptance test pins that loading moves
+        zero ``paddle_executor_cache_misses_total``. Compilation stays
+        lazy (jax.jit traces at first dispatch), so seeding costs one
+        analyze pass per signature, not a compile. Returns True when a
+        plan was installed, False when the signature was already
+        cached."""
+        scope = scope if scope is not None else global_scope()
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in (fetch_list or [])]
+        block = program.global_block()
+        feed_vals, _ = feeds_to_device(feed or {}, block.vars.get,
+                                       self._jax_device())
+        key = self._cache_key(program, feed_vals, fetch_names)
+        if key in self._cache:
+            return False
+        from ..observe.families import (EXECUTOR_CACHE_EVICTIONS,
+                                        EXECUTOR_PREPARE_SECONDS)
+
+        t0 = time.perf_counter()
+        plan = self._prepare(program, feed_vals, fetch_names, scope)
+        plan.sig = "%08x" % (zlib.crc32(repr(key).encode()) & 0xffffffff)
+        EXECUTOR_PREPARE_SECONDS.observe(time.perf_counter() - t0)
+        self._cache[key] = plan
+        while len(self._cache) > self._cache_size:
+            self._cache.popitem(last=False)
+            EXECUTOR_CACHE_EVICTIONS.inc()
+        return True
 
 
 @contextlib.contextmanager

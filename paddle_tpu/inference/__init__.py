@@ -95,14 +95,21 @@ class Predictor:
     def from_program(cls, program: Program, feed_names: Sequence[str],
                      fetch_names: Sequence[str], params: Dict[str, object],
                      warmup_batch_sizes: Sequence[int] = (),
-                     batch_major_fetches: Sequence[str] = ()):
+                     batch_major_fetches: Sequence[str] = (),
+                     pre_optimized: bool = False):
         """Build a Predictor from an IN-MEMORY Program — the dygraph
         capture serving path (``CapturedFunction.as_predictor``): no
         save/load round-trip; ``params`` hands captured state straight
         into the predictor's scope. ``batch_major_fetches`` names fetch
         vars whose lead dim is the batch axis (a capture records them
         with the trace's concrete batch; the bucket router needs the
-        dynamic -1 marker to slice pad rows back off)."""
+        dynamic -1 marker to slice pad rows back off).
+
+        ``pre_optimized`` is the artifact path (``export.load_artifact``):
+        the program was ALREADY inference-rewritten + pipeline-optimized
+        at save time, so the rewrite and the batch-major marking are
+        skipped and the program serves as-is (its ``_pre_optimized``
+        flag makes the executor skip the pass pipeline too)."""
         from ..core.executor import Executor
 
         self = cls.__new__(cls)
@@ -113,12 +120,14 @@ class Predictor:
         self._exe = Executor()
         for n, v in params.items():
             self.scope.set_var(n, v)
-        self.program = _rewrite_for_inference(program)
+        self.program = (program if pre_optimized
+                        else _rewrite_for_inference(program))
         block = self.program.global_block()
-        for n in batch_major_fetches:
-            var = block.vars.get(n)
-            if var is not None and var.shape:
-                var.shape = (-1,) + tuple(var.shape[1:])
+        if not pre_optimized:
+            for n in batch_major_fetches:
+                var = block.vars.get(n)
+                if var is not None and var.shape:
+                    var.shape = (-1,) + tuple(var.shape[1:])
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.fetch_vars = [block.var(n) for n in fetch_names]
@@ -147,14 +156,48 @@ class Predictor:
         compile, counted in ``paddle_serving_bucket_miss_total``. The
         serving micro-batcher and direct callers share this one code
         path. No buckets configured = the classic compile-per-shape
-        behavior."""
+        behavior.
+
+        Artifact-loaded predictors carry frozen ``jax.export``
+        executables per bucket (``export.load_artifact``): a run whose
+        routed batch matches an AOT bucket calls the frozen executable
+        — zero trace, zero re-lowering — counted in
+        ``paddle_export_artifact_aot_calls_total``; anything else
+        falls through to the executor plan path below."""
         feed = self._as_feed(inputs)
         feed, n_rows = self._route_bucket(feed)
-        outs = self._exe.run(self.program, feed=feed,
-                             fetch_list=self.fetch_names, scope=self.scope)
+        outs = self._run_aot(feed)
+        if outs is None:
+            outs = self._exe.run(self.program, feed=feed,
+                                 fetch_list=self.fetch_names,
+                                 scope=self.scope)
         if n_rows is not None:
             outs = [o[:n_rows] if self._batch_major(v) else o
                     for v, o in zip(self.fetch_vars, outs)]
+        return outs
+
+    def _run_aot(self, feed):
+        """Serve one routed feed from the artifact's AOT section, or
+        None when no frozen executable covers it (no ``_aot`` map,
+        batch not a frozen bucket, or feed names diverged)."""
+        aot = getattr(self, "_aot", None)
+        if not aot:
+            return None
+        block = self.program.global_block()
+        sizes = {np.asarray(feed[n]).shape[0] for n in feed
+                 if self._batch_major(block.vars.get(n))}
+        if len(sizes) != 1:
+            return None
+        runner = aot.get(next(iter(sizes)))
+        if runner is None or set(runner.feed_names) != set(feed):
+            return None
+        from ..observe.families import ARTIFACT_AOT_CALLS
+
+        outs = runner(feed)
+        ARTIFACT_AOT_CALLS.inc()
+        if list(runner.out_names) != list(self.fetch_names):
+            order = {n: i for i, n in enumerate(runner.out_names)}
+            outs = [outs[order[n]] for n in self.fetch_names]
         return outs
 
     __call__ = run

@@ -47,7 +47,7 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = ["CACHE_VERSION", "cache_dir", "cache_path", "tune_enabled",
            "deterministic_seed", "lookup", "peek", "tune", "set_entry",
            "load_disk_entries", "persist_entry", "reset", "config_key",
-           "sig_key"]
+           "sig_key", "export_entries", "import_entries"]
 
 CACHE_VERSION = 1
 CACHE_FILE = "tuned_kernels.json"
@@ -231,6 +231,50 @@ def set_entry(op: str, sig: Tuple, decision: Dict[str, Any],
             _EPOCH += 1
     if persist:
         persist_entry(key, decision)
+
+
+def export_entries(keys=None) -> Dict[str, Dict[str, Any]]:
+    """Portable slice of the decision table for a deployable artifact
+    (``paddle_tpu.export``): entries stripped of process-local fields
+    (``source``) and measurement noise (``timings``/``errors``) so the
+    slice is stable across hosts. ``keys`` filters to the given sig_keys
+    or, for strings ending in ``|``, to every entry under that op prefix
+    (``"matmul|"`` takes all matmul signatures); None exports the whole
+    table (memory + the one-shot disk load)."""
+    with _LOCK:
+        _ensure_disk_loaded()
+        out: Dict[str, Dict[str, Any]] = {}
+        for k, v in _MEM.items():
+            if keys is not None:
+                if not any(k == f or (f.endswith("|") and k.startswith(f))
+                           for f in keys):
+                    continue
+            out[k] = {f: x for f, x in v.items()
+                      if f in ("choice", "cfg", "seconds")}
+        return out
+
+
+def import_entries(entries: Dict[str, Dict[str, Any]]) -> int:
+    """Install an exported slice into the in-memory table (artifact
+    load). Grammar-checked like ``load_disk_entries`` (bad entries are
+    skipped, never crash); existing in-memory winners are NOT
+    overwritten — a live tuned decision beats a frozen one. One epoch
+    bump for the whole batch so plans keyed under the old table
+    re-prepare exactly once. Returns the number installed."""
+    global _EPOCH
+    n = 0
+    with _LOCK:
+        for k, v in (entries or {}).items():
+            if not isinstance(k, str) or not isinstance(v, dict):
+                continue
+            if v.get("choice") not in ("pallas", "composed"):
+                continue
+            if k not in _MEM:
+                _MEM[k] = dict(v, source="artifact")
+                n += 1
+        if n:
+            _EPOCH += 1
+    return n
 
 
 def reset() -> None:

@@ -951,6 +951,10 @@ TRACE_SITES = (
     # dygraph capture (imperative/jit.py): one span per trace capture
     # (tagged with the retrace reason) and one per cached replay
     "imperative.capture", "imperative.replay",
+    # frozen deployable artifacts (export/): one span per artifact
+    # build and one per load; the router's rolling upgrade drains ride
+    # the existing serving.router.drain span with reason="roll"
+    "export.save", "export.load",
 )
 
 # -------------------------------------------------------- backend/bench
@@ -1037,3 +1041,87 @@ SHUTDOWN_SIGNALS = REGISTRY.counter(
     "disposition", labels=("signal",))
 for _s in ("SIGTERM", "SIGINT"):
     SHUTDOWN_SIGNALS.labels(signal=_s)
+
+# ------------------------------------------------- deployable artifacts
+# (paddle_tpu/export/: frozen single-file deployment artifacts — see
+# docs/DEPLOYMENT.md. Loading an artifact must move NONE of the
+# paddle_optimizer_*/tuner/plan-cache-miss families for the signatures
+# it covers; the cold-start acceptance test pins exactly that.)
+ARTIFACT_SAVES = REGISTRY.counter(
+    "paddle_export_artifact_saves_total",
+    "Artifacts built by save_artifact (verify + optimize + freeze + "
+    "atomic single-file write)")
+ARTIFACT_SAVE_SECONDS = REGISTRY.histogram(
+    "paddle_export_artifact_save_seconds",
+    "Wall time of one save_artifact: program verify + optimizer "
+    "pipeline (TV forced on) + param checksums + AOT export + the "
+    "atomic zip write")
+ARTIFACT_LOADS = REGISTRY.counter(
+    "paddle_export_artifact_loads_total",
+    "load_artifact calls by outcome: 'ok' rehydrated a servable "
+    "bundle (possibly with counted per-section degradations), 'skew' "
+    "refused with ArtifactSkewError, 'corrupt' refused an unreadable/"
+    "truncated file — a refused artifact is NEVER silently served",
+    labels=("outcome",))
+for _o in ("ok", "skew", "corrupt"):
+    ARTIFACT_LOADS.labels(outcome=_o)
+ARTIFACT_LOAD_SECONDS = REGISTRY.histogram(
+    "paddle_export_artifact_load_seconds",
+    "Wall time of one successful load_artifact: manifest + checksum "
+    "validation, program/param rehydration, winner-table import — the "
+    "cold-start cost the artifact reduces trace/optimize/tune to")
+# every refusal reason the validation ladder can produce, schema-first
+ARTIFACT_SKEW_REASONS = ("corrupt", "future_version", "section_checksum",
+                         "config_key", "param_checksum", "tv_digest")
+ARTIFACT_SKEW = REGISTRY.counter(
+    "paddle_export_artifact_skew_total",
+    "Artifacts refused at load, by validation-ladder reason: 'corrupt' "
+    "= unreadable zip/manifest or truncated file, 'future_version' = "
+    "format newer than this runtime, 'section_checksum' = a section "
+    "blob fails its manifest sha256, 'config_key' = the recorded "
+    "passes/kernels/quant/AMP config differs from the running process, "
+    "'param_checksum' = a parameter fails its per-var sha256, "
+    "'tv_digest' = the rewrite-log digest does not match",
+    labels=("reason",))
+for _r in ARTIFACT_SKEW_REASONS:
+    ARTIFACT_SKEW.labels(reason=_r)
+ARTIFACT_DEGRADED = REGISTRY.counter(
+    "paddle_export_artifact_degraded_total",
+    "OPTIONAL artifact sections dropped at load with the rest of the "
+    "artifact still served, by (section, reason): 'absent' = the save "
+    "side could not produce it, 'version' = the section's own format "
+    "version is unknown to this runtime, 'jax' = jax.export missing or "
+    "deserialization failed. Each count is one recompute the artifact "
+    "was supposed to avoid — mandatory validation failures land in "
+    "paddle_export_artifact_skew_total instead, never here",
+    labels=("section", "reason"))
+for _sec, _r in (("aot", "absent"), ("aot", "version"), ("aot", "jax"),
+                 ("tuned_kernels", "absent"), ("tuned_kernels", "version"),
+                 ("memory", "absent"), ("rewrite_log", "absent"),
+                 ("serving", "absent")):
+    ARTIFACT_DEGRADED.labels(section=_sec, reason=_r)
+ARTIFACT_AOT_CALLS = REGISTRY.counter(
+    "paddle_export_artifact_aot_calls_total",
+    "Predictor runs served by a frozen jax.export executable from the "
+    "artifact's AOT section (zero trace, zero optimize, zero XLA "
+    "re-lowering) instead of the executor plan path")
+ARTIFACT_PLANS_SEEDED = REGISTRY.counter(
+    "paddle_export_plans_seeded_total",
+    "Executor plan-cache entries seeded from a loaded artifact's "
+    "frozen program — each seeded signature's first run is a cache "
+    "HIT (the cold-start contract: zero plan-cache misses for "
+    "covered signatures)")
+ARTIFACT_ROLLS = REGISTRY.counter(
+    "paddle_export_rolls_total",
+    "ReplicaRouter.roll fleet upgrades by outcome: 'ok' = every "
+    "replica replaced, 'partial' = the roll stopped early (router "
+    "closing mid-roll); a replica crash during the roll recovers "
+    "through the ordinary monitor path and does not fail the roll",
+    labels=("outcome",))
+for _o in ("ok", "partial"):
+    ARTIFACT_ROLLS.labels(outcome=_o)
+ARTIFACT_ROLL_REPLICAS = REGISTRY.counter(
+    "paddle_export_roll_replicas_total",
+    "Replicas drained and rebuilt by ReplicaRouter.roll (one count "
+    "per replaced replica, incremented after the replacement engine "
+    "is serving)")

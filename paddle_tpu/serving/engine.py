@@ -508,6 +508,40 @@ class DecodeEngine:
         # the stamp goes stale (serving/router.py)
         self.last_progress = time.monotonic()
 
+    @classmethod
+    def from_artifact(cls, artifact, **overrides) -> "DecodeEngine":
+        """Build an engine from a deployable artifact
+        (``export.save_artifact(..., serving={"cfg": ..., ...})``):
+        the artifact's serving record supplies ``cfg``/``b_max``/
+        ``max_len``/``eos_id``, its params section supplies the
+        weights (already per-var checksummed at load), and its
+        tuned-winner slice is already installed — a replica built this
+        way re-tunes nothing. ``artifact`` is a path or a
+        ``LoadedArtifact``; ``overrides`` pass through to the
+        constructor (``queue_capacity``, ``prefix_store``, ``place``,
+        ...). The engine is built but NOT started, matching the
+        router's ``engine_factory`` contract."""
+        from ..export import ArtifactError, LoadedArtifact, load_artifact
+        from ..observe.families import ARTIFACT_DEGRADED
+
+        art = (artifact if isinstance(artifact, LoadedArtifact)
+               else load_artifact(artifact))
+        if art.serving is None:
+            ARTIFACT_DEGRADED.labels(section="serving",
+                                     reason="absent").inc()
+            raise ArtifactError(
+                "artifact %r carries no serving section — export it "
+                "with serving={'cfg': ...} to build engines from it"
+                % art.path)
+        kw = dict(cfg=art.serving.get("cfg"),
+                  params={n: np.asarray(v)
+                          for n, v in art.params.items()} or None)
+        for k in ("b_max", "max_len", "eos_id", "spec_k"):
+            if art.serving.get(k) is not None:
+                kw[k] = art.serving[k]
+        kw.update(overrides)
+        return cls(**kw)
+
     # ------------------------------------------------------------ caller
     def submit(self, prompt_ids, n_new: int, eos_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,

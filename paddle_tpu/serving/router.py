@@ -248,6 +248,67 @@ class ReplicaRouter:
                              % (seconds,))
         self._stall_deadline_s = seconds
 
+    # ------------------------------------------------------------- roll
+    def roll(self, artifact, engine_factory=None, **engine_kwargs) -> int:
+        """Rolling fleet upgrade: replace every replica, one at a time
+        with drain, with engines built from ``artifact`` (a path or a
+        ``LoadedArtifact``) — the fleet moves from artifact v(N) to
+        v(N+1) with zero stranded requests.
+
+        The artifact is loaded + VALIDATED first: a skewed or corrupt
+        artifact raises ``ArtifactSkewError`` before any replica is
+        touched and the fleet keeps serving the old version. The
+        router's engine factory is swapped to the new version BEFORE
+        the first drain, so a replica that crashes mid-roll is rebuilt
+        by the ordinary monitor path already at the new version (the
+        chaos test pins this). Each replica then drains through the
+        same ``_recover`` machinery a died/wedged replica uses —
+        in-flight requests re-admit onto the other replicas and keep
+        their exactly-once terminal outcome.
+
+        ``engine_factory`` overrides the default
+        ``DecodeEngine.from_artifact`` builder (``engine_kwargs`` pass
+        through to it). Returns the number of replicas rolled; counted
+        in ``paddle_export_roll_replicas_total`` and
+        ``paddle_export_rolls_total{outcome=ok|partial}``."""
+        from ..observe.families import (ARTIFACT_ROLL_REPLICAS,
+                                        ARTIFACT_ROLLS)
+
+        if self._closed:
+            raise RuntimeError("ReplicaRouter is closed")
+        if engine_factory is None:
+            from ..export import LoadedArtifact, load_artifact
+            from .engine import DecodeEngine
+
+            art = (artifact if isinstance(artifact, LoadedArtifact)
+                   else load_artifact(artifact))
+
+            def engine_factory(idx, _art=art, _kw=dict(engine_kwargs)):
+                return DecodeEngine.from_artifact(_art, **_kw)
+
+        self._factory = engine_factory
+        rolled = 0
+        for rep in list(self._replicas):
+            if self._closed:
+                break
+            if self._recover(rep, "roll"):
+                rolled += 1
+                ARTIFACT_ROLL_REPLICAS.inc()
+            elif rep.draining and not self._closed:
+                # the monitor claimed this replica first (it died or
+                # wedged mid-roll) — it is rebuilding through the
+                # factory we already swapped, i.e. at the NEW version;
+                # wait for that rebuild rather than double-draining
+                while rep.draining and not self._closed:
+                    time.sleep(self._poll_s)
+                if not self._closed:
+                    rolled += 1
+                    ARTIFACT_ROLL_REPLICAS.inc()
+        outcome = ("ok" if rolled == len(self._replicas)
+                   and not self._closed else "partial")
+        ARTIFACT_ROLLS.labels(outcome=outcome).inc()
+        return rolled
+
     # ---------------------------------------------------------- dispatch
     def _healthy(self, exclude=()):
         return [r for r in self._replicas
@@ -404,7 +465,7 @@ class ReplicaRouter:
                     self._recover(rep,
                                   "died" if dead else "wedged")
 
-    def _recover(self, rep: _Replica, reason: str) -> None:
+    def _recover(self, rep: _Replica, reason: str) -> bool:
         """Drain a failed replica and rebuild it. ``engine.stop`` with
         a short join fails every in-flight request (a truly wedged
         scheduler thread is abandoned — daemon) and their completion
@@ -418,16 +479,24 @@ class ReplicaRouter:
         detection latency, not stranded work. ``close()`` racing a
         rebuild is handled by re-checking ``_closed`` around the
         factory call: a replacement engine is never installed (or left
-        running) after shutdown."""
+        running) after shutdown.
+
+        Returns True when this call installed the replacement. The
+        draining flag is claimed under the lock so a second caller
+        (``roll`` runs on the caller's thread while the monitor keeps
+        sweeping) backs off instead of double-draining one replica."""
         from ..observe.families import SERVING_ROUTER_RESTARTS
 
-        rep.draining = True
+        with self._lock:
+            if rep.draining:
+                return False
+            rep.draining = True
         self._set_healthy_gauge()
         with _tr.trace_span("serving.router.drain", replica=rep.idx,
                             reason=reason):
             rep.engine.stop(timeout=0.5)
             if self._closed:
-                return  # close() owns the teardown from here
+                return False  # close() owns the teardown from here
             eng = self._factory(rep.idx)
             with self._lock:
                 install = not self._closed
@@ -435,7 +504,7 @@ class ReplicaRouter:
                     rep.engine = eng
             if not install:
                 eng.stop(timeout=0.5)
-                return
+                return False
             eng.start()
         with self._lock:
             rep.outstanding_tokens = 0
@@ -443,6 +512,7 @@ class ReplicaRouter:
         rep.draining = False
         SERVING_ROUTER_RESTARTS.labels(replica=str(rep.idx)).inc()
         self._set_healthy_gauge()
+        return True
 
     def _set_healthy_gauge(self) -> None:
         from ..observe.families import SERVING_ROUTER_HEALTHY

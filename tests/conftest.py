@@ -146,6 +146,19 @@ SLOW_TESTS = {
     "test_rnn_controlflow.py::test_lstm_gru_train",
     "test_sanitizers.py::test_asan_tensor_store_and_datafeed",
     "test_ssd_stack.py::test_ssd_pipeline_trains",
+    # re-tiered 2026-08-07 (fast tier crept past the 870s budget):
+    # the three heaviest gates split — their expensive tails (multi-
+    # minute zoo sweeps, RPC soak, long spec-decode parity runs) move
+    # here while each file keeps cheaper fast-tier siblings pinning
+    # the same invariants (smaller zoo models, in-process fleet
+    # aggregation, the remaining spec-decode/prefix parity tests)
+    "test_memory.py::test_zoo_static_within_stated_factor_of_xla",
+    "test_fleet_telemetry.py::test_fleet_push_over_rpc",
+    "test_fleet_telemetry.py::test_fleet_demo_elastic_job_and_router",
+    "test_serving_fleet.py::test_spec_decode_agreeing_draft_accepts_k_per_dispatch",
+    "test_serving_fleet.py::test_spec_decode_bitwise_with_disagreeing_draft",
+    "test_serving_fleet.py::test_spec_decode_plain_fallback_near_cache_end",
+    "test_serving_fleet.py::test_prefix_store_shared_across_fresh_engine_stays_bitwise",
 }
 
 # real-subprocess cluster tests (excluded from `-m fast` via their own tier)

@@ -281,3 +281,37 @@ def test_bench_deepfm_dist_row(tmp_path):
               if "--dist-ctr-pserver" in l
               and l.split(None, 1)[0] == pgid]
     assert not leaked, leaked
+
+
+def test_bench_artifact_rows(tmp_path):
+    """PADDLE_TPU_BENCH_ARTIFACT=1 swaps the workload list for the
+    deployable-artifact cold-start rows: one row per model, marked
+    artifact:true (so pin_baselines skips them), carrying both the
+    artifact and from-scratch cold-start times, the bitwise parity
+    verdict and the artifact's own memory prediction."""
+    rc, rows = _run(["--worker", "artifact", "--quick"],
+                    {"PADDLE_TPU_BENCH_ARTIFACT": "1",
+                     "PADDLE_TPU_TELEMETRY_DIR": str(tmp_path),
+                     "PADDLE_TPU_BENCH_WORKLOAD_TIMEOUT": "560"}, 590)
+    assert rc == 0, rows
+    by_metric = {r["metric"]: r for r in rows if "value" in r}
+    assert set(by_metric) == {"artifact_mnist"}  # quick: one model
+    row = by_metric["artifact_mnist"]
+    assert row["artifact"] is True
+    assert row["unit"] == "cold_start_seconds"
+    assert row["value"] > 0 and row["from_scratch_s"] > 0
+    assert row["speedup_vs_scratch"] == pytest.approx(
+        row["from_scratch_s"] / row["value"], rel=0.05)
+    assert row["bitwise_vs_scratch"] is True
+    assert row["peak_bytes_predicted"] > 0
+    assert row["tuned_imported"] >= 0  # cold process: slice may be empty
+    assert row["vs_baseline"] == 1.0  # never compares to baselines
+    side = json.load(open(tmp_path / "BENCH_artifact.telemetry.json"))
+    m = side["metrics"]
+    assert any(s["value"] >= 1 for s in
+               m["paddle_export_artifact_saves_total"]["samples"])
+    assert any(s["value"] >= 1 and s["labels"].get("outcome") == "ok"
+               for s in
+               m["paddle_export_artifact_loads_total"]["samples"])
+    assert any(s["value"] >= 1 for s in
+               m["paddle_export_plans_seeded_total"]["samples"])

@@ -243,3 +243,19 @@ def test_cost_model_columns_are_informational(tmp_path):
         "BASELINE_SPC")[0].split("BASELINES")[1]
     assert "predicted_seconds" not in pinned_span
     assert "cost_model_ratio" not in pinned_span
+
+
+def test_artifact_rows_never_pin(tmp_path):
+    # PADDLE_TPU_BENCH_ARTIFACT=1 rows measure cold-start-to-first-token
+    # off a frozen artifact — a LOAD path, not a training throughput;
+    # neither the artifact row nor a mismarked training row may pin
+    proc, base, spc = _pin(tmp_path, [
+        {"metric": "artifact_mnist", "value": 0.2, "artifact": True,
+         "unit": "cold_start_seconds", "from_scratch_s": 0.4,
+         "speedup_vs_scratch": 2.0, "steps_per_call": 1},
+        {"metric": ROW, "value": 9999.0, "artifact": True,
+         "steps_per_call": 1}])
+    assert proc.stdout.count("SKIP") == 2
+    assert "artifact" in proc.stdout
+    assert base[ROW] == 509.8
+    assert "artifact_mnist" not in base

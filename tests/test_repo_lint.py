@@ -543,3 +543,63 @@ def test_cost_rule_registrations_match_runtime():
     assert repo_lint.declared_zero_cost(ROOT) == set(ZERO_COST)
     # the partition is total AND disjoint on the real tree
     assert repo_lint.cost_rule_coverage_violations(ROOT) == []
+
+
+def _artifact_tree(tmp_path, caller_src,
+                   sections=("program", "params")):
+    """Synthetic tree with an export package: a SECTIONS schema tuple
+    plus one caller module for rule 11 to scan."""
+    root = _fake_repo(tmp_path, "x = 1\n", "y = 1\n")
+    exp = os.path.join(root, "paddle_tpu", "export")
+    os.makedirs(exp)
+    with open(os.path.join(exp, "format.py"), "w") as f:
+        f.write("SECTIONS = (%s)\n"
+                % "".join("%r, " % s for s in sections))
+    with open(os.path.join(exp, "artifact.py"), "w") as f:
+        f.write(caller_src)
+    return root
+
+
+def test_undeclared_artifact_section_detected(tmp_path):
+    src = textwrap.dedent("""
+        def save(blobs, manifest, zf):
+            write_section(blobs, manifest, "program", b"x")
+            write_section(blobs, manifest, "tuned_kernelz", b"x")
+            fmt.read_section(manifest, zf, "params")
+    """)
+    out = repo_lint.artifact_section_violations(
+        _artifact_tree(tmp_path, src))
+    assert len(out) == 1 and "tuned_kernelz" in out[0]
+    assert "SECTIONS" in out[0]
+
+
+def test_declared_and_dynamic_artifact_sections_pass(tmp_path):
+    src = textwrap.dedent("""
+        def load(manifest, zf, name):
+            read_section(manifest, zf, "program")
+            read_section(manifest, zf, name)        # dynamic: skipped
+            for s in ("params",):
+                section_path(s)                     # dynamic: skipped
+            section_path("params")
+    """)
+    assert repo_lint.artifact_section_violations(
+        _artifact_tree(tmp_path, src)) == []
+
+
+def test_artifact_rule_out_of_scope_without_export_package(tmp_path):
+    # a tree with no export/format.py is out of rule 11's scope even
+    # if something in it happens to call a write_section-shaped name
+    root = _fake_repo(tmp_path, "x = 1\n",
+                      'def f(a, b):\n'
+                      '    write_section(a, b, "whatever", b"")\n')
+    assert repo_lint.artifact_section_violations(root) == []
+
+
+def test_artifact_sections_match_runtime():
+    """Schema pin: the AST-parsed SECTIONS tuple is exactly what the
+    runtime container format exposes, and the real tree only passes
+    declared names."""
+    from paddle_tpu.export.format import SECTIONS
+
+    assert repo_lint.declared_artifact_sections(ROOT) == set(SECTIONS)
+    assert repo_lint.artifact_section_violations(ROOT) == []

@@ -92,7 +92,7 @@ def main():
                 or "flash_min_seq" in row or row.get("pipelined") \
                 or row.get("serving") or row.get("fleet") \
                 or row.get("elastic") or row.get("quantized") \
-                or row.get("dygraph"):
+                or row.get("dygraph") or row.get("artifact"):
             # fleet rows (prefix cache + speculative draft + router)
             # measure a DIFFERENT serving configuration again: they are
             # incomparable with non-fleet serving rows too, not just
@@ -101,10 +101,13 @@ def main():
             # quantized rows compiled a DIFFERENT (int8-PTQ) program
             # with its own accuracy/latency trade; dygraph rows (eager
             # AND captured-replay) measure dispatch overhead on a toy
-            # MLP, not any training baseline's workload
+            # MLP, not any training baseline's workload; artifact rows
+            # measure cold-start-to-first-token (a load path), not
+            # steady-state training throughput
             print("SKIP %s: recompute/scaled-batch/dispatch-override/"
-                  "pipelined/serving/fleet/elastic/quantized/dygraph "
-                  "rows never pin over the plain-config baseline" % name)
+                  "pipelined/serving/fleet/elastic/quantized/dygraph/"
+                  "artifact rows never pin over the plain-config "
+                  "baseline" % name)
             continue
         if row.get("kernel_tuned") or row.get("kernels") == "off":
             # a tuned kernel-tier cache or the PADDLE_TPU_KERNELS=0

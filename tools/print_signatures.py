@@ -43,6 +43,7 @@ MODULES = [
     "paddle_tpu.imperative.optimizer",
     "paddle_tpu.imperative.jit",
     "paddle_tpu.inference",
+    "paddle_tpu.export",
     "paddle_tpu.kernels",
     "paddle_tpu.serving",
     "paddle_tpu.resilience",

@@ -94,6 +94,18 @@ tests/test_repo_lint.py):
     widening rule 7 exists to prevent in the range engine. Same
     registration-idiom resolution as rule 7.
 
+11. **undeclared-artifact-section** — the trace-site contract (rule 3)
+    for the deployable-artifact container (``paddle_tpu/export/``):
+    every literal section name passed to ``write_section`` /
+    ``read_section`` / ``section_path`` must be declared in
+    ``export/format.py``'s ``SECTIONS`` schema tuple. The manifest's
+    section list IS the format — a section written outside the schema
+    would round-trip unchecked (no recorded version, outside the
+    ordered manifest contract docs/DEPLOYMENT.md documents), and a
+    typo'd read would silently degrade every artifact. The runtime
+    mirror (declared tuple == ``paddle_tpu.export.format.SECTIONS``)
+    is pinned in tests/test_repo_lint.py.
+
 Usage: ``python tools/repo_lint.py [--root DIR]``; exit 1 on violations.
 """
 
@@ -692,6 +704,70 @@ def env_knob_violations(root: str, files=None) -> List[str]:
     return violations
 
 
+# --------------------------------------- rule 11: artifact sections
+EXPORT_FORMAT_FILE = os.path.join("paddle_tpu", "export", "format.py")
+# calls whose literal section-name argument (by position) must be
+# declared in format.py's SECTIONS tuple — the container schema
+_SECTION_CALL_ARG = {"write_section": 2, "read_section": 2,
+                     "section_path": 0}
+
+
+def declared_artifact_sections(root: str) -> Set[str]:
+    """Section names in export/format.py's ``SECTIONS = (...)`` tuple."""
+    path = os.path.join(root, EXPORT_FORMAT_FILE)
+    if not os.path.exists(path):
+        return set()
+    for node in ast.walk(_parse(path)):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "SECTIONS"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {el.value for el in node.value.elts
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)}
+    return set()
+
+
+def artifact_section_violations(root: str, files=None) -> List[str]:
+    """Rule 11: every literal section name handed to
+    ``write_section``/``read_section``/``section_path`` must be
+    declared in export/format.py's SECTIONS schema tuple. Dynamic
+    names (variables, loops over the tuple itself) are skipped like
+    rule 3's dynamic sites."""
+    if not os.path.exists(os.path.join(root, EXPORT_FORMAT_FILE)):
+        return []  # synthetic trees without the export package
+    declared = declared_artifact_sections(root)
+    fmt_rel = EXPORT_FORMAT_FILE.replace("/", os.sep)
+    violations = []
+    for path in (files or iter_py_files(root)):
+        rel = os.path.relpath(path, root)
+        if rel == fmt_rel:
+            continue  # the schema file's own helpers/doc examples
+        for node in ast.walk(_parse(path)):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fn_name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            argpos = _SECTION_CALL_ARG.get(fn_name)
+            if argpos is None or len(node.args) <= argpos:
+                continue
+            arg = node.args[argpos]
+            if not isinstance(arg, ast.Constant) \
+                    or not isinstance(arg.value, str):
+                continue  # dynamic names are the escape hatch
+            if arg.value not in declared:
+                violations.append(
+                    "%s:%d: artifact section %r is passed to %s() but "
+                    "not declared in %s SECTIONS (the manifest schema "
+                    "tuple is the container format — declare it there)"
+                    % (rel, node.lineno, arg.value, fn_name,
+                       EXPORT_FORMAT_FILE))
+    return violations
+
+
 def run(root: str = REPO_ROOT) -> List[str]:
     """All violations (empty list = clean). tests/test_repo_lint.py
     asserts on this."""
@@ -703,7 +779,8 @@ def run(root: str = REPO_ROOT) -> List[str]:
             + range_rule_coverage_violations(root)
             + env_knob_violations(root)
             + dead_family_violations(root)
-            + cost_rule_coverage_violations(root))
+            + cost_rule_coverage_violations(root)
+            + artifact_section_violations(root))
 
 
 def main(argv=None) -> int:
