@@ -23,9 +23,13 @@ from .cost import (CostAnalysis, DeviceModel,  # noqa: F401
                    cost_model_enabled, predict_step_seconds)
 from .cost_rules import register_cost_rule  # noqa: F401 (attaches rules)
 from .dataflow import Dataflow  # noqa: F401
-from .infer import (Finding, InferContext, InferError,  # noqa: F401
-                    ProgramVerifyError, infer_program_shapes,
-                    validation_enabled, verify_program)
+from .distributed import (BARRIER_OPS, WIRE_OPS,  # noqa: F401
+                          pserver_spec_findings, shard_fit_report,
+                          validate_distributed, validate_transpile)
+from .infer import (DIST_RULES, Finding, InferContext,  # noqa: F401
+                    InferError, ProgramVerifyError,
+                    infer_program_shapes, validation_enabled,
+                    verify_program)
 from .lint import LINT_RULES, lint_program  # noqa: F401
 from .memory import (BytesPoly, MemoryAnalysis,  # noqa: F401
                      decode_cache_bytes, device_budget,
@@ -37,9 +41,11 @@ from .tv import (ProgramSnapshot, RewriteViolation,  # noqa: F401
 
 __all__ = [
     "AbstractValue",
+    "BARRIER_OPS",
     "BytesPoly",
     "Calibration",
     "CostAnalysis",
+    "DIST_RULES",
     "Dataflow",
     "DeviceModel",
     "Finding",
@@ -52,6 +58,7 @@ __all__ = [
     "RangeAnalysis",
     "RangeContext",
     "RewriteViolation",
+    "WIRE_OPS",
     "cost_model_enabled",
     "decode_cache_bytes",
     "describe_rewrites",
@@ -60,11 +67,15 @@ __all__ = [
     "infer_program_shapes",
     "lint_program",
     "predict_step_seconds",
+    "pserver_spec_findings",
     "register_cost_rule",
     "register_footprint_rule",
     "register_range_rule",
+    "shard_fit_report",
     "tv_enabled",
+    "validate_distributed",
     "validate_rewrite",
+    "validate_transpile",
     "validation_enabled",
     "verify_program",
 ]

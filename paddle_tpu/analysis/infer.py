@@ -27,6 +27,7 @@ from ..core.program import Block, Program, Variable
 from ..core.registry import OPS
 
 __all__ = [
+    "DIST_RULES",
     "Finding",
     "InferContext",
     "InferError",
@@ -74,6 +75,28 @@ RULES = (
     "dead-persistable",   # persistable resident but never read/written
 )
 
+# rules of the distributed multi-program verifier
+# (analysis/distributed.py) — kept in their own tuple because these
+# findings ride the paddle_analysis_dist_findings_total family, not the
+# per-program paddle_analysis_findings_total schema; families.py mirrors
+# this list as _DIST_RULES the same way it mirrors RULES
+DIST_RULES = (
+    "dist-wire-unresolved",   # send/recv/prefetch var has no endpoint-side var
+    "dist-wire-shape",        # wire shape/dtype skew between the two sides
+    "dist-wire-compress",     # bf16 grad compression (note / corrupting dtype)
+    "dist-sparse-wire",       # SelectedRows send/prefetch vs hosted table skew
+    "dist-shard-gap",         # shards do not cover the parameter (gap/drop)
+    "dist-shard-overlap",     # shards overlap / over-cover the parameter
+    "dist-shard-assignment",  # hosted endpoint disagrees with declared map
+    "dist-opt-pairing",       # pserver optimizer op <-> shard pairing broken
+    "dist-table-coverage",    # distributed table slice misses vocab rows
+    "dist-barrier",           # unmatched/mismatched barrier cycle
+    "dist-ordering",          # recv-before-send / barrier ordering broken
+    "dist-fanin",             # pserver Fanin disagrees with trainer count
+    "dist-tv",                # cross-program translation validation violation
+    "dist-pserver-memory",    # pserver-role resident set vs device budget
+)
+
 
 class Finding:
     """One verifier result, with op provenance when anchored to an op."""
@@ -86,7 +109,7 @@ class Finding:
                  op_idx: int = -1, name_scope: str = "",
                  def_site: Optional[str] = None, var: Optional[str] = None):
         assert severity in SEVERITIES, severity
-        assert rule in RULES, rule
+        assert rule in RULES or rule in DIST_RULES, rule
         self.rule = rule
         self.severity = severity
         self.message = message

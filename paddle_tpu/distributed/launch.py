@@ -20,6 +20,10 @@ membership leases, and every membership change reshards
 deterministically from the latest checkpoint manifest (docs/
 RESILIENCE.md "Elastic jobs"). The worker program comes from
 ``--elastic_builder module:fn`` (default: the built-in demo model).
+Under ``PADDLE_TPU_VALIDATE=1`` every worker statically verifies its
+generation's transpiled world before serving or training
+(``analysis.validate_distributed``, counted at ``site=elastic``), so a
+miscompiled reshard aborts the generation instead of deadlocking it.
 """
 
 from __future__ import annotations

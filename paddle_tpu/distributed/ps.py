@@ -62,6 +62,23 @@ def run_pserver_loop(attrs: Dict, scope: Scope, executor=None):
     opt_prog: Program = attrs["optimize_program"]
     specs: List[dict] = attrs["block_specs"]
 
+    # PADDLE_TPU_VALIDATE=1: prove the declared block specs internally
+    # consistent (every spec backed by an optimize-program var of the
+    # declared shape/dtype) BEFORE binding the port — a hand-built or
+    # corrupted server program fails here instead of serving junk
+    from ..analysis.infer import validation_enabled
+
+    if validation_enabled():
+        from ..analysis.distributed import pserver_spec_findings
+        from ..analysis.infer import ProgramVerifyError
+
+        probe = Program()
+        probe.global_block().append_op("listen_and_serv", {}, {},
+                                       dict(attrs))
+        findings = pserver_spec_findings(endpoint, probe)
+        if any(f.severity == "error" for f in findings):
+            raise ProgramVerifyError(findings)
+
     exe = executor or Executor()
     server = _PREBOUND.pop(endpoint, None)
     if server is None:

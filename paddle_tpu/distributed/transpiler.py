@@ -157,11 +157,85 @@ class DistributeTranspiler:
             # launcher env + init_parallel_env boot the global mesh
             # (gen_nccl_id_op.cc analog lives in parallel/env.py)
             self.trainer_program = self.origin_program
+            self.rewrite_log = {
+                "mode": self.config.mode, "trainers": trainers,
+                "sync_mode": sync_mode, "endpoints": [],
+                "split_method": self.config.split_method.__name__,
+                "dispatch_order": [], "splits": [], "tables": [],
+                "renames": {}, "removed_update_ops": [],
+                "endpoint_map": {},
+            }
             return
 
         assert self.pserver_endpoints, "pserver mode needs pserver endpoints"
         self._analyze()
         self._build_trainer_program()
+        self.rewrite_log = self._build_rewrite_log()
+
+    def _build_rewrite_log(self) -> dict:
+        """The transpile's declared rewrites — the same contract the
+        optimizer passes honor for per-pass translation validation
+        (analysis/tv.py), lifted to the program SPLIT: which update ops
+        vanished from the trainer program, how each parameter was sliced
+        into endpoint-hosted blocks (offset/rows per shard), which
+        names were renamed across the wire, and where every block and
+        sparse table lives. analysis/distributed.py's cross-program
+        verifier proves the transpiled programs equivalent to the
+        origin *modulo exactly these declarations*."""
+        splits = []
+        renames: Dict[str, List[str]] = {}
+        endpoint_map: Dict[str, str] = {}
+        for pname, info in sorted(self.param_infos.items()):
+            blocks = []
+            for vb in info["blocks"]:
+                blocks.append({
+                    "name": vb.block_name, "grad": vb.grad_name,
+                    "idx": vb.idx, "offset": vb.offset, "rows": vb.rows,
+                    "shape": list(vb.shape), "endpoint": vb.endpoint,
+                })
+                endpoint_map[vb.block_name] = vb.endpoint
+            splits.append({
+                "param": pname, "grad": info["grad"],
+                "shape": list(info["var"].shape or ()),
+                "dtype": info["var"].dtype, "blocks": blocks,
+            })
+            renames[pname] = [vb.block_name for vb in info["blocks"]]
+            renames[info["grad"]] = [vb.grad_name for vb in info["blocks"]]
+        tables = []
+        for wname, info in sorted(self.table_infos.items()):
+            tables.append({
+                "name": wname, "shape": list(info["var"].shape or ()),
+                "dtype": info["var"].dtype, "endpoint": info["endpoint"],
+                "grad": grad_var_name(wname),
+            })
+            endpoint_map[wname] = info["endpoint"]
+        return {
+            "mode": "pserver",
+            "trainers": self.trainer_num,
+            "sync_mode": self.sync_mode,
+            "endpoints": list(self.pserver_endpoints),
+            "split_method": self.config.split_method.__name__,
+            # dispatch happens over blocks in update-op order, NOT the
+            # name-sorted `splits` order — declare it so the verifier
+            # can replay the dispatcher deterministically
+            "dispatch_order": [vb.block_name for vb in self.all_blocks],
+            "splits": splits,
+            "tables": tables,
+            "renames": renames,
+            "removed_update_ops": [
+                {"type": op.type, "param": op.input("Param")[0],
+                 "grad": op.input("Grad")[0]}
+                for op in self.update_ops],
+            "endpoint_map": endpoint_map,
+        }
+
+    def get_rewrite_log(self) -> dict:
+        """The declared rewrite log of the last :meth:`transpile` call
+        (see :meth:`_build_rewrite_log`); raises if transpile has not
+        run."""
+        if not hasattr(self, "rewrite_log"):
+            raise RuntimeError("transpile() has not run: no rewrite log")
+        return self.rewrite_log
 
     # ------------------------------------------------------------ analyze
     def _analyze(self):

@@ -611,11 +611,12 @@ ANALYSIS_MEMORY_PROGRAMS = REGISTRY.counter(
     "(MemoryAnalysis construction), by trigger: 'lint' = the memory "
     "lint rules, 'cli' = tools/memory_report.py, 'window_tune' = the "
     "window-candidate budget pruner, 'serving' = the engine admission "
-    "guard, 'bench' = the peak_bytes_predicted row field, 'api' = "
+    "guard, 'bench' = the peak_bytes_predicted row field, 'dist' = the "
+    "distributed verifier's per-pserver shard-fit proof, 'api' = "
     "direct callers (contrib.memory_usage_calc and user code)",
     labels=("site",))
 for _s in ("api", "lint", "cli", "window_tune", "serving", "bench",
-           "capture"):
+           "capture", "dist"):
     ANALYSIS_MEMORY_PROGRAMS.labels(site=_s)
 ANALYSIS_MEMORY_SECONDS = REGISTRY.histogram(
     "paddle_analysis_memory_seconds",
@@ -652,6 +653,43 @@ ANALYSIS_COST_UNRULED = REGISTRY.counter(
     "FLOPs): the engine's coverage debt. The shape-ruled vocabulary "
     "can never land here — tools/repo_lint.py rule 10 proves every "
     "shape-ruled op carries a cost rule or a ZERO_COST declaration")
+
+# ------------------------------------------------ distributed verifier
+# (paddle_tpu/analysis/distributed.py: the cross-program wire/shard/
+# deadlock verifier over transpiler output — see docs/ANALYSIS.md
+# "Distributed verification")
+ANALYSIS_DIST_JOBS = REGISTRY.counter(
+    "paddle_analysis_dist_jobs_verified_total",
+    "Distributed jobs (trainer + pserver program sets) run through "
+    "analysis.validate_distributed, by trigger: 'api' = direct "
+    "callers, 'cli' = tools/lint_distributed.py, 'elastic' = the "
+    "elastic tier verifying a reshard generation's world pre-launch "
+    "(PADDLE_TPU_VALIDATE=1)", labels=("site",))
+for _s in ("api", "cli", "elastic"):
+    ANALYSIS_DIST_JOBS.labels(site=_s)
+ANALYSIS_DIST_FINDINGS = REGISTRY.counter(
+    "paddle_analysis_dist_findings_total",
+    "Distributed-verifier findings by rule (catalog in docs/ANALYSIS.md "
+    "'Distributed verification'); errors raise ProgramVerifyError "
+    "before any job process launches", labels=("rule",))
+# pre-materialized mirror of analysis.infer.DIST_RULES (same data-
+# dependency contract as _ANALYSIS_RULES above; set equality is pinned
+# by tests/test_dist_verifier.py and repo_lint rule 12 proves every
+# family referenced from analysis/distributed.py is declared here)
+_DIST_RULES = (
+    "dist-wire-unresolved", "dist-wire-shape", "dist-wire-compress",
+    "dist-sparse-wire", "dist-shard-gap", "dist-shard-overlap",
+    "dist-shard-assignment", "dist-opt-pairing", "dist-table-coverage",
+    "dist-barrier", "dist-ordering", "dist-fanin", "dist-tv",
+    "dist-pserver-memory",
+)
+for _r in _DIST_RULES:
+    ANALYSIS_DIST_FINDINGS.labels(rule=_r)
+ANALYSIS_DIST_SECONDS = REGISTRY.histogram(
+    "paddle_analysis_dist_verify_seconds",
+    "Wall time of one whole-job distributed verification (all four "
+    "rule groups + the per-pserver memory proof) — scales with total "
+    "op count across the program set, never with tensor payloads")
 
 # ----------------------------------------------------- dygraph capture
 # (paddle_tpu/imperative/jit.py + capture.py: eager functions traced

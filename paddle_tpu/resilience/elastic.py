@@ -159,6 +159,22 @@ def _resolve_builder(spec: Optional[str]):
     return getattr(importlib.import_module(modname), fn)
 
 
+def _validate_world(transpiler) -> None:
+    """PADDLE_TPU_VALIDATE=1: statically verify this generation's
+    transpiled world (wire typing, shard coverage, barrier graph,
+    translation validation — analysis/distributed.py) BEFORE any
+    process of the generation starts serving or training. A reshard
+    that miscompiled fails loudly here, counted at site=elastic, instead
+    of deadlocking the barrier cycle mid-generation."""
+    from ..analysis.infer import validation_enabled
+
+    if not validation_enabled():
+        return
+    from ..analysis.distributed import validate_distributed
+
+    validate_distributed(transpiler, site="elastic")
+
+
 # ------------------------------------------------------- worker mains
 def _run_trainer() -> int:
     from ..distributed.membership import HeartbeatSender, make_world
@@ -186,6 +202,7 @@ def _run_trainer() -> int:
     t.transpile(trainer_id=rank, program=main, pservers=pservers,
                 trainers=len(tids), sync_mode=True,
                 startup_program=startup)
+    _validate_world(t)
     trainer_prog = t.get_trainer_program()
 
     hb = HeartbeatSender(member_ep, tid, generation) if member_ep \
@@ -263,6 +280,7 @@ def _run_pserver() -> int:
     t.transpile(trainer_id=0, program=main, pservers=pservers,
                 trainers=len(tids), sync_mode=True,
                 startup_program=startup)
+    _validate_world(t)
     exe = fluid.Executor()
     exe.run(t.get_startup_program(endpoint))
     exe.run(t.get_pserver_program(endpoint))

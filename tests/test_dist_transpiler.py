@@ -118,3 +118,90 @@ def test_collective_mode_no_surgery():
     t.transpile(trainer_id=0, program=main, trainers=2,
                 startup_program=startup)
     assert t.get_trainer_program() is main
+
+
+# ------------------------------------------------------------ rewrite log
+def test_rewrite_log_declares_splits_and_renames():
+    """transpile() emits a first-class rewrite log: the declared
+    contract analysis/distributed.py's cross-program translation
+    validation holds the transpiled programs to."""
+    main, startup, loss = _build_net()
+    t = fluid.DistributeTranspiler()
+    eps = "127.0.0.1:6170,127.0.0.1:6171"
+    t.transpile(trainer_id=0, program=main, pservers=eps, trainers=2,
+                sync_mode=True, startup_program=startup)
+    log = t.get_rewrite_log()
+    assert log["mode"] == "pserver"
+    assert log["trainers"] == 2 and log["sync_mode"] is True
+    assert log["endpoints"] == eps.split(",")
+    assert log["split_method"] == "RoundRobin"
+    # every split declares tiling blocks with offsets/rows/endpoints
+    for split in log["splits"]:
+        off = 0
+        for b in sorted(split["blocks"], key=lambda b: b["idx"]):
+            assert b["offset"] == off
+            off += b["rows"]
+            assert b["endpoint"] in log["endpoints"]
+            assert log["endpoint_map"][b["name"]] == b["endpoint"]
+        assert off == split["shape"][0]
+        # renames map origin param/grad to the wire block names
+        assert log["renames"][split["param"]] == [
+            b["name"] for b in split["blocks"]]
+        assert log["renames"][split["grad"]] == [
+            b["grad"] for b in split["blocks"]]
+    # the removed update ops are declared by (type, param, grad)
+    assert {r["type"] for r in log["removed_update_ops"]} == {"sgd"}
+    # dispatch order covers exactly the declared blocks
+    declared = {b["name"] for s in log["splits"] for b in s["blocks"]}
+    assert set(log["dispatch_order"]) == declared
+
+
+def test_rewrite_log_requires_transpile():
+    t = fluid.DistributeTranspiler()
+    with pytest.raises(RuntimeError):
+        t.get_rewrite_log()
+
+
+def test_rewrite_log_collective_mode_is_empty():
+    main, startup, loss = _build_net()
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.mode = "nccl2"
+    t = fluid.DistributeTranspiler(cfg)
+    t.transpile(trainer_id=0, program=main, trainers=2,
+                startup_program=startup)
+    log = t.get_rewrite_log()
+    assert log["mode"] == "nccl2"
+    assert log["splits"] == [] and log["removed_update_ops"] == []
+
+
+def test_transpile_does_not_mutate_origin_programs():
+    """Regression pin for the mutation audit: transpile() reads the
+    origin programs and builds clones — the input main/startup programs
+    must come out structurally identical (op list, var metadata),
+    or the rewrite log would under-declare."""
+
+    def snapshot(prog):
+        blk = prog.global_block()
+        return (
+            [(op.type, sorted((s, tuple(n)) for s, n in op.inputs.items()),
+              sorted((s, tuple(n)) for s, n in op.outputs.items()),
+              sorted((k, repr(v)) for k, v in op.attrs.items()))
+             for op in blk.ops],
+            {n: (tuple(v.shape or ()), v.dtype, bool(v.persistable))
+             for n, v in blk.vars.items()},
+        )
+
+    main, startup, loss = _build_net()
+    before_main, before_startup = snapshot(main), snapshot(startup)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main,
+                pservers="127.0.0.1:6170,127.0.0.1:6171", trainers=2,
+                sync_mode=True, startup_program=startup)
+    # exercise every derived-program getter too
+    t.get_trainer_program()
+    t.get_trainer_startup_program()
+    for ep in t.pserver_endpoints:
+        t.get_pserver_program(ep)
+        t.get_startup_program(ep)
+    assert snapshot(main) == before_main
+    assert snapshot(startup) == before_startup

@@ -603,3 +603,93 @@ def test_artifact_sections_match_runtime():
 
     assert repo_lint.declared_artifact_sections(ROOT) == set(SECTIONS)
     assert repo_lint.artifact_section_violations(ROOT) == []
+
+
+# ------------------------------------------------- rule 12: dist verifier
+def _dist_tree(tmp_path, wire_ops='("send", "recv")',
+               barrier_ops='("send_barrier",)', extra_src=""):
+    """Synthetic tree with an analysis/distributed.py, a register_op'd
+    vocabulary, and a declared paddle_analysis_dist family."""
+    root = _fake_repo(tmp_path, "x = 1\n", "y = 1\n")
+    fam_name = "paddle_analysis_dist" + "_jobs_total"
+    fam = os.path.join(root, "paddle_tpu", "observe", "families.py")
+    with open(fam, "a") as f:
+        f.write('C = REGISTRY.counter(%r, "help")\n' % fam_name)
+    with open(os.path.join(root, "tools", "use_families.py"), "a") as f:
+        f.write('USED += (%r,)\n' % fam_name)
+    ops_dir = os.path.join(root, "paddle_tpu", "ops")
+    os.makedirs(ops_dir)
+    with open(os.path.join(ops_dir, "wire_ops.py"), "w") as f:
+        f.write(textwrap.dedent("""
+            def register_op(name, **kw):
+                def deco(fn):
+                    return fn
+                return deco
+
+            @register_op("send", no_grad=True)
+            def _send(): pass
+
+            @register_op("recv", no_grad=True)
+            def _recv(): pass
+
+            @register_op("send_barrier", no_grad=True)
+            def _sb(): pass
+        """))
+    adir = os.path.join(root, "paddle_tpu", "analysis")
+    os.makedirs(adir)
+    with open(os.path.join(adir, "distributed.py"), "w") as f:
+        f.write("WIRE_OPS = %s\nBARRIER_OPS = %s\n%s"
+                % (wire_ops, barrier_ops, extra_src))
+    return root
+
+
+def test_dist_vocabulary_clean_tree_passes(tmp_path):
+    assert repo_lint.dist_verifier_violations(_dist_tree(tmp_path)) == []
+
+
+def test_dist_vocabulary_unregistered_op_detected(tmp_path):
+    root = _dist_tree(tmp_path, wire_ops='("send", "send_varz")')
+    out = repo_lint.dist_verifier_violations(root)
+    assert len(out) == 1 and "send_varz" in out[0]
+    assert "register_op" in out[0]
+
+
+def test_dist_vocabulary_missing_tuple_detected(tmp_path):
+    root = _dist_tree(tmp_path, barrier_ops="()")
+    out = repo_lint.dist_verifier_violations(root)
+    assert len(out) == 1 and "BARRIER_OPS" in out[0]
+
+
+def test_dist_family_reference_checked(tmp_path):
+    # an import of an undeclared family var and a typo'd literal both trip
+    bad_literal = "paddle_analysis_dist" + "_typo_total"
+    root = _dist_tree(
+        tmp_path,
+        extra_src=("from ..observe.families import C, D\n"
+                   'NAME = "%s"\n' % bad_literal))
+    out = repo_lint.dist_verifier_violations(root)
+    assert len(out) == 2
+    assert any("'D'" in v for v in out)
+    assert any(bad_literal in v for v in out)
+
+
+def test_dist_rule_out_of_scope_without_verifier(tmp_path):
+    root = _fake_repo(tmp_path, "x = 1\n", "y = 1\n")
+    assert repo_lint.dist_verifier_violations(root) == []
+
+
+def test_dist_vocabulary_matches_runtime():
+    """Schema pin: the AST-parsed WIRE_OPS/BARRIER_OPS tuples are
+    exactly the runtime verifier's, every entry is a registered op, and
+    the real tree is rule-12 clean."""
+    from paddle_tpu.analysis.distributed import BARRIER_OPS, WIRE_OPS
+    from paddle_tpu.core.registry import OPS
+
+    dist_path = os.path.join(ROOT, repo_lint.ANALYSIS_DIST_FILE)
+    assert repo_lint._module_tuple(dist_path, "WIRE_OPS") == set(WIRE_OPS)
+    assert repo_lint._module_tuple(
+        dist_path, "BARRIER_OPS") == set(BARRIER_OPS)
+    registered = repo_lint.registered_op_types(ROOT)
+    assert set(WIRE_OPS) | set(BARRIER_OPS) <= registered
+    assert registered <= set(OPS)
+    assert repo_lint.dist_verifier_violations(ROOT) == []

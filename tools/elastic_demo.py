@@ -13,7 +13,10 @@ job's telemetry sidecars.
         --rejoin 1@7 --json
 
 Exit 0 when the job completes; 1 otherwise. See docs/RESILIENCE.md
-"Elastic jobs" for what each timeline event means.
+"Elastic jobs" for what each timeline event means. Under
+``PADDLE_TPU_VALIDATE=1`` each worker statically verifies its
+generation's transpiled world before running it (docs/ANALYSIS.md
+"Distributed verification", counted at ``site=elastic``).
 """
 
 from __future__ import annotations

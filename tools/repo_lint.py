@@ -106,6 +106,20 @@ tests/test_repo_lint.py):
     mirror (declared tuple == ``paddle_tpu.export.format.SECTIONS``)
     is pinned in tests/test_repo_lint.py.
 
+12. **dist-verifier-vocabulary** — the distributed verifier
+    (``analysis/distributed.py``) matches trainer-side ops against its
+    ``WIRE_OPS``/``BARRIER_OPS`` tuples: every op type named there must
+    exist in the op registry (AST scan of ``register_op(...)`` literal
+    first args across ``paddle_tpu/``) — a typo'd entry silently
+    exempts that op from wire typing and the deadlock graph. And every
+    ``paddle_analysis_dist_*`` observe family the verifier references
+    (by imported variable or string literal) must be declared in
+    ``families.py`` — the rule-2/9 contract pinned specifically for
+    this engine, because its families are the only launch-abort signal
+    a fleet dashboard sees. (``listen_and_serv`` is deliberately in
+    NEITHER set: the Executor special-cases it as the PS-loop entry,
+    it never lowers through the registry.)
+
 Usage: ``python tools/repo_lint.py [--root DIR]``; exit 1 on violations.
 """
 
@@ -342,7 +356,13 @@ def trace_site_violations(root: str, files=None) -> List[str]:
 def _declared_tuple(root: str, var_name: str) -> Set[str]:
     """String elements of a top-level ``VAR = (...)`` tuple/list in
     observe/families.py (TRACE_SITES, FAULT_SITES)."""
-    tree = _parse(os.path.join(root, FAMILIES_FILE))
+    return _module_tuple(os.path.join(root, FAMILIES_FILE), var_name)
+
+
+def _module_tuple(path: str, var_name: str) -> Set[str]:
+    """String elements of a top-level ``VAR = (...)`` tuple/list in an
+    arbitrary module (rule 12 reads WIRE_OPS/BARRIER_OPS this way)."""
+    tree = _parse(path)
     for node in ast.walk(tree):
         if not isinstance(node, ast.Assign):
             continue
@@ -768,6 +788,80 @@ def artifact_section_violations(root: str, files=None) -> List[str]:
     return violations
 
 
+ANALYSIS_DIST_FILE = os.path.join("paddle_tpu", "analysis",
+                                  "distributed.py")
+_DIST_FAMILY_PREFIX = "paddle_analysis_dist"
+
+
+def registered_op_types(root: str) -> Set[str]:
+    """Op types registered via ``register_op(...)`` anywhere under
+    ``paddle_tpu/`` (literal first args, the decorator idiom), resolved
+    through the same three idioms as rules 7/10."""
+    out: Set[str] = set()
+    for path in iter_py_files(root):
+        rel = os.path.relpath(path, root)
+        if rel.split(os.sep)[0] != "paddle_tpu":
+            continue
+        out |= _rule_registrations(path, "register_op")
+    return out
+
+
+def dist_verifier_violations(root: str, files=None) -> List[str]:
+    """Rule 12: the distributed verifier's op vocabulary must exist in
+    the op registry, and every ``paddle_analysis_dist_*`` family it
+    references must be declared in families.py."""
+    dist_path = os.path.join(root, ANALYSIS_DIST_FILE)
+    if not os.path.exists(dist_path):
+        return []  # synthetic trees without the analysis package
+    rel = ANALYSIS_DIST_FILE.replace("/", os.sep)
+    violations = []
+
+    registered = registered_op_types(root)
+    for var in ("WIRE_OPS", "BARRIER_OPS"):
+        names = _module_tuple(dist_path, var)
+        if not names:
+            violations.append(
+                "%s: %s tuple is missing or empty — the verifier's op "
+                "vocabulary must be declared as a module-level literal "
+                "tuple (rule 12 and the deadlock graph both read it)"
+                % (rel, var))
+            continue
+        for op_type in sorted(names - registered):
+            violations.append(
+                "%s: %s names op type %r which no register_op(...) "
+                "call under paddle_tpu/ registers — a typo here "
+                "silently exempts the op from wire typing and the "
+                "deadlock graph" % (rel, var, op_type))
+
+    declared = declared_families(root)
+    var_to_name = declared_family_vars(root)
+    for node in ast.walk(_parse(dist_path)):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.rsplit(".", 1)[-1] == "families":
+            for alias in node.names:
+                fam = var_to_name.get(alias.name)
+                if fam is None:
+                    violations.append(
+                        "%s:%d: imports %r from observe/families.py "
+                        "but no REGISTRY.counter/gauge/histogram "
+                        "assignment declares it" % (rel, node.lineno,
+                                                    alias.name))
+        elif isinstance(node, ast.Constant) \
+                and isinstance(node.value, str):
+            for m in _FAMILY_RE.finditer(node.value):
+                name = m.group(0)
+                if not name.startswith(_DIST_FAMILY_PREFIX) \
+                        or name == _DIST_FAMILY_PREFIX:
+                    continue  # the bare prefix is prose (globs in docs)
+                if not any((name[: -len(s)] if s else name) in declared
+                           for s in ("",) + _RENDER_SUFFIXES):
+                    violations.append(
+                        "%s:%d: references family %r which is not "
+                        "declared in %s" % (rel, node.lineno, name,
+                                            FAMILIES_FILE))
+    return violations
+
+
 def run(root: str = REPO_ROOT) -> List[str]:
     """All violations (empty list = clean). tests/test_repo_lint.py
     asserts on this."""
@@ -780,7 +874,8 @@ def run(root: str = REPO_ROOT) -> List[str]:
             + env_knob_violations(root)
             + dead_family_violations(root)
             + cost_rule_coverage_violations(root)
-            + artifact_section_violations(root))
+            + artifact_section_violations(root)
+            + dist_verifier_violations(root))
 
 
 def main(argv=None) -> int:
